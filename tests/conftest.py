@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-tablet sharding
+(Mesh/shard_map/psum over the tablet axis) is exercised without TPU hardware,
+per the standard JAX testing recipe. This must happen before jax initializes
+a backend, hence the env mutation at module import time (conftest imports
+before any test module).
+
+Reference test-strategy analog: the in-process MiniCluster
+(src/yb/integration-tests/mini_cluster.h) — "multi-node" behavior validated
+inside one process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,134 @@
+"""Order-preservation property tests for the DocKey encoding.
+
+Reference test analog: src/yb/docdb/doc_key-test.cc and
+primitive_value-test.cc (encode/decode round-trip + ordering).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.encoding import (
+    decode_doc_key,
+    decode_key_component,
+    encode_doc_key,
+    encode_doc_key_prefix,
+    encode_key_component,
+    prefix_successor,
+)
+from yugabyte_db_tpu.utils.planes import key_prefix_planes
+
+
+def _rand_value(dtype, rnd):
+    if dtype == DataType.INT64:
+        return rnd.randrange(-(1 << 62), 1 << 62)
+    if dtype == DataType.INT32:
+        return rnd.randrange(-(1 << 31), 1 << 31)
+    if dtype == DataType.DOUBLE:
+        return rnd.choice([
+            rnd.uniform(-1e18, 1e18), 0.0, -0.0, 1.5, -1.5,
+            float("inf"), float("-inf"),
+        ])
+    if dtype == DataType.BOOL:
+        return rnd.choice([True, False])
+    if dtype == DataType.STRING:
+        n = rnd.randrange(0, 20)
+        return "".join(rnd.choice("ab\x01cde\x7fxyz0") for _ in range(n))
+    if dtype == DataType.BINARY:
+        n = rnd.randrange(0, 20)
+        return bytes(rnd.randrange(0, 256) for _ in range(n))
+    raise AssertionError(dtype)
+
+
+@pytest.mark.parametrize("dtype", [
+    DataType.INT64, DataType.INT32, DataType.DOUBLE, DataType.BOOL,
+    DataType.STRING, DataType.BINARY,
+])
+def test_component_roundtrip_and_order(dtype):
+    rnd = random.Random(42 + dtype)
+    values = [_rand_value(dtype, rnd) for _ in range(300)]
+    encoded = [encode_key_component(v, dtype) for v in values]
+    # Round trip.
+    for v, e in zip(values, encoded):
+        decoded, pos = decode_key_component(e, 0)
+        assert pos == len(e)
+        if dtype == DataType.DOUBLE:
+            assert decoded == v or (np.isnan(decoded) and np.isnan(v))
+        else:
+            assert decoded == v
+    # Order preservation: byte order == logical order.
+    pairs = sorted(zip(values, encoded), key=lambda p: p[0])
+    for (v1, e1), (v2, e2) in zip(pairs, pairs[1:]):
+        if v1 == v2:
+            assert e1 == e2, f"{v1!r} == {v2!r} but encodings differ"
+        else:
+            assert e1 < e2, f"{v1!r} < {v2!r} but {e1!r} >= {e2!r}"
+
+
+def test_null_sorts_first():
+    for dtype in (DataType.INT64, DataType.STRING, DataType.DOUBLE, DataType.BOOL):
+        null_e = encode_key_component(None, dtype)
+        small = {DataType.INT64: -(1 << 62), DataType.STRING: "",
+                 DataType.DOUBLE: float("-inf"), DataType.BOOL: False}[dtype]
+        assert null_e < encode_key_component(small, dtype)
+
+
+def test_doc_key_roundtrip():
+    key = encode_doc_key(
+        0xBEEF,
+        [("user7", DataType.STRING), (42, DataType.INT64)],
+        [("2020-01-01", DataType.STRING), (7, DataType.INT64)],
+    )
+    h, hashed, ranges = decode_doc_key(key)
+    assert h == 0xBEEF
+    assert hashed == ["user7", 42]
+    assert ranges == ["2020-01-01", 7]
+
+
+def test_doc_key_composite_ordering():
+    """Multi-component keys sort component-wise; shorter prefixes sort first."""
+    def k(h, hs, rs):
+        return encode_doc_key(h, [(v, DataType.STRING) for v in hs],
+                              [(v, DataType.INT64) for v in rs])
+
+    assert k(1, ["a"], [1]) < k(2, ["a"], [0])          # hash code dominates
+    assert k(1, ["a"], [1]) < k(1, ["b"], [0])          # then hashed cols
+    assert k(1, ["a"], [1]) < k(1, ["a"], [2])          # then range cols
+    assert k(1, ["a"], []) < k(1, ["a"], [-(1 << 62)])  # prefix-group sorts first
+
+    # A key prefix is a byte-prefix of every key extending it.
+    prefix = encode_doc_key_prefix(1, [("a", DataType.STRING)], [])
+    full = k(1, ["a"], [123, 456][:1])
+    assert full.startswith(prefix)
+
+
+def test_prefix_successor():
+    assert prefix_successor(b"ab") == b"ac"
+    assert prefix_successor(b"a\xff") == b"b"
+    assert prefix_successor(b"\xff\xff") == b""
+    p = encode_doc_key_prefix(3, [("x", DataType.STRING)], [])
+    s = prefix_successor(p)
+    assert p < s
+
+
+def test_key_prefix_planes_order_matches_bytes():
+    """int32-plane signed-lex order == byte order on the prefix width."""
+    rnd = random.Random(7)
+    keys = []
+    for _ in range(500):
+        h = rnd.randrange(0, 1 << 16)
+        u = _rand_value(DataType.STRING, rnd)
+        r = _rand_value(DataType.INT64, rnd)
+        keys.append(encode_doc_key(h, [(u, DataType.STRING)], [(r, DataType.INT64)]))
+    planes = key_prefix_planes(keys, num_words=8)  # 32-byte prefix
+
+    def plane_tuple(i):
+        return tuple(int(w) for w in planes[i])
+
+    order_bytes = sorted(range(len(keys)), key=lambda i: keys[i][:32])
+    order_planes = sorted(range(len(keys)), key=plane_tuple)
+    # Same order up to ties in the 32-byte prefix.
+    for a, b in zip(order_bytes, order_planes):
+        assert keys[a][:32] == keys[b][:32]

@@ -1,0 +1,82 @@
+"""Schema and partitioning tests.
+
+Reference analog: src/yb/common/schema-test.cc, partition-test.cc.
+"""
+
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import (
+    MAX_PARTITION_KEY,
+    PartitionSchema,
+    compute_hash_code,
+    hash_column_compound_value,
+)
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+
+
+def make_schema():
+    return Schema([
+        ColumnSchema("v", DataType.STRING),
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("n", DataType.INT64),
+    ], table_id="t1")
+
+
+def test_schema_normalizes_column_order():
+    s = make_schema()
+    assert [c.name for c in s.columns] == ["k", "r", "v", "n"]
+    assert s.num_hash == 1 and s.num_range == 1
+    assert [c.name for c in s.value_columns] == ["v", "n"]
+    assert s.column("r").kind == ColumnKind.RANGE
+
+
+def test_schema_column_ids_stable_and_unique():
+    s = make_schema()
+    ids = [c.col_id for c in s.columns]
+    assert len(set(ids)) == len(ids)
+    s2 = Schema.from_dict(s.to_dict())
+    assert [c.col_id for c in s2.columns] == ids
+    assert [c.name for c in s2.columns] == [c.name for c in s.columns]
+
+
+def test_schema_duplicate_names_rejected():
+    with pytest.raises(ValueError):
+        Schema([ColumnSchema("a", DataType.INT64),
+                ColumnSchema("a", DataType.STRING)])
+
+
+def test_hash_stability_and_spread():
+    s = make_schema()
+    codes = [compute_hash_code(s, {"k": f"user{i}"}) for i in range(2000)]
+    assert codes == [compute_hash_code(s, {"k": f"user{i}"}) for i in range(2000)]
+    assert all(0 <= c <= MAX_PARTITION_KEY for c in codes)
+    # Reasonable spread over 8 buckets.
+    buckets = [0] * 8
+    for c in codes:
+        buckets[c * 8 // (MAX_PARTITION_KEY + 1)] += 1
+    assert min(buckets) > 2000 / 8 * 0.5
+
+
+def test_partitions_cover_space_exactly():
+    for n in (1, 3, 8, 16, 100):
+        parts = PartitionSchema(n).create_partitions()
+        assert parts[0].start == 0
+        assert parts[-1].end == MAX_PARTITION_KEY + 1
+        for a, b in zip(parts, parts[1:]):
+            assert a.end == b.start
+
+
+def test_partition_routing_consistent():
+    ps = PartitionSchema(7)
+    parts = ps.create_partitions()
+    for h in [0, 1, 9362, 9363, 30000, MAX_PARTITION_KEY]:
+        idx = ps.partition_index_for_hash(h)
+        assert parts[idx].contains(h), (h, idx, parts[idx])
+
+
+def test_range_partitioned_single_tablet():
+    ps = PartitionSchema(5, hash_partitioned=False)
+    assert ps.num_tablets == 1
+    assert len(ps.create_partitions()) == 1

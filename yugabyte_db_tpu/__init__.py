@@ -1,0 +1,37 @@
+"""yugabyte_db_tpu — a TPU-native distributed SQL database framework.
+
+A brand-new implementation of the capabilities of YugaByte DB (reference:
+glycerine/yugabyte-db v1.2.4): a hybrid-time MVCC document store (DocDB)
+sharded into replicated tablets, serving Cassandra-compatible (YCQL),
+Redis-compatible (YEDIS) and PostgreSQL-compatible (YSQL) APIs.
+
+Design stance (TPU-first, not a port):
+
+- Control plane (RPC, consensus, WAL, tablet lifecycle, catalog, txns) runs
+  on host CPU, mirroring the reference's C++ architecture
+  (src/yb/tserver, src/yb/consensus, src/yb/master).
+- The storage-engine data plane is rebuilt for TPU: SSTable data blocks
+  (reference: src/yb/rocksdb/table/block_builder.cc row-wise prefix-delta
+  blocks) become HBM-resident columnar blocks, and range scans, predicate
+  filtering, MVCC visibility resolution, aggregate pushdown and compaction
+  merges run as JAX/XLA/Pallas kernels, selected by a
+  ``tablet_storage_engine=tpu`` option behind the storage seam (reference:
+  ``common::YQLStorageIf``, src/yb/common/ql_storage_interface.h:31).
+
+Subpackage map (reference directory in parens):
+
+- ``utils``      base libraries: status, hybrid time, encoding (src/yb/util, src/yb/gutil)
+- ``models``     the data model: types, values, doc keys, schema, partitioning (src/yb/common, src/yb/docdb key encoding)
+- ``storage``    the LSM storage engine: memtable, columnar runs, compaction (src/yb/rocksdb + src/yb/docdb storage)
+- ``ops``        TPU kernels: scan/filter/MVCC/aggregate/merge (the new capability; no reference analog — replaces per-row iterators)
+- ``parallel``   device-mesh sharding of tablets, ICI collectives (replaces single-threaded per-tablet scans)
+- ``tablet``     replicated shard: MVCC mgr, operation pipeline, WAL, bootstrap (src/yb/tablet, src/yb/consensus/log*)
+- ``consensus``  Raft consensus (src/yb/consensus)
+- ``rpc``        messenger/proxy/service RPC framework (src/yb/rpc)
+- ``tserver``    data-node daemon (src/yb/tserver)
+- ``master``     control plane: catalog, placement, load balancing (src/yb/master)
+- ``client``     routing client: meta cache, batcher, sessions (src/yb/client)
+- ``yql``        API frontends: cql/, redis/, pgsql/ (src/yb/yql)
+"""
+
+__version__ = "0.1.0"

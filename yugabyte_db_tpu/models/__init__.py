@@ -1,0 +1,18 @@
+"""The data model: types, primitive values, document keys, schema, partitioning.
+
+Reference analog: src/yb/common (schema.h, partition.h, ql_value.h) and the
+key-encoding half of src/yb/docdb (doc_key.h, primitive_value.h,
+value_type.h). This package is pure host-side Python/numpy: it defines the
+*logical* encoding whose ordering the TPU kernels reproduce on fixed-width
+int32 key planes.
+"""
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.models.encoding import (
+    encode_key_component,
+    decode_key_component,
+    encode_doc_key,
+    decode_doc_key,
+)
+from yugabyte_db_tpu.models.partition import PartitionSchema, Partition

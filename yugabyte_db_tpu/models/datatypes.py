@@ -1,0 +1,117 @@
+"""Logical data types and their device representations.
+
+Reference analog: src/yb/common/ql_type.h / DataType in common.proto. Each
+logical type maps to (a) a byte-comparable key encoding (models.encoding),
+and (b) a device column representation: a numpy/jax dtype for fixed-width
+types, or a varlen byte-pool + 64-bit order-preserving prefix planes for
+strings/binary (TPU kernels compare/select on the prefix; the host resolves
+rare prefix ties and materializes full bytes).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    NULL = 0
+    INT8 = 1
+    INT16 = 2
+    INT32 = 3
+    INT64 = 4
+    FLOAT = 5
+    DOUBLE = 6
+    BOOL = 7
+    STRING = 8
+    BINARY = 9
+    TIMESTAMP = 10  # micros since epoch, int64 semantics
+    COUNTER = 11    # int64 with increment semantics (YCQL counter)
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return self not in (DataType.STRING, DataType.BINARY)
+
+    @property
+    def is_integer(self) -> bool:
+        return self in (
+            DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64,
+            DataType.TIMESTAMP, DataType.COUNTER,
+        )
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self in (DataType.FLOAT, DataType.DOUBLE)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        """Host (numpy) storage dtype of a value column of this type."""
+        return {
+            DataType.INT8: np.dtype(np.int8),
+            DataType.INT16: np.dtype(np.int16),
+            DataType.INT32: np.dtype(np.int32),
+            DataType.INT64: np.dtype(np.int64),
+            DataType.TIMESTAMP: np.dtype(np.int64),
+            DataType.COUNTER: np.dtype(np.int64),
+            DataType.FLOAT: np.dtype(np.float32),
+            DataType.DOUBLE: np.dtype(np.float64),
+            DataType.BOOL: np.dtype(np.bool_),
+        }[self]
+
+    @property
+    def device_planes(self) -> int:
+        """Number of int32/float32 planes this type occupies device-side.
+
+        int64-family and double columns ship as two 32-bit planes (TPU has no
+        cheap 64-bit); varlen types ship as two planes of order-preserving
+        8-byte prefix.
+        """
+        if self in (DataType.STRING, DataType.BINARY):
+            return 2
+        if self.np_dtype.itemsize == 8:
+            return 2
+        return 1
+
+    @staticmethod
+    def parse(name: str) -> "DataType":
+        aliases = {
+            "TINYINT": DataType.INT8,
+            "SMALLINT": DataType.INT16,
+            "INT": DataType.INT32,
+            "INTEGER": DataType.INT32,
+            "BIGINT": DataType.INT64,
+            "FLOAT": DataType.FLOAT,
+            "REAL": DataType.FLOAT,
+            "DOUBLE": DataType.DOUBLE,
+            "BOOLEAN": DataType.BOOL,
+            "BOOL": DataType.BOOL,
+            "TEXT": DataType.STRING,
+            "VARCHAR": DataType.STRING,
+            "STRING": DataType.STRING,
+            "BLOB": DataType.BINARY,
+            "BINARY": DataType.BINARY,
+            "TIMESTAMP": DataType.TIMESTAMP,
+            "COUNTER": DataType.COUNTER,
+        }
+        key = name.strip().upper()
+        if key not in aliases:
+            raise ValueError(f"unknown data type: {name}")
+        return aliases[key]
+
+
+def python_value_matches(dtype: DataType, value) -> bool:
+    """Loose runtime type check for a python value against a logical type."""
+    if value is None:
+        return True
+    if dtype.is_integer:
+        return isinstance(value, int) and not isinstance(value, bool)
+    if dtype in (DataType.FLOAT, DataType.DOUBLE):
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if dtype == DataType.BOOL:
+        return isinstance(value, bool)
+    if dtype == DataType.STRING:
+        return isinstance(value, str)
+    if dtype == DataType.BINARY:
+        return isinstance(value, (bytes, bytearray))
+    return False

@@ -1,0 +1,201 @@
+"""Byte-comparable key encoding: the DocKey of the framework.
+
+Reference analog: src/yb/docdb/doc_key.h:68 (DocKey), primitive_value.cc
+(PrimitiveValue::AppendToKey), value_type.h:31-140 (ValueType tags),
+src/yb/util/memcmpable_varint.cc. The invariant this module guarantees —
+and the whole TPU data plane rests on — is:
+
+    memcmp(encode(a), encode(b))  ==  logical_compare(a, b)
+
+so that device kernels can compare fixed-width big-endian word prefixes of
+encoded keys with plain int32 signed comparisons (after bias-flip, see
+utils.planes) and reproduce logical key order.
+
+Layout of an encoded DocKey (hash-partitioned table):
+
+    [kHash][2-byte partition hash BE] [hashed components]* [kGroupEnd]
+    [range components]* [kGroupEnd]
+
+and for range-partitioned tables the hash prelude is omitted. Each component
+is [type tag][payload]; tag values are chosen so kGroupEnd sorts before every
+component tag (a shorter key group is a strict prefix and must sort first),
+and NULL sorts before all values of a column.
+
+Unlike the reference, the MVCC hybrid time is *not* appended to the key
+(reference SubDocKey suffixes a descending-encoded DocHybridTime): columnar
+blocks store (key, commit_ht) in separate planes and sort by (key asc,
+ht desc) explicitly, which is what the device kernels want.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from yugabyte_db_tpu.models.datatypes import DataType
+
+# Type tags. Ordering constraints:
+#   GROUP_END < NULL < FALSE < TRUE < INT < DOUBLE-family < STRING < BINARY
+# GROUP_END lowest so shorter composite keys sort first; NULL lowest within a
+# column so nulls sort first (CQL semantics).
+GROUP_END = 0x01
+TAG_NULL = 0x04
+TAG_FALSE = 0x10
+TAG_TRUE = 0x11
+TAG_INT = 0x20      # all integer types normalize to int64 in keys
+TAG_DOUBLE = 0x28   # float/double normalize to float64 in keys
+TAG_STRING = 0x30
+TAG_BINARY = 0x32
+TAG_HASH = 0x08     # 2-byte partition-hash prelude (reference kUInt16Hash)
+
+_STRING_TERM = b"\x00\x00"
+
+
+def _encode_int64(v: int) -> bytes:
+    # Sign-flip to map signed order onto unsigned byte order.
+    return struct.pack(">Q", (v + (1 << 63)) & ((1 << 64) - 1))
+
+
+def _decode_int64(b: bytes) -> int:
+    return struct.unpack(">Q", b)[0] - (1 << 63)
+
+
+def _encode_double(v: float) -> bytes:
+    v = float(v)
+    if v == 0.0:
+        v = 0.0  # canonicalize -0.0: logically equal keys must encode equal
+    bits = struct.unpack(">Q", struct.pack(">d", v))[0]
+    if bits & (1 << 63):
+        bits = ~bits & ((1 << 64) - 1)      # negative: flip all bits
+    else:
+        bits |= 1 << 63                      # positive: flip sign bit
+    return struct.pack(">Q", bits)
+
+
+def _decode_double(b: bytes) -> float:
+    bits = struct.unpack(">Q", b)[0]
+    if bits & (1 << 63):
+        bits &= ~(1 << 63) & ((1 << 64) - 1)
+    else:
+        bits = ~bits & ((1 << 64) - 1)
+    return struct.unpack(">d", struct.pack(">Q", bits))[0]
+
+
+def _encode_str_bytes(raw: bytes) -> bytes:
+    # Escape embedded NULs (0x00 -> 0x00 0x01) and terminate with 0x00 0x00,
+    # keeping byte order == lexicographic order on the raw bytes
+    # (reference: primitive_value.cc ZeroEncodeAndAppendStrToKey).
+    return raw.replace(b"\x00", b"\x00\x01") + _STRING_TERM
+
+
+def _decode_str_bytes(buf: bytes, pos: int) -> tuple[bytes, int]:
+    out = bytearray()
+    while True:
+        nxt = buf.index(b"\x00", pos)
+        out += buf[pos:nxt]
+        marker = buf[nxt + 1]
+        if marker == 0x00:
+            return bytes(out), nxt + 2
+        if marker != 0x01:
+            raise ValueError("corrupt string encoding")
+        out.append(0)
+        pos = nxt + 2
+
+
+def encode_key_component(value, dtype: DataType) -> bytes:
+    """Encode one key column value as [tag][payload]."""
+    if value is None:
+        return bytes([TAG_NULL])
+    if dtype == DataType.BOOL:
+        return bytes([TAG_TRUE if value else TAG_FALSE])
+    if dtype.is_integer:
+        return bytes([TAG_INT]) + _encode_int64(int(value))
+    if dtype in (DataType.FLOAT, DataType.DOUBLE):
+        return bytes([TAG_DOUBLE]) + _encode_double(float(value))
+    if dtype == DataType.STRING:
+        return bytes([TAG_STRING]) + _encode_str_bytes(value.encode("utf-8"))
+    if dtype == DataType.BINARY:
+        return bytes([TAG_BINARY]) + _encode_str_bytes(bytes(value))
+    raise ValueError(f"type {dtype} not valid in a key")
+
+
+def decode_key_component(buf: bytes, pos: int) -> tuple[object, int]:
+    """Decode one component at pos -> (python value, new pos)."""
+    tag = buf[pos]
+    pos += 1
+    if tag == TAG_NULL:
+        return None, pos
+    if tag == TAG_FALSE:
+        return False, pos
+    if tag == TAG_TRUE:
+        return True, pos
+    if tag == TAG_INT:
+        return _decode_int64(buf[pos:pos + 8]), pos + 8
+    if tag == TAG_DOUBLE:
+        return _decode_double(buf[pos:pos + 8]), pos + 8
+    if tag == TAG_STRING:
+        raw, pos = _decode_str_bytes(buf, pos)
+        return raw.decode("utf-8"), pos
+    if tag == TAG_BINARY:
+        return _decode_str_bytes(buf, pos)
+    raise ValueError(f"unknown key tag 0x{tag:02x} at {pos - 1}")
+
+
+def encode_doc_key(hash_code: int | None,
+                   hashed_components: list[tuple[object, DataType]],
+                   range_components: list[tuple[object, DataType]]) -> bytes:
+    """Encode a full DocKey. hash_code is the uint16 partition hash, or None
+    for range-partitioned tables (reference doc_key.cc DocKey::AppendTo)."""
+    return encode_doc_key_prefix(
+        hash_code, hashed_components, range_components) + bytes([GROUP_END])
+
+
+def encode_doc_key_prefix(hash_code: int | None,
+                          hashed_components: list[tuple[object, DataType]],
+                          range_components: list[tuple[object, DataType]]) -> bytes:
+    """Encode a key *prefix* (for range scans bounded on leading range
+    columns): like encode_doc_key but without the trailing GROUP_END, so all
+    keys extending the given range components share this byte prefix."""
+    out = bytearray()
+    if hash_code is not None:
+        out.append(TAG_HASH)
+        out += struct.pack(">H", hash_code & 0xFFFF)
+        for value, dtype in hashed_components:
+            out += encode_key_component(value, dtype)
+        out.append(GROUP_END)
+    for value, dtype in range_components:
+        out += encode_key_component(value, dtype)
+    return bytes(out)
+
+
+def decode_doc_key(buf: bytes) -> tuple[int | None, list, list]:
+    """Decode -> (hash_code, hashed values, range values)."""
+    pos = 0
+    hash_code = None
+    hashed: list = []
+    if buf and buf[0] == TAG_HASH:
+        hash_code = struct.unpack(">H", buf[1:3])[0]
+        pos = 3
+        while buf[pos] != GROUP_END:
+            value, pos = decode_key_component(buf, pos)
+            hashed.append(value)
+        pos += 1
+    ranges: list = []
+    while pos < len(buf) and buf[pos] != GROUP_END:
+        value, pos = decode_key_component(buf, pos)
+        ranges.append(value)
+    return hash_code, hashed, ranges
+
+
+def prefix_successor(prefix: bytes) -> bytes:
+    """Smallest byte string greater than every string with this prefix.
+
+    Empty result means "no upper bound" (prefix was all 0xFF). Used to turn a
+    key prefix into an exclusive scan upper bound (reference analog:
+    rocksdb iterate_upper_bound construction)."""
+    b = bytearray(prefix)
+    while b:
+        if b[-1] != 0xFF:
+            b[-1] += 1
+            return bytes(b)
+        b.pop()
+    return b""

@@ -1,0 +1,132 @@
+"""Table schema: columns, key structure, ids.
+
+Reference analog: src/yb/common/schema.h (Schema, ColumnSchema, ColumnId).
+A schema is hash columns + range columns (together the primary key) +
+regular value columns; key encoding order is hash cols then range cols
+(models.encoding.encode_doc_key).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.encoding import encode_doc_key
+
+
+class ColumnKind(enum.IntEnum):
+    HASH = 0
+    RANGE = 1
+    REGULAR = 2
+    STATIC = 3  # YCQL static columns (per-partition); stored as regular for now
+
+
+@dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    dtype: DataType
+    kind: ColumnKind = ColumnKind.REGULAR
+    nullable: bool = True
+    # Column ids are stable across ALTER TABLE (reference schema.h ColumnId);
+    # assigned by Schema/catalog.
+    col_id: int = -1
+
+    @property
+    def is_key(self) -> bool:
+        return self.kind in (ColumnKind.HASH, ColumnKind.RANGE)
+
+
+class Schema:
+    """Immutable table schema.
+
+    Column order: hash columns, then range columns, then regular columns —
+    the same normalized layout the reference keeps (schema.h: key columns
+    first).
+    """
+
+    def __init__(self, columns: list[ColumnSchema], table_id: str = "",
+                 version: int = 0):
+        hash_cols = [c for c in columns if c.kind == ColumnKind.HASH]
+        range_cols = [c for c in columns if c.kind == ColumnKind.RANGE]
+        value_cols = [c for c in columns if not c.is_key]
+        ordered = hash_cols + range_cols + value_cols
+        # Assign stable column ids if unset (first schema version).
+        self.columns: list[ColumnSchema] = []
+        next_id = 10  # start above 0 to catch id/index confusion in tests
+        used = {c.col_id for c in ordered if c.col_id >= 0}
+        for c in ordered:
+            if c.col_id < 0:
+                while next_id in used:
+                    next_id += 1
+                c = ColumnSchema(c.name, c.dtype, c.kind, c.nullable, next_id)
+                used.add(next_id)
+                next_id += 1
+            self.columns.append(c)
+        self.table_id = table_id
+        self.version = version
+        self._by_name = {c.name: i for i, c in enumerate(self.columns)}
+        if len(self._by_name) != len(self.columns):
+            raise ValueError("duplicate column names")
+        self.num_hash = len(hash_cols)
+        self.num_range = len(range_cols)
+
+    # -- structure ---------------------------------------------------------
+    @property
+    def hash_columns(self) -> list[ColumnSchema]:
+        return self.columns[: self.num_hash]
+
+    @property
+    def range_columns(self) -> list[ColumnSchema]:
+        return self.columns[self.num_hash: self.num_hash + self.num_range]
+
+    @property
+    def key_columns(self) -> list[ColumnSchema]:
+        return self.columns[: self.num_hash + self.num_range]
+
+    @property
+    def value_columns(self) -> list[ColumnSchema]:
+        return self.columns[self.num_hash + self.num_range:]
+
+    def column_index(self, name: str) -> int:
+        if name not in self._by_name:
+            raise KeyError(f"no column {name!r}")
+        return self._by_name[name]
+
+    def column(self, name: str) -> ColumnSchema:
+        return self.columns[self.column_index(name)]
+
+    def has_column(self, name: str) -> bool:
+        return name in self._by_name
+
+    # -- key encoding ------------------------------------------------------
+    def encode_primary_key(self, key_values: dict, hash_code: int) -> bytes:
+        """Encode the DocKey for a row given its key column values."""
+        hashed = [(key_values[c.name], c.dtype) for c in self.hash_columns]
+        ranges = [(key_values[c.name], c.dtype) for c in self.range_columns]
+        return encode_doc_key(hash_code if self.num_hash else None, hashed, ranges)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(
+            f"{c.name}:{c.dtype.name}:{c.kind.name}" for c in self.columns)
+        return f"Schema[{cols}]"
+
+    def to_dict(self) -> dict:
+        return {
+            "table_id": self.table_id,
+            "version": self.version,
+            "columns": [
+                {"name": c.name, "dtype": int(c.dtype), "kind": int(c.kind),
+                 "nullable": c.nullable, "col_id": c.col_id}
+                for c in self.columns
+            ],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Schema":
+        cols = [
+            ColumnSchema(c["name"], DataType(c["dtype"]), ColumnKind(c["kind"]),
+                         c["nullable"], c["col_id"])
+            for c in d["columns"]
+        ]
+        return Schema(cols, d.get("table_id", ""), d.get("version", 0))

@@ -1,0 +1,8 @@
+"""Base libraries: status/result, hybrid time, byte-comparable encoding, planes.
+
+Reference analog: src/yb/util (Status/Result, hybrid time helpers,
+memcmpable_varint.cc) and src/yb/gutil.
+"""
+
+from yugabyte_db_tpu.utils.status import Status, StatusError, ok, not_found, invalid_argument
+from yugabyte_db_tpu.utils.hybrid_time import HybridTime, HybridClock, LogicalClock
