@@ -13,11 +13,22 @@ inside one process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the real TPU
+# tunnel registered by sitecustomize) and its get_backend hook initializes
+# the axon backend even under JAX_PLATFORMS=cpu — which would (a) run every
+# test against the remote chip and (b) hang the whole suite whenever the
+# tunnel is unavailable. Unregister the factory and pin the config instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+import jax._src.xla_bridge as _xb
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

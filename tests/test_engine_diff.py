@@ -1,0 +1,271 @@
+"""Engine-diff tests: TPU engine vs CPU oracle — identical results.
+
+The TPU data plane (columnar runs + device scan kernels) must reproduce the
+CPU engine's results on every scan: same rows, same order, same aggregates
+(floating-point sums to tolerance). This is the framework's equivalent of
+the reference's randomized DocDB-vs-InMemDocDbState oracle tests
+(src/yb/docdb/randomized_docdb-test.cc).
+
+Runs on the CPU JAX backend (conftest) — same kernels the TPU executes.
+"""
+
+import random
+
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import (
+    AggSpec, Predicate, RowVersion, ScanSpec, make_engine,
+)
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401  (registers 'tpu')
+
+
+def make_schema():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("a", DataType.INT64),
+        ColumnSchema("b", DataType.STRING),
+        ColumnSchema("c", DataType.DOUBLE),
+        ColumnSchema("d", DataType.INT32),
+    ], table_id="t")
+
+
+def enc(schema, k, r):
+    return schema.encode_primary_key(
+        {"k": k, "r": r}, compute_hash_code(schema, {"k": k}))
+
+
+def ids(schema):
+    return {c.name: c.col_id for c in schema.value_columns}
+
+
+def both_engines(opts=None):
+    schema = make_schema()
+    return (schema,
+            make_engine("cpu", schema, dict(opts or {})),
+            make_engine("tpu", schema, dict(opts or {}, rows_per_block=64)))
+
+
+def apply_both(cpu, tpu, rows):
+    cpu.apply(rows)
+    tpu.apply(rows)
+
+
+def assert_same_scan(cpu, tpu, spec_kwargs, approx_cols=()):
+    a = cpu.scan(ScanSpec(**spec_kwargs))
+    b = tpu.scan(ScanSpec(**spec_kwargs))
+    assert a.columns == b.columns
+    if not approx_cols:
+        assert a.rows == b.rows, f"spec={spec_kwargs}"
+    else:
+        assert len(a.rows) == len(b.rows)
+        for ra, rb in zip(a.rows, b.rows):
+            for i, (va, vb) in enumerate(zip(ra, rb)):
+                if a.columns[i] in approx_cols and va is not None:
+                    assert vb == pytest.approx(va, rel=1e-4, abs=1e-4)
+                else:
+                    assert va == vb
+    assert (a.resume_key is None) == (b.resume_key is None)
+    return a, b
+
+
+def load_sample(schema, cpu, tpu, n=300, seed=5):
+    rnd = random.Random(seed)
+    cids = ids(schema)
+    ht = 0
+    for i in range(n):
+        ht += rnd.randrange(1, 4)
+        part = rnd.choice(["p", "q", "rr"])
+        key = enc(schema, part, i % 97)
+        roll = rnd.random()
+        if roll < 0.1:
+            apply_both(cpu, tpu, [RowVersion(key, ht=ht, tombstone=True)])
+        elif roll < 0.6:
+            apply_both(cpu, tpu, [RowVersion(
+                key, ht=ht, liveness=True,
+                columns={cids["a"]: rnd.randrange(-1000, 1000),
+                         cids["b"]: rnd.choice(["xy", "xyz", "zz", None,
+                                                "commonprefix-aa",
+                                                "commonprefix-ab"]),
+                         cids["c"]: rnd.uniform(-5, 5),
+                         cids["d"]: rnd.randrange(-50, 50)},
+                expire_ht=ht + rnd.randrange(5, 200) if rnd.random() < 0.15 else MAX_HT)])
+        else:
+            col = rnd.choice(["a", "b", "c", "d"])
+            val = {"a": rnd.randrange(-1000, 1000), "b": rnd.choice(["w", None]),
+                   "c": rnd.uniform(-5, 5), "d": rnd.randrange(-50, 50)}[col]
+            apply_both(cpu, tpu, [RowVersion(key, ht=ht, columns={cids[col]: val})])
+    return ht
+
+
+def test_diff_single_run_full_scan():
+    schema, cpu, tpu = both_engines()
+    max_ht = load_sample(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert_same_scan(cpu, tpu, dict(read_ht=max_ht // 2))
+    assert_same_scan(cpu, tpu, dict(read_ht=1))
+
+
+def test_diff_range_bounds():
+    schema, cpu, tpu = both_engines()
+    load_sample(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    lo = enc(schema, "p", 10)
+    hi = enc(schema, "p", 60)
+    assert_same_scan(cpu, tpu, dict(lower=lo, upper=hi, read_ht=MAX_HT))
+    # Degenerate and unbounded edges.
+    assert_same_scan(cpu, tpu, dict(lower=hi, upper=hi and lo, read_ht=MAX_HT))
+    assert_same_scan(cpu, tpu, dict(lower=b"", upper=lo, read_ht=MAX_HT))
+    assert_same_scan(cpu, tpu, dict(lower=hi, upper=b"", read_ht=MAX_HT))
+
+
+def test_diff_multi_run_and_memtable_overlay():
+    schema, cpu, tpu = both_engines()
+    ht = load_sample(schema, cpu, tpu, n=150, seed=7)
+    cpu.flush(); tpu.flush()
+    ht = load_sample(schema, cpu, tpu, n=150, seed=8)
+    cpu.flush(); tpu.flush()
+    # Third batch stays in the memtable: three overlapping sources.
+    load_sample(schema, cpu, tpu, n=80, seed=9)
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert_same_scan(cpu, tpu, dict(read_ht=ht))
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT,
+        predicates=[Predicate("a", ">=", 0), Predicate("d", "<", 25)]))
+
+
+def test_diff_predicates_single_run():
+    schema, cpu, tpu = both_engines()
+    load_sample(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    cases = [
+        [Predicate("a", ">", 0)],
+        [Predicate("a", "<=", -5), Predicate("d", "!=", 0)],
+        [Predicate("c", ">=", 0.0)],
+        [Predicate("b", "=", "xy")],     # varlen: device superset + host verify
+        [Predicate("b", "!=", "xy")],
+        [Predicate("b", "<", "xz")],
+        [Predicate("r", ">=", 50)],      # key-column predicate: host path
+        [Predicate("a", "IN", (1, 2, 3, 500))],
+    ]
+    for preds in cases:
+        assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT, predicates=preds))
+
+
+def test_diff_paging():
+    schema, cpu, tpu = both_engines()
+    load_sample(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    spec_a = ScanSpec(read_ht=MAX_HT, limit=7)
+    spec_b = ScanSpec(read_ht=MAX_HT, limit=7)
+    pages = 0
+    while True:
+        ra, rb = cpu.scan(spec_a), tpu.scan(spec_b)
+        assert ra.rows == rb.rows
+        assert (ra.resume_key is None) == (rb.resume_key is None)
+        pages += 1
+        if ra.resume_key is None:
+            break
+        spec_a = ScanSpec(lower=ra.resume_key, read_ht=MAX_HT, limit=7)
+        spec_b = ScanSpec(lower=rb.resume_key, read_ht=MAX_HT, limit=7)
+    assert pages > 2
+
+
+def test_diff_aggregates_device_path():
+    schema, cpu, tpu = both_engines()
+    load_sample(schema, cpu, tpu, n=400)
+    cpu.flush(); tpu.flush()
+    aggs = [AggSpec("count", None), AggSpec("count", "b"), AggSpec("sum", "a"),
+            AggSpec("sum", "d"), AggSpec("min", "a"), AggSpec("max", "a"),
+            AggSpec("min", "d"), AggSpec("max", "d"), AggSpec("avg", "a")]
+    a, b = assert_same_scan(
+        cpu, tpu, dict(read_ht=MAX_HT, aggregates=aggs),
+        approx_cols={"avg(a)"})
+    # Exact integer sums.
+    assert a.rows[0][2] == b.rows[0][2]
+    # Float aggregates to tolerance.
+    assert_same_scan(cpu, tpu,
+                     dict(read_ht=MAX_HT, aggregates=[AggSpec("sum", "c"),
+                                                      AggSpec("min", "c"),
+                                                      AggSpec("max", "c")]),
+                     approx_cols={"sum(c)"})
+
+
+def test_diff_aggregates_with_predicates():
+    schema, cpu, tpu = both_engines()
+    load_sample(schema, cpu, tpu, n=400)
+    cpu.flush(); tpu.flush()
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT, aggregates=[AggSpec("count", None), AggSpec("sum", "a")],
+        predicates=[Predicate("a", ">", 0)]))
+    # String predicate forces the row-path fallback; results still identical.
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT, aggregates=[AggSpec("count", None)],
+        predicates=[Predicate("b", "=", "xy")]))
+
+
+def test_diff_aggregate_group_by_fallback():
+    schema, cpu, tpu = both_engines()
+    load_sample(schema, cpu, tpu, n=200)
+    cpu.flush(); tpu.flush()
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT, group_by=["b"],
+        aggregates=[AggSpec("count", None), AggSpec("sum", "a")]))
+
+
+def test_diff_aggregates_multi_run_fallback():
+    schema, cpu, tpu = both_engines()
+    load_sample(schema, cpu, tpu, n=120, seed=20)
+    cpu.flush(); tpu.flush()
+    load_sample(schema, cpu, tpu, n=120, seed=21)
+    cpu.flush(); tpu.flush()
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT, aggregates=[AggSpec("count", None), AggSpec("sum", "a")]))
+
+
+def test_diff_compaction_equivalence():
+    schema, cpu, tpu = both_engines()
+    ht = load_sample(schema, cpu, tpu, n=250, seed=31)
+    cpu.flush(); tpu.flush()
+    load_sample(schema, cpu, tpu, n=250, seed=32)
+    cpu.flush(); tpu.flush()
+    cpu.compact(history_cutoff_ht=ht)
+    tpu.compact(history_cutoff_ht=ht)
+    assert cpu.stats()["num_runs"] == tpu.stats()["num_runs"] == 1
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert_same_scan(cpu, tpu, dict(read_ht=ht))
+
+
+def test_diff_randomized_many_read_points():
+    schema, cpu, tpu = both_engines(
+        {"memtable_flush_versions": 61, "compaction_trigger": 3})
+    rnd = random.Random(77)
+    cids = ids(schema)
+    ht = 0
+    read_points = []
+    for step in range(500):
+        ht += rnd.randrange(1, 4)
+        key = enc(schema, rnd.choice("ab"), rnd.randrange(40))
+        roll = rnd.random()
+        if roll < 0.12:
+            rv = RowVersion(key, ht=ht, tombstone=True)
+        elif roll < 0.55:
+            rv = RowVersion(key, ht=ht, liveness=True,
+                            columns={cids["a"]: rnd.randrange(100),
+                                     cids["c"]: rnd.uniform(0, 1)},
+                            expire_ht=ht + rnd.randrange(3, 60) if rnd.random() < 0.2 else MAX_HT)
+        else:
+            col = rnd.choice(["a", "b"])
+            val = rnd.choice([5, 9, None]) if col == "a" else \
+                rnd.choice(["s", "commonprefix-aa", "commonprefix-ab", None])
+            rv = RowVersion(key, ht=ht, columns={cids[col]: val})
+        apply_both(cpu, tpu, [rv])
+        if step % 50 == 0:
+            read_points.append(ht)
+    for rp in read_points + [ht, MAX_HT]:
+        assert_same_scan(cpu, tpu, dict(read_ht=rp))

@@ -1,0 +1,93 @@
+"""Engine-diff regression tests for review-caught edge cases:
+float32 predicate rounding, string/key-column aggregates, same-ht
+tombstone shadowing."""
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import AggSpec, Predicate, RowVersion, ScanSpec, make_engine
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401
+
+
+def schema_f():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("f", DataType.FLOAT),
+        ColumnSchema("s", DataType.STRING),
+    ])
+
+
+def enc(schema, k, r):
+    return schema.encode_primary_key(
+        {"k": k, "r": r}, compute_hash_code(schema, {"k": k}))
+
+
+def pair():
+    s = schema_f()
+    return s, make_engine("cpu", s), make_engine("tpu", s, {"rows_per_block": 64})
+
+
+def same(cpu, tpu, **kw):
+    a, b = cpu.scan(ScanSpec(**kw)), tpu.scan(ScanSpec(**kw))
+    assert a.rows == b.rows, (a.rows, b.rows)
+    return a
+
+
+def test_float32_predicate_rounding_ties():
+    s, cpu, tpu = pair()
+    ids = {c.name: c.col_id for c in s.value_columns}
+    vals = [0.3 + 1e-9, 0.3, 0.3 - 1e-9, 0.2999, 1.5]
+    for i, v in enumerate(vals):
+        rv = RowVersion(enc(s, "p", i), ht=10 + i, liveness=True,
+                        columns={ids["f"]: v})
+        cpu.apply([rv]); tpu.apply([rv])
+    cpu.flush(); tpu.flush()
+    for op in ("=", "!=", "<", "<=", ">", ">="):
+        same(cpu, tpu, read_ht=MAX_HT, predicates=[Predicate("f", op, 0.3)])
+
+
+def test_string_minmax_falls_back_to_host():
+    s, cpu, tpu = pair()
+    ids = {c.name: c.col_id for c in s.value_columns}
+    for i, v in enumerate(["banana", "apple", "cherry", "commonprefix-zz",
+                           "commonprefix-aa"]):
+        rv = RowVersion(enc(s, "p", i), ht=10 + i, liveness=True,
+                        columns={ids["s"]: v})
+        cpu.apply([rv]); tpu.apply([rv])
+    cpu.flush(); tpu.flush()
+    r = same(cpu, tpu, read_ht=MAX_HT,
+             aggregates=[AggSpec("min", "s"), AggSpec("max", "s")])
+    assert r.rows == [("apple", "commonprefix-zz")]
+
+
+def test_key_column_aggregates():
+    s, cpu, tpu = pair()
+    ids = {c.name: c.col_id for c in s.value_columns}
+    for i in range(7):
+        rv = RowVersion(enc(s, "p", i), ht=10 + i, liveness=True,
+                        columns={ids["f"]: float(i)})
+        cpu.apply([rv]); tpu.apply([rv])
+    cpu.flush(); tpu.flush()
+    r = same(cpu, tpu, read_ht=MAX_HT,
+             aggregates=[AggSpec("min", "r"), AggSpec("max", "r"),
+                         AggSpec("count", "r"), AggSpec("sum", "r")])
+    assert r.rows == [(0, 6, 7, 21)]
+
+
+def test_same_ht_tombstone_shadows_value():
+    """DELETE + re-write in one batch share a hybrid time: the tombstone
+    shadows the value (merge.py <= semantics) on BOTH paths, including the
+    device aggregate path which has no host verification."""
+    s, cpu, tpu = pair()
+    ids = {c.name: c.col_id for c in s.value_columns}
+    key = enc(s, "p", 1)
+    batch = [RowVersion(key, ht=50, tombstone=True),
+             RowVersion(key, ht=50, columns={ids["f"]: 7.0})]
+    cpu.apply(batch); tpu.apply(batch)
+    cpu.flush(); tpu.flush()
+    r = same(cpu, tpu, read_ht=MAX_HT,
+             aggregates=[AggSpec("count", None), AggSpec("sum", "f")])
+    assert r.rows == [(0, None)]
+    same(cpu, tpu, read_ht=MAX_HT)

@@ -76,9 +76,13 @@ class DataType(enum.IntEnum):
     @staticmethod
     def parse(name: str) -> "DataType":
         aliases = {
+            "INT8": DataType.INT8,
+            "INT16": DataType.INT16,
+            "INT64": DataType.INT64,
             "TINYINT": DataType.INT8,
             "SMALLINT": DataType.INT16,
             "INT": DataType.INT32,
+            "INT32": DataType.INT32,
             "INTEGER": DataType.INT32,
             "BIGINT": DataType.INT64,
             "FLOAT": DataType.FLOAT,

@@ -51,8 +51,10 @@ _STRING_TERM = b"\x00\x00"
 
 
 def _encode_int64(v: int) -> bytes:
+    if not -(1 << 63) <= v < (1 << 63):
+        raise ValueError(f"integer key value out of int64 range: {v}")
     # Sign-flip to map signed order onto unsigned byte order.
-    return struct.pack(">Q", (v + (1 << 63)) & ((1 << 64) - 1))
+    return struct.pack(">Q", v + (1 << 63))
 
 
 def _decode_int64(b: bytes) -> int:
@@ -156,7 +158,10 @@ def encode_doc_key_prefix(hash_code: int | None,
     columns): like encode_doc_key but without the trailing GROUP_END, so all
     keys extending the given range components share this byte prefix."""
     out = bytearray()
-    if hash_code is not None:
+    if hash_code is None:
+        if hashed_components:
+            raise ValueError("hashed components require a hash_code")
+    else:
         out.append(TAG_HASH)
         out += struct.pack(">H", hash_code & 0xFFFF)
         for value, dtype in hashed_components:

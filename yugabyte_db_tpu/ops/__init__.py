@@ -1,0 +1,15 @@
+"""TPU device kernels: the data plane.
+
+This package replaces the reference's three read-path hot loops
+(SURVEY.md §3.2): DocRowwiseIterator row materialization
+(src/yb/docdb/doc_rowwise_iterator.cc:545), the rocksdb
+MergingIterator/BlockIter byte iteration, and QLExprExecutor per-row
+predicate eval (src/yb/common/ql_expr.h:210) — with vectorized XLA/Pallas
+computation over columnar plane arrays:
+
+- scan: MVCC visibility + tombstone shadowing + per-column latest-visible
+  merge + range/predicate masks + aggregate partials, one fused device
+  program per block window;
+- merge: compaction as a device sort (lax.sort multi-key) over concatenated
+  runs (replacing compaction_job.cc's k-way heap merge).
+"""

@@ -1,0 +1,82 @@
+"""DeviceRun: a ColumnarRun's planes resident in device memory (HBM).
+
+Reference analog: the block cache holding SSTable blocks in RAM
+(src/yb/rocksdb/util/cache.cc) — except the TPU engine keeps whole runs
+HBM-resident and lets scans window over them with dynamic slices, so a
+scan is pure compute with no host↔device data motion besides its scalars
+and its (small) result.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.storage.columnar import ColumnarRun
+
+
+def dtype_kind(dt: DataType) -> str:
+    if dt in (DataType.STRING, DataType.BINARY):
+        return "str"
+    if dt == DataType.DOUBLE:
+        return "f64"
+    if dt == DataType.FLOAT:
+        return "f32"
+    if dt.np_dtype.itemsize == 8:
+        return "i64"
+    return "i32"
+
+
+class DeviceRun:
+    """Uploads a ColumnarRun, padding the block axis to a multiple of the
+    window size so window tiling never clamps (clamped dynamic slices would
+    re-read earlier blocks and double-count aggregates)."""
+
+    def __init__(self, run: ColumnarRun, window_blocks: int, device=None):
+        self.run = run
+        self.K = window_blocks
+        B = max(run.B, 1)
+        pad = (-B) % window_blocks
+        self.B = B + pad
+        self.device = device or jax.devices()[0]
+
+        def pad_b(arr):
+            if pad == 0:
+                return arr
+            shape = (pad,) + arr.shape[1:]
+            return np.concatenate([arr, np.zeros(shape, dtype=arr.dtype)], axis=0)
+
+        def up(arr):
+            return jax.device_put(pad_b(arr), self.device)
+
+        # Padding blocks: valid=False, group_start=True (each pad row its own
+        # group), everything else zero.
+        gs = pad_b(run.group_start)
+        if pad:
+            gs[B:] = True
+        self.arrays = {
+            "valid": up(run.valid),
+            "group_start": jax.device_put(gs, self.device),
+            "tomb": up(run.tomb),
+            "live": up(run.live),
+            "ht_hi": up(run.ht_hi),
+            "ht_lo": up(run.ht_lo),
+            "exp_hi": up(run.exp_hi),
+            "exp_lo": up(run.exp_lo),
+            "cols": {},
+        }
+        for cid, col in run.cols.items():
+            entry = {
+                "set": up(col.set_),
+                "isnull": up(col.isnull),
+                "cmp": up(col.cmp_planes),
+            }
+            if col.arith is not None:
+                entry["arith"] = up(col.arith)
+            self.arrays["cols"][cid] = entry
+
+    @property
+    def num_windows(self) -> int:
+        return self.B // self.K

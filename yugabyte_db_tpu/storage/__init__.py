@@ -1,0 +1,23 @@
+"""The LSM storage engine: memtable, sorted runs, compaction, engines.
+
+Reference analog: src/yb/rocksdb (the forked storage engine) + the storage
+half of src/yb/docdb. Differences by design (TPU-first):
+
+- Data blocks are columnar (SoA planes sized for HBM tiling), not row-wise
+  prefix-delta byte blocks (reference block_builder.cc:29-46).
+- MVCC versions are (key, commit_ht) plane pairs sorted (key asc, ht desc);
+  there is no per-instance WAL (the tablet's Raft log is the WAL, matching
+  the reference's disabled-rocksdb-WAL design, docdb_rocksdb_util.cc:430).
+- Compaction is a device sort-merge over columnar runs rather than a k-way
+  heap merge of byte iterators (reference compaction_job.cc:622).
+
+The pluggable seam (reference: common::YQLStorageIf,
+src/yb/common/ql_storage_interface.h:31) is storage.engine.StorageEngine,
+with CpuStorageEngine (exact oracle + baseline, the InMemDocDbState pattern
+from src/yb/docdb/in_mem_docdb.cc) and TpuStorageEngine (device data plane).
+"""
+
+from yugabyte_db_tpu.storage.row_version import RowVersion, MAX_HT
+from yugabyte_db_tpu.storage.scan_spec import Predicate, ScanSpec, ScanResult, AggSpec
+from yugabyte_db_tpu.storage.engine import StorageEngine, make_engine
+from yugabyte_db_tpu.storage.cpu_engine import CpuStorageEngine

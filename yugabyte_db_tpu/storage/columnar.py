@@ -1,0 +1,297 @@
+"""Columnar sorted runs: the TPU-native SSTable.
+
+This is the storage-format heart of the framework (SURVEY.md §7): where the
+reference stores row-wise prefix-delta-compressed byte blocks
+(src/yb/rocksdb/table/block_builder.cc:29-46), a ColumnarRun stores
+fixed-shape SoA planes sized for HBM tiling:
+
+- rows are MVCC versions sorted (encoded key asc, commit ht desc), grouped
+  by key; a key's versions never span a block boundary (so device kernels
+  can treat each block window as segment-complete);
+- keys are represented device-side by a fixed-width big-endian word prefix
+  as int32 "planes" (signed compare == byte order, utils.planes); full key
+  bytes stay host-side for ties/materialization;
+- every 64-bit ordered quantity (hybrid times, int64/double values) is two
+  int32 planes; varlen values keep an 8-byte order-preserving prefix on
+  device and their payload host-side;
+- per-block metadata (min/max key, max commit ht) plays the role of the
+  reference's index blocks + UserFrontiers (src/yb/rocksdb/metadata.h:103)
+  and drives host-side block pruning.
+
+The numpy arrays here are the host mirror; ops.device_run uploads them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+from yugabyte_db_tpu.utils import planes as P
+
+DEFAULT_ROWS_PER_BLOCK = 2048
+KEY_WORDS = 8  # 32-byte key prefix on device
+
+
+@dataclass
+class ColumnData:
+    """Host planes for one value column across all blocks: [B, R, ...]."""
+
+    dtype: DataType
+    set_: np.ndarray          # bool: version sets this column
+    isnull: np.ndarray        # bool: set and value is NULL
+    cmp_planes: np.ndarray    # [B, R, P] int32: order planes (compare/minmax)
+    arith: np.ndarray | None  # [B, R] float32 arithmetic plane (numeric only)
+    varlen: list | None       # per-block list of python payloads (varlen only)
+
+
+@dataclass
+class BlockMeta:
+    min_key: bytes
+    max_key: bytes
+    num_valid: int
+
+
+class ColumnarRun:
+    """One immutable sorted run in blocked columnar layout."""
+
+    def __init__(self, schema: Schema, rows_per_block: int = DEFAULT_ROWS_PER_BLOCK):
+        self.schema = schema
+        self.R = rows_per_block
+        self.B = 0
+        self.num_versions = 0
+        self.blocks: list[BlockMeta] = []
+        # Filled by build():
+        self.key_planes: np.ndarray | None = None   # [B, R, KEY_WORDS] i32
+        self.ht_hi = self.ht_lo = None              # [B, R] i32
+        self.exp_hi = self.exp_lo = None            # [B, R] i32
+        self.tomb = self.live = self.valid = self.group_start = None  # [B, R] bool
+        self.cols: dict[int, ColumnData] = {}       # col_id -> ColumnData
+        # Host-side exact data for ties/materialization/compaction:
+        self.row_keys: list[list[bytes]] = []       # per block, len R (b"" pad)
+        self.row_versions: list[list[RowVersion | None]] = []
+        self.min_key = b""
+        self.max_key = b""
+        self.max_ht = 0
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def build(schema: Schema, entries: list[tuple[bytes, list[RowVersion]]],
+              rows_per_block: int = DEFAULT_ROWS_PER_BLOCK) -> "ColumnarRun":
+        """entries: (key asc, versions ht-desc) — MemTable.drain_sorted() or a
+        compaction merge. Packs key groups into blocks without splitting."""
+        run = ColumnarRun(schema, rows_per_block)
+        R = run.R
+        # Greedy block packing, key groups kept whole.
+        blocks: list[list[tuple[bytes, list[RowVersion]]]] = [[]]
+        fill = 0
+        for key, versions in entries:
+            n = len(versions)
+            if n > R:
+                raise ValueError(
+                    f"key has {n} versions > rows_per_block={R}; "
+                    "compact with a history cutoff before flushing this")
+            if fill + n > R:
+                blocks.append([])
+                fill = 0
+            blocks[-1].append((key, versions))
+            fill += n
+        if blocks == [[]]:
+            blocks = []
+        B = max(1, len(blocks))
+        run.B = B
+        run._alloc(B)
+        for b, group_list in enumerate(blocks):
+            run._fill_block(b, group_list)
+        run.min_key = blocks[0][0][0] if blocks else b""
+        run.max_key = blocks[-1][-1][0] if blocks else b""
+        run.num_versions = sum(len(v) for _, v in entries)
+        return run
+
+    def _alloc(self, B: int) -> None:
+        R = self.R
+        self.key_planes = np.zeros((B, R, KEY_WORDS), dtype=np.int32)
+        self.ht_hi = np.zeros((B, R), dtype=np.int32)
+        self.ht_lo = np.zeros((B, R), dtype=np.int32)
+        maxhi, maxlo = P.scalar_ht_planes(MAX_HT)
+        self.exp_hi = np.full((B, R), maxhi, dtype=np.int32)
+        self.exp_lo = np.full((B, R), maxlo, dtype=np.int32)
+        self.tomb = np.zeros((B, R), dtype=bool)
+        self.live = np.zeros((B, R), dtype=bool)
+        self.valid = np.zeros((B, R), dtype=bool)
+        # Padding rows are each their own group so they never join a real one.
+        self.group_start = np.ones((B, R), dtype=bool)
+        for c in self.schema.value_columns:
+            P_cmp = 2 if c.dtype.device_planes == 2 else 1
+            self.cols[c.col_id] = ColumnData(
+                dtype=c.dtype,
+                set_=np.zeros((B, R), dtype=bool),
+                isnull=np.zeros((B, R), dtype=bool),
+                cmp_planes=np.zeros((B, R, P_cmp), dtype=np.int32),
+                arith=(np.zeros((B, R), dtype=np.float32)
+                       if c.dtype.is_numeric else None),
+                varlen=([[None] * R for _ in range(B)]
+                        if not c.dtype.is_fixed_width else None),
+            )
+        self.row_keys = [[b""] * R for _ in range(B)]
+        self.row_versions = [[None] * R for _ in range(B)]
+        self.blocks = [BlockMeta(b"", b"", 0) for _ in range(B)]
+
+    def _fill_block(self, b: int, group_list) -> None:
+        R = self.R
+        r = 0
+        keys_flat: list[bytes] = []
+        for key, versions in group_list:
+            for j, v in enumerate(versions):
+                self.valid[b, r] = True
+                self.group_start[b, r] = (j == 0)
+                self.tomb[b, r] = v.tombstone
+                self.live[b, r] = v.liveness
+                self.row_keys[b][r] = key
+                self.row_versions[b][r] = v
+                keys_flat.append(key)
+                hts = P.scalar_ht_planes(v.ht)
+                self.ht_hi[b, r], self.ht_lo[b, r] = hts
+                if v.ht > self.max_ht:
+                    self.max_ht = v.ht
+                if v.has_ttl:
+                    es = P.scalar_ht_planes(v.expire_ht)
+                    self.exp_hi[b, r], self.exp_lo[b, r] = es
+                for cid, val in v.columns.items():
+                    self._fill_value(b, r, cid, val)
+                r += 1
+        if keys_flat:
+            kp = P.key_prefix_planes(keys_flat, KEY_WORDS)
+            self.key_planes[b, : len(keys_flat)] = kp
+        self.blocks[b] = BlockMeta(
+            group_list[0][0] if group_list else b"",
+            group_list[-1][0] if group_list else b"",
+            r,
+        )
+
+    def _fill_value(self, b: int, r: int, cid: int, val) -> None:
+        col = self.cols[cid]
+        col.set_[b, r] = True
+        if val is None:
+            col.isnull[b, r] = True
+            return
+        dt = col.dtype
+        if dt.is_integer or dt == DataType.BOOL:
+            iv = int(val)
+            if dt == DataType.BOOL:
+                iv = int(bool(val))
+            if col.cmp_planes.shape[-1] == 2:
+                hi, lo = P.i64_to_ordered_planes(np.array([iv], dtype=np.int64))
+                col.cmp_planes[b, r, 0] = hi[0]
+                col.cmp_planes[b, r, 1] = lo[0]
+            else:
+                col.cmp_planes[b, r, 0] = iv
+            col.arith[b, r] = np.float32(iv)
+        elif dt == DataType.FLOAT:
+            fv = np.float32(val)
+            col.cmp_planes[b, r, 0] = fv.view(np.int32)  # raw bits; compare via arith plane
+            col.arith[b, r] = fv
+        elif dt == DataType.DOUBLE:
+            hi, lo = P.f64_to_ordered_planes(np.array([val], dtype=np.float64))
+            col.cmp_planes[b, r, 0] = hi[0]
+            col.cmp_planes[b, r, 1] = lo[0]
+            col.arith[b, r] = np.float32(val)
+        else:  # STRING / BINARY
+            raw = val.encode("utf-8") if isinstance(val, str) else bytes(val)
+            hi, lo = P.varlen_prefix_planes([raw])
+            col.cmp_planes[b, r, 0] = hi[0]
+            col.cmp_planes[b, r, 1] = lo[0]
+            col.varlen[b][r] = val
+
+    # -- host-side access (compaction input, materialization) -------------
+    def iter_entries(self):
+        """Yield (key, versions ht-desc) in key order — compaction input."""
+        for b in range(self.B):
+            meta = self.blocks[b]
+            r = 0
+            while r < meta.num_valid:
+                key = self.row_keys[b][r]
+                versions = []
+                while r < meta.num_valid and self.row_keys[b][r] == key:
+                    versions.append(self.row_versions[b][r])
+                    r += 1
+                yield key, versions
+
+    def group_versions(self, b: int, r: int) -> tuple[bytes, list[RowVersion]]:
+        """The key group starting at (block b, row r) — r must be group_start."""
+        key = self.row_keys[b][r]
+        versions = []
+        meta = self.blocks[b]
+        while r < meta.num_valid and self.row_keys[b][r] == key:
+            versions.append(self.row_versions[b][r])
+            r += 1
+        return key, versions
+
+    # -- exact host-side key location (bounds, point lookups) --------------
+    def lower_row(self, key: bytes) -> int:
+        """Global row index (b*R + r) of the first valid row with
+        row_key >= key. Exact on full key bytes — this is what turns scan
+        bounds into device row-index bounds with no prefix-tie ambiguity."""
+        import bisect as _bisect
+
+        if self.B == 0 or not self.blocks[0].num_valid:
+            return 0
+        maxes = [m.max_key for m in self.blocks if m.num_valid]
+        b = _bisect.bisect_left(maxes, key)
+        if b >= len(maxes):
+            return self.total_rows()
+        meta = self.blocks[b]
+        r = _bisect.bisect_left(self.row_keys[b], key, 0, meta.num_valid)
+        return b * self.R + r
+
+    def upper_row(self, upper: bytes) -> int:
+        """Global row index bound for exclusive upper (b'' = unbounded)."""
+        if not upper:
+            return self.total_rows()
+        return self.lower_row(upper)
+
+    def total_rows(self) -> int:
+        return self.B * self.R
+
+    def find_versions(self, key: bytes) -> list[RowVersion]:
+        """All versions of key in this run (ht-desc), or []."""
+        import bisect as _bisect
+
+        row = self.lower_row(key)
+        if row >= self.total_rows():
+            return []
+        b, r = divmod(row, self.R)
+        if b >= self.B or r >= self.blocks[b].num_valid or \
+                self.row_keys[b][r] != key:
+            return []
+        out = []
+        meta = self.blocks[b]
+        while r < meta.num_valid and self.row_keys[b][r] == key:
+            out.append(self.row_versions[b][r])
+            r += 1
+        return out
+
+    def key_at(self, global_row: int) -> bytes:
+        b, r = divmod(global_row, self.R)
+        return self.row_keys[b][r]
+
+    # -- block pruning -----------------------------------------------------
+    def block_range(self, lower: bytes, upper: bytes) -> tuple[int, int]:
+        """[b0, b1) of blocks that may contain keys in [lower, upper)."""
+        if self.B == 0 or not self.blocks[0].num_valid:
+            return 0, 0
+        b0 = 0
+        while b0 < self.B and self.blocks[b0].num_valid and \
+                self.blocks[b0].max_key < lower:
+            b0 += 1
+        b1 = self.B
+        if upper:
+            while b1 > b0 and (not self.blocks[b1 - 1].num_valid or
+                               self.blocks[b1 - 1].min_key >= upper):
+                b1 -= 1
+        while b1 > b0 and not self.blocks[b1 - 1].num_valid:
+            b1 -= 1
+        return b0, b1

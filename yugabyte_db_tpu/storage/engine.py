@@ -1,0 +1,84 @@
+"""The storage-engine seam: the pluggable boundary the query layer scans through.
+
+Reference analog: common::YQLStorageIf (src/yb/common/ql_storage_interface.h:31)
+— the only interface the query execution layer uses to read a tablet, with
+the engine selected where the tablet injects its storage
+(src/yb/tablet/tablet.h:648). Here the seam also carries writes (the
+reference applies writes through rocksdb::DB::Write below the same tablet).
+
+Engines:
+- ``cpu``: exact Python/numpy engine — the correctness oracle and the
+  baseline the TPU engine is benchmarked against.
+- ``tpu``: columnar HBM-resident data plane driven by JAX/Pallas kernels
+  (the ``tablet_storage_engine=tpu`` option of the north star).
+"""
+
+from __future__ import annotations
+
+import abc
+
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.storage.row_version import RowVersion
+from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+
+
+class StorageEngine(abc.ABC):
+    """Per-tablet storage: an LSM of MVCC row versions behind a scan API."""
+
+    def __init__(self, schema: Schema, options: dict | None = None):
+        self.schema = schema
+        self.options = dict(options or {})
+
+    # -- writes ------------------------------------------------------------
+    @abc.abstractmethod
+    def apply(self, rows: list[RowVersion]) -> None:
+        """Apply committed row versions (the Raft-apply stage calls this)."""
+
+    # -- reads -------------------------------------------------------------
+    @abc.abstractmethod
+    def scan(self, spec: ScanSpec) -> ScanResult:
+        """MVCC scan/aggregate at spec.read_ht over [lower, upper)."""
+
+    # -- lifecycle ---------------------------------------------------------
+    @abc.abstractmethod
+    def flush(self) -> None:
+        """Persist the memtable as a new sorted run."""
+
+    @abc.abstractmethod
+    def compact(self, history_cutoff_ht: int = 0) -> None:
+        """Merge all sorted runs into one, GCing history older than cutoff."""
+
+    @abc.abstractmethod
+    def stats(self) -> dict:
+        """Observability counters (runs, rows, bytes, versions)."""
+
+    def maybe_compact(self, history_cutoff_ht: int = 0) -> bool:
+        """Universal-compaction trigger: compact when run count reaches the
+        threshold (reference: universal style with num_levels=1,
+        docdb_rocksdb_util.cc:476-482)."""
+        trigger = self.options.get("compaction_trigger", 4)
+        if self.stats().get("num_runs", 0) >= trigger:
+            self.compact(history_cutoff_ht)
+            return True
+        return False
+
+    def close(self) -> None:
+        pass
+
+
+_ENGINES: dict[str, type] = {}
+
+
+def register_engine(name: str, cls: type) -> None:
+    _ENGINES[name] = cls
+
+
+def make_engine(name: str, schema: Schema, options: dict | None = None) -> StorageEngine:
+    """Factory behind the ``tablet_storage_engine`` option."""
+    if name == "tpu" and name not in _ENGINES:
+        # Lazy: importing the TPU engine pulls in jax; CPU-only paths
+        # (tools, tests of the host layers) shouldn't pay for it.
+        import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401
+    if name not in _ENGINES:
+        raise ValueError(f"unknown storage engine {name!r}; have {sorted(_ENGINES)}")
+    return _ENGINES[name](schema, options)

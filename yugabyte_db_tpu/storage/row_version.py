@@ -1,0 +1,42 @@
+"""RowVersion: one MVCC version of one row — the storage write record.
+
+Reference analog: in DocDB a logical row version is *shredded* into one
+RocksDB KV per column (SubDocKey = DocKey + column_id + DocHybridTime,
+src/yb/docdb/doc_key.h) plus a liveness system column written by INSERT.
+The columnar TPU layout wants whole-row versions instead: one record per
+(DocKey, commit hybrid time) carrying the set of columns that write touched.
+The semantics are identical:
+
+- INSERT  -> liveness=True, all provided columns set
+- UPDATE  -> liveness=False, only the SET columns present
+- DELETE  -> tombstone=True (row tombstone)
+- SET col=NULL -> column present with value None (column tombstone)
+- TTL     -> expire_ht precomputed at write time; an expired value reads as
+  a tombstone at its own hybrid time (shadowing older versions), matching
+  DocDBCompactionFilter/GetSubDocument expiry semantics
+  (src/yb/docdb/docdb_compaction_filter.cc).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+MAX_HT = (1 << 63) - 1
+
+
+@dataclass
+class RowVersion:
+    key: bytes                 # encoded DocKey
+    ht: int                    # commit hybrid time (HybridTime.value)
+    tombstone: bool = False    # row delete marker
+    liveness: bool = False     # INSERT liveness marker
+    columns: dict = field(default_factory=dict)  # col_id -> value (None = null)
+    expire_ht: int = MAX_HT    # TTL expiry as a hybrid time; MAX_HT = no TTL
+
+    def __post_init__(self):
+        if self.tombstone and (self.liveness or self.columns):
+            raise ValueError("tombstone carries no columns or liveness")
+
+    @property
+    def has_ttl(self) -> bool:
+        return self.expire_ht != MAX_HT

@@ -1,0 +1,101 @@
+"""Sorted-run persistence: save/load runs as codec files.
+
+Reference analog: SSTable files on disk (block_based_table_builder.cc) +
+MANIFEST tracking. Both engines persist the same logical content (key ->
+MVCC versions); the TPU engine rebuilds its columnar planes from it at load
+time. Columnar plane snapshots (zero-rebuild load) come later; this format
+is the durable source of truth either way.
+
+File format: codec.encode of
+  ["run1", [ [key, [ [ht, tombstone, liveness, {col: val}, expire_ht], ...ht-desc ], ...key-asc ] ]
+"""
+
+from __future__ import annotations
+
+import os
+
+from yugabyte_db_tpu.utils import codec
+from yugabyte_db_tpu.storage.row_version import RowVersion
+
+_MAGIC = "run1"
+
+
+def save_run(path: str, entries: list[tuple[bytes, list[RowVersion]]]) -> None:
+    payload = [
+        [key, [[v.ht, v.tombstone, v.liveness,
+                {str(c): val for c, val in v.columns.items()}, v.expire_ht]
+               for v in versions]]
+        for key, versions in entries
+    ]
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(codec.encode([_MAGIC, payload]))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class RunPersistence:
+    """Tracks a directory of numbered run files for one engine instance.
+    ``None`` data_dir = in-memory engine (tests, caches)."""
+
+    def __init__(self, data_dir: str | None):
+        self.data_dir = data_dir
+        self._seq = 0
+        self.files: list[str] = []
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            names = sorted(n for n in os.listdir(data_dir)
+                           if n.startswith("run-") and n.endswith(".dat"))
+            self.files = [os.path.join(data_dir, n) for n in names]
+            if names:
+                self._seq = max(int(n[4:-4]) for n in names) + 1
+
+    @property
+    def enabled(self) -> bool:
+        return self.data_dir is not None
+
+    def load_all(self):
+        return [load_run(p) for p in self.files]
+
+    def save_new(self, entries) -> None:
+        if not self.enabled:
+            return
+        path = os.path.join(self.data_dir, f"run-{self._seq:010d}.dat")
+        self._seq += 1
+        save_run(path, entries)
+        self.files.append(path)
+
+    def replace_all(self, entries) -> None:
+        """Atomically-ish swap every run file for one merged run (compaction).
+        New file is durable before old ones are unlinked, so a crash leaves
+        either the old set or a superset — load_all after a crash between
+        steps would see duplicated data, which the version-merge semantics
+        absorb (identical versions merge idempotently)."""
+        if not self.enabled:
+            return
+        old = list(self.files)
+        self.files = []
+        if entries:
+            self.save_new(entries)
+        for p in old:
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+
+
+def load_run(path: str) -> list[tuple[bytes, list[RowVersion]]]:
+    with open(path, "rb") as f:
+        magic, payload = codec.decode(f.read())
+    if magic != _MAGIC:
+        raise ValueError(f"{path}: bad run file magic {magic!r}")
+    out = []
+    for key, versions in payload:
+        out.append((key, [
+            RowVersion(key, ht=ht, tombstone=tomb, liveness=live,
+                       columns={int(c): val for c, val in cols.items()},
+                       expire_ht=exp)
+            for ht, tomb, live, cols, exp in versions
+        ]))
+    return out

@@ -1,0 +1,93 @@
+"""Scan specification and results: what the query layer pushes down.
+
+Reference analog: src/yb/common/ql_scanspec.h (QLScanRange/QLScanSpec — the
+key-range bounds), the condition PBs of ql_protocol.proto evaluated by
+QLExprExecutor (src/yb/common/ql_expr.h:210), and aggregate pushdown
+(PgsqlReadOperation::EvalAggregate, src/yb/docdb/pgsql_operation.cc:473).
+Paging mirrors QLPagingStatePB: a scan resumes from an encoded key.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+
+# Predicate operators the engines evaluate. NULL semantics are SQL-ish:
+# a comparison with NULL is false (rows with null operands never match).
+OPS = ("=", "!=", "<", "<=", ">", ">=", "IN")
+
+AGG_FNS = ("count", "sum", "min", "max", "avg")
+
+
+@dataclass(frozen=True)
+class Predicate:
+    column: str
+    op: str
+    value: object  # literal; for IN, a tuple of literals
+
+    def __post_init__(self):
+        if self.op not in OPS:
+            raise ValueError(f"bad predicate op {self.op!r}")
+
+    def matches(self, v) -> bool:
+        if v is None:
+            return False
+        if self.op == "=":
+            return v == self.value
+        if self.op == "!=":
+            return v != self.value
+        if self.op == "<":
+            return v < self.value
+        if self.op == "<=":
+            return v <= self.value
+        if self.op == ">":
+            return v > self.value
+        if self.op == ">=":
+            return v >= self.value
+        if self.op == "IN":
+            return v in self.value
+        raise AssertionError(self.op)
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    fn: str          # count | sum | min | max | avg
+    column: str | None  # None for count(*)
+
+    def __post_init__(self):
+        if self.fn not in AGG_FNS:
+            raise ValueError(f"bad aggregate {self.fn!r}")
+        if self.fn != "count" and self.column is None:
+            raise ValueError(f"{self.fn} needs a column")
+
+
+@dataclass
+class ScanSpec:
+    """A bounded MVCC scan request against one tablet's storage."""
+
+    lower: bytes = b""          # inclusive encoded-key lower bound
+    upper: bytes = b""          # exclusive encoded-key upper bound; b"" = unbounded
+    read_ht: int = MAX_HT       # MVCC read point (HybridTime.value)
+    predicates: list[Predicate] = field(default_factory=list)
+    projection: list[str] | None = None   # column names; None = all columns
+    limit: int | None = None              # max rows returned (page size)
+    aggregates: list[AggSpec] | None = None
+    group_by: list[str] | None = None     # grouping columns (with aggregates)
+
+    def in_range(self, key: bytes) -> bool:
+        if key < self.lower:
+            return False
+        return not self.upper or key < self.upper
+
+    @property
+    def is_aggregate(self) -> bool:
+        return bool(self.aggregates)
+
+
+@dataclass
+class ScanResult:
+    columns: list[str]            # names, in output order
+    rows: list[tuple]             # materialized rows (or aggregate row(s))
+    resume_key: bytes | None = None  # exclusive "scan resumes at" key, None = done
+    rows_scanned: int = 0         # observability: merged rows examined

@@ -1,0 +1,462 @@
+"""TPU storage engine: the ``tablet_storage_engine=tpu`` data plane.
+
+The north-star component (BASELINE.json): scans, MVCC merge-on-read,
+predicate filtering and aggregate pushdown execute as device programs over
+HBM-resident columnar runs (ops.scan over storage.columnar), while writes,
+the memtable, and exact tie/varlen handling stay host-side. Query results
+are required to be identical to CpuStorageEngine (the oracle) — the
+engine-diff tests enforce it.
+
+Read-path policy (correctness first, device fast path where it's sound):
+
+- single-source scans (one run covers the range, memtable empty there):
+  device evaluates visibility + range + numeric predicates exactly; varlen
+  (string) predicates produce a candidate SUPERSET that the host verifies
+  during materialization.
+- multi-source scans (several overlapping runs and/or a live memtable):
+  each run reports candidate keys from the device without predicate
+  filtering (a column's latest value may live in another source, so
+  per-source predicate evaluation is unsound — see ops/scan.py); the host
+  merges versions across sources per candidate key (storage.merge) and
+  applies predicates. Memtable keys in range are always candidates.
+- aggregates push down to the device (per-block partials, exact integer
+  limb sums) only when the scan is single-source and every predicate is
+  device-exact; otherwise they fall back to the row path + host Aggregator.
+
+Reference analog of the seam/merge behavior: DocRowwiseIterator over an
+IntentAwareIterator merging regular/provisional sources
+(src/yb/docdb/doc_rowwise_iterator.cc, intent_aware_iterator.h:81).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.ops import scan as dscan
+from yugabyte_db_tpu.ops.device_run import DeviceRun, dtype_kind
+from yugabyte_db_tpu.storage.columnar import ColumnarRun
+from yugabyte_db_tpu.storage.cpu_engine import Aggregator, RowMaterializer
+from yugabyte_db_tpu.storage.engine import StorageEngine, register_engine
+from yugabyte_db_tpu.storage.memtable import MemTable
+from yugabyte_db_tpu.storage.merge import merge_versions
+from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+from yugabyte_db_tpu.utils import planes as P
+
+WINDOW_BLOCKS = 8          # blocks per device dispatch on the row path
+PAD_BLOCKS = 64            # run block-axis padding (multiple of every window)
+AGG_WINDOW_BLOCKS = 64     # blocks per dispatch on the aggregate path
+
+
+class TpuRun:
+    def __init__(self, crun: ColumnarRun):
+        self.crun = crun
+        self.dev = DeviceRun(crun, PAD_BLOCKS)
+
+
+class TpuStorageEngine(StorageEngine):
+    def __init__(self, schema: Schema, options: dict | None = None):
+        super().__init__(schema, options)
+        self.memtable = MemTable()
+        self.runs: list[TpuRun] = []
+        self.mat = RowMaterializer(schema)
+        self.flushed_frontier_ht = 0
+        self.rows_per_block = self.options.get("rows_per_block", 2048)
+        self._kinds = {c.col_id: dtype_kind(c.dtype)
+                       for c in schema.value_columns}
+        self._name_to_id = {c.name: c.col_id for c in schema.value_columns}
+        self._key_col_names = {c.name for c in schema.key_columns}
+        from yugabyte_db_tpu.storage.run_io import RunPersistence
+
+        self.persist = RunPersistence(self.options.get("data_dir"))
+        for entries in self.persist.load_all():
+            crun = ColumnarRun.build(self.schema, entries, self.rows_per_block)
+            self.runs.append(TpuRun(crun))
+            self.flushed_frontier_ht = max(self.flushed_frontier_ht, crun.max_ht)
+
+    # -- writes ------------------------------------------------------------
+    def apply(self, rows: list[RowVersion]) -> None:
+        self.memtable.apply(rows)
+        limit = self.options.get("memtable_flush_versions", 1 << 60)
+        if self.memtable.num_versions >= limit:
+            self.flush()
+            self.maybe_compact()
+
+    # -- lifecycle ---------------------------------------------------------
+    def flush(self) -> None:
+        if self.memtable.is_empty:
+            return
+        if self.memtable.max_ht is not None:
+            self.flushed_frontier_ht = max(self.flushed_frontier_ht,
+                                           self.memtable.max_ht)
+        entries = self.memtable.drain_sorted()
+        self.persist.save_new(entries)
+        crun = ColumnarRun.build(self.schema, entries, self.rows_per_block)
+        self.runs.append(TpuRun(crun))
+        self.memtable = MemTable()
+
+    def compact(self, history_cutoff_ht: int = 0) -> None:
+        """Merge all runs into one. Host-side k-way merge + shared GC for
+        now; the device sort-merge path (ops.merge) takes over for large
+        runs once wired in."""
+        import heapq
+
+        from yugabyte_db_tpu.storage.cpu_engine import CpuStorageEngine
+
+        if len(self.runs) <= 1 and history_cutoff_ht == 0:
+            return
+
+        def run_iter(trun):
+            return ((k, vs) for k, vs in trun.crun.iter_entries())
+
+        merged = []
+        current, bucket = None, []
+        for key, versions in heapq.merge(*[run_iter(t) for t in self.runs],
+                                         key=lambda p: p[0]):
+            if key != current:
+                if current is not None:
+                    self._emit_group(merged, current, bucket, history_cutoff_ht,
+                                     CpuStorageEngine)
+                current, bucket = key, []
+            bucket.extend(versions)
+        if current is not None:
+            self._emit_group(merged, current, bucket, history_cutoff_ht,
+                             CpuStorageEngine)
+        self.persist.replace_all(merged)
+        crun = ColumnarRun.build(self.schema, merged, self.rows_per_block)
+        self.runs = [TpuRun(crun)] if merged else []
+
+    @staticmethod
+    def _emit_group(out, key, versions, cutoff, cpu_cls):
+        versions = sorted(versions, key=lambda r: -r.ht)
+        kept = cpu_cls._gc_versions(key, versions, cutoff)
+        if kept:
+            out.append((key, kept))
+
+    def stats(self) -> dict:
+        return {
+            "num_runs": len(self.runs),
+            "memtable_versions": self.memtable.num_versions,
+            "run_versions": sum(t.crun.num_versions for t in self.runs),
+            "flushed_frontier_ht": self.flushed_frontier_ht,
+        }
+
+    # -- scan plumbing ------------------------------------------------------
+    def _overlapping_runs(self, spec: ScanSpec) -> list[TpuRun]:
+        out = []
+        for t in self.runs:
+            if t.crun.num_versions == 0:
+                continue
+            if spec.upper and t.crun.min_key >= spec.upper:
+                continue
+            if t.crun.max_key < spec.lower:
+                continue
+            out.append(t)
+        return out
+
+    def _memtable_in_range(self, spec: ScanSpec) -> bool:
+        return next(self.memtable.scan_keys(spec.lower, spec.upper), None) is not None
+
+    def _split_predicates(self, spec: ScanSpec):
+        """(device-exact preds, device-superset preds, host-only preds).
+
+        'str' prefixes and 'f32' rounded values give superset masks only
+        (ties are maybe-matches the host verifies); key-column and IN
+        predicates are host-only."""
+        exact, superset, host_only = [], [], []
+        for p in spec.predicates:
+            if p.column in self._key_col_names or p.op == "IN":
+                host_only.append(p)
+                continue
+            kind = self._kinds[self._name_to_id[p.column]]
+            if kind in ("str", "f32"):
+                superset.append(p)
+            else:
+                exact.append(p)
+        return exact, superset, host_only
+
+    def _aggs_device_eligible(self, spec: ScanSpec) -> bool:
+        """Device aggregates need every aggregate column to be a numeric
+        VALUE column (key columns live in the encoded key, not in planes;
+        string min/max needs full bytes the device doesn't have)."""
+        for a in spec.aggregates:
+            if a.column is None:
+                continue
+            cid = self._name_to_id.get(a.column)
+            if cid is None:
+                return False  # key column (or unknown): host path
+            if self._kinds[cid] == "str" and a.fn != "count":
+                return False
+        return True
+
+    def _pred_sig_and_literals(self, preds):
+        sigs, lits = [], []
+        for p in preds:
+            cid = self._name_to_id[p.column]
+            kind = self._kinds[cid]
+            sigs.append(dscan.PredSig(cid, kind, p.op))
+            lits.append(_literal(kind, p.value))
+        return tuple(sigs), tuple(lits)
+
+    def _col_sigs(self):
+        return tuple(dscan.ColSig(c.col_id, self._kinds[c.col_id])
+                     for c in self.schema.value_columns)
+
+    def _read_planes(self, spec: ScanSpec):
+        r_hi, r_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT))
+        e_hi, e_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
+        return (jnp.int32(r_hi), jnp.int32(r_lo),
+                jnp.int32(e_hi), jnp.int32(e_lo))
+
+    def _device_candidates(self, trun: TpuRun, spec: ScanSpec,
+                           pred_sigs, pred_lits, apply_preds: bool):
+        """Run the device row-scan over the block windows covering the range;
+        yield candidate keys (host-materialized, in key order)."""
+        crun = trun.crun
+        row_lo = crun.lower_row(spec.lower)
+        row_hi = crun.upper_row(spec.upper)
+        if row_lo >= row_hi:
+            return
+        R = crun.R
+        K = WINDOW_BLOCKS
+        b_first = (row_lo // R) // K * K
+        b_last = ((row_hi - 1) // R) // K * K
+        sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
+                            preds=pred_sigs, aggs=(), apply_preds=apply_preds)
+        fn = dscan.compiled_scan(sig)
+        r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
+        for b0 in range(b_first, b_last + 1, K):
+            base = b0 * R
+            res = fn(trun.dev.arrays, jnp.int32(b0),
+                     jnp.int32(np.clip(row_lo - base, -(1 << 30), 1 << 30)),
+                     jnp.int32(np.clip(row_hi - base, -(1 << 30), 1 << 30)),
+                     r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
+            mask = np.asarray(res["result"])
+            ng = int(res["num_groups"])
+            start = np.asarray(res["start_idx"])
+            for g in np.nonzero(mask[:ng])[0]:
+                yield crun.key_at(base + int(start[g]))
+
+    # -- reads -------------------------------------------------------------
+    def scan(self, spec: ScanSpec) -> ScanResult:
+        runs = self._overlapping_runs(spec)
+        mem_live = self._memtable_in_range(spec)
+        exact, superset, host_only = self._split_predicates(spec)
+        single_source = len(runs) == 1 and not mem_live
+
+        if spec.is_aggregate:
+            eligible = (single_source and not superset and not host_only
+                        and not spec.group_by
+                        and self._aggs_device_eligible(spec))
+            if eligible and runs:
+                return self._device_aggregate(runs[0], spec, exact)
+            return self._row_scan(spec, runs, mem_live,
+                                  (exact, superset, host_only), aggregate=True)
+        return self._row_scan(spec, runs, mem_live,
+                              (exact, superset, host_only), aggregate=False)
+
+    def _row_scan(self, spec: ScanSpec, runs, mem_live, pred_split,
+                  aggregate: bool):
+        exact, superset, host_only = pred_split
+        single_source = len(runs) == 1 and not mem_live
+        apply_preds = single_source
+        pred_sigs, pred_lits = (
+            self._pred_sig_and_literals(exact + superset) if apply_preds
+            else ((), ()))
+
+        key_streams = [
+            self._device_candidates(t, spec, pred_sigs, pred_lits, apply_preds)
+            for t in runs
+        ]
+        if mem_live or not self.memtable.is_empty:
+            key_streams.append(self.memtable.scan_keys(spec.lower, spec.upper))
+
+        import heapq
+
+        candidates = heapq.merge(*key_streams)
+        projection = spec.projection or [c.name for c in self.schema.columns]
+        agg = Aggregator(spec.aggregates or [], spec.group_by or []) \
+            if aggregate else None
+        rows: list[tuple] = []
+        scanned = 0
+        resume = None
+        last = None
+        for key in candidates:
+            if key == last:
+                continue
+            last = key
+            scanned += 1
+            versions: list[RowVersion] = []
+            for t in runs:
+                versions.extend(t.crun.find_versions(key))
+            versions.extend(self.memtable.versions(key))
+            merged = merge_versions(key, versions, spec.read_ht)
+            if not merged.exists:
+                continue
+            key_vals = self.mat.key_values(key)
+            if not self.mat.matches(spec, key_vals, merged):
+                continue
+            if aggregate:
+                agg.add(lambda name: self.mat.value(name, key_vals, merged))
+                continue
+            rows.append(tuple(
+                self.mat.value(name, key_vals, merged) for name in projection))
+            if spec.limit is not None and len(rows) >= spec.limit:
+                resume = key + b"\x00"
+                break
+        if aggregate:
+            return ScanResult(agg.column_names(), agg.results(), None, scanned)
+        return ScanResult(projection, rows, resume, scanned)
+
+    # -- device aggregate path ---------------------------------------------
+    def _device_aggregate(self, trun: TpuRun, spec: ScanSpec, exact_preds):
+        crun = trun.crun
+        row_lo = crun.lower_row(spec.lower)
+        row_hi = crun.upper_row(spec.upper)
+        pred_sigs, pred_lits = self._pred_sig_and_literals(exact_preds)
+
+        # Lower each AggSpec to device ops: avg = sum + count.
+        dev_aggs: list[dscan.AggSig] = []
+        lowering: list[tuple] = []  # (fn, indices into dev_aggs)
+        for a in spec.aggregates:
+            cid = self._name_to_id.get(a.column) if a.column else None
+            kind = self._kinds[cid] if cid is not None else None
+            if a.fn == "count":
+                lowering.append(("count", len(dev_aggs)))
+                dev_aggs.append(dscan.AggSig("count", cid, kind))
+            elif a.fn in ("sum", "min", "max"):
+                lowering.append((a.fn, len(dev_aggs)))
+                dev_aggs.append(dscan.AggSig(a.fn, cid, kind))
+            else:  # avg
+                lowering.append(("avg", len(dev_aggs)))
+                dev_aggs.append(dscan.AggSig("sum", cid, kind))
+
+        R, K = crun.R, AGG_WINDOW_BLOCKS
+        sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
+                            preds=pred_sigs, aggs=tuple(dev_aggs),
+                            apply_preds=True)
+        fn = dscan.compiled_scan(sig)
+        r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
+
+        acc = [_AggAcc(a) for a in dev_aggs]
+        scanned = 0
+        if row_lo < row_hi:
+            b_first = (row_lo // R) // K * K
+            b_last = ((row_hi - 1) // R) // K * K
+            for b0 in range(b_first, b_last + 1, K):
+                base = b0 * R
+                res = fn(trun.dev.arrays, jnp.int32(b0),
+                         jnp.int32(np.clip(row_lo - base, -(1 << 30), 1 << 30)),
+                         jnp.int32(np.clip(row_hi - base, -(1 << 30), 1 << 30)),
+                         r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
+                scanned += int(np.asarray(res["result"]).sum())
+                for i, a in enumerate(acc):
+                    a.absorb({k.split("_", 1)[1]: v for k, v in res.items()
+                              if k.split("_", 1)[0] == f"agg{i}"})
+
+        out_row = []
+        names = []
+        for a, (fn_name, di) in zip(spec.aggregates, lowering):
+            names.append(f"{a.fn}({a.column or '*'})")
+            if fn_name == "count":
+                out_row.append(acc[di].count_value())
+            elif fn_name == "sum":
+                out_row.append(acc[di].sum_value())
+            elif fn_name in ("min", "max"):
+                out_row.append(acc[di].ext_value())
+            else:  # avg
+                s = acc[di].sum_value()
+                n = acc[di].n
+                out_row.append(None if not n else s / n)
+        return ScanResult(names, [tuple(out_row)], None, scanned)
+
+
+class _AggAcc:
+    """Host-side exact combine of per-window device partials."""
+
+    def __init__(self, sig: dscan.AggSig):
+        self.sig = sig
+        self.n = 0
+        self.count = 0
+        self.limb_total = 0       # Σ limbs·2^16j (biased)
+        self.fsum = 0.0
+        self.ext_planes = None    # (hi, lo) or scalar plane
+        self.fext = None
+
+    def absorb(self, parts: dict) -> None:
+        s = self.sig
+        if s.fn == "count":
+            self.count += int(parts["count"])
+            return
+        n = int(parts["n"])
+        self.n += n
+        if s.fn == "sum":
+            if s.kind in ("f32", "f64"):
+                self.fsum += float(np.asarray(parts["fsum"], dtype=np.float64).sum())
+            else:
+                limbs = np.asarray(parts["limbs"], dtype=np.int64).sum(axis=0)
+                self.limb_total += sum(int(limbs[j]) << (16 * j) for j in range(4))
+            return
+        if n == 0:
+            return
+        better = max if s.fn == "max" else min
+        if s.kind == "f32":
+            v = float(parts["fext"])
+            self.fext = v if self.fext is None else better(self.fext, v)
+        elif s.kind == "i32":
+            v = int(parts["ext"])
+            self.fext = v if self.fext is None else better(self.fext, v)
+        else:
+            hi, lo = int(parts["ext_hi"]), int(parts["ext_lo"])
+            if self.ext_planes is None:
+                self.ext_planes = (hi, lo)
+            else:
+                cur = self.ext_planes
+                if s.fn == "max":
+                    self.ext_planes = max(cur, (hi, lo))
+                else:
+                    self.ext_planes = min(cur, (hi, lo))
+
+    def count_value(self) -> int:
+        return self.count
+
+    def sum_value(self):
+        if self.n == 0:
+            return None
+        if self.sig.kind in ("f32", "f64"):
+            return self.fsum
+        bias = (1 << 63) if self.sig.kind == "i64" else (1 << 31)
+        return self.limb_total - self.n * bias
+
+    def ext_value(self):
+        if self.n == 0:
+            return None
+        if self.sig.kind in ("f32", "i32"):
+            return self.fext
+        hi = np.array([self.ext_planes[0]], dtype=np.int32)
+        lo = np.array([self.ext_planes[1]], dtype=np.int32)
+        if self.sig.kind == "i64":
+            return int(P.ordered_planes_to_i64(hi, lo)[0])
+        return float(P.ordered_planes_to_f64(hi, lo)[0])
+
+
+def _literal(kind: str, value):
+    if kind == "i32":
+        return jnp.int32(int(value) if not isinstance(value, bool) else int(value))
+    if kind == "f32":
+        return jnp.float32(value)
+    if kind == "i64":
+        hi, lo = P.i64_to_ordered_planes(np.array([int(value)], dtype=np.int64))
+        return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
+    if kind == "f64":
+        hi, lo = P.f64_to_ordered_planes(np.array([value], dtype=np.float64))
+        return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
+    raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+    hi, lo = P.varlen_prefix_planes([raw])
+    return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
+
+
+register_engine("tpu", TpuStorageEngine)

@@ -1,0 +1,13 @@
+"""The replicated tablet: one shard of a table.
+
+Reference analog: src/yb/tablet (Tablet, TabletPeer, MvccManager, operation
+pipeline, TabletBootstrap) + src/yb/consensus/log* (the WAL). A tablet owns
+its storage engine behind the pluggable seam (tablet.h:648), an MVCC manager
+for safe-time reads (mvcc.h:46), and its durability comes from the
+replicated log — the storage engine has no WAL of its own, matching the
+reference's disabled-rocksdb-WAL design (consensus/README).
+"""
+
+from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
+from yugabyte_db_tpu.tablet.mvcc import MvccManager
+from yugabyte_db_tpu.tablet.tablet import Tablet, TabletMetadata

@@ -1,0 +1,202 @@
+"""Tablet: one shard — storage engine + WAL + MVCC + operation pipeline.
+
+Reference analog: src/yb/tablet/tablet.{h,cc} and the operation lifecycle of
+operations/operation_driver.h:70-95 (Prepare -> Replicate(WAL) -> Apply),
+with TabletBootstrap (tablet_bootstrap.cc) replaying the log over the
+flushed frontier on restart.
+
+Single-node consensus note: this tablet runs under a LocalConsensus-style
+pipeline (append + fsync locally == replicated); consensus.RaftConsensus
+drives the same hooks for replicated tablets — the tablet only sees
+``replicate(entry) -> op_id`` and ``apply(entry)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.storage.engine import make_engine
+from yugabyte_db_tpu.storage.row_version import RowVersion
+from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+from yugabyte_db_tpu.tablet.mvcc import MvccManager
+from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
+from yugabyte_db_tpu.utils.hybrid_time import HybridClock, HybridTime
+
+
+@dataclass
+class TabletMetadata:
+    """The tablet superblock (reference: tablet_metadata.cc RaftGroupMetadata)."""
+
+    tablet_id: str
+    table_name: str
+    schema: Schema
+    partition_start: int
+    partition_end: int
+    engine: str = "cpu"              # tablet_storage_engine option
+    flushed_op_index: int = 0        # WAL replay frontier
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "tablet_id": self.tablet_id,
+                "table_name": self.table_name,
+                "schema": self.schema.to_dict(),
+                "partition_start": self.partition_start,
+                "partition_end": self.partition_end,
+                "engine": self.engine,
+                "flushed_op_index": self.flushed_op_index,
+            }, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "TabletMetadata":
+        with open(path) as f:
+            d = json.load(f)
+        return TabletMetadata(
+            d["tablet_id"], d["table_name"], Schema.from_dict(d["schema"]),
+            d["partition_start"], d["partition_end"], d["engine"],
+            d["flushed_op_index"],
+        )
+
+
+class Tablet:
+    """A live tablet. Thread-safe: writes serialize through the apply lock
+    (the reference serializes through the single-threaded Preparer +
+    per-tablet apply token)."""
+
+    def __init__(self, meta: TabletMetadata, data_root: str,
+                 clock: HybridClock | None = None,
+                 engine_options: dict | None = None,
+                 fsync: bool = True):
+        self.meta = meta
+        self.dir = os.path.join(data_root, meta.tablet_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self.meta_path = os.path.join(self.dir, "tablet-meta.json")
+        self.clock = clock or HybridClock()
+        self.mvcc = MvccManager(self.clock)
+        opts = dict(engine_options or {})
+        opts.setdefault("data_dir", os.path.join(self.dir, "runs"))
+        self.engine = make_engine(meta.engine, meta.schema, opts)
+        self.log = Log(os.path.join(self.dir, "wal"), fsync=fsync)
+        self._write_lock = threading.Lock()
+        self._term = 1
+        self._last_index = self.log.last_appended.index
+        self._applied_index = meta.flushed_op_index
+        self.bootstrap()
+
+    # -- bootstrap ----------------------------------------------------------
+    def bootstrap(self) -> None:
+        """Replay WAL entries newer than the flushed frontier into the
+        engine (reference: TabletBootstrap::PlaySegments)."""
+        replayed = 0
+        for entry in self.log.read_all(self.meta.flushed_op_index + 1):
+            if entry.op_type == "write":
+                rows = _decode_rows(entry.body)
+                self.engine.apply(rows)
+                replayed += 1
+            self._last_index = max(self._last_index, entry.op_id.index)
+            self._applied_index = max(self._applied_index, entry.op_id.index)
+            self.clock.update(HybridTime(entry.ht))
+        self._replayed_on_bootstrap = replayed
+
+    # -- write path ---------------------------------------------------------
+    def write(self, rows: list[RowVersion]) -> HybridTime:
+        """Apply one write operation (a batch of row versions, HT-stamped
+        here). Durable (WAL fsync) before apply, matching the reference's
+        Replicate-before-Apply invariant."""
+        with self._write_lock:
+            ht = self.clock.now()
+            self.mvcc.add_pending(ht)
+            try:
+                stamped = [
+                    RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
+                               liveness=r.liveness, columns=r.columns,
+                               expire_ht=r.expire_ht)
+                    for r in rows
+                ]
+                self._last_index += 1
+                op_id = OpId(self._term, self._last_index)
+                self.log.append(LogEntry(op_id, ht.value, "write",
+                                         _encode_rows(stamped)))
+                self.log.sync()  # group commit point (batching comes from callers)
+                self.engine.apply(stamped)
+                self._applied_index = op_id.index
+            except BaseException:
+                self.mvcc.aborted(ht)
+                raise
+            self.mvcc.replicated(ht)
+            return ht
+
+    # -- read path ----------------------------------------------------------
+    def read_time(self) -> HybridTime:
+        return self.mvcc.safe_time()
+
+    def scan(self, spec: ScanSpec) -> ScanResult:
+        return self.engine.scan(spec)
+
+    # -- maintenance --------------------------------------------------------
+    def flush(self) -> None:
+        """Flush memtable to a durable run, advance the replay frontier,
+        GC fully-flushed WAL segments."""
+        with self._write_lock:
+            self.engine.flush()
+            self.meta.flushed_op_index = self._applied_index
+            self.meta.save(self.meta_path)
+            self.log.sync()
+            self.log.gc(self.meta.flushed_op_index + 1)
+
+    def compact(self, history_cutoff_ht: int = 0) -> None:
+        self.engine.compact(history_cutoff_ht)
+
+    def stats(self) -> dict:
+        s = self.engine.stats()
+        s.update({
+            "tablet_id": self.meta.tablet_id,
+            "last_index": self._last_index,
+            "applied_index": self._applied_index,
+            "flushed_op_index": self.meta.flushed_op_index,
+            "wal_segments": len(self.log.segment_paths()),
+        })
+        return s
+
+    def close(self) -> None:
+        self.log.close()
+        self.engine.close()
+
+    # -- lifecycle helpers ---------------------------------------------------
+    @staticmethod
+    def create(meta: TabletMetadata, data_root: str, **kwargs) -> "Tablet":
+        tdir = os.path.join(data_root, meta.tablet_id)
+        os.makedirs(tdir, exist_ok=True)
+        meta.save(os.path.join(tdir, "tablet-meta.json"))
+        return Tablet(meta, data_root, **kwargs)
+
+    @staticmethod
+    def open(tablet_id: str, data_root: str, **kwargs) -> "Tablet":
+        meta = TabletMetadata.load(
+            os.path.join(data_root, tablet_id, "tablet-meta.json"))
+        return Tablet(meta, data_root, **kwargs)
+
+
+def _encode_rows(rows: list[RowVersion]) -> list:
+    return [
+        [r.key, r.ht, r.tombstone, r.liveness,
+         {str(c): v for c, v in r.columns.items()}, r.expire_ht]
+        for r in rows
+    ]
+
+
+def _decode_rows(body: list) -> list[RowVersion]:
+    return [
+        RowVersion(key, ht=ht, tombstone=tomb, liveness=live,
+                   columns={int(c): v for c, v in cols.items()},
+                   expire_ht=exp)
+        for key, ht, tomb, live, cols, exp in body
+    ]

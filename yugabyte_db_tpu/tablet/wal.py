@@ -1,0 +1,183 @@
+"""The write-ahead log: segmented, group-committed, CRC-protected.
+
+Reference analog: src/yb/consensus/log.{h,cc} — "this replicated consistent
+log also plays the role of the WAL for the tablet" (consensus/README). The
+log stores consensus records (term, index) with opaque payloads; it is the
+ONLY durability mechanism (the storage engine never fsyncs its own WAL).
+
+Format per segment file (``wal-<first_index>.seg``):
+  repeated records: [u32 len][u32 crc32(payload)][payload]
+  payload = codec.encode([term, index, ht, op_type, body])
+
+Group commit: append() buffers; sync() writes+fsyncs once per batch —
+callers (the tablet's operation pipeline / Raft) batch many operations per
+sync, the reference's Log::AsyncAppend + TaskStream pattern.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+
+from yugabyte_db_tpu.utils import codec
+
+_HEADER = struct.Struct("<II")
+
+
+@dataclass(frozen=True, order=True)
+class OpId:
+    """Consensus operation id (term, index) — reference consensus.proto OpId."""
+
+    term: int
+    index: int
+
+    @staticmethod
+    def min() -> "OpId":
+        return OpId(0, 0)
+
+
+@dataclass
+class LogEntry:
+    op_id: OpId
+    ht: int           # hybrid time of the operation
+    op_type: str      # "write" | "no_op" | "change_config" | ...
+    body: object      # codec-encodable payload
+
+
+class Log:
+    """A tablet's durable log of replicated operations."""
+
+    def __init__(self, wal_dir: str, segment_bytes: int = 8 * 1024 * 1024,
+                 fsync: bool = True):
+        self.wal_dir = wal_dir
+        self.segment_bytes = segment_bytes
+        self.fsync = fsync
+        os.makedirs(wal_dir, exist_ok=True)
+        self._file = None
+        self._file_path = None
+        self._file_size = 0
+        self._buffer: list[bytes] = []
+        self._buffer_bytes = 0
+        self.last_appended = OpId.min()
+        # Recover last_appended from the tail segments only (newest first);
+        # the full log is decoded once, by bootstrap replay, not here.
+        for path in reversed(self.segment_paths()):
+            entries, _ = self._read_segment(path, 0)
+            if entries:
+                self.last_appended = entries[-1].op_id
+                break
+
+    # -- segments ----------------------------------------------------------
+    def segment_paths(self) -> list[str]:
+        names = sorted(n for n in os.listdir(self.wal_dir)
+                       if n.startswith("wal-") and n.endswith(".seg"))
+        return [os.path.join(self.wal_dir, n) for n in names]
+
+    def _open_segment(self, first_index: int) -> None:
+        self._close_file()
+        name = f"wal-{first_index:020d}.seg"
+        self._file_path = os.path.join(self.wal_dir, name)
+        self._file = open(self._file_path, "ab")
+        self._file_size = self._file.tell()
+
+    def _close_file(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    # -- append ------------------------------------------------------------
+    def append(self, entry: LogEntry) -> None:
+        """Buffer an entry; durable after the next sync()."""
+        if entry.op_id <= self.last_appended:
+            raise ValueError(
+                f"non-monotonic append {entry.op_id} after {self.last_appended}")
+        payload = codec.encode([
+            entry.op_id.term, entry.op_id.index, entry.ht,
+            entry.op_type, entry.body,
+        ])
+        rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        if self._file is None or \
+                self._file_size + self._buffer_bytes >= self.segment_bytes:
+            # Roll BEFORE buffering this record so the new segment's name
+            # (its first index) truthfully covers it — GC relies on that.
+            self._flush_buffer()
+            self._open_segment(entry.op_id.index)
+        self._buffer.append(rec)
+        self._buffer_bytes += len(rec)
+        self.last_appended = entry.op_id
+
+    def _flush_buffer(self) -> None:
+        if not self._buffer or self._file is None:
+            return
+        data = b"".join(self._buffer)
+        self._buffer.clear()
+        self._buffer_bytes = 0
+        self._file.write(data)
+        self._file_size += len(data)
+
+    def sync(self) -> None:
+        """Group commit: flush buffered records and fsync the segment."""
+        if self._file is None and self._buffer:
+            self._open_segment(max(1, self.last_appended.index))
+        self._flush_buffer()
+        if self._file is not None:
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
+
+    # -- read / replay -----------------------------------------------------
+    def read_all(self, min_index: int = 0):
+        """Yield entries with index >= min_index, tolerating a torn tail
+        (a partial last record after a crash is dropped, matching WAL
+        recovery semantics)."""
+        for path in self.segment_paths():
+            entries, clean = self._read_segment(path, min_index)
+            yield from entries
+            if not clean:
+                return  # stop replay at first torn/corrupt record globally
+
+    @staticmethod
+    def _read_segment(path: str, min_index: int) -> tuple[list, bool]:
+        """-> (entries, clean). clean=False on torn tail or CRC mismatch."""
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        out: list[LogEntry] = []
+        while pos + _HEADER.size <= len(data):
+            length, crc = _HEADER.unpack_from(data, pos)
+            start = pos + _HEADER.size
+            end = start + length
+            if end > len(data):
+                return out, False  # torn tail
+            payload = data[start:end]
+            if zlib.crc32(payload) != crc:
+                return out, False  # corruption: stop at last good record
+            term, index, ht, op_type, body = codec.decode(payload)
+            if index >= min_index:
+                out.append(LogEntry(OpId(term, index), ht, op_type, body))
+            pos = end
+        return out, True
+
+    # -- GC ----------------------------------------------------------------
+    def gc(self, min_retained_index: int) -> int:
+        """Delete whole segments whose every entry index < min_retained_index.
+        Returns segments deleted. (Reference: Log::GC after flushed frontier
+        advances.)"""
+        paths = self.segment_paths()
+        deleted = 0
+        # A segment's name carries its first index; a segment can be deleted
+        # when the NEXT segment's first index is still <= min_retained.
+        for i, path in enumerate(paths[:-1]):  # never delete the active tail
+            nxt_first = int(os.path.basename(paths[i + 1])[4:-4])
+            if nxt_first <= min_retained_index:
+                os.unlink(path)
+                deleted += 1
+            else:
+                break
+        return deleted
+
+    def close(self) -> None:
+        self.sync()
+        self._close_file()

@@ -1,0 +1,138 @@
+"""Compact self-describing binary codec for WAL records and RPC payloads.
+
+Reference analog: protobuf serialization of consensus/log records
+(src/yb/consensus/consensus.proto, log.proto). A hand-rolled tagged format
+keeps the framework dependency-free; the C++ runtime implements the same
+format (native/codec.cc) so host tools can read WAL segments.
+
+Wire grammar (tag byte, then payload):
+  N 0x00 | T 0x01 | F 0x02 | I 0x03 varint(zigzag) | D 0x04 8B f64 LE
+  S 0x05 varint len + utf8 | B 0x06 varint len + bytes
+  L 0x07 varint count + items | M 0x08 varint count + key/value pairs
+"""
+
+from __future__ import annotations
+
+import struct
+
+_T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_F64, _T_STR, _T_BYTES, _T_LIST, _T_MAP = range(9)
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    # Arbitrary-precision zigzag: non-negative -> 2v, negative -> -2v-1.
+    return (v << 1) if v >= 0 else ((-v - 1) << 1) | 1
+
+
+def _unzigzag(v: int) -> int:
+    return (v >> 1) if not v & 1 else -((v >> 1) + 1)
+
+
+def _encode_into(out: bytearray, v) -> None:
+    if v is None:
+        out.append(_T_NONE)
+    elif v is True:
+        out.append(_T_TRUE)
+    elif v is False:
+        out.append(_T_FALSE)
+    elif isinstance(v, int):
+        out.append(_T_INT)
+        _write_varint(out, _zigzag(v))
+    elif isinstance(v, float):
+        out.append(_T_F64)
+        out += struct.pack("<d", v)
+    elif isinstance(v, str):
+        raw = v.encode("utf-8")
+        out.append(_T_STR)
+        _write_varint(out, len(raw))
+        out += raw
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        out.append(_T_BYTES)
+        _write_varint(out, len(v))
+        out += bytes(v)
+    elif isinstance(v, (list, tuple)):
+        out.append(_T_LIST)
+        _write_varint(out, len(v))
+        for item in v:
+            _encode_into(out, item)
+    elif isinstance(v, dict):
+        out.append(_T_MAP)
+        _write_varint(out, len(v))
+        for k, val in v.items():
+            _encode_into(out, k)
+            _encode_into(out, val)
+    else:
+        raise TypeError(f"codec cannot encode {type(v).__name__}")
+
+
+def encode(v) -> bytes:
+    out = bytearray()
+    _encode_into(out, v)
+    return bytes(out)
+
+
+def _decode_from(buf: bytes, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_INT:
+        raw, pos = _read_varint(buf, pos)
+        return _unzigzag(raw), pos
+    if tag == _T_F64:
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if tag == _T_STR:
+        n, pos = _read_varint(buf, pos)
+        return buf[pos:pos + n].decode("utf-8"), pos + n
+    if tag == _T_BYTES:
+        n, pos = _read_varint(buf, pos)
+        return bytes(buf[pos:pos + n]), pos + n
+    if tag == _T_LIST:
+        n, pos = _read_varint(buf, pos)
+        items = []
+        for _ in range(n):
+            item, pos = _decode_from(buf, pos)
+            items.append(item)
+        return items, pos
+    if tag == _T_MAP:
+        n, pos = _read_varint(buf, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _decode_from(buf, pos)
+            val, pos = _decode_from(buf, pos)
+            d[k] = val
+        return d, pos
+    raise ValueError(f"codec: bad tag 0x{tag:02x} at {pos - 1}")
+
+
+def decode(buf: bytes):
+    v, pos = _decode_from(buf, 0)
+    if pos != len(buf):
+        raise ValueError(f"codec: {len(buf) - pos} trailing bytes")
+    return v

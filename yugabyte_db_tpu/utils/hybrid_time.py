@@ -37,7 +37,9 @@ class HybridTime:
     # -- constructors ------------------------------------------------------
     @staticmethod
     def from_micros(micros: int, logical: int = 0) -> "HybridTime":
-        return HybridTime((micros << BITS_FOR_LOGICAL) | (logical & LOGICAL_MASK))
+        # '+' (not '|') so a logical overflow carries into the physical
+        # component instead of silently wrapping backwards in time.
+        return HybridTime((micros << BITS_FOR_LOGICAL) + logical)
 
     @staticmethod
     def min() -> "HybridTime":
@@ -117,9 +119,14 @@ class HybridClock:
                 self._last = observed.value
 
     def max_global_now(self) -> HybridTime:
-        """Upper bound on any hybrid time issued anywhere (clock-skew bound)."""
-        # Single-process deployments have no skew; multi-node config adds it.
-        return self.now()
+        """Upper bound on any hybrid time issued anywhere (clock-skew bound).
+
+        Read-only: observing the bound must not issue a timestamp.
+        Single-process deployments have no skew; multi-node config adds it.
+        """
+        physical = self._now_micros() << BITS_FOR_LOGICAL
+        with self._lock:
+            return HybridTime(max(self._last, physical))
 
 
 class LogicalClock:

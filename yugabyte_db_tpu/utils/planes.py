@@ -59,6 +59,53 @@ def scalar_ht_planes(ht_value: int) -> tuple[int, int]:
     return int(hi[0]), int(lo[0])
 
 
+def i64_to_ordered_planes(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Signed int64 -> (hi, lo) int32 planes; signed-lex plane order == value order.
+
+    Sign-flips to u64 (v ^ 2^63) then bias-flips both 32-bit words.
+    """
+    u = values.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
+    hi = (u >> np.uint64(32)).astype(np.uint32)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return u32_to_plane(hi), u32_to_plane(lo)
+
+
+def ordered_planes_to_i64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    u = (plane_to_u32(hi).astype(np.uint64) << np.uint64(32)) | \
+        plane_to_u32(lo).astype(np.uint64)
+    return (u ^ np.uint64(1 << 63)).view(np.int64)
+
+
+def f64_to_ordered_planes(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """float64 -> (hi, lo) int32 planes; plane order == IEEE total order
+    (with -0.0 == 0.0 canonicalized). Same transform as the key encoding:
+    negative: flip all bits, else set sign bit."""
+    v = values.astype(np.float64).copy()
+    v[v == 0.0] = 0.0  # canonicalize -0.0
+    bits = v.view(np.uint64)
+    neg = (bits >> np.uint64(63)).astype(bool)
+    flipped = np.where(neg, ~bits, bits | np.uint64(1 << 63))
+    hi = (flipped >> np.uint64(32)).astype(np.uint32)
+    lo = (flipped & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return u32_to_plane(hi), u32_to_plane(lo)
+
+
+def ordered_planes_to_f64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    flipped = (plane_to_u32(hi).astype(np.uint64) << np.uint64(32)) | \
+        plane_to_u32(lo).astype(np.uint64)
+    neg = ~(flipped >> np.uint64(63)).astype(bool)
+    bits = np.where(neg, ~flipped, flipped & ~np.uint64(1 << 63))
+    return bits.view(np.float64)
+
+
+def varlen_prefix_planes(raws: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """First 8 bytes of each byte string -> (hi, lo) int32 planes whose
+    signed-lex order equals byte order on the 8-byte prefix. Equal planes are
+    a TIE (strings may differ past 8 bytes) — callers must host-verify."""
+    planes = key_prefix_planes(list(raws), num_words=2)
+    return planes[:, 0], planes[:, 1]
+
+
 def bytes_to_key_words(data: bytes, num_words: int) -> np.ndarray:
     """Key bytes -> fixed-width big-endian uint32 words, zero-padded.
 

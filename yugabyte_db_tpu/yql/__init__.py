@@ -1,0 +1,5 @@
+"""YQL API frontends: cql/ (Cassandra QL), redis/ (RESP), pgsql/ (YSQL).
+
+Reference analog: src/yb/yql — the query-language layer above the client
+(cql/ql parser+analyzer+executor, redisserver, pggate/postgres).
+"""
