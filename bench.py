@@ -1,0 +1,75 @@
+"""Headline benchmark: range-scan + aggregate rows/sec through the TPU
+storage engine vs the CPU engine baseline (BASELINE.json configs 1-3).
+
+Workload shape: TPC-H-Q6-flavored aggregate range scan (count/sum/min/max
+with a numeric predicate) over a YCSB-style KV table — the path where the
+reference walks DocRowwiseIterator/MergingIterator row by row
+(src/yb/docdb/doc_rowwise_iterator.cc:545) and this framework runs the
+MVCC-merge + filter + aggregate as one device program over columnar blocks.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+value = MVCC row versions scanned per second on the device engine and
+vs_baseline = speedup over the CPU oracle engine on identical data+query.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+NUM_KEYS = int(sys.argv[1]) if len(sys.argv) > 1 else 120_000
+TIMED_ITERS = 8
+
+
+def main():
+    from __graft_entry__ import _make_rows, _make_schema
+    from yugabyte_db_tpu.storage import AggSpec, Predicate, ScanSpec, make_engine
+    import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401 (registers 'tpu')
+
+    schema = _make_schema()
+    rows, max_ht = _make_rows(schema, NUM_KEYS)
+
+    tpu = make_engine("tpu", schema, {"rows_per_block": 2048})
+    tpu.apply(rows)
+    tpu.flush()
+
+    spec = ScanSpec(read_ht=max_ht + 1,
+                    predicates=[Predicate("d", ">=", -500_000)],
+                    aggregates=[AggSpec("count", None), AggSpec("sum", "a"),
+                                AggSpec("min", "a"), AggSpec("max", "a"),
+                                AggSpec("sum", "d")])
+
+    warm = tpu.scan(spec)           # compile + upload warmup
+    t0 = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        res = tpu.scan(spec)
+    tpu_dt = (time.perf_counter() - t0) / TIMED_ITERS
+    assert res.rows == warm.rows
+    versions = tpu.runs[0].crun.num_versions
+    tpu_rows_s = versions / tpu_dt
+
+    cpu = make_engine("cpu", schema)
+    cpu.apply(rows)
+    cpu.flush()
+    t0 = time.perf_counter()
+    cres = cpu.scan(spec)
+    cpu_dt = time.perf_counter() - t0
+    cpu_rows_s = versions / cpu_dt
+
+    for g, w in zip(res.rows[0], cres.rows[0]):
+        if isinstance(w, float):
+            assert g is not None and abs(g - w) <= 1e-3 + 1e-5 * abs(w), (g, w)
+        else:
+            assert g == w, (g, w)
+
+    print(json.dumps({
+        "metric": "aggregate_range_scan_rows_per_sec",
+        "value": round(tpu_rows_s, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(tpu_rows_s / cpu_rows_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,312 @@
+"""Sharded multi-tablet aggregate: shard_map over a ("t", "b") mesh.
+
+Layout: every tablet's ColumnarRun planes are stacked to [T, B, R, ...] and
+placed with NamedSharding(P("t", "b")) — tablets split over the "t" mesh
+axis (data parallel; the reference's unit of sharding, one tablet per
+scanning thread at best), blocks of each tablet split over "b" (sequence
+parallel; no reference analog — a tablet scan there is strictly
+single-threaded). Each device fori_loops scan windows over its local
+(tablet, block-range) shard reusing ops.scan.scan_window, folds exact
+per-block aggregate partials into carry-safe accumulators, and the final
+combine rides ICI collectives:
+
+- count / n / fsum: ``psum`` over both axes;
+- integer sums: base-2^16 digit vectors (int32) with a carry-propagation
+  step per window so digits never overflow int32, ``psum``-ed then
+  recombined host-side in arbitrary precision — bit-exact at any scale;
+- min/max: two-int32-plane lexicographic maxima via a two-step collective
+  (pmax on the high plane, then pmax on the tie-masked low plane).
+
+Group/window invariant: key groups never span blocks (storage.columnar
+build invariant), so any contiguous block range — in particular a device's
+"b"-shard — is segment-complete and partials add up exactly.
+
+Reference analog of the combine being replaced: the client-side merge of
+per-tablet partial aggregates (src/yb/yql/cql/ql/exec/eval_aggr.cc,
+src/yb/docdb/pgsql_operation.cc:473).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.ops import scan as dscan
+from yugabyte_db_tpu.ops.agg_fold import (agg_init, check_limb_bound,
+                                          finalize, fold_window, lower_aggs,
+                                          pred_literal)
+from yugabyte_db_tpu.ops.scan import I32_MAX, I32_MIN
+from yugabyte_db_tpu.storage.columnar import ColumnarRun
+from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+from yugabyte_db_tpu.utils import planes as PL
+
+
+# -- host-side assembly ------------------------------------------------------
+
+class ShardedTablets:
+    """Stacked, mesh-sharded device residency for T tablets' single runs.
+
+    Each tablet contributes one ColumnarRun (compact first); runs are padded
+    to a common block count divisible by mesh_b * window and stacked to
+    [T, B, R, ...]. Dummy all-invalid tablets pad T to a multiple of mesh_t.
+    """
+
+    def __init__(self, schema: Schema, runs: list[ColumnarRun], mesh: Mesh,
+                 window_blocks: int = 8):
+        if not runs:
+            raise ValueError("need at least one run")
+        R = runs[0].R
+        if any(r.R != R for r in runs):
+            raise ValueError("all runs must share rows_per_block")
+        self.schema = schema
+        self.mesh = mesh
+        self.K = window_blocks
+        self.R = R
+        mesh_t = mesh.shape["t"]
+        mesh_b = mesh.shape["b"]
+        self.T = len(runs)
+        self.runs = runs
+        pad_t = (-self.T) % mesh_t
+        chunk = mesh_b * window_blocks
+        Bmax = max(r.B for r in runs)
+        self.B = Bmax + ((-Bmax) % chunk)
+        self.Bl = self.B // mesh_b
+        if self.Bl % window_blocks:
+            raise AssertionError("local block count not a window multiple")
+
+        stacked = self._stack(runs, pad_t)
+        spec_tb = P("t", "b")
+        self.arrays = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, spec_tb)), stacked)
+        self.padded_T = self.T + pad_t
+
+    def _stack(self, runs, pad_t):
+        B, R = self.B, self.R
+        T = len(runs) + pad_t
+
+        def alloc(shape, dtype, fill=0):
+            return np.full((T, B) + shape, fill, dtype=dtype)
+
+        out = {
+            "valid": alloc((R,), bool, False),
+            # pad rows are their own groups so they never join a real one
+            "group_start": alloc((R,), bool, True),
+            "tomb": alloc((R,), bool, False),
+            "live": alloc((R,), bool, False),
+            "ht_hi": alloc((R,), np.int32),
+            "ht_lo": alloc((R,), np.int32),
+            "exp_hi": alloc((R,), np.int32),
+            "exp_lo": alloc((R,), np.int32),
+            "cols": {},
+        }
+        for c in self.schema.value_columns:
+            nplanes = runs[0].cols[c.col_id].cmp_planes.shape[-1]
+            entry = {
+                "set": alloc((R,), bool, False),
+                "isnull": alloc((R,), bool, False),
+                "cmp": alloc((R, nplanes), np.int32),
+            }
+            if runs[0].cols[c.col_id].arith is not None:
+                entry["arith"] = alloc((R,), np.float32)
+            out["cols"][c.col_id] = entry
+        for t, run in enumerate(runs):
+            b = run.B
+            out["valid"][t, :b] = run.valid
+            out["group_start"][t, :b] = run.group_start
+            out["tomb"][t, :b] = run.tomb
+            out["live"][t, :b] = run.live
+            out["ht_hi"][t, :b] = run.ht_hi
+            out["ht_lo"][t, :b] = run.ht_lo
+            out["exp_hi"][t, :b] = run.exp_hi
+            out["exp_lo"][t, :b] = run.exp_lo
+            for cid, col in run.cols.items():
+                e = out["cols"][cid]
+                e["set"][t, :b] = col.set_
+                e["isnull"][t, :b] = col.isnull
+                e["cmp"][t, :b] = col.cmp_planes
+                if col.arith is not None:
+                    e["arith"][t, :b] = col.arith
+        return out
+
+    # -- per-tablet exact row bounds (host bisection over full key bytes) ---
+    def row_bounds(self, lower: bytes, upper: bytes):
+        lo = np.zeros(self.padded_T, dtype=np.int32)
+        hi = np.zeros(self.padded_T, dtype=np.int32)
+        for t, run in enumerate(self.runs):
+            lo[t] = run.lower_row(lower)
+            hi[t] = run.upper_row(upper)
+        return lo, hi
+
+
+# -- the device program ------------------------------------------------------
+
+def _lex_collective_ext(hi, lo, is_max, axes):
+    """Lexicographic (hi, lo) extreme across mesh axes: pmax the high plane,
+    then pmax the low plane masked to high-plane ties."""
+    red = jax.lax.pmax if is_max else jax.lax.pmin
+    fill = I32_MIN if is_max else I32_MAX
+    mhi = red(hi, axes)
+    mlo = red(jnp.where(hi == mhi, lo, fill), axes)
+    return mhi, mlo
+
+
+def _combine_across_mesh(sig_aggs, acc, scanned, axes=("t", "b")):
+    out = []
+    for ag, a in zip(sig_aggs, acc):
+        if ag.fn == "count":
+            out.append({"count": jax.lax.psum(a["count"], axes)})
+        elif ag.fn == "sum":
+            if ag.kind in ("f32", "f64"):
+                out.append({"fsum": jax.lax.psum(a["fsum"], axes),
+                            "fcomp": jax.lax.psum(a["fcomp"], axes),
+                            "n": jax.lax.psum(a["n"], axes)})
+            else:
+                out.append({"digits": jax.lax.psum(a["digits"], axes),
+                            "n": jax.lax.psum(a["n"], axes)})
+        else:
+            is_max = ag.fn == "max"
+            n = jax.lax.psum(a["n"], axes)
+            if ag.kind == "f32":
+                red = jax.lax.pmax if is_max else jax.lax.pmin
+                out.append({"fext": red(a["fext"], axes), "n": n})
+            elif ag.kind == "i32":
+                red = jax.lax.pmax if is_max else jax.lax.pmin
+                out.append({"ext": red(a["ext"], axes), "n": n})
+            else:
+                mhi, mlo = _lex_collective_ext(a["ext_hi"], a["ext_lo"],
+                                               is_max, axes)
+                out.append({"ext_hi": mhi, "ext_lo": mlo, "n": n})
+    return out, jax.lax.psum(scanned, axes)
+
+
+def _shard_body(sig: dscan.ScanSig, Tl: int, Bl: int, R: int,
+                run, row_lo, row_hi, read_hi, read_lo, rexp_hi, rexp_lo,
+                pred_lits):
+    """Runs on one device over its [Tl, Bl, R] shard. Returns replicated
+    combined aggregate partials + scanned-row count."""
+    K = sig.K
+    W = Bl // K
+    block_off = jax.lax.axis_index("b") * Bl
+    # Loop carries become device-varying inside the loop body; mark the
+    # replicated initial values as varying so the carry types match.
+    varying = lambda x: jax.lax.pcast(x, ("t", "b"), to="varying")
+    acc = jax.tree.map(varying, agg_init(sig.aggs))
+    scanned = varying(jnp.int32(0))
+    for t in range(Tl):
+        local = jax.tree.map(lambda a: a[t], run)
+        lo_t, hi_t = row_lo[t], row_hi[t]
+        body = functools.partial(
+            fold_window, sig, local, row_lo=lo_t, row_hi=hi_t,
+            read_planes=(read_hi, read_lo, rexp_hi, rexp_lo),
+            pred_lits=pred_lits, block_off=block_off)
+        # Local window bounds: only windows of this shard overlapping the
+        # tablet's row range (floor division is floor for negatives too).
+        w_first = jnp.clip((lo_t // R - block_off) // K, 0, W)
+        w_last = jnp.clip(((hi_t - 1) // R - block_off) // K + 1, 0, W)
+        acc, scanned = jax.lax.fori_loop(
+            w_first, w_last, lambda w, c: body(w, c), (acc, scanned))
+    return _combine_across_mesh(sig.aggs, acc, scanned)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_dist_agg(sig: dscan.ScanSig, mesh: Mesh, Tl: int, Bl: int):
+    """One jitted shard_map program per (scan signature, mesh). Mesh is
+    hashable and the cache entry keeps it alive only until eviction."""
+    spec_tb = P("t", "b")
+    in_specs = (
+        _run_specs(sig, spec_tb),  # stacked run pytree
+        P("t"), P("t"),            # row bounds
+        P(), P(), P(), P(),        # read/expiry planes
+        P(),                       # predicate literals (replicated)
+    )
+    body = functools.partial(_shard_body, sig, Tl, Bl, sig.R)
+    smapped = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                            out_specs=(_acc_specs(sig), P()))
+    return jax.jit(smapped)
+
+
+def _run_specs(sig, spec_tb):
+    cols = {}
+    for cs in sig.cols:
+        entry = {"set": spec_tb, "isnull": spec_tb, "cmp": spec_tb}
+        if cs.kind != "str":
+            entry["arith"] = spec_tb
+        cols[cs.col_id] = entry
+    return {
+        "valid": spec_tb, "group_start": spec_tb, "tomb": spec_tb,
+        "live": spec_tb, "ht_hi": spec_tb, "ht_lo": spec_tb,
+        "exp_hi": spec_tb, "exp_lo": spec_tb, "cols": cols,
+    }
+
+
+def _acc_specs(sig):
+    return [jax.tree.map(lambda _: P(), a)
+            for a in agg_init(sig.aggs)]
+
+
+# -- public API --------------------------------------------------------------
+
+def sharded_aggregate(st: ShardedTablets, spec: ScanSpec) -> ScanResult:
+    """Evaluate spec's aggregates over all tablets on the mesh.
+
+    Constraints (callers fall back to the per-tablet host path otherwise):
+    aggregate-only spec, no GROUP BY, device-exact predicates only
+    (non-key i32/i64/f64 columns), numeric aggregate columns.
+    """
+    if not spec.is_aggregate or spec.group_by:
+        raise ValueError("sharded_aggregate handles plain aggregate specs")
+    schema = st.schema
+    name_to_id = {c.name: c.col_id for c in schema.value_columns}
+    kinds = {c.col_id: _kind(c) for c in schema.value_columns}
+    key_names = {c.name for c in schema.key_columns}
+
+    pred_sigs, pred_lits = [], []
+    for p in spec.predicates:
+        if p.column in key_names or p.op == "IN":
+            raise ValueError(f"predicate on {p.column} not device-exact")
+        cid = name_to_id[p.column]
+        if kinds[cid] in ("str", "f32"):
+            raise ValueError(f"predicate kind {kinds[cid]} not device-exact")
+        pred_sigs.append(dscan.PredSig(cid, kinds[cid], p.op))
+        pred_lits.append(pred_literal(kinds[cid], p.value))
+
+    for a in spec.aggregates:
+        if a.column and a.column not in name_to_id:
+            raise ValueError(f"aggregate on key column {a.column}")
+        if a.column and kinds[name_to_id[a.column]] == "str" and a.fn != "count":
+            raise ValueError("string min/max needs the host path")
+    dev_aggs, lowering = lower_aggs(spec.aggregates, name_to_id, kinds)
+
+    check_limb_bound(st.R, st.K)
+    col_sigs = tuple(dscan.ColSig(c.col_id, kinds[c.col_id])
+                     for c in schema.value_columns)
+    sig = dscan.ScanSig(B=st.B, R=st.R, K=st.K, cols=col_sigs,
+                        preds=tuple(pred_sigs), aggs=dev_aggs,
+                        apply_preds=True)
+
+    lo, hi = st.row_bounds(spec.lower, spec.upper)
+    from yugabyte_db_tpu.storage.row_version import MAX_HT
+    r_hi, r_lo = PL.scalar_ht_planes(min(spec.read_ht, MAX_HT))
+    e_hi, e_lo = PL.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
+
+    Tl = st.padded_T // st.mesh.shape["t"]
+    fn = _compiled_dist_agg(sig, st.mesh, Tl, st.Bl)
+    acc, scanned = fn(st.arrays, jnp.asarray(lo), jnp.asarray(hi),
+                      jnp.int32(r_hi), jnp.int32(r_lo),
+                      jnp.int32(e_hi), jnp.int32(e_lo), tuple(pred_lits))
+
+    out_row, names = [], []
+    for a, (fn_name, di) in zip(spec.aggregates, lowering):
+        names.append(f"{a.fn}({a.column or '*'})")
+        out_row.append(finalize(dev_aggs[di], acc[di], fn_name))
+    return ScanResult(names, [tuple(out_row)], None, int(scanned))
+
+
+def _kind(c):
+    from yugabyte_db_tpu.ops.device_run import dtype_kind
+    return dtype_kind(c.dtype)

@@ -30,11 +30,13 @@ IntentAwareIterator merging regular/provisional sources
 
 from __future__ import annotations
 
-import numpy as np
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.ops import agg_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.device_run import DeviceRun, dtype_kind
 from yugabyte_db_tpu.storage.columnar import ColumnarRun
@@ -48,7 +50,6 @@ from yugabyte_db_tpu.utils import planes as P
 
 WINDOW_BLOCKS = 8          # blocks per device dispatch on the row path
 PAD_BLOCKS = 64            # run block-axis padding (multiple of every window)
-AGG_WINDOW_BLOCKS = 64     # blocks per dispatch on the aggregate path
 
 
 class TpuRun:
@@ -313,150 +314,39 @@ class TpuStorageEngine(StorageEngine):
 
     # -- device aggregate path ---------------------------------------------
     def _device_aggregate(self, trun: TpuRun, spec: ScanSpec, exact_preds):
+        """Single-dispatch full-run aggregate: the device fori_loops every
+        window and returns two packed vectors (ops.agg_fold) — one dispatch
+        plus two small transfers per scan, because the host link pays
+        per-transfer latency (see ops/agg_fold.py docstring)."""
         crun = trun.crun
         row_lo = crun.lower_row(spec.lower)
         row_hi = crun.upper_row(spec.upper)
         pred_sigs, pred_lits = self._pred_sig_and_literals(exact_preds)
+        dev_aggs, lowering = agg_fold.lower_aggs(
+            spec.aggregates, self._name_to_id, self._kinds)
 
-        # Lower each AggSpec to device ops: avg = sum + count.
-        dev_aggs: list[dscan.AggSig] = []
-        lowering: list[tuple] = []  # (fn, indices into dev_aggs)
-        for a in spec.aggregates:
-            cid = self._name_to_id.get(a.column) if a.column else None
-            kind = self._kinds[cid] if cid is not None else None
-            if a.fn == "count":
-                lowering.append(("count", len(dev_aggs)))
-                dev_aggs.append(dscan.AggSig("count", cid, kind))
-            elif a.fn in ("sum", "min", "max"):
-                lowering.append((a.fn, len(dev_aggs)))
-                dev_aggs.append(dscan.AggSig(a.fn, cid, kind))
-            else:  # avg
-                lowering.append(("avg", len(dev_aggs)))
-                dev_aggs.append(dscan.AggSig("sum", cid, kind))
-
-        R, K = crun.R, AGG_WINDOW_BLOCKS
+        R = crun.R
+        K = agg_fold.safe_window_blocks(R, agg_fold.FULL_WINDOW_BLOCKS)
         sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
-                            preds=pred_sigs, aggs=tuple(dev_aggs),
-                            apply_preds=True)
-        fn = dscan.compiled_scan(sig)
+                            preds=pred_sigs, aggs=dev_aggs, apply_preds=True)
+        W = trun.dev.B // K
+        w_first, w_last = agg_fold.window_bounds(row_lo, row_hi, R, K, W)
+        fn = agg_fold.compiled_full_aggregate(sig)
         r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
+        ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo), jnp.int32(row_hi),
+                        jnp.int32(w_first), jnp.int32(w_last),
+                        r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
+        iv, fv = jax.device_get([ivec, fvec])
+        acc, scanned = agg_fold.unpack(dev_aggs, iv, fv)
 
-        acc = [_AggAcc(a) for a in dev_aggs]
-        scanned = 0
-        if row_lo < row_hi:
-            b_first = (row_lo // R) // K * K
-            b_last = ((row_hi - 1) // R) // K * K
-            for b0 in range(b_first, b_last + 1, K):
-                base = b0 * R
-                res = fn(trun.dev.arrays, jnp.int32(b0),
-                         jnp.int32(np.clip(row_lo - base, -(1 << 30), 1 << 30)),
-                         jnp.int32(np.clip(row_hi - base, -(1 << 30), 1 << 30)),
-                         r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
-                scanned += int(np.asarray(res["result"]).sum())
-                for i, a in enumerate(acc):
-                    a.absorb({k.split("_", 1)[1]: v for k, v in res.items()
-                              if k.split("_", 1)[0] == f"agg{i}"})
-
-        out_row = []
-        names = []
+        out_row, names = [], []
         for a, (fn_name, di) in zip(spec.aggregates, lowering):
             names.append(f"{a.fn}({a.column or '*'})")
-            if fn_name == "count":
-                out_row.append(acc[di].count_value())
-            elif fn_name == "sum":
-                out_row.append(acc[di].sum_value())
-            elif fn_name in ("min", "max"):
-                out_row.append(acc[di].ext_value())
-            else:  # avg
-                s = acc[di].sum_value()
-                n = acc[di].n
-                out_row.append(None if not n else s / n)
+            out_row.append(agg_fold.finalize(dev_aggs[di], acc[di], fn_name))
         return ScanResult(names, [tuple(out_row)], None, scanned)
 
 
-class _AggAcc:
-    """Host-side exact combine of per-window device partials."""
-
-    def __init__(self, sig: dscan.AggSig):
-        self.sig = sig
-        self.n = 0
-        self.count = 0
-        self.limb_total = 0       # Σ limbs·2^16j (biased)
-        self.fsum = 0.0
-        self.ext_planes = None    # (hi, lo) or scalar plane
-        self.fext = None
-
-    def absorb(self, parts: dict) -> None:
-        s = self.sig
-        if s.fn == "count":
-            self.count += int(parts["count"])
-            return
-        n = int(parts["n"])
-        self.n += n
-        if s.fn == "sum":
-            if s.kind in ("f32", "f64"):
-                self.fsum += float(np.asarray(parts["fsum"], dtype=np.float64).sum())
-            else:
-                limbs = np.asarray(parts["limbs"], dtype=np.int64).sum(axis=0)
-                self.limb_total += sum(int(limbs[j]) << (16 * j) for j in range(4))
-            return
-        if n == 0:
-            return
-        better = max if s.fn == "max" else min
-        if s.kind == "f32":
-            v = float(parts["fext"])
-            self.fext = v if self.fext is None else better(self.fext, v)
-        elif s.kind == "i32":
-            v = int(parts["ext"])
-            self.fext = v if self.fext is None else better(self.fext, v)
-        else:
-            hi, lo = int(parts["ext_hi"]), int(parts["ext_lo"])
-            if self.ext_planes is None:
-                self.ext_planes = (hi, lo)
-            else:
-                cur = self.ext_planes
-                if s.fn == "max":
-                    self.ext_planes = max(cur, (hi, lo))
-                else:
-                    self.ext_planes = min(cur, (hi, lo))
-
-    def count_value(self) -> int:
-        return self.count
-
-    def sum_value(self):
-        if self.n == 0:
-            return None
-        if self.sig.kind in ("f32", "f64"):
-            return self.fsum
-        bias = (1 << 63) if self.sig.kind == "i64" else (1 << 31)
-        return self.limb_total - self.n * bias
-
-    def ext_value(self):
-        if self.n == 0:
-            return None
-        if self.sig.kind in ("f32", "i32"):
-            return self.fext
-        hi = np.array([self.ext_planes[0]], dtype=np.int32)
-        lo = np.array([self.ext_planes[1]], dtype=np.int32)
-        if self.sig.kind == "i64":
-            return int(P.ordered_planes_to_i64(hi, lo)[0])
-        return float(P.ordered_planes_to_f64(hi, lo)[0])
-
-
-def _literal(kind: str, value):
-    if kind == "i32":
-        return jnp.int32(int(value) if not isinstance(value, bool) else int(value))
-    if kind == "f32":
-        return jnp.float32(value)
-    if kind == "i64":
-        hi, lo = P.i64_to_ordered_planes(np.array([int(value)], dtype=np.int64))
-        return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
-    if kind == "f64":
-        hi, lo = P.f64_to_ordered_planes(np.array([value], dtype=np.float64))
-        return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
-    raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
-    hi, lo = P.varlen_prefix_planes([raw])
-    return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
+_literal = agg_fold.pred_literal
 
 
 register_engine("tpu", TpuStorageEngine)
