@@ -74,6 +74,21 @@ class StatusError(Exception):
         self.status = status
 
 
+class InvalidArgument(StatusError):
+    def __init__(self, message: str):
+        super().__init__(Status(Code.INVALID_ARGUMENT, message))
+
+
+class NotFound(StatusError):
+    def __init__(self, message: str):
+        super().__init__(Status(Code.NOT_FOUND, message))
+
+
+class AlreadyPresent(StatusError):
+    def __init__(self, message: str):
+        super().__init__(Status(Code.ALREADY_PRESENT, message))
+
+
 OK = Status()
 
 

@@ -1,0 +1,113 @@
+"""CQL parse-tree nodes.
+
+Reference analog: the PT* node hierarchy of src/yb/yql/cql/ql/ptree/
+(pt_select.h, pt_insert.h, pt_update.h, pt_delete.h, pt_create_table.h,
+pt_create_keyspace.h, ...). Statements parse into these dataclasses, the
+processor's binder resolves names against the catalog, and the executor
+lowers them to storage operations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.models.datatypes import DataType
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    dtype: DataType
+    is_static: bool = False
+
+
+@dataclass
+class CreateKeyspace:
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropKeyspace:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class UseKeyspace:
+    name: str
+
+
+@dataclass
+class CreateTable:
+    name: str                      # possibly keyspace-qualified "ks.t"
+    columns: list[ColumnDef]
+    hash_keys: list[str]
+    range_keys: list[str]
+    if_not_exists: bool = False
+    properties: dict = field(default_factory=dict)  # WITH k = v (tablets=N)
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Relation:
+    """column <op> literal (op: = != < <= > >= IN)."""
+
+    column: str
+    op: str
+    value: object
+
+
+@dataclass
+class SelectItem:
+    """A projection item: a column, or an aggregate fn over a column/'*'."""
+
+    column: str | None          # None for fn(*)
+    agg_fn: str | None = None   # count/sum/min/max/avg or None for plain col
+    alias: str | None = None
+
+    @property
+    def output_name(self) -> str:
+        if self.alias:
+            return self.alias
+        if self.agg_fn:
+            return f"{self.agg_fn}({self.column or '*'})"
+        return self.column
+
+
+@dataclass
+class Select:
+    table: str
+    items: list[SelectItem] | None   # None = '*'
+    where: list[Relation] = field(default_factory=list)
+    limit: int | None = None
+    allow_filtering: bool = False
+
+
+@dataclass
+class Insert:
+    table: str
+    columns: list[str]
+    values: list[object]
+    ttl_seconds: int | None = None
+    if_not_exists: bool = False
+
+
+@dataclass
+class Update:
+    table: str
+    assignments: list[tuple[str, object]]
+    where: list[Relation]
+    ttl_seconds: int | None = None
+
+
+@dataclass
+class Delete:
+    table: str
+    where: list[Relation]
+    columns: list[str] | None = None   # DELETE col[, col] FROM — col deletes

@@ -1,0 +1,381 @@
+"""CQL recursive-descent parser (tokenizer + statement grammar).
+
+Reference analog: the Bison/Flex grammar of src/yb/yql/cql/ql/parser/
+(parser_gram.y, scanner_lex.l). The reference generates a ~30-statement
+grammar; this covers the DDL/DML core (CREATE/DROP KEYSPACE|TABLE, USE,
+INSERT, SELECT incl. aggregates, UPDATE, DELETE) and grows per statement.
+"""
+
+from __future__ import annotations
+
+import re
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.storage.scan_spec import AGG_FNS as _AGG_FN_TUPLE
+from yugabyte_db_tpu.utils.status import InvalidArgument
+from yugabyte_db_tpu.yql.cql import ast
+
+AGG_FNS = frozenset(_AGG_FN_TUPLE)
+
+_TOKEN_RE = re.compile(r"""
+    \s+
+  | (?P<comment>--[^\n]*|//[^\n]*)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<blob>0[xX][0-9a-fA-F]*)
+  | (?P<number>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\.\d+|-?\d+[eE][+-]?\d+|-?\d+)
+  | (?P<name>[A-Za-z_][A-Za-z_0-9]*|"(?:[^"]|"")*")
+  | (?P<op><=|>=|!=|=|<|>)
+  | (?P<sym>[(),.;*?{}:])
+""", re.VERBOSE)
+
+
+class Token:
+    __slots__ = ("kind", "text")
+
+    def __init__(self, kind, text):
+        self.kind = kind
+        self.text = text
+
+    def __repr__(self):
+        return f"{self.kind}:{self.text}"
+
+
+def tokenize(sql: str) -> list[Token]:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise InvalidArgument(f"CQL syntax error near {sql[pos:pos+20]!r}")
+        pos = m.end()
+        for kind in ("string", "blob", "number", "name", "op", "sym"):
+            text = m.group(kind)
+            if text is not None:
+                out.append(Token(kind, text))
+                break
+    return out
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self) -> Token | None:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Token:
+        t = self.peek()
+        if t is None:
+            raise InvalidArgument("unexpected end of statement")
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws) -> bool:
+        t = self.peek()
+        return (t is not None and t.kind == "name"
+                and t.text.upper() in kws)
+
+    def take_kw(self, *kws) -> bool:
+        if self.at_kw(*kws):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.take_kw(kw):
+            raise InvalidArgument(f"expected {kw}, got {self.peek()}")
+
+    def at_sym(self, s: str) -> bool:
+        t = self.peek()
+        return t is not None and t.kind in ("sym", "op") and t.text == s
+
+    def take_sym(self, s: str) -> bool:
+        if self.at_sym(s):
+            self.i += 1
+            return True
+        return False
+
+    def expect_sym(self, s: str) -> None:
+        if not self.take_sym(s):
+            raise InvalidArgument(f"expected {s!r}, got {self.peek()}")
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind != "name":
+            raise InvalidArgument(f"expected identifier, got {t}")
+        if t.text.startswith('"'):
+            return t.text[1:-1].replace('""', '"')
+        return t.text.lower()
+
+    def qualified_name(self) -> str:
+        name = self.ident()
+        if self.take_sym("."):
+            return f"{name}.{self.ident()}"
+        return name
+
+    def literal(self):
+        t = self.next()
+        if t.kind == "string":
+            return t.text[1:-1].replace("''", "'")
+        if t.kind == "blob":
+            hexpart = t.text[2:]
+            if len(hexpart) % 2:
+                raise InvalidArgument(f"odd-length blob literal {t.text}")
+            return bytes.fromhex(hexpart)
+        if t.kind == "number":
+            txt = t.text
+            if any(c in txt for c in ".eE"):
+                return float(txt)
+            return int(txt)
+        if t.kind == "name":
+            up = t.text.upper()
+            if up == "TRUE":
+                return True
+            if up == "FALSE":
+                return False
+            if up == "NULL":
+                return None
+        raise InvalidArgument(f"expected literal, got {t}")
+
+    # -- statements --------------------------------------------------------
+    def parse(self):
+        t = self.peek()
+        if t is None:
+            raise InvalidArgument("empty statement")
+        kw = t.text.upper() if t.kind == "name" else ""
+        fn = {
+            "CREATE": self._create, "DROP": self._drop, "USE": self._use,
+            "INSERT": self._insert, "SELECT": self._select,
+            "UPDATE": self._update, "DELETE": self._delete,
+        }.get(kw)
+        if fn is None:
+            raise InvalidArgument(f"unsupported statement {t.text!r}")
+        stmt = fn()
+        self.take_sym(";")
+        if self.peek() is not None:
+            raise InvalidArgument(f"trailing tokens at {self.peek()}")
+        return stmt
+
+    def _if_not_exists(self) -> bool:
+        if self.take_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _if_exists(self) -> bool:
+        if self.take_kw("IF"):
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _create(self):
+        self.expect_kw("CREATE")
+        if self.take_kw("KEYSPACE", "SCHEMA"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            self._skip_with()
+            return ast.CreateKeyspace(name, ine)
+        self.expect_kw("TABLE")
+        ine = self._if_not_exists()
+        name = self.qualified_name()
+        cols, hash_keys, range_keys = self._table_body()
+        props = self._with_properties()
+        return ast.CreateTable(name, cols, hash_keys, range_keys, ine, props)
+
+    def _table_body(self):
+        self.expect_sym("(")
+        cols: list[ast.ColumnDef] = []
+        hash_keys: list[str] = []
+        range_keys: list[str] = []
+        while True:
+            if self.at_kw("PRIMARY"):
+                self.next()
+                self.expect_kw("KEY")
+                self.expect_sym("(")
+                if self.take_sym("("):   # ((h1, h2), r1, ...)
+                    hash_keys.append(self.ident())
+                    while self.take_sym(","):
+                        hash_keys.append(self.ident())
+                    self.expect_sym(")")
+                else:                     # (h1, r1, ...)
+                    hash_keys.append(self.ident())
+                while self.take_sym(","):
+                    range_keys.append(self.ident())
+                self.expect_sym(")")
+            else:
+                cname = self.ident()
+                dtype = self._type()
+                is_static = bool(self.take_kw("STATIC"))
+                if self.take_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    hash_keys.append(cname)
+                cols.append(ast.ColumnDef(cname, dtype, is_static))
+            if not self.take_sym(","):
+                break
+        self.expect_sym(")")
+        if not hash_keys:
+            raise InvalidArgument("table needs a primary key")
+        return cols, hash_keys, range_keys
+
+    def _type(self) -> DataType:
+        name = self.ident()
+        try:
+            return DataType.parse(name)
+        except ValueError as e:
+            raise InvalidArgument(str(e))
+
+    def _with_properties(self) -> dict:
+        props = {}
+        if self.take_kw("WITH"):
+            while True:
+                key = self.ident()
+                self.expect_sym("=")
+                props[key] = self.literal()
+                if not self.take_kw("AND"):
+                    break
+        return props
+
+    def _skip_with(self):
+        # CREATE KEYSPACE ... WITH replication = {...}: accept and ignore.
+        if self.take_kw("WITH"):
+            while self.peek() is not None and not self.at_sym(";"):
+                self.next()
+
+    def _drop(self):
+        self.expect_kw("DROP")
+        if self.take_kw("KEYSPACE", "SCHEMA"):
+            ie = self._if_exists()
+            return ast.DropKeyspace(self.ident(), ie)
+        self.expect_kw("TABLE")
+        ie = self._if_exists()
+        return ast.DropTable(self.qualified_name(), ie)
+
+    def _use(self):
+        self.expect_kw("USE")
+        return ast.UseKeyspace(self.ident())
+
+    def _insert(self):
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.qualified_name()
+        self.expect_sym("(")
+        columns = [self.ident()]
+        while self.take_sym(","):
+            columns.append(self.ident())
+        self.expect_sym(")")
+        self.expect_kw("VALUES")
+        self.expect_sym("(")
+        values = [self.literal()]
+        while self.take_sym(","):
+            values.append(self.literal())
+        self.expect_sym(")")
+        ine = self._if_not_exists()
+        ttl = self._using_ttl()
+        if len(columns) != len(values):
+            raise InvalidArgument("column/value count mismatch")
+        return ast.Insert(table, columns, values, ttl, ine)
+
+    def _using_ttl(self):
+        if self.take_kw("USING"):
+            self.expect_kw("TTL")
+            ttl = self.literal()
+            if not isinstance(ttl, int) or ttl < 0:
+                raise InvalidArgument("TTL must be a non-negative integer")
+            return ttl
+        return None
+
+    def _select(self):
+        self.expect_kw("SELECT")
+        items = None
+        if not self.take_sym("*"):
+            items = [self._select_item()]
+            while self.take_sym(","):
+                items.append(self._select_item())
+        self.expect_kw("FROM")
+        table = self.qualified_name()
+        where = self._where_opt()
+        limit = None
+        if self.take_kw("LIMIT"):
+            limit = self.literal()
+            if not isinstance(limit, int) or limit < 0:
+                raise InvalidArgument("LIMIT must be a non-negative integer")
+        allow = False
+        if self.take_kw("ALLOW"):
+            self.expect_kw("FILTERING")
+            allow = True
+        return ast.Select(table, items, where, limit, allow)
+
+    def _select_item(self) -> ast.SelectItem:
+        name = self.ident()
+        if name in AGG_FNS and self.at_sym("("):
+            self.next()
+            col = None if self.take_sym("*") else self.ident()
+            self.expect_sym(")")
+            item = ast.SelectItem(col, agg_fn=name)
+        else:
+            item = ast.SelectItem(name)
+        if self.take_kw("AS"):
+            item.alias = self.ident()
+        return item
+
+    def _where_opt(self) -> list[ast.Relation]:
+        if not self.take_kw("WHERE"):
+            return []
+        return self._relation_list()
+
+    def _where_required(self) -> list[ast.Relation]:
+        self.expect_kw("WHERE")
+        return self._relation_list()
+
+    def _relation_list(self) -> list[ast.Relation]:
+        rels = [self._relation()]
+        while self.take_kw("AND"):
+            rels.append(self._relation())
+        return rels
+
+    def _relation(self) -> ast.Relation:
+        col = self.ident()
+        t = self.next()
+        if t.kind == "name" and t.text.upper() == "IN":
+            self.expect_sym("(")
+            vals = [self.literal()]
+            while self.take_sym(","):
+                vals.append(self.literal())
+            self.expect_sym(")")
+            return ast.Relation(col, "IN", tuple(vals))
+        if t.kind != "op":
+            raise InvalidArgument(f"expected comparison operator, got {t}")
+        return ast.Relation(col, t.text, self.literal())
+
+    def _update(self):
+        self.expect_kw("UPDATE")
+        table = self.qualified_name()
+        ttl = self._using_ttl()
+        self.expect_kw("SET")
+        assigns = [self._assignment()]
+        while self.take_sym(","):
+            assigns.append(self._assignment())
+        return ast.Update(table, assigns, self._where_required(), ttl)
+
+    def _assignment(self):
+        col = self.ident()
+        self.expect_sym("=")
+        return (col, self.literal())
+
+    def _delete(self):
+        self.expect_kw("DELETE")
+        columns = None
+        if not self.at_kw("FROM"):
+            columns = [self.ident()]
+            while self.take_sym(","):
+                columns.append(self.ident())
+        self.expect_kw("FROM")
+        table = self.qualified_name()
+        return ast.Delete(table, self._where_required(), columns)
+
+
+def parse_statement(sql: str):
+    """Parse one CQL statement -> ast node."""
+    return Parser(sql).parse()

@@ -1,0 +1,546 @@
+"""QLProcessor: parse -> bind -> execute CQL against tablets.
+
+Reference analog: ql::QLProcessor (src/yb/yql/cql/ql/ql_processor.h:55) with
+its Prepare (parse+analyze) and Execute phases; execution lowers statements
+to per-tablet read/write operations the way exec/executor.cc builds
+QLReadRequestPB/QLWriteRequestPB and routes them through the client
+(Batcher groups ops per tablet, src/yb/client/batcher.h:80).
+
+The storage seam here is the ``Cluster`` protocol (create/drop/route/scan);
+``LocalCluster`` runs tablets in-process (the MiniCluster test shape), and
+the distributed client implements the same surface on top of the master
+catalog + tserver RPCs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.models.datatypes import DataType, python_value_matches
+from yugabyte_db_tpu.models.encoding import (encode_doc_key_prefix,
+                                             encode_key_component,
+                                             prefix_successor)
+from yugabyte_db_tpu.models.partition import (PartitionSchema,
+                                              compute_hash_code)
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+from yugabyte_db_tpu.storage.scan_spec import AggSpec, Predicate, ScanSpec
+from yugabyte_db_tpu.tablet.tablet import Tablet, TabletMetadata
+from yugabyte_db_tpu.utils.hybrid_time import HybridClock
+from yugabyte_db_tpu.utils.status import (AlreadyPresent, InvalidArgument,
+                                          NotFound)
+from yugabyte_db_tpu.yql.cql import ast
+from yugabyte_db_tpu.yql.cql.parser import parse_statement
+
+
+@dataclass
+class ResultSet:
+    """Rows returned to the driver (reference: QLRowBlock serialized into
+    the CQL RESULT message)."""
+
+    columns: list[str] = field(default_factory=list)
+    rows: list[tuple] = field(default_factory=list)
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def dicts(self) -> list[dict]:
+        return [dict(zip(self.columns, r)) for r in self.rows]
+
+
+# -- cluster seam ------------------------------------------------------------
+
+@dataclass
+class TableHandle:
+    name: str
+    schema: Schema
+    partition_schema: PartitionSchema
+    tablets: list[Tablet]
+
+
+class LocalCluster:
+    """In-process tablet host: every table is num_tablets Tablets in one
+    process (reference test shape: MiniCluster,
+    src/yb/integration-tests/mini_cluster.h:92)."""
+
+    def __init__(self, data_root: str | None = None, num_tablets: int = 4,
+                 engine: str = "cpu", fsync: bool = False,
+                 engine_options: dict | None = None):
+        self._own_dir = data_root is None
+        self.data_root = data_root or tempfile.mkdtemp(prefix="yb_tpu_")
+        self.num_tablets = num_tablets
+        self.engine = engine
+        self.engine_options = engine_options
+        self.fsync = fsync
+        self.clock = HybridClock()
+        self.tables: dict[str, TableHandle] = {}
+        if engine == "tpu":
+            import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401
+
+    def create_table(self, name: str, schema: Schema,
+                     num_tablets: int | None = None) -> TableHandle:
+        if name in self.tables:
+            raise AlreadyPresent(f"table {name} exists")
+        n = num_tablets or self.num_tablets
+        pschema = PartitionSchema(n, hash_partitioned=schema.num_hash > 0)
+        tablets = []
+        for i, part in enumerate(pschema.create_partitions()):
+            meta = TabletMetadata(
+                tablet_id=f"{name}-t{i:04d}", table_name=name, schema=schema,
+                partition_start=part.start, partition_end=part.end,
+                engine=self.engine)
+            tablets.append(Tablet.create(
+                meta, os.path.join(self.data_root, name), clock=self.clock,
+                fsync=self.fsync, engine_options=self.engine_options))
+        handle = TableHandle(name, schema, pschema, tablets)
+        self.tables[name] = handle
+        return handle
+
+    def drop_table(self, name: str) -> None:
+        handle = self.tables.pop(name, None)
+        if handle is None:
+            raise NotFound(f"table {name} not found")
+        for t in handle.tablets:
+            t.close()
+        shutil.rmtree(os.path.join(self.data_root, name), ignore_errors=True)
+
+    def table(self, name: str) -> TableHandle:
+        if name not in self.tables:
+            raise NotFound(f"table {name} not found")
+        return self.tables[name]
+
+    def tablet_for_hash(self, handle: TableHandle, hash_code: int) -> Tablet:
+        idx = handle.partition_schema.partition_index_for_hash(hash_code)
+        return handle.tablets[idx]
+
+    def close(self) -> None:
+        for h in list(self.tables.values()):
+            for t in h.tablets:
+                t.close()
+        self.tables.clear()
+        if self._own_dir:
+            shutil.rmtree(self.data_root, ignore_errors=True)
+
+
+# -- the processor -----------------------------------------------------------
+
+class QLProcessor:
+    """One CQL session: keyspace state + statement execution."""
+
+    def __init__(self, cluster: LocalCluster):
+        self.cluster = cluster
+        self.keyspace = "default"
+        self.keyspaces = {"default", "system"}
+
+    # -- entry points ------------------------------------------------------
+    def execute(self, sql: str) -> ResultSet | None:
+        stmt = parse_statement(sql)
+        fn = {
+            ast.CreateKeyspace: self._exec_create_keyspace,
+            ast.DropKeyspace: self._exec_drop_keyspace,
+            ast.UseKeyspace: self._exec_use,
+            ast.CreateTable: self._exec_create_table,
+            ast.DropTable: self._exec_drop_table,
+            ast.Insert: self._exec_insert,
+            ast.Update: self._exec_update,
+            ast.Delete: self._exec_delete,
+            ast.Select: self._exec_select,
+        }[type(stmt)]
+        return fn(stmt)
+
+    # -- name resolution ---------------------------------------------------
+    def _qualify(self, name: str) -> str:
+        return name if "." in name else f"{self.keyspace}.{name}"
+
+    # -- DDL ---------------------------------------------------------------
+    def _exec_create_keyspace(self, stmt: ast.CreateKeyspace):
+        if stmt.name in self.keyspaces:
+            if not stmt.if_not_exists:
+                raise AlreadyPresent(f"keyspace {stmt.name} exists")
+            return None
+        self.keyspaces.add(stmt.name)
+        return None
+
+    def _exec_drop_keyspace(self, stmt: ast.DropKeyspace):
+        if stmt.name not in self.keyspaces:
+            if not stmt.if_exists:
+                raise NotFound(f"keyspace {stmt.name} not found")
+            return None
+        in_use = [t for t in self.cluster.tables
+                  if t.startswith(stmt.name + ".")]
+        if in_use:
+            raise InvalidArgument(f"keyspace {stmt.name} is not empty")
+        self.keyspaces.discard(stmt.name)
+        return None
+
+    def _exec_use(self, stmt: ast.UseKeyspace):
+        if stmt.name not in self.keyspaces:
+            raise NotFound(f"keyspace {stmt.name} not found")
+        self.keyspace = stmt.name
+        return None
+
+    def _exec_create_table(self, stmt: ast.CreateTable):
+        name = self._qualify(stmt.name)
+        if name in self.cluster.tables:
+            if stmt.if_not_exists:
+                return None
+            raise AlreadyPresent(f"table {name} exists")
+        by_name = {c.name: c for c in stmt.columns}
+        for k in stmt.hash_keys + stmt.range_keys:
+            if k not in by_name:
+                raise InvalidArgument(f"primary key column {k} not defined")
+        cols = []
+        for c in stmt.columns:
+            if c.name in stmt.hash_keys:
+                kind = ColumnKind.HASH
+            elif c.name in stmt.range_keys:
+                kind = ColumnKind.RANGE
+            elif c.is_static:
+                kind = ColumnKind.STATIC
+            else:
+                kind = ColumnKind.REGULAR
+            if kind in (ColumnKind.HASH, ColumnKind.RANGE) and \
+                    c.dtype in (DataType.FLOAT, DataType.DOUBLE):
+                raise InvalidArgument(
+                    f"floating-point column {c.name} cannot be a key column")
+            cols.append(ColumnSchema(c.name, c.dtype, kind,
+                                     nullable=kind == ColumnKind.REGULAR))
+        schema = Schema(cols, table_id=name)
+        num_tablets = stmt.properties.get("tablets")
+        self.cluster.create_table(name, schema, num_tablets)
+        return None
+
+    def _exec_drop_table(self, stmt: ast.DropTable):
+        name = self._qualify(stmt.name)
+        try:
+            self.cluster.drop_table(name)
+        except NotFound:
+            if not stmt.if_exists:
+                raise
+        return None
+
+    # -- writes ------------------------------------------------------------
+    def _coerce(self, col: ColumnSchema, value):
+        if value is None:
+            return None
+        dt = col.dtype
+        if dt.is_integer and isinstance(value, bool):
+            raise InvalidArgument(f"bad value for {col.name}")
+        if dt == DataType.DOUBLE or dt == DataType.FLOAT:
+            if isinstance(value, int) and not isinstance(value, bool):
+                value = float(value)
+        if dt == DataType.BINARY and isinstance(value, str):
+            value = value.encode("utf-8")
+        if not python_value_matches(dt, value):
+            raise InvalidArgument(
+                f"bad value {value!r} for {col.name} ({dt.name})")
+        return value
+
+    def _key_and_tablet(self, handle: TableHandle, key_values: dict):
+        schema = handle.schema
+        hash_code = compute_hash_code(schema, key_values)
+        key = schema.encode_primary_key(key_values, hash_code)
+        tablet = (self.cluster.tablet_for_hash(handle, hash_code)
+                  if schema.num_hash else handle.tablets[0])
+        return key, tablet
+
+    def _expire_ht(self, ttl_seconds):
+        if ttl_seconds is None:
+            return MAX_HT
+        now = self.cluster.clock.now()
+        from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+        return HybridTime.from_micros(
+            now.physical_micros + ttl_seconds * 1_000_000,
+            now.logical).value
+
+    def _exec_insert(self, stmt: ast.Insert):
+        handle = self.cluster.table(self._qualify(stmt.table))
+        schema = handle.schema
+        provided = dict(zip(stmt.columns, stmt.values))
+        for cname in provided:
+            if not schema.has_column(cname):
+                raise InvalidArgument(f"unknown column {cname}")
+        key_values, columns = {}, {}
+        for c in schema.key_columns:
+            if c.name not in provided or provided[c.name] is None:
+                raise InvalidArgument(f"missing key column {c.name}")
+            key_values[c.name] = self._coerce(c, provided[c.name])
+        for c in schema.value_columns:
+            if c.name in provided:
+                columns[c.col_id] = self._coerce(c, provided[c.name])
+        key, tablet = self._key_and_tablet(handle, key_values)
+        if stmt.if_not_exists:
+            # Conditional insert: CQL returns an [applied] row. (The
+            # reference runs this as a read-modify-write inside the tablet,
+            # cql_operation.cc QLWriteOperation::ApplyForRegularColumns.)
+            spec = ScanSpec(lower=key, upper=key + b"\xff",
+                            read_ht=tablet.read_time().value, limit=1)
+            if tablet.scan(spec).rows:
+                return ResultSet(columns=["[applied]"], rows=[(False,)])
+            tablet.write([RowVersion(
+                key, ht=0, liveness=True, columns=columns,
+                expire_ht=self._expire_ht(stmt.ttl_seconds))])
+            return ResultSet(columns=["[applied]"], rows=[(True,)])
+        tablet.write([RowVersion(key, ht=0, liveness=True, columns=columns,
+                                 expire_ht=self._expire_ht(stmt.ttl_seconds))])
+        return None
+
+    def _bound_key_values(self, schema: Schema, where: list[ast.Relation],
+                          require_full_key: bool) -> tuple[dict, list]:
+        """Split WHERE into full-PK equality bindings + leftover relations."""
+        key_values, leftover = {}, []
+        key_names = {c.name for c in schema.key_columns}
+        for rel in where:
+            if rel.column in key_names and rel.op == "=" and \
+                    rel.column not in key_values:
+                key_values[rel.column] = rel.value
+            else:
+                leftover.append(rel)
+        if require_full_key:
+            missing = key_names - set(key_values)
+            if missing:
+                raise InvalidArgument(
+                    f"DML requires the full primary key; missing {sorted(missing)}")
+            if leftover:
+                raise InvalidArgument(
+                    "non-key relations not allowed in UPDATE/DELETE WHERE")
+        coerced = {}
+        for c in schema.key_columns:
+            if c.name in key_values:
+                coerced[c.name] = self._coerce(c, key_values[c.name])
+        return coerced, leftover
+
+    def _exec_update(self, stmt: ast.Update):
+        handle = self.cluster.table(self._qualify(stmt.table))
+        schema = handle.schema
+        key_values, _ = self._bound_key_values(schema, stmt.where, True)
+        columns = {}
+        for cname, value in stmt.assignments:
+            if not schema.has_column(cname):
+                raise InvalidArgument(f"unknown column {cname}")
+            col = schema.column(cname)
+            if col.is_key:
+                raise InvalidArgument(f"cannot SET key column {cname}")
+            columns[col.col_id] = self._coerce(col, value)
+        key, tablet = self._key_and_tablet(handle, key_values)
+        # CQL UPDATE is an upsert of the SET columns (no liveness marker:
+        # the row exists only while some column is live — reference
+        # semantics of UPDATE vs INSERT in DocDB).
+        tablet.write([RowVersion(key, ht=0, columns=columns,
+                                 expire_ht=self._expire_ht(stmt.ttl_seconds))])
+        return None
+
+    def _exec_delete(self, stmt: ast.Delete):
+        handle = self.cluster.table(self._qualify(stmt.table))
+        schema = handle.schema
+        key_values, _ = self._bound_key_values(schema, stmt.where, True)
+        key, tablet = self._key_and_tablet(handle, key_values)
+        if stmt.columns:
+            columns = {}
+            for cname in stmt.columns:
+                if not schema.has_column(cname):
+                    raise InvalidArgument(f"unknown column {cname}")
+                col = schema.column(cname)
+                if col.is_key:
+                    raise InvalidArgument(f"cannot DELETE key column {cname}")
+                columns[col.col_id] = None   # column tombstone
+            tablet.write([RowVersion(key, ht=0, columns=columns)])
+        else:
+            tablet.write([RowVersion(key, ht=0, tombstone=True)])
+        return None
+
+    # -- reads -------------------------------------------------------------
+    def _exec_select(self, stmt: ast.Select):
+        handle = self.cluster.table(self._qualify(stmt.table))
+        schema = handle.schema
+        plan = self._plan_select(handle, stmt)
+        if plan.aggregates:
+            return self._run_aggregate(handle, stmt, plan)
+        return self._run_rows(handle, stmt, plan)
+
+    def _plan_select(self, handle: TableHandle, stmt: ast.Select):
+        schema = handle.schema
+        hash_names = [c.name for c in schema.hash_columns]
+        range_cols = schema.range_columns
+
+        eq = {}
+        rest: list[ast.Relation] = []
+        for rel in stmt.where:
+            col = rel.column
+            if not schema.has_column(col):
+                raise InvalidArgument(f"unknown column {col} in WHERE")
+            if rel.op == "=" and col not in eq and (
+                    col in hash_names or
+                    col in [c.name for c in range_cols]):
+                eq[col] = self._coerce(schema.column(col), rel.value)
+            else:
+                rest.append(rel)
+
+        # Single-tablet point/range plan when every hash column is '='-bound.
+        single = all(name in eq for name in hash_names) and schema.num_hash
+        hash_code = None
+        lower = b""
+        upper = b""
+        if single:
+            hash_code = compute_hash_code(
+                schema, {n: eq[n] for n in hash_names})
+            hashed = [(eq[n], schema.column(n).dtype) for n in hash_names]
+            # Extend the prefix with leading '='-bound range columns.
+            bound_ranges = []
+            i = 0
+            while i < len(range_cols) and range_cols[i].name in eq:
+                c = range_cols[i]
+                bound_ranges.append((eq[c.name], c.dtype))
+                i += 1
+            prefix = encode_doc_key_prefix(hash_code, hashed, bound_ranges)
+            lower, upper = prefix, prefix_successor(prefix)
+            # '='-bound range columns past the first unbound one can't join
+            # the prefix; re-emit them as row predicates.
+            consumed = set(hash_names) | {c.name for c in range_cols[:i]}
+            for name, v in eq.items():
+                if name not in consumed:
+                    rest.append(ast.Relation(name, "=", v))
+            # One more range column may carry inequalities tightening bounds.
+            if i < len(range_cols):
+                nxt = range_cols[i]
+                keep = []
+                for rel in rest:
+                    if rel.column != nxt.name or rel.op in ("IN", "!="):
+                        keep.append(rel)
+                        continue
+                    v = self._coerce(nxt, rel.value)
+                    comp = encode_key_component(v, nxt.dtype)
+                    if rel.op in (">", ">="):
+                        cand = prefix + (prefix_successor(comp)
+                                         if rel.op == ">" else comp)
+                        lower = max(lower, cand)
+                    elif rel.op in ("<", "<="):
+                        cand = prefix + (prefix_successor(comp)
+                                         if rel.op == "<=" else comp)
+                        if upper == b"" or (cand != b"" and cand < upper):
+                            upper = cand
+                    elif rel.op == "=":
+                        lo = prefix + comp
+                        lower = max(lower, lo)
+                        cand = prefix + prefix_successor(comp)
+                        if upper == b"" or (cand != b"" and cand < upper):
+                            upper = cand
+                rest = keep
+        else:
+            # eq bindings on range cols without hash bindings: filter later.
+            for name, v in eq.items():
+                rest.append(ast.Relation(name, "=", v))
+
+        predicates = []
+        for rel in rest:
+            col = schema.column(rel.column)
+            value = (tuple(self._coerce(col, v) for v in rel.value)
+                     if rel.op == "IN" else self._coerce(col, rel.value))
+            predicates.append(Predicate(rel.column, rel.op, value))
+
+        aggregates = []
+        if stmt.items and any(it.agg_fn for it in stmt.items):
+            if not all(it.agg_fn for it in stmt.items):
+                raise InvalidArgument(
+                    "cannot mix aggregates and plain columns without GROUP BY")
+            for it in stmt.items:
+                if it.column and not schema.has_column(it.column):
+                    raise InvalidArgument(f"unknown column {it.column}")
+                aggregates.append(AggSpec(it.agg_fn, it.column))
+
+        projection = None
+        if stmt.items and not aggregates:
+            for it in stmt.items:
+                if not schema.has_column(it.column):
+                    raise InvalidArgument(f"unknown column {it.column}")
+            projection = [it.column for it in stmt.items]
+
+        @dataclass
+        class Plan:
+            single: bool
+            hash_code: int | None
+            lower: bytes
+            upper: bytes
+            predicates: list
+            projection: list | None
+            aggregates: list
+
+        return Plan(bool(single), hash_code, lower, upper, predicates,
+                    projection, aggregates)
+
+    def _target_tablets(self, handle: TableHandle, plan):
+        if plan.single and handle.schema.num_hash:
+            return [self.cluster.tablet_for_hash(handle, plan.hash_code)]
+        return handle.tablets
+
+    def _run_rows(self, handle: TableHandle, stmt: ast.Select, plan):
+        schema = handle.schema
+        projection = plan.projection or [c.name for c in schema.columns]
+        if stmt.items:
+            names = [it.output_name for it in stmt.items]
+        else:
+            names = list(projection)
+        out = ResultSet(columns=names)
+        remaining = stmt.limit
+        for tablet in self._target_tablets(handle, plan):
+            spec = ScanSpec(lower=plan.lower, upper=plan.upper,
+                            read_ht=tablet.read_time().value,
+                            predicates=plan.predicates,
+                            projection=projection, limit=remaining)
+            res = tablet.scan(spec)
+            out.rows.extend(res.rows)
+            if remaining is not None:
+                remaining -= len(res.rows)
+                if remaining <= 0:
+                    break
+        return out
+
+    def _run_aggregate(self, handle: TableHandle, stmt: ast.Select, plan):
+        """Fan the aggregate out per tablet, combine partials host-side
+        (reference: per-tablet partial agg merged above the scan,
+        src/yb/docdb/pgsql_operation.cc:473 + exec/eval_aggr.cc). avg
+        lowers to sum+count so the combine stays exact."""
+        lowered: list[AggSpec] = []
+        avg_map = {}
+        for a in plan.aggregates:
+            if a.fn == "avg":
+                avg_map[len(lowered)] = a
+                lowered.append(AggSpec("sum", a.column))
+                lowered.append(AggSpec("count", a.column))
+            else:
+                lowered.append(a)
+
+        partials = []
+        for tablet in self._target_tablets(handle, plan):
+            spec = ScanSpec(lower=plan.lower, upper=plan.upper,
+                            read_ht=tablet.read_time().value,
+                            predicates=plan.predicates, aggregates=lowered)
+            partials.append(tablet.scan(spec).rows[0])
+
+        combined = []
+        i = 0
+        for a in plan.aggregates:
+            if a.fn == "avg":
+                s = self._combine([p[i] for p in partials], "sum")
+                n = self._combine([p[i + 1] for p in partials], "count")
+                combined.append(None if not n else s / n)
+                i += 2
+            else:
+                combined.append(self._combine([p[i] for p in partials], a.fn))
+                i += 1
+        names = [it.output_name for it in stmt.items]
+        return ResultSet(columns=names, rows=[tuple(combined)])
+
+    @staticmethod
+    def _combine(vals, fn):
+        vals = [v for v in vals if v is not None]
+        if fn == "count":
+            return sum(vals) if vals else 0
+        if not vals:
+            return None
+        if fn == "sum":
+            return sum(vals)
+        return max(vals) if fn == "max" else min(vals)
