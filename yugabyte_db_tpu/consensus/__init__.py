@@ -1,0 +1,20 @@
+"""Per-tablet Raft consensus.
+
+Reference analog: src/yb/consensus/ — RaftConsensus (raft_consensus.cc),
+the peer replication queue (consensus_queue.cc, consensus_peers.cc), leader
+election (leader_election.cc), leader leases (leader_lease.h), and the
+consensus metadata file (consensus_meta.cc). The WAL (tablet.wal.Log) is the
+Raft log — "this replicated consistent log also plays the role of the WAL"
+(consensus/README).
+"""
+
+from yugabyte_db_tpu.consensus.metadata import ConsensusMetadata, RaftConfig
+from yugabyte_db_tpu.consensus.raft import (NotLeader, RaftConsensus,
+                                            RaftOptions, Role)
+from yugabyte_db_tpu.consensus.transport import (LocalTransport, Transport,
+                                                 TransportError)
+
+__all__ = [
+    "ConsensusMetadata", "RaftConfig", "RaftConsensus", "RaftOptions",
+    "Role", "NotLeader", "LocalTransport", "Transport", "TransportError",
+]

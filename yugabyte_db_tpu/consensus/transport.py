@@ -1,0 +1,119 @@
+"""Message transport between consensus peers / cluster nodes.
+
+Reference analog: the rpc layer's Messenger/Proxy pair (src/yb/rpc/) as seen
+by consensus — ``Peer`` sends UpdateConsensus/RequestConsensusVote through a
+``ConsensusServiceProxy``. Here the seam is one method:
+``send(dst, method, payload) -> response`` with node-level handlers.
+
+``LocalTransport`` is the in-process fabric used by MiniCluster-style tests
+(reference: mini_cluster.h runs real servers on loopback; we go one step
+lighter and skip sockets). It supports fault injection — partitions, drops,
+latency — the ExternalMiniCluster role of forcing failure paths. The socket
+transport lives in yugabyte_db_tpu.rpc and plugs in behind the same seam.
+"""
+
+from __future__ import annotations
+
+import abc
+import random
+import threading
+import time
+
+
+class TransportError(Exception):
+    """Delivery failure (unreachable, partitioned, dropped, timed out)."""
+
+
+class Transport(abc.ABC):
+    @abc.abstractmethod
+    def send(self, dst: str, method: str, payload: dict, timeout: float = 5.0) -> dict:
+        """Deliver a request to node ``dst``; return its response.
+        Raises TransportError if the node is unreachable."""
+
+    @abc.abstractmethod
+    def register(self, uuid: str, handler) -> None:
+        """Register ``handler(method, payload) -> response`` for a node."""
+
+    @abc.abstractmethod
+    def unregister(self, uuid: str) -> None:
+        ...
+
+
+class LocalTransport(Transport):
+    """In-process transport with fault injection for tests."""
+
+    def __init__(self, seed: int = 0):
+        self._handlers: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._partitioned: set[frozenset] = set()
+        self._isolated: set[str] = set()
+        self.drop_rate = 0.0
+        self.delay_s = 0.0
+        self._rng = random.Random(seed)
+
+    # -- wiring ------------------------------------------------------------
+    def register(self, uuid: str, handler) -> None:
+        with self._lock:
+            self._handlers[uuid] = handler
+
+    def unregister(self, uuid: str) -> None:
+        with self._lock:
+            self._handlers.pop(uuid, None)
+
+    # -- fault injection ---------------------------------------------------
+    def partition(self, a: str, b: str) -> None:
+        """Block traffic between a and b (both directions)."""
+        with self._lock:
+            self._partitioned.add(frozenset((a, b)))
+
+    def heal(self, a: str | None = None, b: str | None = None) -> None:
+        with self._lock:
+            if a is None:
+                self._partitioned.clear()
+                self._isolated.clear()
+            elif b is None:
+                self._isolated.discard(a)
+                self._partitioned = {p for p in self._partitioned if a not in p}
+            else:
+                self._partitioned.discard(frozenset((a, b)))
+
+    def isolate(self, uuid: str) -> None:
+        """Cut a node off from everyone (network-level "kill")."""
+        with self._lock:
+            self._isolated.add(uuid)
+
+    # -- delivery ----------------------------------------------------------
+    def send(self, dst: str, method: str, payload: dict,
+             timeout: float = 5.0, src: str | None = None) -> dict:
+        with self._lock:
+            handler = self._handlers.get(dst)
+            blocked = (dst in self._isolated
+                       or (src is not None
+                           and (src in self._isolated
+                                or frozenset((src, dst)) in self._partitioned)))
+            drop = self.drop_rate and self._rng.random() < self.drop_rate
+            delay = self.delay_s
+        if delay:
+            time.sleep(delay)
+        if handler is None or blocked or drop:
+            raise TransportError(f"{dst} unreachable ({method})")
+        return handler(method, payload)
+
+    def bind(self, src: str) -> "BoundTransport":
+        """A view that stamps the sender uuid (so partitions apply)."""
+        return BoundTransport(self, src)
+
+
+class BoundTransport(Transport):
+    def __init__(self, inner: LocalTransport, src: str):
+        self._inner = inner
+        self.src = src
+
+    def send(self, dst: str, method: str, payload: dict, timeout: float = 5.0) -> dict:
+        return self._inner.send(dst, method, payload, timeout, src=self.src)
+
+    def register(self, uuid: str, handler) -> None:
+        self._inner.register(uuid, handler)
+
+    def unregister(self, uuid: str) -> None:
+        self._inner.unregister(uuid)

@@ -11,3 +11,12 @@ reference's disabled-rocksdb-WAL design (consensus/README).
 from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
 from yugabyte_db_tpu.tablet.mvcc import MvccManager
 from yugabyte_db_tpu.tablet.tablet import Tablet, TabletMetadata
+
+
+def __getattr__(name):
+    # Lazy: tablet_peer pulls in consensus, which itself builds on the WAL
+    # defined here — importing it eagerly would be a cycle.
+    if name == "TabletPeer":
+        from yugabyte_db_tpu.tablet.tablet_peer import TabletPeer
+        return TabletPeer
+    raise AttributeError(name)

@@ -74,7 +74,7 @@ class Tablet:
     def __init__(self, meta: TabletMetadata, data_root: str,
                  clock: HybridClock | None = None,
                  engine_options: dict | None = None,
-                 fsync: bool = True):
+                 fsync: bool = True, consensus_managed: bool = False):
         self.meta = meta
         self.dir = os.path.join(data_root, meta.tablet_id)
         os.makedirs(self.dir, exist_ok=True)
@@ -87,6 +87,10 @@ class Tablet:
         self.log = Log(os.path.join(self.dir, "wal"), fsync=fsync)
         self._write_lock = threading.Lock()
         self._term = 1
+        # consensus_managed: a RaftConsensus owns the log (appends, term
+        # tracking) and drives applies through apply_replicated(); the
+        # tablet's own write() path is disabled.
+        self.consensus_managed = consensus_managed
         self._last_index = self.log.last_appended.index
         self._applied_index = meta.flushed_op_index
         self.bootstrap()
@@ -94,16 +98,34 @@ class Tablet:
     # -- bootstrap ----------------------------------------------------------
     def bootstrap(self) -> None:
         """Replay WAL entries newer than the flushed frontier into the
-        engine (reference: TabletBootstrap::PlaySegments)."""
+        engine (reference: TabletBootstrap::PlaySegments). Under consensus
+        management only entries known committed (from the piggybacked commit
+        watermark) are applied — the uncommitted tail is left for Raft to
+        commit or truncate (tablet_bootstrap.cc hands those back as
+        pending)."""
+        all_entries = list(self.log.read_all(0))
+        if self.consensus_managed:
+            committed_frontier = max((e.committed for e in all_entries),
+                                     default=0)
+            # Consensus reuses this single decode pass for its entry cache
+            # (avoids a second full-log read at startup).
+            self.bootstrap_entries = all_entries
+        else:
+            committed_frontier = None  # local-consensus: everything durable
         replayed = 0
-        for entry in self.log.read_all(self.meta.flushed_op_index + 1):
+        for entry in all_entries:
+            self._last_index = max(self._last_index, entry.op_id.index)
+            self.clock.update(HybridTime(entry.ht))
+            if entry.op_id.index <= self.meta.flushed_op_index:
+                continue  # already durable in the engine's flushed runs
+            if committed_frontier is not None and \
+                    entry.op_id.index > committed_frontier:
+                continue
             if entry.op_type == "write":
                 rows = _decode_rows(entry.body)
                 self.engine.apply(rows)
                 replayed += 1
-            self._last_index = max(self._last_index, entry.op_id.index)
             self._applied_index = max(self._applied_index, entry.op_id.index)
-            self.clock.update(HybridTime(entry.ht))
         self._replayed_on_bootstrap = replayed
 
     # -- write path ---------------------------------------------------------
@@ -111,6 +133,8 @@ class Tablet:
         """Apply one write operation (a batch of row versions, HT-stamped
         here). Durable (WAL fsync) before apply, matching the reference's
         Replicate-before-Apply invariant."""
+        if self.consensus_managed:
+            raise RuntimeError("writes must go through the TabletPeer")
         with self._write_lock:
             ht = self.clock.now()
             self.mvcc.add_pending(ht)
@@ -133,6 +157,20 @@ class Tablet:
                 raise
             self.mvcc.replicated(ht)
             return ht
+
+    def apply_replicated(self, entry) -> None:
+        """Apply one committed log entry (the Raft apply stage; reference:
+        Tablet::ApplyRowOperations, tablet.cc:667). Rows carry their hybrid
+        time already (stamped by the leader before replication). Runs under
+        the write lock: engines have no internal locking, and flush() swaps
+        the memtable under the same lock — an apply racing that swap would
+        vanish while the replay frontier still advances past it."""
+        with self._write_lock:
+            if entry.op_type == "write":
+                self.engine.apply(_decode_rows(entry.body))
+            self._applied_index = max(self._applied_index, entry.op_id.index)
+            self._last_index = max(self._last_index, entry.op_id.index)
+        self.clock.update(HybridTime(entry.ht))
 
     # -- read path ----------------------------------------------------------
     def read_time(self) -> HybridTime:

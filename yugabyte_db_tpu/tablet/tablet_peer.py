@@ -1,0 +1,117 @@
+"""TabletPeer: one replica of one tablet — Tablet storage + RaftConsensus.
+
+Reference analog: src/yb/tablet/tablet_peer.{h,cc} — owns the tablet, the
+consensus instance and the log; routes writes through the Raft pipeline
+(Prepare -> Replicate -> Apply, operations/operation_driver.h:70-95) and
+gates reads on leadership + leases.
+
+Read semantics: leader replicas serve reads at the MVCC safe time while
+holding the majority-ack lease; follower replicas can serve explicitly
+requested stale reads at their last-applied state (the reference's
+follower reads are opt-in the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from yugabyte_db_tpu.consensus.metadata import ConsensusMetadata, RaftConfig
+from yugabyte_db_tpu.consensus.raft import (NotLeader, RaftConsensus,
+                                            RaftOptions)
+from yugabyte_db_tpu.storage.row_version import RowVersion
+from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+from yugabyte_db_tpu.tablet.tablet import (Tablet, TabletMetadata,
+                                           _encode_rows)
+from yugabyte_db_tpu.utils.hybrid_time import HybridClock, HybridTime
+
+
+class TabletPeer:
+    def __init__(self, node_uuid: str, meta: TabletMetadata, data_root: str,
+                 transport, initial_peers: list[str],
+                 clock: HybridClock | None = None,
+                 engine_options: dict | None = None,
+                 fsync: bool = True, raft_opts: RaftOptions | None = None):
+        self.node_uuid = node_uuid
+        self.tablet = Tablet(meta, data_root, clock=clock,
+                             engine_options=engine_options, fsync=fsync,
+                             consensus_managed=True)
+        cmeta = ConsensusMetadata(
+            os.path.join(self.tablet.dir, "consensus-meta.json"),
+            node_uuid, RaftConfig(list(initial_peers)))
+        self.raft = RaftConsensus(
+            meta.tablet_id, cmeta, self.tablet.log, transport,
+            self.tablet.clock, self._apply, raft_opts,
+            initial_applied_index=self.tablet._applied_index,
+            preloaded_entries=self.tablet.bootstrap_entries)
+        del self.tablet.bootstrap_entries  # one-shot handoff
+        self._maintenance_lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.raft.start()
+
+    def shutdown(self) -> None:
+        self.raft.shutdown()
+        self.tablet.close()
+
+    @property
+    def tablet_id(self) -> str:
+        return self.tablet.meta.tablet_id
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    # -- write path ---------------------------------------------------------
+    def write(self, rows: list[RowVersion], timeout: float = 10.0) -> HybridTime:
+        """Leader-side write: stamp a hybrid time, replicate through Raft,
+        return once applied on this replica."""
+        if not self.raft.is_leader():
+            raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        ht = self.tablet.clock.now()
+        stamped = [
+            RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
+                       liveness=r.liveness, columns=r.columns,
+                       expire_ht=r.expire_ht)
+            for r in rows
+        ]
+        self.tablet.mvcc.add_pending(ht)
+        try:
+            self.raft.replicate("write", _encode_rows(stamped),
+                                ht=ht.value, timeout=timeout)
+        except BaseException:
+            self.tablet.mvcc.aborted(ht)
+            raise
+        self.tablet.mvcc.replicated(ht)
+        return ht
+
+    def _apply(self, entry) -> None:
+        self.tablet.apply_replicated(entry)
+
+    # -- read path ----------------------------------------------------------
+    def read_time(self) -> HybridTime:
+        return self.tablet.mvcc.safe_time()
+
+    def scan(self, spec: ScanSpec, allow_stale: bool = False) -> ScanResult:
+        """Serve a scan. Leader-with-lease only, unless the caller opted
+        into stale follower reads."""
+        if not allow_stale:
+            if not self.raft.is_leader():
+                raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+            if not self.raft.has_lease():
+                raise NotLeader(self.node_uuid, None)
+        return self.tablet.scan(spec)
+
+    # -- maintenance --------------------------------------------------------
+    def flush(self) -> None:
+        with self._maintenance_lock:
+            self.tablet.flush()
+
+    def compact(self, history_cutoff_ht: int = 0) -> None:
+        with self._maintenance_lock:
+            self.tablet.compact(history_cutoff_ht)
+
+    def stats(self) -> dict:
+        s = self.tablet.stats()
+        s["raft"] = self.raft.stats()
+        return s

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
 from dataclasses import dataclass
 
@@ -44,6 +45,11 @@ class LogEntry:
     ht: int           # hybrid time of the operation
     op_type: str      # "write" | "no_op" | "change_config" | ...
     body: object      # codec-encodable payload
+    committed: int = 0  # commit index known when this entry was appended
+    # ``committed`` mirrors the reference piggybacking the committed op id on
+    # every replicate message (consensus.proto UpdateConsensus); bootstrap
+    # replays only entries known committed and hands the tail back to
+    # consensus as pending (tablet_bootstrap.cc).
 
 
 class Log:
@@ -54,6 +60,10 @@ class Log:
         self.wal_dir = wal_dir
         self.segment_bytes = segment_bytes
         self.fsync = fsync
+        # Appends are serialized by the caller (one writer: the consensus
+        # pipeline); this lock only guards append/sync/gc/truncate racing
+        # each other (e.g. flush-triggered GC vs an append).
+        self._lock = threading.RLock()
         os.makedirs(wal_dir, exist_ok=True)
         self._file = None
         self._file_path = None
@@ -90,12 +100,16 @@ class Log:
     # -- append ------------------------------------------------------------
     def append(self, entry: LogEntry) -> None:
         """Buffer an entry; durable after the next sync()."""
+        with self._lock:
+            self._append_locked(entry)
+
+    def _append_locked(self, entry: LogEntry) -> None:
         if entry.op_id <= self.last_appended:
             raise ValueError(
                 f"non-monotonic append {entry.op_id} after {self.last_appended}")
         payload = codec.encode([
             entry.op_id.term, entry.op_id.index, entry.ht,
-            entry.op_type, entry.body,
+            entry.op_type, entry.body, entry.committed,
         ])
         rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         if self._file is None or \
@@ -119,13 +133,14 @@ class Log:
 
     def sync(self) -> None:
         """Group commit: flush buffered records and fsync the segment."""
-        if self._file is None and self._buffer:
-            self._open_segment(max(1, self.last_appended.index))
-        self._flush_buffer()
-        if self._file is not None:
-            self._file.flush()
-            if self.fsync:
-                os.fsync(self._file.fileno())
+        with self._lock:
+            if self._file is None and self._buffer:
+                self._open_segment(max(1, self.last_appended.index))
+            self._flush_buffer()
+            if self._file is not None:
+                self._file.flush()
+                if self.fsync:
+                    os.fsync(self._file.fileno())
 
     # -- read / replay -----------------------------------------------------
     def read_all(self, min_index: int = 0):
@@ -154,17 +169,66 @@ class Log:
             payload = data[start:end]
             if zlib.crc32(payload) != crc:
                 return out, False  # corruption: stop at last good record
-            term, index, ht, op_type, body = codec.decode(payload)
+            rec = codec.decode(payload)
+            term, index, ht, op_type, body = rec[:5]
+            committed = rec[5] if len(rec) > 5 else 0
             if index >= min_index:
-                out.append(LogEntry(OpId(term, index), ht, op_type, body))
+                out.append(LogEntry(OpId(term, index), ht, op_type, body,
+                                    committed))
             pos = end
         return out, True
+
+    # -- truncation --------------------------------------------------------
+    def truncate_after(self, last_kept_index: int) -> int:
+        """Drop every entry with index > last_kept_index (a follower erasing
+        a conflicting suffix on divergence from a new leader). Returns the
+        number of entries dropped. Rewrites only the segments that contain
+        dropped entries; earlier segments are untouched."""
+        with self._lock:
+            return self._truncate_after_locked(last_kept_index)
+
+    def _truncate_after_locked(self, last_kept_index: int) -> int:
+        self.sync()
+        self._close_file()
+        dropped = 0
+        for path in self.segment_paths():
+            entries, _ = self._read_segment(path, 0)
+            if not entries or entries[-1].op_id.index <= last_kept_index:
+                continue
+            kept = [e for e in entries if e.op_id.index <= last_kept_index]
+            dropped += len(entries) - len(kept)
+            if kept:
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    for e in kept:
+                        payload = codec.encode([
+                            e.op_id.term, e.op_id.index, e.ht,
+                            e.op_type, e.body, e.committed,
+                        ])
+                        f.write(_HEADER.pack(len(payload),
+                                             zlib.crc32(payload)) + payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            else:
+                os.unlink(path)
+        self.last_appended = OpId.min()
+        for path in reversed(self.segment_paths()):
+            entries, _ = self._read_segment(path, 0)
+            if entries:
+                self.last_appended = entries[-1].op_id
+                break
+        return dropped
 
     # -- GC ----------------------------------------------------------------
     def gc(self, min_retained_index: int) -> int:
         """Delete whole segments whose every entry index < min_retained_index.
         Returns segments deleted. (Reference: Log::GC after flushed frontier
         advances.)"""
+        with self._lock:
+            return self._gc_locked(min_retained_index)
+
+    def _gc_locked(self, min_retained_index: int) -> int:
         paths = self.segment_paths()
         deleted = 0
         # A segment's name carries its first index; a segment can be deleted
