@@ -55,17 +55,18 @@ class NotLeader(Exception):
 class RaftOptions:
     election_timeout_s: float = 0.5     # base; actual is jittered 1-2x
     heartbeat_interval_s: float = 0.1
-    lease_s: float = 1.0                # leader lease window
+    lease_s: float = 0.5                # leader lease window
     max_batch_entries: int = 64         # per UpdateConsensus request
     rpc_timeout_s: float = 2.0
 
-
-def _encode_entry(e: LogEntry) -> list:
-    return [e.op_id.term, e.op_id.index, e.ht, e.op_type, e.body, e.committed]
-
-
-def _decode_entry(rec: list) -> LogEntry:
-    return LogEntry(OpId(rec[0], rec[1]), rec[2], rec[3], rec[4], rec[5])
+    @property
+    def effective_lease_s(self) -> float:
+        """The lease window a leader may trust. Clamped to the MINIMUM
+        election delay: followers withhold votes only for
+        election_timeout_s after the last heartbeat, so a lease longer
+        than that could outlive a successor's election and serve stale
+        reads. The 0.8 factor keeps a margin from the exact boundary."""
+        return min(self.lease_s, 0.8 * self.election_timeout_s)
 
 
 class _PeerState:
@@ -105,10 +106,12 @@ class RaftConsensus:
         self._election_timeout = self._next_timeout()
         self._last_heartbeat_recv = time.monotonic()
         self._last_broadcast = 0.0
+        self._leader_since = 0.0  # when this node last won an election
         self._running = False
 
         # Log state: full in-memory entry cache (LogCache analog).
         self._entries: dict[int, LogEntry] = {}
+        self._sync_lock = threading.Lock()  # serializes fsyncs (group commit)
         self._last_index = 0
         self._commit_index = 0
         self._applied_index = initial_applied_index
@@ -129,6 +132,7 @@ class RaftConsensus:
                     self.cmeta.pending_config = cfg
         self._commit_index = min(self._commit_index, self._last_index)
         self._applied_index = min(self._applied_index, self._last_index)
+        self._durable_index = self._last_index  # on-disk log is durable
 
         self._peers: dict[str, _PeerState] = {}
         self._threads: list[threading.Thread] = []
@@ -172,8 +176,15 @@ class RaftConsensus:
         with self._lock:
             if self._role != Role.LEADER:
                 return False
+            now = time.monotonic()
             cfg = self.cmeta.active_config
-            cutoff = time.monotonic() - self.opts.lease_s
+            # A fresh leader first waits out any predecessor's lease window
+            # (the reference's "old leader lease expiry" wait) — except the
+            # trivial single-member group, which has no predecessor reads.
+            if len(cfg.peers) > 1 and \
+                    now < self._leader_since + self.opts.effective_lease_s:
+                return False
+            cutoff = now - self.opts.effective_lease_s
             acked = 0
             for uuid in cfg.peers:
                 if uuid == self.uuid:
@@ -205,9 +216,16 @@ class RaftConsensus:
                   timeout: float = 10.0) -> LogEntry:
         """Leader-only: append, replicate to a majority, apply; returns the
         committed entry (with its assigned op id + hybrid time)."""
+        entry = self.append_leader(op_type, body, ht)
+        self.wait_applied(entry.op_id, timeout)
+        return entry
+
+    def append_leader(self, op_type: str, body, ht: int | None = None) -> LogEntry:
+        """Leader append + durability, without waiting for commit. Callers
+        that need the outcome follow with wait_applied()."""
         with self._lock:
             entry = self._leader_append_locked(op_type, body, ht)
-        self._wait_applied(entry.op_id, timeout)
+        self._ensure_durable(entry.op_id.index)
         return entry
 
     def _leader_append_locked(self, op_type: str, body, ht: int | None) -> LogEntry:
@@ -217,10 +235,27 @@ class RaftConsensus:
             ht = self.clock.now().value
         entry = LogEntry(OpId(self.cmeta.current_term, self._last_index + 1),
                          ht, op_type, body, self._commit_index)
-        self._append_local(entry)
-        self._advance_commit_locked()
+        # No fsync under the lock: durability is established by
+        # _ensure_durable OUTSIDE it, and the entry only counts toward the
+        # majority (self's match = _durable_index) once synced. Concurrent
+        # appends share one fsync — the WAL's group-commit design.
+        self._append_local(entry, sync=False)
         self._signal_peers_locked()
         return entry
+
+    def _ensure_durable(self, index: int) -> None:
+        """Fsync the log up to at least ``index`` (batched across callers),
+        then let the commit watermark advance with self counted."""
+        with self._sync_lock:
+            with self._lock:
+                if self._durable_index >= index:
+                    return
+                target = self._last_index
+            self.log.sync()
+            with self._lock:
+                self._durable_index = max(self._durable_index, target)
+                if self._role == Role.LEADER:
+                    self._advance_commit_locked()
 
     def change_config(self, new_peers: list[str], timeout: float = 10.0) -> LogEntry:
         """Replicate a new replica set (one-at-a-time membership change).
@@ -237,7 +272,8 @@ class RaftConsensus:
             entry = self._leader_append_locked(
                 "change_config", {"peers": list(new_peers), "opid_index": 0},
                 None)
-        self._wait_applied(entry.op_id, timeout)
+        self._ensure_durable(entry.op_id.index)
+        self.wait_applied(entry.op_id, timeout)
         return entry
 
     def transfer_leadership(self, target: str) -> None:
@@ -316,7 +352,7 @@ class RaftConsensus:
                                               prev_index - 1)}
             appended = False
             for rec in req["entries"]:
-                e = _decode_entry(rec)
+                e = LogEntry.from_record(rec)
                 existing = self._entries.get(e.op_id.index)
                 if existing is not None:
                     if existing.op_id.term == e.op_id.term:
@@ -325,7 +361,8 @@ class RaftConsensus:
                 self._append_local(e, sync=False)
                 appended = True
             if appended:
-                self.log.sync()
+                self.log.sync()  # one fsync per request (group commit)
+                self._durable_index = self._last_index
             new_commit = min(req["commit_index"], self._last_index)
             if new_commit > self._commit_index:
                 self._commit_index = new_commit
@@ -351,6 +388,7 @@ class RaftConsensus:
     def _truncate_suffix(self, last_kept: int) -> None:
         """Erase a conflicting log suffix (follower divergence)."""
         self.log.truncate_after(last_kept)
+        self._durable_index = min(self._durable_index, last_kept)
         for idx in range(last_kept + 1, self._last_index + 1):
             e = self._entries.pop(idx, None)
             if e is not None and e.op_type == "change_config" and \
@@ -392,7 +430,7 @@ class RaftConsensus:
                 idx = peer.next_index
                 while idx <= self._last_index and \
                         len(batch) < self.opts.max_batch_entries:
-                    batch.append(_encode_entry(self._entries[idx]))
+                    batch.append(self._entries[idx].to_record())
                     idx += 1
                 req = {
                     "tablet_id": self.tablet_id, "term": term,
@@ -404,7 +442,10 @@ class RaftConsensus:
             try:
                 resp = self.transport.send(peer.uuid, "raft.update_consensus",
                                            req, timeout=self.opts.rpc_timeout_s)
-            except TransportError:
+            except Exception:
+                # ANY send/remote failure (not just TransportError — e.g. a
+                # remote handler error surfacing as RpcCallError) must leave
+                # this replication thread alive; retry on the next tick.
                 continue
             with self._lock:
                 if not self._running or self._role != Role.LEADER or \
@@ -434,7 +475,7 @@ class RaftConsensus:
         matches = []
         for uuid in cfg.peers:
             if uuid == self.uuid:
-                matches.append(self._last_index)  # only while a member
+                matches.append(self._durable_index)  # only once fsynced
                 continue
             p = self._peers.get(uuid)
             matches.append(p.match_index if p else 0)
@@ -475,10 +516,17 @@ class RaftConsensus:
                     self._apply_cond.wait(timeout=0.5)
                 if not self._running:
                     return
-                start = self._applied_index + 1
-                end = self._commit_index
-                batch = [self._entries[i] for i in range(start, end + 1)
-                         if i in self._entries]
+                # Strictly contiguous batch: a hole (possible transiently
+                # after an interrupted truncation) must stall the apply, not
+                # be skipped over — and must not busy-spin.
+                batch = []
+                i = self._applied_index + 1
+                while i <= self._commit_index and i in self._entries:
+                    batch.append(self._entries[i])
+                    i += 1
+                if not batch:
+                    self._apply_cond.wait(timeout=0.2)
+                    continue
             for e in batch:
                 if e.op_type not in ("no_op", "change_config"):
                     self.apply_cb(e)
@@ -486,7 +534,10 @@ class RaftConsensus:
                     self._applied_index = e.op_id.index
                     self._commit_cond.notify_all()
 
-    def _wait_applied(self, op_id: OpId, timeout: float) -> None:
+    def wait_applied(self, op_id: OpId, timeout: float) -> None:
+        """Block until the entry is applied. Raises NotLeader if it was
+        truncated (definitely aborted) and TimeoutError if the outcome is
+        still UNKNOWN — a timed-out entry may yet commit."""
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:
@@ -582,16 +633,13 @@ class RaftConsensus:
             self._role = Role.LEADER
             self._leader_uuid = self.uuid
             self._last_broadcast = time.monotonic()
+            self._leader_since = self._last_broadcast
             self._peers.clear()
             self._sync_peer_threads_locked()
             # Assert leadership with a no_op; committing it commits all
             # prior-term entries (reference appends a NO_OP on election).
-            entry = LogEntry(OpId(term, self._last_index + 1),
-                             self.clock.now().value, "no_op", None,
-                             self._commit_index)
-            self._append_local(entry)
-            self._advance_commit_locked()
-            self._signal_peers_locked()
+            entry = self._leader_append_locked("no_op", None, None)
+        self._ensure_durable(entry.op_id.index)
 
     def _sync_peer_threads_locked(self) -> None:
         """Make replication threads match the active config."""

@@ -77,13 +77,41 @@ class TabletPeer:
         ]
         self.tablet.mvcc.add_pending(ht)
         try:
-            self.raft.replicate("write", _encode_rows(stamped),
-                                ht=ht.value, timeout=timeout)
+            entry = self.raft.append_leader("write", _encode_rows(stamped),
+                                            ht=ht.value)
         except BaseException:
-            self.tablet.mvcc.aborted(ht)
+            self.tablet.mvcc.aborted(ht)  # never entered the log
+            raise
+        try:
+            self.raft.wait_applied(entry.op_id, timeout)
+        except NotLeader:
+            self.tablet.mvcc.aborted(ht)  # entry truncated: definite abort
+            raise
+        except TimeoutError:
+            # Outcome UNKNOWN: the entry is in the log and may still commit.
+            # The pending HT must stay pinned (a premature abort would let
+            # safe_time advance past a write that later commits — a
+            # non-repeatable read). Resolve it in the background.
+            threading.Thread(target=self._resolve_unknown_write,
+                             args=(entry.op_id, ht), daemon=True).start()
             raise
         self.tablet.mvcc.replicated(ht)
         return ht
+
+    def _resolve_unknown_write(self, op_id, ht: HybridTime) -> None:
+        """Keep a timed-out write's HT pinned until Raft resolves it."""
+        while True:
+            try:
+                self.raft.wait_applied(op_id, timeout=10.0)
+                self.tablet.mvcc.replicated(ht)
+                return
+            except NotLeader:
+                self.tablet.mvcc.aborted(ht)
+                return
+            except TimeoutError:
+                if not self.raft._running:
+                    return  # shutting down; pin dies with the process
+                continue
 
     def _apply(self, entry) -> None:
         self.tablet.apply_replicated(entry)

@@ -51,6 +51,16 @@ class LogEntry:
     # replays only entries known committed and hands the tail back to
     # consensus as pending (tablet_bootstrap.cc).
 
+    def to_record(self) -> list:
+        """The single canonical record layout (WAL payload == wire format)."""
+        return [self.op_id.term, self.op_id.index, self.ht,
+                self.op_type, self.body, self.committed]
+
+    @staticmethod
+    def from_record(rec: list) -> "LogEntry":
+        return LogEntry(OpId(rec[0], rec[1]), rec[2], rec[3], rec[4],
+                        rec[5] if len(rec) > 5 else 0)
+
 
 class Log:
     """A tablet's durable log of replicated operations."""
@@ -107,10 +117,7 @@ class Log:
         if entry.op_id <= self.last_appended:
             raise ValueError(
                 f"non-monotonic append {entry.op_id} after {self.last_appended}")
-        payload = codec.encode([
-            entry.op_id.term, entry.op_id.index, entry.ht,
-            entry.op_type, entry.body, entry.committed,
-        ])
+        payload = codec.encode(entry.to_record())
         rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         if self._file is None or \
                 self._file_size + self._buffer_bytes >= self.segment_bytes:
@@ -169,12 +176,9 @@ class Log:
             payload = data[start:end]
             if zlib.crc32(payload) != crc:
                 return out, False  # corruption: stop at last good record
-            rec = codec.decode(payload)
-            term, index, ht, op_type, body = rec[:5]
-            committed = rec[5] if len(rec) > 5 else 0
-            if index >= min_index:
-                out.append(LogEntry(OpId(term, index), ht, op_type, body,
-                                    committed))
+            entry = LogEntry.from_record(codec.decode(payload))
+            if entry.op_id.index >= min_index:
+                out.append(entry)
             pos = end
         return out, True
 
@@ -191,7 +195,10 @@ class Log:
         self.sync()
         self._close_file()
         dropped = 0
-        for path in self.segment_paths():
+        # Newest-first so a crash mid-truncation always leaves a CONTIGUOUS
+        # prefix (a tail segment is fully gone before an earlier one is
+        # rewritten) — recovery then sees a valid, if longer, log.
+        for path in reversed(self.segment_paths()):
             entries, _ = self._read_segment(path, 0)
             if not entries or entries[-1].op_id.index <= last_kept_index:
                 continue
@@ -201,10 +208,7 @@ class Log:
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     for e in kept:
-                        payload = codec.encode([
-                            e.op_id.term, e.op_id.index, e.ht,
-                            e.op_type, e.body, e.committed,
-                        ])
+                        payload = codec.encode(e.to_record())
                         f.write(_HEADER.pack(len(payload),
                                              zlib.crc32(payload)) + payload)
                     f.flush()
