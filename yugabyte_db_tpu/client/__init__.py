@@ -1,0 +1,14 @@
+"""Client library: location-aware, batching, retrying cluster access.
+
+Reference analog: src/yb/client/ — YBClient (client.cc), YBSession +
+Batcher grouping ops per tablet (batcher.h:80), MetaCache mapping
+partition ranges to tablets and leaders (meta_cache.cc), and
+TabletInvoker's replica-failover retry policy (tablet_rpc.h:52). The YQL
+frontends sit on this API exactly as the reference's CQL/Redis/pggate
+frontends sit on the C++ client.
+"""
+
+from yugabyte_db_tpu.client.client import YBClient, YBTable
+from yugabyte_db_tpu.client.session import YBSession
+
+__all__ = ["YBClient", "YBTable", "YBSession"]

@@ -1,0 +1,168 @@
+"""YBClient: the cluster entry point.
+
+Reference analog: src/yb/client/client.cc — master RPCs with leader
+failover, table handles, and the tablet-RPC retry engine
+(TabletInvoker, tablet_rpc.cc): try the known leader, learn from
+NOT_THE_LEADER hints, fall back to other replicas, refresh locations.
+"""
+
+from __future__ import annotations
+
+import time
+
+from yugabyte_db_tpu.client.meta_cache import MetaCache, TabletLocation
+from yugabyte_db_tpu.consensus.transport import TransportError
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnSchema, Schema
+
+
+class MasterUnavailable(Exception):
+    pass
+
+
+class TabletOpFailed(Exception):
+    pass
+
+
+class YBTable:
+    """A table handle: schema + key helpers (reference: YBTable)."""
+
+    def __init__(self, name: str, table_id: str, schema: Schema,
+                 engine: str = "cpu"):
+        self.name = name
+        self.table_id = table_id
+        self.schema = schema
+        self.engine = engine
+        self.col_id = {c.name: c.col_id for c in schema.columns}
+
+    def hash_code(self, key_values: dict) -> int:
+        hc = compute_hash_code(self.schema, key_values)
+        return 0 if hc is None else hc
+
+    def encode_key(self, key_values: dict) -> bytes:
+        hc = compute_hash_code(self.schema, key_values)
+        return self.schema.encode_primary_key(key_values, hc)
+
+
+class YBClient:
+    def __init__(self, transport, master_uuids: list[str],
+                 default_rpc_timeout_s: float = 10.0):
+        self.transport = transport
+        self.master_uuids = list(master_uuids)
+        self.default_rpc_timeout_s = default_rpc_timeout_s
+        self.meta_cache = MetaCache(self)
+        self._master_leader_hint: str | None = None
+
+    # -- master path ---------------------------------------------------------
+    def master_rpc(self, method: str, payload: dict,
+                   timeout_s: float | None = None) -> dict:
+        """Call the master leader, following NOT_THE_LEADER hints and
+        retrying through the master set until the deadline."""
+        deadline = time.monotonic() + (timeout_s or self.default_rpc_timeout_s)
+        last = None
+        while time.monotonic() < deadline:
+            targets = ([self._master_leader_hint]
+                       if self._master_leader_hint else []) + \
+                [u for u in self.master_uuids
+                 if u != self._master_leader_hint]
+            for target in targets:
+                try:
+                    resp = self.transport.send(target, method, payload,
+                                               timeout=2.0)
+                except (TransportError, TimeoutError) as e:
+                    last = e
+                    continue
+                if resp.get("code") == "not_leader":
+                    self._master_leader_hint = resp.get("leader_hint")
+                    last = resp
+                    continue
+                self._master_leader_hint = target
+                return resp
+            time.sleep(0.05)
+        raise MasterUnavailable(f"{method}: no master leader ({last})")
+
+    # -- ddl ----------------------------------------------------------------
+    def create_table(self, name: str, columns: list[ColumnSchema],
+                     num_tablets: int = 4, replication_factor: int = 3,
+                     engine: str = "cpu", timeout_s: float = 30.0) -> YBTable:
+        schema = Schema(columns, table_id=name)
+        resp = self.master_rpc("master.create_table", {
+            "name": name, "schema": schema.to_dict(),
+            "num_tablets": num_tablets,
+            "replication_factor": replication_factor,
+            "engine": engine,
+        }, timeout_s=timeout_s)
+        if resp.get("code") not in ("ok", "partial", "already_present"):
+            raise RuntimeError(f"create_table {name}: {resp}")
+        return self.open_table(name)
+
+    def delete_table(self, name: str) -> None:
+        resp = self.master_rpc("master.delete_table", {"name": name})
+        if resp.get("code") not in ("ok", "not_found"):
+            raise RuntimeError(f"delete_table {name}: {resp}")
+        self.meta_cache.invalidate(name)
+
+    def open_table(self, name: str) -> YBTable:
+        resp = self.master_rpc("master.get_table", {"name": name})
+        if resp.get("code") != "ok":
+            raise KeyError(f"table {name!r} not found")
+        return YBTable(name, resp["table_id"],
+                       Schema.from_dict(resp["schema"]),
+                       resp.get("engine", "cpu"))
+
+    def list_tables(self) -> list[dict]:
+        return self.master_rpc("master.list_tables", {})["tables"]
+
+    def list_tservers(self) -> list[dict]:
+        return self.master_rpc("master.list_tservers", {})["tservers"]
+
+    # -- tablet path (TabletInvoker) -----------------------------------------
+    def tablet_rpc(self, table_name: str, loc: TabletLocation, method: str,
+                   payload: dict, timeout_s: float | None = None) -> dict:
+        """Invoke a tablet RPC against its leader, with hint-following and
+        replica fallback (reference: TabletInvoker::Execute)."""
+        deadline = time.monotonic() + (timeout_s or self.default_rpc_timeout_s)
+        payload = dict(payload, tablet_id=loc.tablet_id)
+        tried_refresh = False
+        last = None
+        while time.monotonic() < deadline:
+            targets = ([loc.leader] if loc.leader else []) + \
+                [r for r in loc.replicas if r != loc.leader]
+            for target in targets:
+                try:
+                    resp = self.transport.send(target, method, payload,
+                                               timeout=5.0)
+                except (TransportError, TimeoutError) as e:
+                    last = e
+                    continue
+                code = resp.get("code")
+                if code == "not_leader":
+                    hint = resp.get("leader_hint")
+                    loc.leader = hint
+                    self.meta_cache.mark_leader(table_name, loc.tablet_id,
+                                                hint)
+                    last = resp
+                    continue
+                if code == "not_found":
+                    last = resp
+                    continue  # replica being moved/created: try others
+                if code == "ok":
+                    self.meta_cache.mark_leader(table_name, loc.tablet_id,
+                                                target)
+                    loc.leader = target
+                    return resp
+                last = resp
+            if not tried_refresh:
+                # Replica set may have changed (re-replication): refresh.
+                tried_refresh = True
+                try:
+                    locs = self.meta_cache.locations(table_name, refresh=True)
+                    for t in locs.tablets:
+                        if t.tablet_id == loc.tablet_id:
+                            loc = t
+                            break
+                except Exception as e:  # noqa: BLE001
+                    last = e
+            time.sleep(0.05)
+        raise TabletOpFailed(
+            f"{method} on {loc.tablet_id} failed before deadline: {last}")

@@ -1,0 +1,81 @@
+"""MetaCache: table -> tablet locations + leader tracking.
+
+Reference analog: src/yb/client/meta_cache.cc — the client-side cache of
+tablet partition ranges, replica sets, and last-known leaders; refreshed
+from the master on miss and corrected by NOT_THE_LEADER responses.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TabletLocation:
+    tablet_id: str
+    partition_start: int
+    partition_end: int
+    replicas: list[str] = field(default_factory=list)
+    leader: str | None = None
+
+    def contains(self, hash_code: int) -> bool:
+        return self.partition_start <= hash_code < self.partition_end
+
+
+@dataclass
+class TableLocations:
+    table_id: str
+    schema_dict: dict
+    tablets: list[TabletLocation] = field(default_factory=list)  # sorted
+
+
+class MetaCache:
+    def __init__(self, client):
+        self._client = client
+        self._lock = threading.Lock()
+        self._tables: dict[str, TableLocations] = {}
+
+    def locations(self, table_name: str,
+                  refresh: bool = False) -> TableLocations:
+        with self._lock:
+            locs = self._tables.get(table_name)
+        if locs is not None and not refresh:
+            return locs
+        resp = self._client.master_rpc("master.get_table_locations",
+                                       {"name": table_name})
+        if resp.get("code") != "ok":
+            raise KeyError(f"table {table_name!r}: {resp}")
+        locs = TableLocations(resp["table_id"], resp["schema"])
+        for t in resp["tablets"]:
+            locs.tablets.append(TabletLocation(
+                t["tablet_id"], t["partition_start"], t["partition_end"],
+                [r["uuid"] for r in t["replicas"]], t.get("leader")))
+        with self._lock:
+            self._tables[table_name] = locs
+        return locs
+
+    def lookup_by_hash(self, table_name: str, hash_code: int) -> TabletLocation:
+        """Route a key's hash code to its tablet (the EP-routing analog)."""
+        locs = self.locations(table_name)
+        for t in locs.tablets:
+            if t.contains(hash_code):
+                return t
+        raise KeyError(f"no tablet for hash {hash_code} in {table_name}")
+
+    def mark_leader(self, table_name: str, tablet_id: str,
+                    leader: str | None) -> None:
+        with self._lock:
+            locs = self._tables.get(table_name)
+            if locs is None:
+                return
+            for t in locs.tablets:
+                if t.tablet_id == tablet_id:
+                    t.leader = leader
+
+    def invalidate(self, table_name: str | None = None) -> None:
+        with self._lock:
+            if table_name is None:
+                self._tables.clear()
+            else:
+                self._tables.pop(table_name, None)

@@ -1,0 +1,190 @@
+"""YBSession: buffered ops, per-tablet batching, scans with merge.
+
+Reference analog: src/yb/client/session.cc (YBSession::Apply/FlushAsync)
++ batcher.cc (group ops per tablet, one RPC per tablet per flush) + the
+frontend-side result merging the reference does for multi-tablet reads
+(CQL executor page merging; aggregate combine as in
+PgsqlReadOperation partials, src/yb/docdb/pgsql_operation.cc:473).
+
+Aggregate fan-out: avg is decomposed into sum+count partials per tablet
+and recombined here — the cross-shard combine (CP analog) of SURVEY §2.4.
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.client.client import YBClient, YBTable
+from yugabyte_db_tpu.storage import wire
+from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
+                                               ScanSpec)
+
+
+class YBSession:
+    def __init__(self, client: YBClient):
+        self.client = client
+        self._ops: list[tuple[YBTable, int, RowVersion]] = []
+
+    # -- write ops -----------------------------------------------------------
+    def insert(self, table: YBTable, values: dict,
+               ttl_expire_ht: int = MAX_HT) -> None:
+        key_values = {c.name: values[c.name] for c in table.schema.key_columns}
+        cols = {table.col_id[c.name]: values[c.name]
+                for c in table.schema.value_columns if c.name in values}
+        row = RowVersion(table.encode_key(key_values), ht=0, liveness=True,
+                         columns=cols, expire_ht=ttl_expire_ht)
+        self._ops.append((table, table.hash_code(key_values), row))
+
+    def update(self, table: YBTable, key_values: dict, set_values: dict,
+               ttl_expire_ht: int = MAX_HT) -> None:
+        cols = {table.col_id[name]: v for name, v in set_values.items()}
+        row = RowVersion(table.encode_key(key_values), ht=0, liveness=False,
+                         columns=cols, expire_ht=ttl_expire_ht)
+        self._ops.append((table, table.hash_code(key_values), row))
+
+    def delete(self, table: YBTable, key_values: dict) -> None:
+        row = RowVersion(table.encode_key(key_values), ht=0, tombstone=True)
+        self._ops.append((table, table.hash_code(key_values), row))
+
+    def apply_row(self, table: YBTable, hash_code: int, row: RowVersion) -> None:
+        self._ops.append((table, hash_code, row))
+
+    @property
+    def pending_ops(self) -> int:
+        return len(self._ops)
+
+    def flush(self, timeout_s: float = 15.0) -> int:
+        """Group buffered ops per tablet and issue one write RPC per tablet
+        (the Batcher). Returns the number of rows written. Raises on any
+        tablet failure (ops for OTHER tablets may have applied — same
+        per-tablet atomicity as the reference without transactions)."""
+        ops, self._ops = self._ops, []
+        by_tablet: dict[str, tuple[YBTable, object, list]] = {}
+        for table, hash_code, row in ops:
+            loc = self.client.meta_cache.lookup_by_hash(table.name, hash_code)
+            key = loc.tablet_id
+            if key not in by_tablet:
+                by_tablet[key] = (table, loc, [])
+            by_tablet[key][2].append(row)
+        written = 0
+        for table, loc, rows in by_tablet.values():
+            resp = self.client.tablet_rpc(
+                table.name, loc, "ts.write",
+                {"rows": wire.encode_rows(rows)}, timeout_s=timeout_s)
+            written += len(rows)
+        return written
+
+    # -- point read ----------------------------------------------------------
+    def get(self, table: YBTable, key_values: dict) -> tuple | None:
+        """Point read by full primary key."""
+        from yugabyte_db_tpu.models.encoding import prefix_successor
+        key = table.encode_key(key_values)
+        spec = ScanSpec(lower=key, upper=prefix_successor(key), limit=1)
+        res = self.scan(table, spec)
+        return res.rows[0] if res.rows else None
+
+    # -- scans ---------------------------------------------------------------
+    def scan(self, table: YBTable, spec: ScanSpec,
+             timeout_s: float = 30.0) -> ScanResult:
+        """Fan a scan out over the table's tablets and merge.
+
+        Row scans: tablets are visited in partition order, honoring
+        spec.limit across tablets with per-tablet paging. Aggregates:
+        per-tablet partials combined client-side (avg via sum+count)."""
+        if spec.is_aggregate:
+            return self._scan_aggregate(table, spec, timeout_s)
+        locs = self.client.meta_cache.locations(table.name)
+        out_rows: list[tuple] = []
+        columns: list[str] = []
+        scanned = 0
+        remaining = spec.limit
+        for loc in locs.tablets:
+            resume = spec.lower
+            while True:
+                sub = ScanSpec(lower=resume, upper=spec.upper,
+                               read_ht=spec.read_ht,
+                               predicates=spec.predicates,
+                               projection=spec.projection,
+                               limit=remaining,
+                               group_by=spec.group_by)
+                resp = self.client.tablet_rpc(
+                    table.name, loc, "ts.scan",
+                    {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+                res = wire.decode_result(resp)
+                columns = res.columns
+                out_rows.extend(res.rows)
+                scanned += res.rows_scanned
+                if remaining is not None:
+                    remaining -= len(res.rows)
+                    if remaining <= 0:
+                        return ScanResult(columns, out_rows, None, scanned)
+                if res.resume_key is None:
+                    break
+                resume = res.resume_key
+        return ScanResult(columns, out_rows, None, scanned)
+
+    def _scan_aggregate(self, table: YBTable, spec: ScanSpec,
+                        timeout_s: float) -> ScanResult:
+        # Decompose avg into sum+count partials (reference: per-tablet
+        # EvalAggregate partials recombined above the scan).
+        partial_aggs: list[AggSpec] = []
+        mapping: list[tuple[str, int, int | None]] = []
+        for a in spec.aggregates:
+            if a.fn == "avg":
+                mapping.append(("avg", len(partial_aggs),
+                                len(partial_aggs) + 1))
+                partial_aggs.append(AggSpec("sum", a.column))
+                partial_aggs.append(AggSpec("count", a.column))
+            else:
+                mapping.append((a.fn, len(partial_aggs), None))
+                partial_aggs.append(a)
+        locs = self.client.meta_cache.locations(table.name)
+        gb = spec.group_by or []
+        ngb = len(gb)
+        # group key -> per-partial-agg accumulators
+        groups: dict[tuple, list[list]] = {}
+        scanned = 0
+        for loc in locs.tablets:
+            sub = ScanSpec(lower=spec.lower, upper=spec.upper,
+                           read_ht=spec.read_ht, predicates=spec.predicates,
+                           aggregates=partial_aggs, group_by=spec.group_by)
+            resp = self.client.tablet_rpc(
+                table.name, loc, "ts.scan",
+                {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+            res = wire.decode_result(resp)
+            scanned += res.rows_scanned
+            for row in res.rows:
+                gkey = tuple(row[:ngb])
+                groups.setdefault(gkey, []).append(list(row[ngb:]))
+        if not groups and not gb:
+            groups[()] = []
+        out_rows = []
+        for gkey in sorted(groups, key=_group_sort_key):
+            partials = groups[gkey]
+            combined: list = []
+            for i, a in enumerate(partial_aggs):
+                vals = [p[i] for p in partials if p[i] is not None]
+                if a.fn == "count":
+                    combined.append(sum(vals) if vals else 0)
+                elif a.fn == "sum":
+                    combined.append(sum(vals) if vals else None)
+                elif a.fn == "min":
+                    combined.append(min(vals) if vals else None)
+                elif a.fn == "max":
+                    combined.append(max(vals) if vals else None)
+            row = list(gkey)
+            for fn, i, j in mapping:
+                if fn == "avg":
+                    s, n = combined[i], combined[j]
+                    row.append(s / n if n else None)
+                else:
+                    row.append(combined[i])
+            out_rows.append(tuple(row))
+        names = list(gb)
+        for a in spec.aggregates:
+            names.append(f"{a.fn}({a.column or '*'})")
+        return ScanResult(names, out_rows, None, scanned)
+
+
+def _group_sort_key(gkey: tuple):
+    # Matches the engine-side group ordering (cpu_engine._sortable).
+    return tuple((v is None, v) for v in gkey)

@@ -603,7 +603,7 @@ class RaftConsensus:
             try:
                 resp = self.transport.send(peer_uuid, "raft.request_vote",
                                            req, timeout=self.opts.rpc_timeout_s)
-            except TransportError:
+            except Exception:  # any delivery failure = a vote not received
                 return
             with self._lock:
                 if resp["term"] > self.cmeta.current_term:

@@ -1,0 +1,12 @@
+"""Integration harnesses: in-process clusters for tests and tools.
+
+Reference analog: src/yb/integration-tests/ — MiniCluster
+(mini_cluster.h:92-106) runs real masters + tservers in one process;
+ExternalMiniCluster adds kill/restart. Here LocalTransport isolation plays
+the kill role, and the socket transport runs the same daemons over real
+loopback TCP.
+"""
+
+from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+
+__all__ = ["MiniCluster"]

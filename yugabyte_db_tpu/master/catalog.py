@@ -1,0 +1,92 @@
+"""Catalog state: tables and tablets, mutated only by replicated entries.
+
+Reference analog: the sys-catalog row types (src/yb/master/catalog_manager.h
+TableInfo/TabletInfo, master.proto SysTablesEntryPB/SysTabletsEntryPB).
+Every mutation is an op dict replicated through the masters' Raft group and
+applied here deterministically on each master.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TabletInfo:
+    tablet_id: str
+    table_id: str
+    partition_start: int
+    partition_end: int
+    replicas: list[str] = field(default_factory=list)  # intended node uuids
+
+
+@dataclass
+class TableInfo:
+    table_id: str
+    name: str
+    schema: dict                       # Schema.to_dict()
+    num_tablets: int
+    tablet_ids: list[str] = field(default_factory=list)
+    state: str = "RUNNING"
+    engine: str = "cpu"
+
+
+class CatalogState:
+    """Deterministic state machine over replicated catalog ops."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.tables: dict[str, TableInfo] = {}
+        self.tables_by_name: dict[str, str] = {}
+        self.tablets: dict[str, TabletInfo] = {}
+
+    def apply(self, op: dict) -> None:
+        kind = op["op"]
+        with self._lock:
+            if kind == "create_table":
+                t = TableInfo(op["table_id"], op["name"], op["schema"],
+                              op["num_tablets"], engine=op.get("engine", "cpu"))
+                for td in op["tablets"]:
+                    info = TabletInfo(td["tablet_id"], t.table_id,
+                                      td["partition_start"],
+                                      td["partition_end"],
+                                      list(td["replicas"]))
+                    self.tablets[info.tablet_id] = info
+                    t.tablet_ids.append(info.tablet_id)
+                self.tables[t.table_id] = t
+                self.tables_by_name[t.name] = t.table_id
+            elif kind == "delete_table":
+                t = self.tables.pop(op["table_id"], None)
+                if t is not None:
+                    self.tables_by_name.pop(t.name, None)
+                    for tid in t.tablet_ids:
+                        self.tablets.pop(tid, None)
+            elif kind == "set_tablet_replicas":
+                info = self.tablets.get(op["tablet_id"])
+                if info is not None:
+                    info.replicas = list(op["replicas"])
+            else:
+                raise ValueError(f"unknown catalog op {kind!r}")
+
+    # -- reads (soft, lock-protected) ---------------------------------------
+    def table_by_name(self, name: str) -> TableInfo | None:
+        with self._lock:
+            tid = self.tables_by_name.get(name)
+            return self.tables.get(tid) if tid else None
+
+    def list_tables(self) -> list[TableInfo]:
+        with self._lock:
+            return list(self.tables.values())
+
+    def tablets_of(self, table_id: str) -> list[TabletInfo]:
+        with self._lock:
+            t = self.tables.get(table_id)
+            if t is None:
+                return []
+            return [self.tablets[tid] for tid in t.tablet_ids
+                    if tid in self.tablets]
+
+    def known_tablet_ids(self) -> set[str]:
+        with self._lock:
+            return set(self.tablets)

@@ -1,0 +1,324 @@
+"""Master daemon: Raft-replicated catalog + control-plane services.
+
+Reference analog: src/yb/master/master.cc + catalog_manager.cc. The sys
+catalog is itself a Raft group over the master set (sys_catalog.h:75 "the
+sys catalog is a tablet"); CreateTable picks placements over live tservers
+and async-creates replicas on them (CreateTabletsFromTable,
+catalog_manager.cc:2274, async_rpc_tasks.cc); TS liveness and tablet
+leadership are soft state from heartbeats; a background loop re-replicates
+tablets off dead tservers (ClusterLoadBalancer's remove/add logic,
+cluster_balance.cc).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid as uuid_mod
+
+from yugabyte_db_tpu.consensus.metadata import ConsensusMetadata, RaftConfig
+from yugabyte_db_tpu.consensus.raft import NotLeader, RaftConsensus, RaftOptions
+from yugabyte_db_tpu.master.catalog import CatalogState
+from yugabyte_db_tpu.master.ts_manager import TSManager
+from yugabyte_db_tpu.models.partition import PartitionSchema
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.tablet.wal import Log
+from yugabyte_db_tpu.utils.hybrid_time import HybridClock
+
+SYS_CATALOG_ID = "sys.catalog"
+
+
+class Master:
+    def __init__(self, uuid: str, fs_root: str, transport,
+                 master_uuids: list[str],
+                 raft_opts: RaftOptions | None = None,
+                 fsync: bool = True,
+                 ts_unresponsive_timeout_s: float = 5.0,
+                 balance_interval_s: float = 1.0,
+                 advertised_addr=None):
+        self.uuid = uuid
+        self.transport = transport
+        self.advertised_addr = advertised_addr
+        self.catalog = CatalogState()
+        self.ts_manager = TSManager(ts_unresponsive_timeout_s)
+        self.balance_interval_s = balance_interval_s
+        self.clock = HybridClock()
+        sys_dir = os.path.join(fs_root, "sys-catalog")
+        os.makedirs(sys_dir, exist_ok=True)
+        self._log = Log(os.path.join(sys_dir, "wal"), fsync=fsync)
+        cmeta = ConsensusMetadata(
+            os.path.join(sys_dir, "consensus-meta.json"), uuid,
+            RaftConfig(list(master_uuids)))
+        self.raft = RaftConsensus(SYS_CATALOG_ID, cmeta, self._log, transport,
+                                  self.clock, self._apply_catalog, raft_opts)
+        self._running = False
+        self._balancer_thread: threading.Thread | None = None
+        self._fixing: dict[str, float] = {}  # tablet_id -> fix start time
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._running = True
+        self.raft.start()
+        self._balancer_thread = threading.Thread(
+            target=self._balancer_loop, name=f"balancer-{self.uuid}",
+            daemon=True)
+        self._balancer_thread.start()
+
+    def shutdown(self) -> None:
+        self._running = False
+        self.raft.shutdown()
+        if self._balancer_thread is not None:
+            self._balancer_thread.join(timeout=5.0)
+        self._log.close()
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    def _apply_catalog(self, entry) -> None:
+        if entry.op_type == "catalog":
+            self.catalog.apply(entry.body)
+
+    # -- rpc dispatch --------------------------------------------------------
+    def handle(self, method: str, payload: dict):
+        if method.startswith("raft."):
+            return self.raft.handle(method, payload)
+        handler = getattr(self, "_h_" + method.replace(".", "_"), None)
+        if handler is None:
+            raise ValueError(f"unknown method {method}")
+        return handler(payload)
+
+    def _not_leader(self) -> dict:
+        return {"code": "not_leader", "leader_hint": self.raft.leader_uuid()}
+
+    # -- ddl ----------------------------------------------------------------
+    def _h_master_create_table(self, p: dict):
+        if not self.raft.is_leader():
+            return self._not_leader()
+        name = p["name"]
+        if self.catalog.table_by_name(name) is not None:
+            return {"code": "already_present", "table_id":
+                    self.catalog.table_by_name(name).table_id}
+        schema = Schema.from_dict(p["schema"])
+        num_tablets = p.get("num_tablets", 4)
+        rf = p.get("replication_factor", 3)
+        engine = p.get("engine", "cpu")
+        live = sorted(self.ts_manager.live_tservers(),
+                      key=lambda d: d.num_live_tablets)
+        if len(live) < rf:
+            return {"code": "error",
+                    "message": f"{len(live)} live tservers < RF {rf}"}
+        table_id = uuid_mod.uuid4().hex[:16]
+        parts = PartitionSchema(
+            num_tablets, hash_partitioned=schema.num_hash > 0
+        ).create_partitions()
+        tablets = []
+        for i, part in enumerate(parts):
+            # Round-robin placement over the least-loaded live tservers.
+            replicas = [live[(i + j) % len(live)].uuid for j in range(rf)]
+            tablets.append({
+                "tablet_id": f"{table_id}-t{i:04d}",
+                "partition_start": part.start,
+                "partition_end": part.end,
+                "replicas": replicas,
+            })
+        op = {"op": "create_table", "table_id": table_id, "name": name,
+              "schema": schema.to_dict(), "num_tablets": len(parts),
+              "engine": engine, "tablets": tablets}
+        try:
+            self.raft.replicate("catalog", op)
+        except NotLeader:
+            return self._not_leader()
+        errors = self._dispatch_tablet_creates(op)
+        if errors:
+            return {"code": "partial", "table_id": table_id, "errors": errors}
+        return {"code": "ok", "table_id": table_id}
+
+    def _dispatch_tablet_creates(self, op: dict) -> list[str]:
+        errors = []
+        for td in op["tablets"]:
+            for replica in td["replicas"]:
+                req = {
+                    "tablet_id": td["tablet_id"],
+                    "table_name": op["name"],
+                    "schema": op["schema"],
+                    "partition_start": td["partition_start"],
+                    "partition_end": td["partition_end"],
+                    "engine": op.get("engine", "cpu"),
+                    "peers": td["replicas"],
+                }
+                try:
+                    self.transport.send(replica, "ts.create_tablet", req,
+                                        timeout=5.0)
+                except Exception as e:  # noqa: BLE001 — balancer retries
+                    errors.append(f"{td['tablet_id']}@{replica}: {e}")
+        return errors
+
+    def _h_master_delete_table(self, p: dict):
+        if not self.raft.is_leader():
+            return self._not_leader()
+        t = self.catalog.table_by_name(p["name"])
+        if t is None:
+            return {"code": "not_found"}
+        tablets = self.catalog.tablets_of(t.table_id)
+        try:
+            self.raft.replicate("catalog",
+                                {"op": "delete_table", "table_id": t.table_id})
+        except NotLeader:
+            return self._not_leader()
+        for info in tablets:
+            for replica in info.replicas:
+                try:
+                    self.transport.send(replica, "ts.delete_tablet",
+                                        {"tablet_id": info.tablet_id},
+                                        timeout=5.0)
+                except Exception:  # noqa: BLE001 — heartbeat GC retries
+                    pass
+        return {"code": "ok"}
+
+    # -- lookups ------------------------------------------------------------
+    def _h_master_get_table(self, p: dict):
+        t = self.catalog.table_by_name(p["name"])
+        if t is None:
+            return {"code": "not_found"}
+        return {"code": "ok", "table_id": t.table_id, "name": t.name,
+                "schema": t.schema, "num_tablets": t.num_tablets,
+                "engine": t.engine}
+
+    def _h_master_get_table_locations(self, p: dict):
+        t = self.catalog.table_by_name(p["name"])
+        if t is None:
+            return {"code": "not_found"}
+        out = []
+        for info in self.catalog.tablets_of(t.table_id):
+            out.append({
+                "tablet_id": info.tablet_id,
+                "partition_start": info.partition_start,
+                "partition_end": info.partition_end,
+                "replicas": [
+                    {"uuid": r, "addr": self.ts_manager.addr_of(r)}
+                    for r in info.replicas
+                ],
+                "leader": self.ts_manager.leader_of(info.tablet_id),
+            })
+        out.sort(key=lambda d: d["partition_start"])
+        return {"code": "ok", "table_id": t.table_id, "schema": t.schema,
+                "tablets": out}
+
+    def _h_master_list_tables(self, p: dict):
+        return {"code": "ok", "tables": [
+            {"table_id": t.table_id, "name": t.name, "state": t.state,
+             "num_tablets": t.num_tablets}
+            for t in self.catalog.list_tables()
+        ]}
+
+    def _h_master_list_tservers(self, p: dict):
+        now_dead = {d.uuid for d in self.ts_manager.dead_tservers()}
+        return {"code": "ok", "tservers": [
+            {"uuid": d.uuid, "addr": d.addr, "alive": d.uuid not in now_dead,
+             "num_live_tablets": d.num_live_tablets}
+            for d in self.ts_manager.all_tservers()
+        ]}
+
+    # -- heartbeats ----------------------------------------------------------
+    def _h_master_ts_heartbeat(self, p: dict):
+        if not self.raft.is_leader():
+            return self._not_leader()
+        self.ts_manager.heartbeat(p)
+        resp = {"code": "ok", "master_uuid": self.uuid}
+        st = self.raft.stats()
+        if st["applied_index"] >= st["commit_index"]:
+            # Catalog fully applied: safe to identify orphaned replicas
+            # (reference: master orders deletion of tablets not in catalog,
+            # and of replicas no longer in the tablet's config).
+            known = self.catalog.known_tablet_ids()
+            now = time.monotonic()
+            to_delete = []
+            for t in p.get("tablets", []):
+                tid = t["tablet_id"]
+                if tid not in known:
+                    to_delete.append(tid)
+                    continue
+                if now - self._fixing.get(tid, 0) < 30.0:
+                    continue  # re-replication in flight; don't race it
+                info = self.catalog.tablets.get(tid)
+                if info is not None and p["ts_uuid"] not in info.replicas:
+                    to_delete.append(tid)
+            resp["tablets_to_delete"] = sorted(to_delete)
+        return resp
+
+    def _rpc_ok(self, dst: str, method: str, payload: dict,
+                timeout: float = 5.0) -> dict:
+        resp = self.transport.send(dst, method, payload, timeout=timeout)
+        if resp.get("code") != "ok":
+            raise RuntimeError(f"{method} to {dst}: {resp}")
+        return resp
+
+    # -- re-replication (ClusterLoadBalancer's failure-recovery half) --------
+    def _balancer_loop(self) -> None:
+        while self._running:
+            time.sleep(self.balance_interval_s)
+            if not self._running or not self.raft.is_leader():
+                continue
+            try:
+                self._rereplicate_once()
+            except Exception:  # noqa: BLE001 — next tick retries
+                pass
+
+    def _rereplicate_once(self) -> None:
+        dead = {d.uuid for d in self.ts_manager.dead_tservers()}
+        if not dead:
+            return
+        live = sorted(self.ts_manager.live_tservers(),
+                      key=lambda d: d.num_live_tablets)
+        if not live:
+            return
+        now = time.monotonic()
+        for t in self.catalog.list_tables():
+            for info in self.catalog.tablets_of(t.table_id):
+                bad = [r for r in info.replicas if r in dead]
+                if not bad:
+                    continue
+                if now - self._fixing.get(info.tablet_id, 0) < 10.0:
+                    continue  # a fix is already in flight
+                candidates = [d.uuid for d in live
+                              if d.uuid not in info.replicas]
+                if not candidates:
+                    continue
+                self._fixing[info.tablet_id] = now
+                replacement = candidates[0]
+                without_dead = [r for r in info.replicas if r != bad[0]]
+                with_new = without_dead + [replacement]
+                leader = self.ts_manager.leader_of(info.tablet_id)
+                if leader is None or leader in dead or leader not in \
+                        without_dead:
+                    continue  # wait for the group to elect a live leader
+                try:
+                    # Raft membership changes are one server at a time:
+                    # REMOVE the dead replica, then ADD the replacement
+                    # (reference: ChangeConfig REMOVE_SERVER/ADD_SERVER).
+                    self._rpc_ok(leader, "ts.change_config", {
+                        "tablet_id": info.tablet_id,
+                        "peers": without_dead,
+                    }, timeout=10.0)
+                    self._rpc_ok(replacement, "ts.create_tablet", {
+                        "tablet_id": info.tablet_id,
+                        "table_name": t.name,
+                        "schema": t.schema,
+                        "partition_start": info.partition_start,
+                        "partition_end": info.partition_end,
+                        "engine": t.engine,
+                        # Not a voter yet: the leader's change_config adds it.
+                        "peers": without_dead,
+                    }, timeout=5.0)
+                    self._rpc_ok(leader, "ts.change_config", {
+                        "tablet_id": info.tablet_id,
+                        "peers": with_new,
+                    }, timeout=10.0)
+                    self.raft.replicate("catalog", {
+                        "op": "set_tablet_replicas",
+                        "tablet_id": info.tablet_id,
+                        "replicas": with_new,
+                    })
+                except Exception:  # noqa: BLE001 — retried next tick
+                    self._fixing.pop(info.tablet_id, None)

@@ -1,0 +1,76 @@
+"""TSManager: tserver liveness + soft cluster state from heartbeats.
+
+Reference analog: src/yb/master/ts_manager.{h,cc} + TSDescriptor — last
+heartbeat time, reported tablets, and the per-tablet leader hints the
+location cache serves. Soft state: NOT replicated, rebuilt from heartbeats
+after master failover (exactly the reference's design).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TSDescriptor:
+    uuid: str
+    addr: object = None
+    last_heartbeat: float = 0.0
+    num_live_tablets: int = 0
+    tablet_roles: dict = field(default_factory=dict)  # tablet_id -> role
+
+
+class TSManager:
+    def __init__(self, unresponsive_timeout_s: float = 5.0):
+        self._lock = threading.Lock()
+        self._descs: dict[str, TSDescriptor] = {}
+        # tablet_id -> (leader uuid, term): freshest leadership seen.
+        self._tablet_leaders: dict[str, tuple[str, int]] = {}
+        self.unresponsive_timeout_s = unresponsive_timeout_s
+
+    def heartbeat(self, req: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            d = self._descs.get(req["ts_uuid"])
+            if d is None:
+                d = TSDescriptor(req["ts_uuid"])
+                self._descs[d.uuid] = d
+            d.addr = req.get("addr")
+            d.last_heartbeat = now
+            d.num_live_tablets = req.get("num_live_tablets", 0)
+            d.tablet_roles = {t["tablet_id"]: t["role"]
+                              for t in req.get("tablets", [])}
+            for t in req.get("tablets", []):
+                leader, term = t.get("leader"), t.get("term", 0)
+                if leader:
+                    cur = self._tablet_leaders.get(t["tablet_id"])
+                    if cur is None or term >= cur[1]:
+                        self._tablet_leaders[t["tablet_id"]] = (leader, term)
+
+    def live_tservers(self) -> list[TSDescriptor]:
+        cutoff = time.monotonic() - self.unresponsive_timeout_s
+        with self._lock:
+            return [d for d in self._descs.values()
+                    if d.last_heartbeat >= cutoff]
+
+    def dead_tservers(self) -> list[TSDescriptor]:
+        cutoff = time.monotonic() - self.unresponsive_timeout_s
+        with self._lock:
+            return [d for d in self._descs.values()
+                    if d.last_heartbeat < cutoff]
+
+    def all_tservers(self) -> list[TSDescriptor]:
+        with self._lock:
+            return list(self._descs.values())
+
+    def leader_of(self, tablet_id: str) -> str | None:
+        with self._lock:
+            v = self._tablet_leaders.get(tablet_id)
+            return v[0] if v else None
+
+    def addr_of(self, uuid: str):
+        with self._lock:
+            d = self._descs.get(uuid)
+            return d.addr if d else None

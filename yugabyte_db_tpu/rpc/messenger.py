@@ -30,7 +30,14 @@ class ConnectionContext:
 
     Subclass per wire protocol. ``feed(data)`` returns a list of parsed
     call objects; ``serialize(response)`` returns bytes to write back.
+
+    ``ordered_responses``: foreign byte protocols (RESP, CQL without
+    stream ids) match replies to requests by ORDER, so their handlers must
+    run one-at-a-time per connection. The native context matches by call
+    id and keeps full cross-call concurrency on one connection.
     """
+
+    ordered_responses = True
 
     def feed(self, data: bytes) -> list:
         raise NotImplementedError
@@ -41,6 +48,8 @@ class ConnectionContext:
 
 class RpcConnectionContext(ConnectionContext):
     """The native framed-codec protocol: [len][codec([call_id, method, body])]."""
+
+    ordered_responses = False  # call ids pair requests with responses
 
     def __init__(self):
         self._buf = bytearray()
@@ -75,6 +84,10 @@ class _Connection:
         self.out = bytearray()
         self.out_lock = threading.Lock()
         self.closed = False
+        # Ordered-dispatch state (foreign protocols): a FIFO of parsed
+        # calls drained by at most one worker at a time.
+        self.call_queue: list = []
+        self.draining = False
 
 
 class Messenger:
@@ -173,10 +186,30 @@ class Messenger:
                 except Exception:
                     self._close_conn(conn)
                     return
-                for call in calls:
-                    self._pool.submit(self._dispatch, conn, call)
+                if conn.context.ordered_responses:
+                    # Replies pair with requests by order: serialize
+                    # handler execution per connection.
+                    with conn.out_lock:
+                        conn.call_queue.extend(calls)
+                        start_drain = calls and not conn.draining
+                        if start_drain:
+                            conn.draining = True
+                    if start_drain:
+                        self._pool.submit(self._drain_ordered, conn)
+                else:
+                    for call in calls:
+                        self._pool.submit(self._dispatch, conn, call)
         if mask & selectors.EVENT_WRITE:
             self._try_write(conn)
+
+    def _drain_ordered(self, conn: _Connection) -> None:
+        while True:
+            with conn.out_lock:
+                if not conn.call_queue or conn.closed:
+                    conn.draining = False
+                    return
+                call = conn.call_queue.pop(0)
+            self._dispatch(conn, call)
 
     def _dispatch(self, conn: _Connection, call) -> None:
         """Worker-side: run the handler, enqueue the response."""
@@ -214,7 +247,9 @@ class Messenger:
                 self._watch(conn, write=False)
                 return
             try:
-                n = conn.sock.send(bytes(conn.out))
+                # Bounded chunk: copy at most 256K per send, not the whole
+                # pending buffer (a 4MB response would otherwise be O(n^2)).
+                n = conn.sock.send(bytes(conn.out[:256 * 1024]))
                 del conn.out[:n]
             except (BlockingIOError, InterruptedError):
                 n = 0
@@ -252,5 +287,6 @@ class Messenger:
         self._wake()
         self._thread.join(timeout=5.0)
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._sel.close()
         self._wake_r.close()
         self._wake_w.close()

@@ -67,6 +67,11 @@ class Proxy:
             with self._lock:
                 self._pending.pop(call_id, None)
             raise TimeoutError(f"rpc {method} to {self.addr} timed out")
+        if pc.status == "conn_closed":
+            # Transport-level loss, NOT a remote handler error: callers'
+            # failover paths key on ConnectionError.
+            raise ConnectionError(f"connection to {self.addr} dropped "
+                                  f"mid-call ({method})")
         if pc.status != "ok":
             raise RpcCallError(pc.body)
         return pc.body
@@ -107,7 +112,7 @@ class Proxy:
             pending = list(self._pending.values())
             self._pending.clear()
         for pc in pending:
-            pc.status, pc.body = "error", "connection closed"
+            pc.status, pc.body = "conn_closed", None
             pc.event.set()
         try:
             self._sock.close()

@@ -1,0 +1,87 @@
+"""Wire codecs for storage types crossing RPC/WAL boundaries.
+
+Reference analog: the request/response protos carrying row operations and
+scan state (src/yb/common/ql_protocol.proto, wire_protocol.proto). One
+canonical encoding serves the WAL body (tablet.py) and the client/tserver
+RPCs, so a WAL entry can be shipped verbatim during catchup.
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
+                                               ScanSpec)
+
+
+# -- rows -------------------------------------------------------------------
+
+def encode_rows(rows: list[RowVersion]) -> list:
+    return [
+        [r.key, r.ht, r.tombstone, r.liveness,
+         {str(c): v for c, v in r.columns.items()}, r.expire_ht]
+        for r in rows
+    ]
+
+
+def decode_rows(body: list) -> list[RowVersion]:
+    return [
+        RowVersion(key, ht=ht, tombstone=tomb, liveness=live,
+                   columns={int(c): v for c, v in cols.items()},
+                   expire_ht=exp)
+        for key, ht, tomb, live, cols, exp in body
+    ]
+
+
+# -- scan specs -------------------------------------------------------------
+
+def encode_spec(spec: ScanSpec) -> dict:
+    return {
+        "lower": spec.lower,
+        "upper": spec.upper,
+        "read_ht": spec.read_ht,
+        "predicates": [[p.column, p.op,
+                        list(p.value) if p.op == "IN" else p.value]
+                       for p in spec.predicates],
+        "projection": spec.projection,
+        "limit": spec.limit,
+        "aggregates": ([[a.fn, a.column] for a in spec.aggregates]
+                       if spec.aggregates else None),
+        "group_by": spec.group_by,
+    }
+
+
+def decode_spec(d: dict) -> ScanSpec:
+    return ScanSpec(
+        lower=d.get("lower", b""),
+        upper=d.get("upper", b""),
+        read_ht=d.get("read_ht", MAX_HT),
+        predicates=[
+            Predicate(c, op, tuple(v) if op == "IN" else v)
+            for c, op, v in d.get("predicates", [])
+        ],
+        projection=d.get("projection"),
+        limit=d.get("limit"),
+        aggregates=([AggSpec(fn, col) for fn, col in d["aggregates"]]
+                    if d.get("aggregates") else None),
+        group_by=d.get("group_by"),
+    )
+
+
+# -- scan results -----------------------------------------------------------
+
+def encode_result(res: ScanResult) -> dict:
+    return {
+        "columns": res.columns,
+        "rows": [list(r) for r in res.rows],
+        "resume_key": res.resume_key,
+        "rows_scanned": res.rows_scanned,
+    }
+
+
+def decode_result(d: dict) -> ScanResult:
+    return ScanResult(
+        columns=d["columns"],
+        rows=[tuple(r) for r in d["rows"]],
+        resume_key=d.get("resume_key"),
+        rows_scanned=d.get("rows_scanned", 0),
+    )

@@ -22,6 +22,9 @@ from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.storage.engine import make_engine
 from yugabyte_db_tpu.storage.row_version import RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+# Canonical row wire codec (shared with RPC payloads).
+from yugabyte_db_tpu.storage.wire import decode_rows as _decode_rows
+from yugabyte_db_tpu.storage.wire import encode_rows as _encode_rows
 from yugabyte_db_tpu.tablet.mvcc import MvccManager
 from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
 from yugabyte_db_tpu.utils.hybrid_time import HybridClock, HybridTime
@@ -223,18 +226,3 @@ class Tablet:
         return Tablet(meta, data_root, **kwargs)
 
 
-def _encode_rows(rows: list[RowVersion]) -> list:
-    return [
-        [r.key, r.ht, r.tombstone, r.liveness,
-         {str(c): v for c, v in r.columns.items()}, r.expire_ht]
-        for r in rows
-    ]
-
-
-def _decode_rows(body: list) -> list[RowVersion]:
-    return [
-        RowVersion(key, ht=ht, tombstone=tomb, liveness=live,
-                   columns={int(c): v for c, v in cols.items()},
-                   expire_ht=exp)
-        for key, ht, tomb, live, cols, exp in body
-    ]

@@ -1,0 +1,84 @@
+"""Heartbeater: periodic tserver -> master liveness + tablet reports.
+
+Reference analog: src/yb/tserver/heartbeater.{h,cc} — finds the master
+leader (trying each master, following NOT_THE_LEADER hints), registers on
+first contact, and ships incremental tablet reports; the master answers
+with the catalog's view (e.g. tablets to delete).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Heartbeater:
+    def __init__(self, server, master_uuids: list[str],
+                 interval_s: float = 0.5):
+        self.server = server
+        self.master_uuids = list(master_uuids)
+        self.interval_s = interval_s
+        self._leader_hint: str | None = None
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        self.last_response: dict | None = None
+        self.consecutive_failures = 0
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name=f"heartbeat-{self.server.uuid}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def trigger(self) -> None:
+        """Heartbeat now (e.g. right after a tablet state change)."""
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self._heartbeat_once()
+                self.consecutive_failures = 0
+            except Exception:
+                self.consecutive_failures += 1
+                self._leader_hint = None
+            self._wake.wait(timeout=self.interval_s)
+            self._wake.clear()
+
+    def _heartbeat_once(self) -> None:
+        req = {
+            "ts_uuid": self.server.uuid,
+            "addr": self.server.advertised_addr,
+            "tablets": self.server.tablet_manager.tablet_reports(),
+            "num_live_tablets": len(self.server.tablet_manager.peers()),
+        }
+        targets = ([self._leader_hint] if self._leader_hint else []) + [
+            u for u in self.master_uuids if u != self._leader_hint]
+        last_err: Exception | None = None
+        for target in targets:
+            try:
+                resp = self.server.transport.send(
+                    target, "master.ts_heartbeat", req, timeout=2.0)
+            except Exception as e:  # noqa: BLE001 — try the next master
+                last_err = e
+                continue
+            if resp.get("code") == "not_leader":
+                self._leader_hint = resp.get("leader_hint")
+                if self._leader_hint and self._leader_hint not in targets:
+                    targets.append(self._leader_hint)
+                continue
+            self._leader_hint = target
+            self.last_response = resp
+            self.server.process_heartbeat_response(resp)
+            return
+        if last_err is not None:
+            raise last_err
+        raise ConnectionError("no master leader reachable")

@@ -1,0 +1,116 @@
+"""TSTabletManager: the set of tablet replicas hosted by one node.
+
+Reference analog: src/yb/tserver/ts_tablet_manager.cc — opens every tablet
+found on disk at startup (each under <fs_root>/tablet-data/<tablet_id>),
+creates/deletes replicas on master request, and routes per-tablet RPCs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+from yugabyte_db_tpu.consensus.raft import RaftOptions
+from yugabyte_db_tpu.tablet.tablet import TabletMetadata
+from yugabyte_db_tpu.tablet.tablet_peer import TabletPeer
+
+
+class TabletAlreadyExists(Exception):
+    pass
+
+
+class TabletNotFound(Exception):
+    pass
+
+
+class TSTabletManager:
+    def __init__(self, node_uuid: str, fs_root: str, transport,
+                 raft_opts: RaftOptions | None = None,
+                 engine_options: dict | None = None, fsync: bool = True):
+        self.node_uuid = node_uuid
+        self.data_root = os.path.join(fs_root, "tablet-data")
+        os.makedirs(self.data_root, exist_ok=True)
+        self.transport = transport
+        self.raft_opts = raft_opts
+        self.engine_options = engine_options
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._peers: dict[str, TabletPeer] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def open_existing(self) -> int:
+        """Open every tablet directory found on disk (startup path)."""
+        opened = 0
+        for tablet_id in sorted(os.listdir(self.data_root)):
+            meta_path = os.path.join(self.data_root, tablet_id,
+                                     "tablet-meta.json")
+            if not os.path.exists(meta_path):
+                continue
+            meta = TabletMetadata.load(meta_path)
+            self._start_peer(meta, initial_peers=[])
+            opened += 1
+        return opened
+
+    def create_tablet(self, meta: TabletMetadata, peers: list[str]) -> TabletPeer:
+        with self._lock:
+            if meta.tablet_id in self._peers:
+                raise TabletAlreadyExists(meta.tablet_id)
+        tdir = os.path.join(self.data_root, meta.tablet_id)
+        os.makedirs(tdir, exist_ok=True)
+        meta.save(os.path.join(tdir, "tablet-meta.json"))
+        return self._start_peer(meta, peers)
+
+    def _start_peer(self, meta: TabletMetadata, initial_peers: list[str]) -> TabletPeer:
+        peer = TabletPeer(self.node_uuid, meta, self.data_root,
+                          self.transport, initial_peers,
+                          engine_options=self.engine_options,
+                          fsync=self.fsync, raft_opts=self.raft_opts)
+        with self._lock:
+            self._peers[meta.tablet_id] = peer
+        peer.start()
+        return peer
+
+    def delete_tablet(self, tablet_id: str) -> None:
+        with self._lock:
+            peer = self._peers.pop(tablet_id, None)
+        if peer is not None:
+            peer.shutdown()
+        tdir = os.path.join(self.data_root, tablet_id)
+        if os.path.isdir(tdir):
+            shutil.rmtree(tdir)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            peers = list(self._peers.values())
+            self._peers.clear()
+        for p in peers:
+            p.shutdown()
+
+    # -- access -------------------------------------------------------------
+    def get(self, tablet_id: str) -> TabletPeer:
+        with self._lock:
+            peer = self._peers.get(tablet_id)
+        if peer is None:
+            raise TabletNotFound(tablet_id)
+        return peer
+
+    def peers(self) -> list[TabletPeer]:
+        with self._lock:
+            return list(self._peers.values())
+
+    def tablet_reports(self) -> list[dict]:
+        """Per-tablet state for the master heartbeat (reference:
+        TabletReportPB in master.proto)."""
+        out = []
+        for p in self.peers():
+            rs = p.raft.stats()
+            out.append({
+                "tablet_id": p.tablet_id,
+                "table_name": p.tablet.meta.table_name,
+                "role": rs["role"],
+                "term": rs["term"],
+                "leader": rs["leader"],
+                "peers": rs["config"]["peers"],
+            })
+        return out

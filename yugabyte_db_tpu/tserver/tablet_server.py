@@ -1,0 +1,148 @@
+"""TabletServer: the data-node daemon and its RPC service.
+
+Reference analog: src/yb/tserver/tablet_server.cc (the daemon) +
+tablet_service.cc (TabletServiceImpl::Write at :718, ::Read at :1001 — the
+leader checks, tablet lookup, and the NOT_THE_LEADER error protocol that
+drives client failover) + the consensus service routing per-tablet RPCs.
+
+Service responses carry {"code": "ok"| "not_leader" | "not_found" | ...};
+NOT_LEADER responses include the best leader hint, which the client's
+MetaCache uses to re-route (the reference's TabletInvoker contract).
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.consensus.raft import NotLeader, RaftOptions
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.storage import wire
+from yugabyte_db_tpu.tablet.tablet import TabletMetadata
+from yugabyte_db_tpu.tserver.heartbeater import Heartbeater
+from yugabyte_db_tpu.tserver.tablet_manager import (TabletNotFound,
+                                                    TSTabletManager)
+
+
+class TabletServer:
+    def __init__(self, uuid: str, fs_root: str, transport,
+                 master_uuids: list[str],
+                 raft_opts: RaftOptions | None = None,
+                 engine_options: dict | None = None,
+                 fsync: bool = True,
+                 heartbeat_interval_s: float = 0.5,
+                 advertised_addr=None):
+        self.uuid = uuid
+        self.transport = transport
+        self.advertised_addr = advertised_addr  # (host, port) when on TCP
+        self.tablet_manager = TSTabletManager(
+            uuid, fs_root, transport, raft_opts=raft_opts,
+            engine_options=engine_options, fsync=fsync)
+        self.heartbeater = Heartbeater(self, master_uuids,
+                                       interval_s=heartbeat_interval_s)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.tablet_manager.open_existing()
+        self.heartbeater.start()
+
+    def shutdown(self) -> None:
+        self.heartbeater.stop()
+        self.tablet_manager.shutdown()
+
+    def process_heartbeat_response(self, resp: dict) -> None:
+        for tablet_id in resp.get("tablets_to_delete", []):
+            try:
+                self.tablet_manager.delete_tablet(tablet_id)
+            except Exception:  # noqa: BLE001 — deletion retried next beat
+                pass
+
+    # -- rpc dispatch --------------------------------------------------------
+    def handle(self, method: str, payload: dict):
+        if method.startswith("raft."):
+            try:
+                peer = self.tablet_manager.get(payload["tablet_id"])
+            except TabletNotFound:
+                return {"code": "not_found", "term": 0, "granted": False,
+                        "success": False, "last_index": 0}
+            return peer.raft.handle(method, payload)
+        handler = getattr(self, "_h_" + method.replace(".", "_"), None)
+        if handler is None:
+            raise ValueError(f"unknown method {method}")
+        return handler(payload)
+
+    # -- service handlers ----------------------------------------------------
+    def _h_ts_create_tablet(self, p: dict):
+        meta = TabletMetadata(
+            p["tablet_id"], p["table_name"], Schema.from_dict(p["schema"]),
+            p["partition_start"], p["partition_end"],
+            p.get("engine", "cpu"))
+        try:
+            self.tablet_manager.create_tablet(meta, p["peers"])
+        except Exception as e:  # includes TabletAlreadyExists (idempotent)
+            if "TabletAlreadyExists" not in type(e).__name__:
+                raise
+        self.heartbeater.trigger()
+        return {"code": "ok"}
+
+    def _h_ts_delete_tablet(self, p: dict):
+        self.tablet_manager.delete_tablet(p["tablet_id"])
+        return {"code": "ok"}
+
+    def _h_ts_write(self, p: dict):
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        rows = wire.decode_rows(p["rows"])
+        try:
+            ht = peer.write(rows, timeout=p.get("timeout", 10.0))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            return {"code": "timed_out"}
+        return {"code": "ok", "ht": ht.value}
+
+    def _h_ts_scan(self, p: dict):
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        spec = wire.decode_spec(p["spec"])
+        if spec.read_ht == wire.MAX_HT:
+            spec.read_ht = peer.read_time().value
+        try:
+            res = peer.scan(spec, allow_stale=p.get("allow_stale", False))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        out = wire.encode_result(res)
+        out["code"] = "ok"
+        out["read_ht"] = spec.read_ht
+        return out
+
+    def _h_ts_flush(self, p: dict):
+        self.tablet_manager.get(p["tablet_id"]).flush()
+        return {"code": "ok"}
+
+    def _h_ts_compact(self, p: dict):
+        self.tablet_manager.get(p["tablet_id"]).compact(
+            p.get("history_cutoff_ht", 0))
+        return {"code": "ok"}
+
+    def _h_ts_change_config(self, p: dict):
+        peer = self.tablet_manager.get(p["tablet_id"])
+        try:
+            peer.raft.change_config(p["peers"])
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        return {"code": "ok"}
+
+    def _h_ts_transfer_leadership(self, p: dict):
+        peer = self.tablet_manager.get(p["tablet_id"])
+        peer.raft.transfer_leadership(p["target"])
+        return {"code": "ok"}
+
+    def _h_ts_status(self, p: dict):
+        return {
+            "code": "ok",
+            "uuid": self.uuid,
+            "tablets": {pr.tablet_id: pr.stats()
+                        for pr in self.tablet_manager.peers()},
+        }
