@@ -97,11 +97,16 @@ class YBSession:
         columns: list[str] = []
         scanned = 0
         remaining = spec.limit
+        # Snapshot consistency across pages/tablets: the first sub-scan's
+        # server-chosen read time is pinned for every subsequent request
+        # (the reference's ConsistentReadPoint contract — the server returns
+        # the chosen read_ht precisely so the client can pin it).
+        read_ht = spec.read_ht
         for loc in locs.tablets:
             resume = spec.lower
             while True:
                 sub = ScanSpec(lower=resume, upper=spec.upper,
-                               read_ht=spec.read_ht,
+                               read_ht=read_ht,
                                predicates=spec.predicates,
                                projection=spec.projection,
                                limit=remaining,
@@ -109,6 +114,8 @@ class YBSession:
                 resp = self.client.tablet_rpc(
                     table.name, loc, "ts.scan",
                     {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+                if "read_ht" in resp:
+                    read_ht = resp["read_ht"]
                 res = wire.decode_result(resp)
                 columns = res.columns
                 out_rows.extend(res.rows)
@@ -143,13 +150,16 @@ class YBSession:
         # group key -> per-partial-agg accumulators
         groups: dict[tuple, list[list]] = {}
         scanned = 0
+        read_ht = spec.read_ht  # pinned after the first sub-scan (see scan())
         for loc in locs.tablets:
             sub = ScanSpec(lower=spec.lower, upper=spec.upper,
-                           read_ht=spec.read_ht, predicates=spec.predicates,
+                           read_ht=read_ht, predicates=spec.predicates,
                            aggregates=partial_aggs, group_by=spec.group_by)
             resp = self.client.tablet_rpc(
                 table.name, loc, "ts.scan",
                 {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+            if "read_ht" in resp:
+                read_ht = resp["read_ht"]
             res = wire.decode_result(resp)
             scanned += res.rows_scanned
             for row in res.rows:

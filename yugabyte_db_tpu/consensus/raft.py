@@ -107,6 +107,7 @@ class RaftConsensus:
         self._last_heartbeat_recv = time.monotonic()
         self._last_broadcast = 0.0
         self._leader_since = 0.0  # when this node last won an election
+        self._own_term_noop = (0, 0)  # (term, index) of our election no_op
         self._running = False
 
         # Log state: full in-memory entry cache (LogCache analog).
@@ -170,6 +171,19 @@ class RaftConsensus:
 
     def is_leader(self) -> bool:
         return self._role == Role.LEADER
+
+    def leader_ready(self) -> bool:
+        """True once this leader has APPLIED an entry of its own term (the
+        election no_op). Before that, the local commit/applied watermarks
+        may lag the true cluster commit — destructive control-plane
+        decisions (orphan-replica GC) must wait for this gate (reference:
+        CatalogManager's leader-ready / sys-catalog-loaded check)."""
+        with self._lock:
+            if self._role != Role.LEADER:
+                return False
+            term, idx = self._own_term_noop
+            return (term == self.cmeta.current_term and idx > 0 and
+                    self._applied_index >= idx)
 
     def has_lease(self) -> bool:
         """Majority-ack leader lease: safe to serve reads locally."""
@@ -639,6 +653,7 @@ class RaftConsensus:
             # Assert leadership with a no_op; committing it commits all
             # prior-term entries (reference appends a NO_OP on election).
             entry = self._leader_append_locked("no_op", None, None)
+            self._own_term_noop = (term, entry.op_id.index)
         self._ensure_durable(entry.op_id.index)
 
     def _sync_peer_threads_locked(self) -> None:

@@ -55,6 +55,12 @@ class Master:
         self._running = False
         self._balancer_thread: threading.Thread | None = None
         self._fixing: dict[str, float] = {}  # tablet_id -> fix start time
+        # (tablet_id, replica) creates that FAILED to dispatch: the balancer
+        # retries exactly these. Recreating any other missing replica would
+        # be unsafe — a voter that lost its disk must not be handed a fresh
+        # empty log while still counted in the config (it could elect a
+        # leader without committed entries); that case is remote bootstrap's.
+        self._failed_creates: set[tuple[str, str]] = set()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -134,23 +140,30 @@ class Master:
             return {"code": "partial", "table_id": table_id, "errors": errors}
         return {"code": "ok", "table_id": table_id}
 
+    @staticmethod
+    def _create_tablet_req(tablet_id: str, table_name: str, schema,
+                           partition_start, partition_end, engine: str,
+                           peers: list[str]) -> dict:
+        """The one canonical ts.create_tablet payload (built in three
+        places: initial dispatch, dead-TS re-replication, create retry)."""
+        return {"tablet_id": tablet_id, "table_name": table_name,
+                "schema": schema, "partition_start": partition_start,
+                "partition_end": partition_end, "engine": engine,
+                "peers": peers}
+
     def _dispatch_tablet_creates(self, op: dict) -> list[str]:
         errors = []
         for td in op["tablets"]:
             for replica in td["replicas"]:
-                req = {
-                    "tablet_id": td["tablet_id"],
-                    "table_name": op["name"],
-                    "schema": op["schema"],
-                    "partition_start": td["partition_start"],
-                    "partition_end": td["partition_end"],
-                    "engine": op.get("engine", "cpu"),
-                    "peers": td["replicas"],
-                }
+                req = self._create_tablet_req(
+                    td["tablet_id"], op["name"], op["schema"],
+                    td["partition_start"], td["partition_end"],
+                    op.get("engine", "cpu"), td["replicas"])
                 try:
                     self.transport.send(replica, "ts.create_tablet", req,
                                         timeout=5.0)
                 except Exception as e:  # noqa: BLE001 — balancer retries
+                    self._failed_creates.add((td["tablet_id"], replica))
                     errors.append(f"{td['tablet_id']}@{replica}: {e}")
         return errors
 
@@ -227,7 +240,12 @@ class Master:
         self.ts_manager.heartbeat(p)
         resp = {"code": "ok", "master_uuid": self.uuid}
         st = self.raft.stats()
-        if st["applied_index"] >= st["commit_index"]:
+        # Orphan GC is destructive: a new leader's LOCAL watermarks can lag
+        # the true cluster commit until its own-term no_op is applied, so a
+        # just-committed table could look absent from the catalog. Gate on
+        # leader_ready() (own-term entry applied) AND fully-applied.
+        if self.raft.leader_ready() and \
+                st["applied_index"] >= st["commit_index"]:
             # Catalog fully applied: safe to identify orphaned replicas
             # (reference: master orders deletion of tablets not in catalog,
             # and of replicas no longer in the tablet's config).
@@ -266,12 +284,13 @@ class Master:
                 pass
 
     def _rereplicate_once(self) -> None:
-        dead = {d.uuid for d in self.ts_manager.dead_tservers()}
-        if not dead:
-            return
         live = sorted(self.ts_manager.live_tservers(),
                       key=lambda d: d.num_live_tablets)
         if not live:
+            return
+        self._recreate_missing_replicas(live)
+        dead = {d.uuid for d in self.ts_manager.dead_tservers()}
+        if not dead:
             return
         now = time.monotonic()
         for t in self.catalog.list_tables():
@@ -301,16 +320,12 @@ class Master:
                         "tablet_id": info.tablet_id,
                         "peers": without_dead,
                     }, timeout=10.0)
-                    self._rpc_ok(replacement, "ts.create_tablet", {
-                        "tablet_id": info.tablet_id,
-                        "table_name": t.name,
-                        "schema": t.schema,
-                        "partition_start": info.partition_start,
-                        "partition_end": info.partition_end,
-                        "engine": t.engine,
-                        # Not a voter yet: the leader's change_config adds it.
-                        "peers": without_dead,
-                    }, timeout=5.0)
+                    # Not a voter yet: the leader's change_config adds it.
+                    self._rpc_ok(replacement, "ts.create_tablet",
+                                 self._create_tablet_req(
+                                     info.tablet_id, t.name, t.schema,
+                                     info.partition_start, info.partition_end,
+                                     t.engine, without_dead), timeout=5.0)
                     self._rpc_ok(leader, "ts.change_config", {
                         "tablet_id": info.tablet_id,
                         "peers": with_new,
@@ -322,3 +337,38 @@ class Master:
                     })
                 except Exception:  # noqa: BLE001 — retried next tick
                     self._fixing.pop(info.tablet_id, None)
+
+    def _recreate_missing_replicas(self, live) -> None:
+        """Retry ts.create_tablet for replicas whose ORIGINAL create failed
+        (tracked in _failed_creates — create_table returned 'partial').
+        Restricted to tracked failures on purpose: a live tserver merely not
+        reporting a tablet may have lost its disk, and handing a still-voting
+        replica a fresh empty log could elect a leader without committed
+        entries. Those are repaired by remote bootstrap, not re-creation."""
+        if not self.raft.leader_ready() or not self._failed_creates:
+            return  # local catalog view may lag; don't act on it
+        now = time.monotonic()
+        live_uuids = {d.uuid for d in live}
+        for tablet_id, replica in list(self._failed_creates):
+            info = self.catalog.tablets.get(tablet_id)
+            if info is None or replica not in info.replicas:
+                self._failed_creates.discard((tablet_id, replica))
+                continue  # table dropped or replica re-placed meanwhile
+            if replica not in live_uuids:
+                continue  # dead-TS path handles it
+            if now - self._fixing.get(tablet_id, 0) < 10.0:
+                continue
+            t = self.catalog.tables.get(info.table_id)
+            if t is None:
+                continue
+            self._fixing[tablet_id] = now
+            try:
+                self.transport.send(replica, "ts.create_tablet",
+                                    self._create_tablet_req(
+                                        tablet_id, t.name, t.schema,
+                                        info.partition_start,
+                                        info.partition_end, t.engine,
+                                        info.replicas), timeout=5.0)
+                self._failed_creates.discard((tablet_id, replica))
+            except Exception:  # noqa: BLE001 — next tick retries
+                pass

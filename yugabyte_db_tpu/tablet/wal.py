@@ -103,7 +103,15 @@ class Log:
         self._file_size = self._file.tell()
 
     def _close_file(self) -> None:
+        # A closed segment must be durable before sync() reports the group
+        # durable: roll-over flushes buffered records into the OLD segment,
+        # and the subsequent sync() only fsyncs the NEW file — without this
+        # fsync, entries in the closed segment would count toward Raft
+        # majority while still sitting in the page cache.
         if self._file is not None:
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
             self._file.close()
             self._file = None
 

@@ -37,6 +37,11 @@ class TSTabletManager:
         self.fsync = fsync
         self._lock = threading.Lock()
         self._peers: dict[str, TabletPeer] = {}
+        # tablet_ids with a create in flight: reserved atomically under the
+        # lock so two concurrent ts.create_tablet RPCs (master dispatch
+        # racing the balancer's retry) can never both start a peer on the
+        # same WAL directory.
+        self._creating: set[str] = set()
 
     # -- lifecycle ----------------------------------------------------------
     def open_existing(self) -> int:
@@ -54,12 +59,18 @@ class TSTabletManager:
 
     def create_tablet(self, meta: TabletMetadata, peers: list[str]) -> TabletPeer:
         with self._lock:
-            if meta.tablet_id in self._peers:
+            if meta.tablet_id in self._peers or \
+                    meta.tablet_id in self._creating:
                 raise TabletAlreadyExists(meta.tablet_id)
-        tdir = os.path.join(self.data_root, meta.tablet_id)
-        os.makedirs(tdir, exist_ok=True)
-        meta.save(os.path.join(tdir, "tablet-meta.json"))
-        return self._start_peer(meta, peers)
+            self._creating.add(meta.tablet_id)
+        try:
+            tdir = os.path.join(self.data_root, meta.tablet_id)
+            os.makedirs(tdir, exist_ok=True)
+            meta.save(os.path.join(tdir, "tablet-meta.json"))
+            return self._start_peer(meta, peers)
+        finally:
+            with self._lock:
+                self._creating.discard(meta.tablet_id)
 
     def _start_peer(self, meta: TabletMetadata, initial_peers: list[str]) -> TabletPeer:
         peer = TabletPeer(self.node_uuid, meta, self.data_root,

@@ -108,6 +108,18 @@ class TabletServer:
         spec = wire.decode_spec(p["spec"])
         if spec.read_ht == wire.MAX_HT:
             spec.read_ht = peer.read_time().value
+        else:
+            # Explicit read point (a client pinning one snapshot across
+            # pages/tablets): advance the local clock past it so no later
+            # write lands at <= read_ht, then wait until every in-flight
+            # write below it resolves (reference: MvccManager::SafeTime
+            # wait in Tablet::DoHandleQLReadRequest).
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+            peer.tablet.clock.update(HybridTime(spec.read_ht))
+            if not peer.tablet.mvcc.wait_for_safe_time(
+                    HybridTime(spec.read_ht),
+                    timeout=p.get("timeout", 10.0)):
+                return {"code": "timed_out"}
         try:
             res = peer.scan(spec, allow_stale=p.get("allow_stale", False))
         except NotLeader as e:
