@@ -216,3 +216,44 @@ def test_socket_transport_cluster(tmp_path):
         assert res.rows[0][0] == 25
     finally:
         c.shutdown()
+
+
+def test_live_missing_replica_repaired_without_failed_creates(tmp_path):
+    """A replica missing from a live tserver (e.g. the create dispatch was
+    lost together with the master's in-memory _failed_creates on restart)
+    is repaired through the config-cycle path: the master removes it from
+    the group, re-creates it, and adds it back."""
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3).start()
+    try:
+        c.wait_tservers_registered()
+        client = c.client()
+        table = client.create_table("fix", COLUMNS, num_tablets=1,
+                                    replication_factor=3)
+        load_rows(client, table, 30)
+        locs = client.meta_cache.locations("fix", refresh=True)
+        tinfo = locs.tablets[0]
+        master = next(iter(c.masters.values()))
+        leader = master.ts_manager.leader_of(tinfo.tablet_id)
+        victim = next(r for r in tinfo.replicas if r != leader)
+        # Simulate "create never happened / data lost" on a live tserver,
+        # with no in-memory record of the failure.
+        c.tservers[victim].tablet_manager.delete_tablet(tinfo.tablet_id)
+        master._failed_creates.clear()
+        master.missing_replica_grace_s = 1.0
+
+        def repaired():
+            ts = c.tservers[victim]
+            try:
+                peer = ts.tablet_manager.get(tinfo.tablet_id)
+            except Exception:
+                return False
+            st = peer.raft.stats()
+            return st["commit_index"] > 0 and \
+                set(st.get("peers", tinfo.replicas)) == set(tinfo.replicas)
+        wait_for(repaired, timeout=30.0, msg="config-cycle repair")
+        # Data still fully readable.
+        from yugabyte_db_tpu.client import YBSession
+        s = YBSession(client)
+        assert len(s.scan(table, ScanSpec()).rows) == 30
+    finally:
+        c.shutdown()

@@ -151,6 +151,11 @@ class YBClient:
                                                 target)
                     loc.leader = target
                     return resp
+                if code == "invalid_read_time":
+                    # Terminal: every replica rejects a read point beyond
+                    # the clock-skew bound; retrying cannot succeed.
+                    raise TabletOpFailed(
+                        f"{method} on {loc.tablet_id}: {resp}")
                 last = resp
             if not tried_refresh:
                 # Replica set may have changed (re-replication): refresh.

@@ -36,6 +36,7 @@ class Master:
                  fsync: bool = True,
                  ts_unresponsive_timeout_s: float = 5.0,
                  balance_interval_s: float = 1.0,
+                 missing_replica_grace_s: float = 10.0,
                  advertised_addr=None):
         self.uuid = uuid
         self.transport = transport
@@ -56,11 +57,18 @@ class Master:
         self._balancer_thread: threading.Thread | None = None
         self._fixing: dict[str, float] = {}  # tablet_id -> fix start time
         # (tablet_id, replica) creates that FAILED to dispatch: the balancer
-        # retries exactly these. Recreating any other missing replica would
-        # be unsafe — a voter that lost its disk must not be handed a fresh
-        # empty log while still counted in the config (it could elect a
-        # leader without committed entries); that case is remote bootstrap's.
+        # retries exactly these directly. Recreating any other missing
+        # replica in place would be unsafe — a voter that lost its disk must
+        # not be handed a fresh empty log while still counted in the config
+        # (it could elect a leader without committed entries). Missing
+        # replicas NOT tracked here (e.g. the set was lost to a master
+        # restart) are repaired through a config cycle instead
+        # (_repair_live_missing_replicas).
         self._failed_creates: set[tuple[str, str]] = set()
+        self.missing_replica_grace_s = missing_replica_grace_s
+        # (tablet_id, replica) -> first time a live tserver's heartbeat was
+        # seen not reporting a replica the catalog assigns to it.
+        self._missing_seen: dict[tuple[str, str], float] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -289,6 +297,7 @@ class Master:
         if not live:
             return
         self._recreate_missing_replicas(live)
+        self._repair_live_missing_replicas(live)
         dead = {d.uuid for d in self.ts_manager.dead_tservers()}
         if not dead:
             return
@@ -337,6 +346,65 @@ class Master:
                     })
                 except Exception:  # noqa: BLE001 — retried next tick
                     self._fixing.pop(info.tablet_id, None)
+
+    def _repair_live_missing_replicas(self, live) -> None:
+        """A live, heartbeating tserver that persistently does NOT report a
+        replica the catalog assigns to it either never created it (the
+        dispatch failure was lost with a master restart/failover, so
+        _failed_creates can't retry it) or lost its disk. Both repair
+        safely through a config cycle: REMOVE the replica from the group,
+        hand the tserver a fresh one, ADD it back — it rejoins as a new
+        member and catches up from the leader, never voting on the
+        strength of an empty log (reference: the load balancer's
+        remove-then-add path, src/yb/master/cluster_balance.cc)."""
+        if not self.raft.leader_ready():
+            return
+        now = time.monotonic()
+        live_by_uuid = {d.uuid: d for d in live}
+        tracked = set()
+        for t in self.catalog.list_tables():
+            for info in self.catalog.tablets_of(t.table_id):
+                for r in info.replicas:
+                    key = (info.tablet_id, r)
+                    d = live_by_uuid.get(r)
+                    if d is None or info.tablet_id in d.tablet_roles or \
+                            key in self._failed_creates:
+                        continue  # dead-TS / direct-retry paths own these
+                    tracked.add(key)
+                    first = self._missing_seen.setdefault(key, now)
+                    if now - first < self.missing_replica_grace_s:
+                        continue
+                    if now - self._fixing.get(info.tablet_id, 0) < 10.0:
+                        continue
+                    others = [x for x in info.replicas if x != r]
+                    leader = self.ts_manager.leader_of(info.tablet_id)
+                    if not others or leader is None or leader not in others \
+                            or leader not in live_by_uuid:
+                        continue  # RF=1 or no live leader: cannot cycle
+                    self._fixing[info.tablet_id] = now
+                    try:
+                        self._rpc_ok(leader, "ts.change_config", {
+                            "tablet_id": info.tablet_id, "peers": others,
+                        }, timeout=10.0)
+                        self._rpc_ok(r, "ts.create_tablet",
+                                     self._create_tablet_req(
+                                         info.tablet_id, t.name, t.schema,
+                                         info.partition_start,
+                                         info.partition_end, t.engine,
+                                         others), timeout=5.0)
+                        self._rpc_ok(leader, "ts.change_config", {
+                            "tablet_id": info.tablet_id,
+                            "peers": info.replicas,
+                        }, timeout=10.0)
+                        self._missing_seen.pop(key, None)
+                        tracked.discard(key)
+                    except Exception:  # noqa: BLE001 — next tick retries
+                        self._fixing.pop(info.tablet_id, None)
+        # Forget pairs that are no longer missing (reported again, table
+        # dropped, or replica re-placed).
+        for key in list(self._missing_seen):
+            if key not in tracked:
+                self._missing_seen.pop(key, None)
 
     def _recreate_missing_replicas(self, live) -> None:
         """Retry ts.create_tablet for replicas whose ORIGINAL create failed

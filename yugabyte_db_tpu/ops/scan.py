@@ -89,6 +89,10 @@ class ScanSig:
     preds: tuple     # tuple[PredSig]
     aggs: tuple      # tuple[AggSig] — empty for row scans
     apply_preds: bool  # False: candidates only (multi-source scans)
+    flat: bool = False  # every key group has exactly 1 version: the MVCC
+                        # merge degenerates to elementwise masks (no
+                        # segment ops / gathers) — the post-compaction
+                        # fast path
 
 
 # -- the program ------------------------------------------------------------
@@ -130,15 +134,19 @@ def _limbs16(lo_u32, hi_u32):
     )
 
 
-def scan_window(sig: ScanSig, run, b0, row_lo, row_hi,
-                read_hi, read_lo, rexp_hi, rexp_lo, pred_literals):
-    """The traced scan program. ``run`` is the device-array pytree
-    (ops.device_run.DeviceRun.arrays); scalars are traced.
+def resolve_window(sig, run, b0, row_lo, row_hi,
+                   read_hi, read_lo, rexp_hi, rexp_lo, pred_literals):
+    """Resolve one K-block window to per-group MVCC state (traced).
 
-    Returns a dict:
-      row scans:  result[N] bool (per group id), start_idx[N] i32,
-                  num_groups i32
-      aggregates: additionally 'agg<i>_*' partials per AggSig.
+    ``sig`` needs K, R, cols, preds, apply_preds (ScanSig or GatherSig).
+    ``row_lo``/``row_hi`` are *window-local* row-index bounds. Returns a
+    dict of per-group arrays (indexed by group id, length N, entries at
+    gid >= num_groups are garbage):
+      result        bool  — exists & in-range & predicates
+      pre_pred      bool  — exists & in-range (before predicates)
+      start_idx     i32   — first row of the group (window-local)
+      col_idx/col_has/col_notnull  per touched column
+      cmp_w/arith_w windowed column planes (per-row, window-local)
     """
     K, R = sig.K, sig.R
     N = K * R
@@ -152,12 +160,17 @@ def scan_window(sig: ScanSig, run, b0, row_lo, row_hi,
     exp_lo = _window(run["exp_lo"], b0, K)
 
     ridx = jnp.arange(N, dtype=jnp.int32)
-    gid = jnp.cumsum(group_start.astype(jnp.int32)) - 1
-    num_groups = gid[-1] + 1
 
     # 1. MVCC visibility at the read point.
     visible = valid & le2(ht_hi, ht_lo, read_hi, read_lo)
     expired = le2(exp_hi, exp_lo, rexp_hi, rexp_lo)
+
+    if sig.flat:
+        return _resolve_flat(sig, run, b0, row_lo, row_hi, pred_literals,
+                             N, ridx, valid, tomb, live, visible, expired)
+
+    gid = jnp.cumsum(group_start.astype(jnp.int32)) - 1
+    num_groups = gid[-1] + 1
 
     # 2. Row-tombstone shadowing: newest visible tombstone per group.
     t_hi = _seg_max(jnp.where(visible & tomb, ht_hi, I32_MIN), gid, N)
@@ -212,6 +225,7 @@ def scan_window(sig: ScanSig, run, b0, row_lo, row_hi,
     result = exists & in_range & valid_group
 
     # 7. Predicates on merged per-group values.
+    pre_pred = result
     if sig.apply_preds:
         for i, ps in enumerate(sig.preds):
             lit = pred_literals[i]
@@ -220,13 +234,97 @@ def scan_window(sig: ScanSig, run, b0, row_lo, row_hi,
             result = result & notnull & _eval_pred(
                 ps, cmp_w.get(ps.col_id), arith_w.get(ps.col_id), idx, lit)
 
-    out = {"result": result, "start_idx": start_idx, "num_groups": num_groups}
+    return {
+        "result": result,
+        "pre_pred": pre_pred,
+        "start_idx": start_idx,
+        "num_groups": num_groups,
+        "ridx": ridx,
+        "col_idx": col_idx,
+        "col_has": col_has,
+        "col_notnull": col_notnull,
+        "cmp_w": cmp_w,
+        "arith_w": arith_w,
+    }
+
+
+def _resolve_flat(sig, run, b0, row_lo, row_hi, pred_literals,
+                  N, ridx, valid, tomb, live, visible, expired):
+    """Single-version-per-key resolve: every row is its own group, so
+    tombstone shadowing, per-column latest-version selection, and the
+    group-start machinery are all elementwise (no segment ops, no
+    gathers). Produces the same output contract as the general path with
+    num_groups == N and col_idx == ridx."""
+    alive = visible & ~tomb
+    live_exists = alive & live & ~expired
+    col_idx = {}
+    col_has = {}
+    col_notnull = {}
+    cmp_w = {}
+    arith_w = {}
+    for cs in sig.cols:
+        c = run["cols"][cs.col_id]
+        set_c = _window(c["set"], b0, sig.K)
+        null_c = _window(c["isnull"], b0, sig.K)
+        has = alive & set_c
+        col_idx[cs.col_id] = ridx
+        col_has[cs.col_id] = has
+        col_notnull[cs.col_id] = has & ~null_c & ~expired
+        cmp_w[cs.col_id] = _window(c["cmp"], b0, sig.K)
+        if "arith" in c:
+            arith_w[cs.col_id] = _window(c["arith"], b0, sig.K)
+
+    exists = live_exists
+    for cs in sig.cols:
+        exists = exists | col_notnull[cs.col_id]
+
+    in_range = (ridx >= row_lo) & (ridx < row_hi)
+    result = exists & in_range & valid
+    pre_pred = result
+    if sig.apply_preds:
+        for i, ps in enumerate(sig.preds):
+            lit = pred_literals[i]
+            result = result & col_notnull[ps.col_id] & _eval_pred(
+                ps, cmp_w.get(ps.col_id), arith_w.get(ps.col_id), ridx, lit)
+
+    return {
+        "result": result,
+        "pre_pred": pre_pred,
+        "start_idx": ridx,
+        "num_groups": jnp.int32(N),
+        "ridx": ridx,
+        "col_idx": col_idx,
+        "col_has": col_has,
+        "col_notnull": col_notnull,
+        "cmp_w": cmp_w,
+        "arith_w": arith_w,
+    }
+
+
+def scan_window(sig: ScanSig, run, b0, row_lo, row_hi,
+                read_hi, read_lo, rexp_hi, rexp_lo, pred_literals):
+    """The traced scan program. ``run`` is the device-array pytree
+    (ops.device_run.DeviceRun.arrays); scalars are traced.
+
+    Returns a dict:
+      row scans:  result[N] bool (per group id), start_idx[N] i32,
+                  num_groups i32
+      aggregates: additionally 'agg<i>_*' partials per AggSig.
+    """
+    K, R = sig.K, sig.R
+    N = K * R
+    r = resolve_window(sig, run, b0, row_lo, row_hi,
+                       read_hi, read_lo, rexp_hi, rexp_lo, pred_literals)
+    result, start_idx = r["result"], r["start_idx"]
+    out = {"result": result, "start_idx": start_idx,
+           "num_groups": r["num_groups"]}
 
     # 8. Aggregate partials.
     block_of_group = start_idx // R  # in [0, K)
     for i, ag in enumerate(sig.aggs):
-        out.update(_eval_agg(f"agg{i}", ag, result, col_idx, col_has,
-                             col_notnull, cmp_w, arith_w, block_of_group, K, N))
+        out.update(_eval_agg(f"agg{i}", ag, result, r["col_idx"], r["col_has"],
+                             r["col_notnull"], r["cmp_w"], r["arith_w"],
+                             block_of_group, K, N))
     return out
 
 

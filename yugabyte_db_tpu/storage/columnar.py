@@ -76,6 +76,10 @@ class ColumnarRun:
         self.min_key = b""
         self.max_key = b""
         self.max_ht = 0
+        # Largest key-group version count. 1 means the run is "flat": every
+        # row is its own group, so device kernels can skip the segmented
+        # MVCC merge machinery entirely (the common post-compaction shape).
+        self.max_group_versions = 0
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -90,6 +94,8 @@ class ColumnarRun:
         fill = 0
         for key, versions in entries:
             n = len(versions)
+            if n > run.max_group_versions:
+                run.max_group_versions = n
             if n > R:
                 raise ValueError(
                     f"key has {n} versions > rows_per_block={R}; "
@@ -138,6 +144,7 @@ class ColumnarRun:
             )
         self.row_keys = [[b""] * R for _ in range(B)]
         self.row_versions = [[None] * R for _ in range(B)]
+        self.row_key_vals = [[None] * R for _ in range(B)]
         self.blocks = [BlockMeta(b"", b"", 0) for _ in range(B)]
 
     def _fill_block(self, b: int, group_list) -> None:
@@ -277,6 +284,18 @@ class ColumnarRun:
     def key_at(self, global_row: int) -> bytes:
         b, r = divmod(global_row, self.R)
         return self.row_keys[b][r]
+
+    def key_vals_at(self, global_row: int) -> list:
+        """Decoded key-column values (hashed + range) of the row's key,
+        memoized per row so repeated scans never re-decode."""
+        from yugabyte_db_tpu.models.encoding import decode_doc_key
+
+        b, r = divmod(global_row, self.R)
+        kv = self.row_key_vals[b][r]
+        if kv is None:
+            _, hashed, ranges = decode_doc_key(self.row_keys[b][r])
+            kv = self.row_key_vals[b][r] = hashed + ranges
+        return kv
 
     # -- block pruning -----------------------------------------------------
     def block_range(self, lower: bytes, upper: bytes) -> tuple[int, int]:

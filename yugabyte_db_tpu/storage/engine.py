@@ -39,6 +39,13 @@ class StorageEngine(abc.ABC):
     def scan(self, spec: ScanSpec) -> ScanResult:
         """MVCC scan/aggregate at spec.read_ht over [lower, upper)."""
 
+    def scan_batch(self, specs: list[ScanSpec]) -> list[ScanResult]:
+        """Execute many scans. Engines with an accelerator data plane
+        override this to pipeline device dispatches (one host↔device
+        round-trip for the whole batch) — the analog of the reference
+        serving hundreds of concurrent YCSB clients per tserver."""
+        return [self.scan(s) for s in specs]
+
     # -- lifecycle ---------------------------------------------------------
     @abc.abstractmethod
     def flush(self) -> None:

@@ -90,4 +90,9 @@ class ScanResult:
     columns: list[str]            # names, in output order
     rows: list[tuple]             # materialized rows (or aggregate row(s))
     resume_key: bytes | None = None  # exclusive "scan resumes at" key, None = done
-    rows_scanned: int = 0         # observability: merged rows examined
+    # Observability: existing rows the engine examined. A work statistic,
+    # not a contract — the device engine resolves whole block windows, so
+    # a LIMIT page may report more rows examined than a row-at-a-time
+    # engine that stops exactly at the limit. Unlimited tombstone-free
+    # scans agree across engines (pinned by tests/test_gather.py).
+    rows_scanned: int = 0

@@ -68,6 +68,7 @@ class TpuStorageEngine(StorageEngine):
         self.rows_per_block = self.options.get("rows_per_block", 2048)
         self._kinds = {c.col_id: dtype_kind(c.dtype)
                        for c in schema.value_columns}
+        self._dtypes = {c.col_id: c.dtype for c in schema.value_columns}
         self._name_to_id = {c.name: c.col_id for c in schema.value_columns}
         self._key_col_names = {c.name for c in schema.key_columns}
         from yugabyte_db_tpu.storage.run_io import RunPersistence
@@ -202,15 +203,22 @@ class TpuStorageEngine(StorageEngine):
             lits.append(_literal(kind, p.value))
         return tuple(sigs), tuple(lits)
 
+    def _pred_sigs_only(self, preds):
+        """PredSigs without materializing device literals (the gather path
+        ships literals inside the params vector; creating jnp scalars here
+        would queue one tiny host->device transfer per predicate ahead of
+        the batched dispatch)."""
+        return tuple(
+            dscan.PredSig(self._name_to_id[p.column],
+                          self._kinds[self._name_to_id[p.column]], p.op)
+            for p in preds)
+
     def _col_sigs(self):
         return tuple(dscan.ColSig(c.col_id, self._kinds[c.col_id])
                      for c in self.schema.value_columns)
 
     def _read_planes(self, spec: ScanSpec):
-        r_hi, r_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT))
-        e_hi, e_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
-        return (jnp.int32(r_hi), jnp.int32(r_lo),
-                jnp.int32(e_hi), jnp.int32(e_lo))
+        return tuple(jnp.int32(v) for v in self._read_plane_ints(spec))
 
     def _device_candidates(self, trun: TpuRun, spec: ScanSpec,
                            pred_sigs, pred_lits, apply_preds: bool):
@@ -226,7 +234,8 @@ class TpuStorageEngine(StorageEngine):
         b_first = (row_lo // R) // K * K
         b_last = ((row_hi - 1) // R) // K * K
         sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
-                            preds=pred_sigs, aggs=(), apply_preds=apply_preds)
+                            preds=pred_sigs, aggs=(), apply_preds=apply_preds,
+                            flat=crun.max_group_versions <= 1)
         fn = dscan.compiled_scan(sig)
         r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
         for b0 in range(b_first, b_last + 1, K):
@@ -242,10 +251,106 @@ class TpuStorageEngine(StorageEngine):
                 yield crun.key_at(base + int(start[g]))
 
     # -- reads -------------------------------------------------------------
+    # The host↔device link pays a full round-trip per *blocking* call,
+    # ~ms per transferred array, and pipelines async dispatches (measured:
+    # 10 async dispatches complete in ~1 RTT). Every scan therefore splits
+    # into a plan step that DESCRIBES device work and a finish step that
+    # decodes fetched results; scan_batch() groups all page scans with the
+    # same static signature into one vmapped dispatch, issues everything
+    # async, and fetches every output in one device_get.
     def scan(self, spec: ScanSpec) -> ScanResult:
+        return self.scan_batch([spec])[0]
+
+    # G buckets for the vmapped page-scan dispatch (one compile per bucket).
+    _G_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+    def scan_batch(self, specs: list[ScanSpec]) -> list[ScanResult]:
+        from yugabyte_db_tpu.ops import row_gather
+
+        plans = [self._plan_scan(s) for s in specs]
+
+        results: list = [None] * len(plans)
+        issued_outs = []
+        host_plans = []
+        gathers: list[tuple[int, "_GatherScan"]] = []
+        for pi, plan in enumerate(plans):
+            if plan[0] == "host":
+                host_plans.append((pi, plan[1]))
+            elif plan[0] == "issued":
+                issued_outs.append((pi, plan[1], plan[2]))
+            else:
+                gathers.append((pi, plan[1]))
+
+        # Round-based batched execution: each round groups every active
+        # gather's pending param-rows by (signature, run) into vmapped
+        # dispatches, fetches all outputs in ONE device_get (plus any
+        # one-shot issued outputs on round 1), and feeds buffers back;
+        # gathers that need more windows contribute rows to the next round.
+        pending = {pi: st.pending for pi, st in gathers if st.pending}
+        states = dict(gathers)
+        first_round = True
+        while pending or first_round:
+            by_sig: dict = {}
+            for pi, rows in pending.items():
+                st = states[pi]
+                for ri, (ip, fp) in enumerate(rows):
+                    by_sig.setdefault((st.sig, id(st.trun)),
+                                      (st.trun, []))[1].append(
+                        (pi, ri, ip, fp))
+            dispatches = []
+            for (sig, _tid), (trun, members) in by_sig.items():
+                for c0 in range(0, len(members), self._G_BUCKETS[-1]):
+                    chunk = members[c0:c0 + self._G_BUCKETS[-1]]
+                    G = next(g for g in self._G_BUCKETS if g >= len(chunk))
+                    ip = np.zeros((G, len(chunk[0][2])), dtype=np.int32)
+                    fp = np.zeros((G, len(chunk[0][3])), dtype=np.float32)
+                    ip[:, 1] = -1  # padding: w_last < w_first -> no work
+                    for j, (_pi, _ri, ipj, fpj) in enumerate(chunk):
+                        ip[j] = ipj
+                        fp[j] = fpj
+                    fn = row_gather.compiled_gather_batch(sig, G)
+                    dispatches.append((chunk, fn(trun.dev.arrays, ip, fp)))
+
+            one_shot = [outs for _pi, outs, _fin in issued_outs] \
+                if first_round else []
+            if first_round:
+                # Device dispatches are in flight; overlap the host-path
+                # scans (multi-source merges) with device execution.
+                for pi, fin in host_plans:
+                    results[pi] = fin()
+            fetched = jax.device_get(
+                [[d for _c, d in dispatches], one_shot])
+            disp_bufs, issued_np = fetched
+            if first_round:
+                for (pi, _outs, fin), f in zip(issued_outs, issued_np):
+                    results[pi] = fin(f)
+                first_round = False
+
+            plan_bufs: dict[int, dict[int, np.ndarray]] = {}
+            for (chunk, _out), bufs in zip(dispatches, disp_bufs):
+                for j, (pi, ri, _ip, _fp) in enumerate(chunk):
+                    plan_bufs.setdefault(pi, {})[ri] = bufs[j]
+
+            next_pending = {}
+            for pi, rows in pending.items():
+                st = states[pi]
+                bufs = [plan_bufs[pi][ri] for ri in range(len(rows))]
+                more = st.consume(bufs)
+                if more:
+                    next_pending[pi] = more
+            pending = next_pending
+
+        for pi, st in gathers:
+            results[pi] = st.result()
+        return results
+
+    def _plan_scan(self, spec: ScanSpec):
+        """-> ("host", finish()) | ("issued", outs, finish(fetched))
+           | ("gather", _GatherScan)."""
         runs = self._overlapping_runs(spec)
         mem_live = self._memtable_in_range(spec)
         exact, superset, host_only = self._split_predicates(spec)
+        pred_split = (exact, superset, host_only)
         single_source = len(runs) == 1 and not mem_live
 
         if spec.is_aggregate:
@@ -253,11 +358,18 @@ class TpuStorageEngine(StorageEngine):
                         and not spec.group_by
                         and self._aggs_device_eligible(spec))
             if eligible and runs:
-                return self._device_aggregate(runs[0], spec, exact)
-            return self._row_scan(spec, runs, mem_live,
-                                  (exact, superset, host_only), aggregate=True)
-        return self._row_scan(spec, runs, mem_live,
-                              (exact, superset, host_only), aggregate=False)
+                outs, fin = self._plan_device_aggregate(runs[0], spec, exact)
+                return ("issued", outs, fin)
+            if single_source and runs:
+                return ("gather", self._plan_gather(
+                    runs[0], spec, pred_split, aggregate=True))
+            return ("host", lambda: self._row_scan(
+                spec, runs, mem_live, pred_split, aggregate=True))
+        if single_source and runs:
+            return ("gather", self._plan_gather(
+                runs[0], spec, pred_split, aggregate=False))
+        return ("host", lambda: self._row_scan(
+            spec, runs, mem_live, pred_split, aggregate=False))
 
     def _row_scan(self, spec: ScanSpec, runs, mem_live, pred_split,
                   aggregate: bool):
@@ -312,8 +424,268 @@ class TpuStorageEngine(StorageEngine):
             return ScanResult(agg.column_names(), agg.results(), None, scanned)
         return ScanResult(projection, rows, resume, scanned)
 
+    # -- device row-materialization path -------------------------------------
+    def _gather_out_cols(self, names):
+        from yugabyte_db_tpu.ops import row_gather
+
+        seen = {}
+        for name in names:
+            cid = self._name_to_id.get(name)
+            if cid is None or cid in seen:
+                continue  # key column (decoded from the key) or duplicate
+            kind = self._kinds[cid]
+            planes = 2 if kind in ("i64", "f64", "str") else 1
+            # FLOAT round-trips through f32 planes lossily vs the stored
+            # python value; STRING/BINARY payloads live host-side — both
+            # fetch the original value via the setter row index instead.
+            seen[cid] = row_gather.OutCol(cid, planes, kind in ("str", "f32"))
+        return tuple(seen.values())
+
+    def _decode_col(self, cid, buf, n, crun, col_offs):
+        """Packed buffer columns -> python value list (None for NULL)."""
+        kind = self._kinds[cid]
+        cmp_off, null_off, idx_off = col_offs[cid]
+        null = buf[:n, null_off] != 0
+        if kind in ("str", "f32"):
+            idxs = buf[:n, idx_off]
+            R = crun.R
+            out = []
+            for i in range(n):
+                gi = int(idxs[i])
+                if null[i] or gi < 0:
+                    out.append(None)
+                else:
+                    b, r = divmod(gi, R)
+                    out.append(crun.row_versions[b][r].columns[cid])
+            return out
+        if kind == "i32":
+            raw = buf[:n, cmp_off].tolist()
+        elif kind == "i64":
+            raw = P.ordered_planes_to_i64(
+                buf[:n, cmp_off], buf[:n, cmp_off + 1]).tolist()
+        else:  # f64
+            raw = P.ordered_planes_to_f64(
+                buf[:n, cmp_off], buf[:n, cmp_off + 1]).tolist()
+        dt = self._dtypes[cid]
+        if dt == DataType.BOOL:
+            return [None if null[i] else bool(raw[i]) for i in range(n)]
+        return [None if null[i] else raw[i] for i in range(n)]
+
+    def _pred_host_literals(self, preds):
+        """Predicate literals -> (int32 plane list, f32 list), host values."""
+        int_lits, f32_lits = [], []
+        for p in preds:
+            kind = self._kinds[self._name_to_id[p.column]]
+            if kind == "f32":
+                f32_lits.append(float(p.value))
+            elif kind == "i32":
+                int_lits.append(int(p.value))
+            elif kind == "i64":
+                hi, lo = P.i64_to_ordered_planes(
+                    np.array([int(p.value)], dtype=np.int64))
+                int_lits += [int(hi[0]), int(lo[0])]
+            elif kind == "f64":
+                hi, lo = P.f64_to_ordered_planes(
+                    np.array([p.value], dtype=np.float64))
+                int_lits += [int(hi[0]), int(lo[0])]
+            else:
+                raw = (p.value.encode("utf-8") if isinstance(p.value, str)
+                       else bytes(p.value))
+                hi, lo = P.varlen_prefix_planes([raw])
+                int_lits += [int(hi[0]), int(lo[0])]
+        return int_lits, f32_lits
+
+    def _plan_gather(self, trun: TpuRun, spec: ScanSpec, pred_split,
+                     aggregate: bool):
+        """Single-source scan fully resolved on device: gather dispatches
+        pack matched rows' value planes into one int32 matrix; the host
+        bulk-decodes. Superset (str/f32) and host-only (key-column, IN)
+        predicates are verified on the decoded values — still
+        result-proportional work.
+
+        Dispatch shape: a LIMIT page is ONE param-row whose while_loop
+        early-exits once the buffer fills; an unbounded scan is one
+        param-row per window with the buffer sized to the window (no
+        overflow possible). scan_batch() coalesces same-signature rows
+        into vmapped dispatches, so whole batches cost one round-trip."""
+        from yugabyte_db_tpu.ops import row_gather
+
+        exact, superset, host_only = pred_split
+        crun = trun.crun
+        projection = spec.projection or [c.name for c in self.schema.columns]
+        verify_preds = superset + host_only
+        if aggregate:
+            agg = Aggregator(spec.aggregates or [], spec.group_by or [])
+            out_names = ([a.column for a in (spec.aggregates or [])
+                          if a.column is not None]
+                         + list(spec.group_by or []))
+        else:
+            agg = None
+            out_names = list(projection)
+        out_names += [p.column for p in verify_preds]
+        out_cols = self._gather_out_cols(out_names)
+        decode_ids = {self._name_to_id[n] for n in out_names
+                      if n in self._name_to_id}
+        device_preds = exact + superset
+        pred_sigs = self._pred_sigs_only(device_preds)
+        int_lits, f32_lits = self._pred_host_literals(device_preds)
+        limit = None if aggregate else spec.limit
+        K = WINDOW_BLOCKS
+        R = crun.R
+
+        ctx = {
+            "crun": crun, "trun": trun, "spec": spec, "agg": agg,
+            "aggregate": aggregate, "projection": projection,
+            "verify_preds": verify_preds, "decode_ids": decode_ids,
+            "limit": limit, "out_cols": out_cols, "pred_sigs": pred_sigs,
+            "int_lits": int_lits, "f32_lits": f32_lits,
+            "key_col_pos": {c.name: i
+                            for i, c in enumerate(self.schema.key_columns)},
+        }
+
+        row_lo = crun.lower_row(spec.lower)
+        row_hi = crun.upper_row(spec.upper)
+        read_planes = self._read_plane_ints(spec)
+        ctx["read_planes"] = read_planes
+        if row_lo >= row_hi:
+            ctx["M"], ctx["sig"] = 256, self._gather_sig(ctx, 256)
+            return _GatherScan(self, ctx, "paged", [], 0, 0, None)
+
+        if limit is not None:
+            # Small windows (K=1) capped per round: a batch of pages stays
+            # in vmap lockstep only for the few windows a page actually
+            # needs; lanes needing more continue in the next batched round.
+            K = 1
+            cap = max(2, -(-2 * limit // R))
+            M = 256 if (not verify_preds and limit + 32 <= 256) else 4096
+        elif device_preds or verify_preds:
+            # Unlimited selective scan: one while_loop over the whole
+            # range; transfers stay proportional to the (selective) result.
+            K = WINDOW_BLOCKS
+            cap = None
+            M = 4096
+        else:
+            # Unbounded, unpredicated: one param-row per window, emitted
+            # in place (every row is a result row; the host compacts).
+            K = WINDOW_BLOCKS
+            M = K * R
+            sig = self._gather_sig(ctx, M, packed=False, K=K)
+            ctx["M"], ctx["sig"] = M, sig
+            w_first = row_lo // (K * R)
+            w_last = (row_hi - 1) // (K * R)
+            param_rows = [
+                row_gather.pack_params(w, w, row_lo, row_hi, read_planes,
+                                       int_lits, f32_lits)
+                for w in range(w_first, w_last + 1)
+            ]
+            return _GatherScan(self, ctx, "chunks", param_rows,
+                               w_last, row_hi, None)
+
+        sig = self._gather_sig(ctx, M, K=K)
+        ctx["M"], ctx["sig"] = M, sig
+        w_first = row_lo // (K * R)
+        w_last = (row_hi - 1) // (K * R)
+        w_cap = w_last if cap is None else min(w_last, w_first + cap - 1)
+        ip, fp = row_gather.pack_params(
+            w_first, w_cap, row_lo, row_hi, read_planes, int_lits, f32_lits)
+        return _GatherScan(self, ctx, "paged", [(ip, fp)],
+                           w_last, row_hi, cap)
+
+    def _read_plane_ints(self, spec: ScanSpec):
+        r_hi, r_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT))
+        e_hi, e_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
+        return (r_hi, r_lo, e_hi, e_lo)
+
+    def _gather_sig(self, ctx, M, packed=True, K=WINDOW_BLOCKS):
+        from yugabyte_db_tpu.ops import row_gather
+
+        return row_gather.GatherSig(
+            B=ctx["trun"].dev.B, R=ctx["crun"].R, K=K, M=M,
+            cols=self._col_sigs(), preds=ctx["pred_sigs"], apply_preds=True,
+            out_cols=ctx["out_cols"],
+            flat=ctx["crun"].max_group_versions <= 1, packed=packed)
+
+    def _emit_fetched(self, ctx, buf, rows):
+        """Decode one fetched packed buffer into ctx's sinks.
+
+        Returns (count, emitted_n, hit_limit, last_start). ``last_start``
+        is the global row index of the last *consumed* packed row (for
+        resume / continuation bounds)."""
+        from yugabyte_db_tpu.ops import row_gather
+
+        crun = ctx["crun"]
+        M = ctx["M"]
+        limit = ctx["limit"]
+        verify_preds = ctx["verify_preds"]
+        aggregate = ctx["aggregate"]
+        agg = ctx["agg"]
+        projection = ctx["projection"]
+        key_col_pos = ctx["key_col_pos"]
+        count = int(buf[M, 0])
+        if not ctx["sig"].packed:
+            # In-place window: compact matched rows with numpy.
+            body = buf[:M]
+            buf = body[body[:, 0] >= 0]
+            n = buf.shape[0]
+        else:
+            n = min(count, M)
+            buf = buf[:n]
+        if n == 0:
+            return 0, 0, False, None
+        _w, col_offs = row_gather.out_layout(ctx["sig"])
+        starts = buf[:n, 0]
+        colvals = {cid: self._decode_col(cid, buf, n, crun, col_offs)
+                   for cid in ctx["decode_ids"]}
+
+        def getter(name, i, _s=starts, _cv=colvals, _kp=key_col_pos):
+            if name in _kp:
+                return crun.key_vals_at(int(_s[i]))[_kp[name]]
+            return _cv[self._name_to_id[name]][i]
+
+        hit_limit = False
+        if not verify_preds and not aggregate:
+            # Columnar fast path: per-column lists, tuples built by zip.
+            n_take = n if limit is None else min(n, limit - len(rows))
+            cols_out = []
+            for nm in projection:
+                if nm in key_col_pos:
+                    p = key_col_pos[nm]
+                    cols_out.append([crun.key_vals_at(int(s))[p]
+                                     for s in starts[:n_take]])
+                else:
+                    cols_out.append(colvals[self._name_to_id[nm]][:n_take])
+            rows.extend(zip(*cols_out))
+            hit_limit = limit is not None and len(rows) >= limit
+            return count, n, hit_limit, int(starts[n_take - 1])
+        taken_i = -1
+        for i in range(n):
+            if verify_preds and not all(
+                    p.matches(getter(p.column, i)) for p in verify_preds):
+                taken_i = i
+                continue
+            if aggregate:
+                agg.add(lambda nm, _i=i: getter(nm, _i))
+                taken_i = i
+                continue
+            rows.append(tuple(getter(nm, i) for nm in projection))
+            taken_i = i
+            if limit is not None and len(rows) >= limit:
+                hit_limit = True
+                break
+        last = int(starts[taken_i]) if taken_i >= 0 else None
+        return count, n, hit_limit, last
+
+    def _gather_result(self, ctx, rows, scanned, resume):
+        if ctx["aggregate"]:
+            return ScanResult(ctx["agg"].column_names(), ctx["agg"].results(),
+                              None, scanned)
+        return ScanResult(ctx["projection"], rows, resume, scanned)
+
+    # (gather round execution lives in _GatherScan below)
+
     # -- device aggregate path ---------------------------------------------
-    def _device_aggregate(self, trun: TpuRun, spec: ScanSpec, exact_preds):
+    def _plan_device_aggregate(self, trun: TpuRun, spec: ScanSpec,
+                               exact_preds):
         """Single-dispatch full-run aggregate: the device fori_loops every
         window and returns two packed vectors (ops.agg_fold) — one dispatch
         plus two small transfers per scan, because the host link pays
@@ -328,7 +700,8 @@ class TpuStorageEngine(StorageEngine):
         R = crun.R
         K = agg_fold.safe_window_blocks(R, agg_fold.FULL_WINDOW_BLOCKS)
         sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
-                            preds=pred_sigs, aggs=dev_aggs, apply_preds=True)
+                            preds=pred_sigs, aggs=dev_aggs, apply_preds=True,
+                            flat=crun.max_group_versions <= 1)
         W = trun.dev.B // K
         w_first, w_last = agg_fold.window_bounds(row_lo, row_hi, R, K, W)
         fn = agg_fold.compiled_full_aggregate(sig)
@@ -336,14 +709,99 @@ class TpuStorageEngine(StorageEngine):
         ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo), jnp.int32(row_hi),
                         jnp.int32(w_first), jnp.int32(w_last),
                         r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
-        iv, fv = jax.device_get([ivec, fvec])
-        acc, scanned = agg_fold.unpack(dev_aggs, iv, fv)
 
-        out_row, names = [], []
-        for a, (fn_name, di) in zip(spec.aggregates, lowering):
-            names.append(f"{a.fn}({a.column or '*'})")
-            out_row.append(agg_fold.finalize(dev_aggs[di], acc[di], fn_name))
-        return ScanResult(names, [tuple(out_row)], None, scanned)
+        def finish(f):
+            iv, fv = f
+            acc, scanned = agg_fold.unpack(dev_aggs, iv, fv)
+            out_row, names = [], []
+            for a, (fn_name, di) in zip(spec.aggregates, lowering):
+                names.append(f"{a.fn}({a.column or '*'})")
+                out_row.append(agg_fold.finalize(dev_aggs[di], acc[di],
+                                                 fn_name))
+            return ScanResult(names, [tuple(out_row)], None, scanned)
+
+        return [ivec, fvec], finish
+
+
+class _GatherScan:
+    """State of one in-flight device scan across scan_batch rounds.
+
+    ``pending`` holds the param-rows to dispatch this round; ``consume``
+    decodes the fetched buffers and returns the next round's param-rows
+    ([] when the scan is complete). Continuations advance by global row
+    index only — no host key lookups on the continuation path."""
+
+    def __init__(self, eng: TpuStorageEngine, ctx, mode: str, pending,
+                 w_last: int, row_hi: int, cap: int | None):
+        self.eng = eng
+        self.ctx = ctx
+        self.mode = mode          # "paged" | "chunks"
+        self.pending = pending
+        self.sig = ctx["sig"]
+        self.trun = ctx["trun"]
+        self.w_last = w_last
+        self.row_hi = row_hi
+        self.cap = cap
+        self.rows: list[tuple] = []
+        self.scanned = 0
+        self.resume: bytes | None = None
+
+    def consume(self, bufs) -> list:
+        eng, ctx = self.eng, self.ctx
+        M = ctx["M"]
+        if self.mode == "chunks":
+            for buf in bufs:
+                self.scanned += int(buf[M, 1])
+                eng._emit_fetched(ctx, buf, self.rows)
+            return []
+
+        from yugabyte_db_tpu.ops import row_gather
+
+        buf = bufs[0]
+        (prev_ip, _prev_fp) = self.pending[0]
+        w_cap = int(prev_ip[1])
+        count = int(buf[M, 0])
+        self.scanned += int(buf[M, 1])
+        w_end = int(buf[M, 2])
+        n = min(count, M)
+        last_start = int(buf[n - 1, 0]) if n else None
+        _c, _n, hit_limit, last = eng._emit_fetched(ctx, buf, self.rows)
+        if hit_limit:
+            self.resume = ctx["crun"].key_at(last) + b"\x00"
+            self.pending = []
+            return []
+        # Complete iff no match was dropped (count > M: overflow) AND the
+        # loop consumed every window up to the range end.
+        if count <= M and w_end > w_cap and w_cap >= self.w_last:
+            self.pending = []
+            return []
+        K, R = self.sig.K, self.sig.R
+        if count > M:
+            row_lo2 = last_start + 1
+        else:
+            row_lo2 = max(int(prev_ip[2]), w_end * K * R)
+        if row_lo2 >= self.row_hi:
+            self.pending = []
+            return []
+        w_first2 = row_lo2 // (K * R)
+        if self.cap is not None:
+            # Geometric growth: a page over a sparse region converges in
+            # O(log windows) rounds instead of O(windows).
+            self.cap = min(self.cap * 4, 4096)
+        w_cap2 = self.w_last if self.cap is None else \
+            min(self.w_last, w_first2 + self.cap - 1)
+        # Windows up to w_end were already counted toward rows_scanned;
+        # a mid-window resume must not re-count them.
+        scan_from = max(row_lo2, w_end * K * R)
+        ip, fp = row_gather.pack_params(
+            w_first2, w_cap2, row_lo2, self.row_hi, ctx["read_planes"],
+            ctx["int_lits"], ctx["f32_lits"], scan_from=scan_from)
+        self.pending = [(ip, fp)]
+        return self.pending
+
+    def result(self) -> ScanResult:
+        return self.eng._gather_result(self.ctx, self.rows, self.scanned,
+                                       self.resume)
 
 
 _literal = agg_fold.pred_literal

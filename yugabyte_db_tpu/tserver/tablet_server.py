@@ -114,11 +114,23 @@ class TabletServer:
             # write lands at <= read_ht, then wait until every in-flight
             # write below it resolves (reference: MvccManager::SafeTime
             # wait in Tablet::DoHandleQLReadRequest).
-            from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+            from yugabyte_db_tpu.utils.hybrid_time import (
+                BITS_FOR_LOGICAL, MAX_CLOCK_SKEW_US, HybridTime)
+            # Never let a client-supplied read point ratchet the clock
+            # beyond the skew bound — an arbitrary far-future read_ht would
+            # poison every subsequent write HT on this tablet. (Logical
+            # clocks in tests have no wall-clock skew semantics: no bound.)
+            bound_fn = getattr(peer.tablet.clock, "max_global_now", None)
+            if bound_fn is not None and spec.read_ht > bound_fn().value + (
+                    MAX_CLOCK_SKEW_US << BITS_FOR_LOGICAL):
+                return {"code": "invalid_read_time"}
             peer.tablet.clock.update(HybridTime(spec.read_ht))
+            # Default below the client's 5s per-attempt transport timeout
+            # (client.py tablet_rpc) so the clean "timed_out" reply reaches
+            # the caller instead of a transport error.
             if not peer.tablet.mvcc.wait_for_safe_time(
                     HybridTime(spec.read_ht),
-                    timeout=p.get("timeout", 10.0)):
+                    timeout=p.get("timeout", 4.0)):
                 return {"code": "timed_out"}
         try:
             res = peer.scan(spec, allow_stale=p.get("allow_stale", False))

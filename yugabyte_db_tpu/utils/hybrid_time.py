@@ -27,6 +27,12 @@ LOGICAL_MASK = (1 << BITS_FOR_LOGICAL) - 1
 
 _MAX_HT = (1 << 63) - 1
 
+# Bound on tolerated clock skew between nodes: remote/client-supplied hybrid
+# times further than this ahead of the local clock are rejected instead of
+# ratcheting the clock (reference: FLAGS_max_clock_skew_usec,
+# src/yb/server/hybrid_clock.cc).
+MAX_CLOCK_SKEW_US = 500_000
+
 
 @dataclass(frozen=True, order=True)
 class HybridTime:
