@@ -257,3 +257,44 @@ def test_live_missing_replica_repaired_without_failed_creates(tmp_path):
         assert len(s.scan(table, ScanSpec()).rows) == 30
     finally:
         c.shutdown()
+
+
+def test_mesh_multi_tablet_aggregate(tmp_path):
+    """Multi-tablet aggregates execute as ONE device program on the
+    tserver's mesh (ts.multi_agg_scan -> parallel.sharded_aggregate with
+    psum/pmax combine), not as per-tablet scans merged on the client."""
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=1).start()
+    try:
+        c.wait_tservers_registered(1)
+        client = c.client()
+        table = client.create_table("mesh", COLUMNS, num_tablets=4,
+                                    replication_factor=1, engine="tpu")
+        from yugabyte_db_tpu.client import YBSession
+        s = YBSession(client)
+        n = 200
+        for i in range(n):
+            s.insert(table, {"k": f"key{i}", "r": i, "v": i * 10,
+                             "s": f"val-{i}"})
+        assert s.flush() == n
+        ts = next(iter(c.tservers.values()))
+        for peer in ts.tablet_manager.peers():
+            peer.flush()
+        res = s.scan(table, ScanSpec(aggregates=[
+            AggSpec("count", None), AggSpec("sum", "v"),
+            AggSpec("min", "v"), AggSpec("max", "v"), AggSpec("avg", "v")]))
+        total = sum(i * 10 for i in range(n))
+        assert res.rows == [(n, total, 0, 1990, total / n)]
+        assert ts.mesh_scan.served >= 1, "aggregate did not ride the mesh"
+        # Device-exact predicate pushdown through the mesh path.
+        res2 = s.scan(table, ScanSpec(
+            predicates=[Predicate("v", ">=", 1000)],
+            aggregates=[AggSpec("count", None)]))
+        assert res2.rows == [(100,)]
+        assert ts.mesh_scan.served >= 2
+        # Ineligible spec (string min needs the host path) falls back and
+        # still returns correct results.
+        res3 = s.scan(table, ScanSpec(aggregates=[AggSpec("max", "s")]))
+        assert res3.rows == [("val-99",)]
+        assert ts.mesh_scan.fallbacks >= 1
+    finally:
+        c.shutdown()

@@ -151,13 +151,9 @@ class YBSession:
         groups: dict[tuple, list[list]] = {}
         scanned = 0
         read_ht = spec.read_ht  # pinned after the first sub-scan (see scan())
-        for loc in locs.tablets:
-            sub = ScanSpec(lower=spec.lower, upper=spec.upper,
-                           read_ht=read_ht, predicates=spec.predicates,
-                           aggregates=partial_aggs, group_by=spec.group_by)
-            resp = self.client.tablet_rpc(
-                table.name, loc, "ts.scan",
-                {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+
+        def consume(resp):
+            nonlocal read_ht, scanned
             if "read_ht" in resp:
                 read_ht = resp["read_ht"]
             res = wire.decode_result(resp)
@@ -165,6 +161,47 @@ class YBSession:
             for row in res.rows:
                 gkey = tuple(row[:ngb])
                 groups.setdefault(gkey, []).append(list(row[ngb:]))
+
+        # Mesh path first: tablets grouped by leading tserver, ONE
+        # ts.multi_agg_scan per group — the tserver runs all its tablets
+        # as one device program with an ICI collective combine
+        # (tserver.mesh_scan). Any non-ok reply demotes that group to the
+        # per-tablet path below; the host combine here remains only the
+        # cross-tserver (and fallback) merge.
+        remaining_tablets = list(locs.tablets)
+        if not gb and table.engine == "tpu":
+            by_leader: dict[str, list] = {}
+            for loc in locs.tablets:
+                if loc.leader:
+                    by_leader.setdefault(loc.leader, []).append(loc)
+            for leader, group in by_leader.items():
+                if len(group) < 2:
+                    continue
+                sub = ScanSpec(lower=spec.lower, upper=spec.upper,
+                               read_ht=read_ht, predicates=spec.predicates,
+                               aggregates=partial_aggs)
+                try:
+                    resp = self.client.transport.send(
+                        leader, "ts.multi_agg_scan",
+                        {"tablet_ids": [g.tablet_id for g in group],
+                         "spec": wire.encode_spec(sub)}, timeout=5.0)
+                except Exception:  # noqa: BLE001 — per-tablet fallback
+                    continue
+                if resp.get("code") != "ok":
+                    continue
+                consume(resp)
+                served = {g.tablet_id for g in group}
+                remaining_tablets = [t for t in remaining_tablets
+                                     if t.tablet_id not in served]
+
+        for loc in remaining_tablets:
+            sub = ScanSpec(lower=spec.lower, upper=spec.upper,
+                           read_ht=read_ht, predicates=spec.predicates,
+                           aggregates=partial_aggs, group_by=spec.group_by)
+            resp = self.client.tablet_rpc(
+                table.name, loc, "ts.scan",
+                {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+            consume(resp)
         if not groups and not gb:
             groups[()] = []
         out_rows = []

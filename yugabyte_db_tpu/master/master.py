@@ -367,9 +367,37 @@ class Master:
                 for r in info.replicas:
                     key = (info.tablet_id, r)
                     d = live_by_uuid.get(r)
-                    if d is None or info.tablet_id in d.tablet_roles or \
-                            key in self._failed_creates:
+                    if d is None or key in self._failed_creates:
                         continue  # dead-TS / direct-retry paths own these
+                    if info.tablet_id in d.tablet_roles:
+                        # Hosted — but is it a MEMBER? If a previous repair
+                        # cycle crashed between its create and add-back
+                        # steps, the replica hosts an orphan copy outside
+                        # the group config; finish the add-back (the raft
+                        # config arrives with the leader's heartbeat).
+                        cfg = self.ts_manager.config_of(info.tablet_id)
+                        if cfg is None or r in cfg:
+                            continue
+                        tracked.add(key)
+                        first = self._missing_seen.setdefault(key, now)
+                        if now - first < self.missing_replica_grace_s:
+                            continue
+                        if now - self._fixing.get(info.tablet_id, 0) < 10.0:
+                            continue
+                        leader = self.ts_manager.leader_of(info.tablet_id)
+                        if leader is None or leader not in live_by_uuid:
+                            continue
+                        self._fixing[info.tablet_id] = now
+                        try:
+                            self._rpc_ok(leader, "ts.change_config", {
+                                "tablet_id": info.tablet_id,
+                                "peers": sorted(set(cfg) | {r}),
+                            }, timeout=10.0)
+                            self._missing_seen.pop(key, None)
+                            tracked.discard(key)
+                        except Exception:  # noqa: BLE001 — next tick
+                            self._fixing.pop(info.tablet_id, None)
+                        continue
                     tracked.add(key)
                     first = self._missing_seen.setdefault(key, now)
                     if now - first < self.missing_replica_grace_s:

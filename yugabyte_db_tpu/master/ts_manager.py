@@ -28,6 +28,10 @@ class TSManager:
         self._descs: dict[str, TSDescriptor] = {}
         # tablet_id -> (leader uuid, term): freshest leadership seen.
         self._tablet_leaders: dict[str, tuple[str, int]] = {}
+        # tablet_id -> (raft config peers, term) as reported by the
+        # freshest leader replica — the authoritative membership view the
+        # repair paths compare against the catalog.
+        self._tablet_configs: dict[str, tuple[tuple, int]] = {}
         self.unresponsive_timeout_s = unresponsive_timeout_s
 
     def heartbeat(self, req: dict) -> None:
@@ -48,6 +52,11 @@ class TSManager:
                     cur = self._tablet_leaders.get(t["tablet_id"])
                     if cur is None or term >= cur[1]:
                         self._tablet_leaders[t["tablet_id"]] = (leader, term)
+                if t.get("role") == "leader" and t.get("peers"):
+                    cur = self._tablet_configs.get(t["tablet_id"])
+                    if cur is None or term >= cur[1]:
+                        self._tablet_configs[t["tablet_id"]] = (
+                            tuple(t["peers"]), term)
 
     def live_tservers(self) -> list[TSDescriptor]:
         cutoff = time.monotonic() - self.unresponsive_timeout_s
@@ -68,6 +77,12 @@ class TSManager:
     def leader_of(self, tablet_id: str) -> str | None:
         with self._lock:
             v = self._tablet_leaders.get(tablet_id)
+            return v[0] if v else None
+
+    def config_of(self, tablet_id: str) -> tuple | None:
+        """Raft config peers as last reported by the tablet's leader."""
+        with self._lock:
+            v = self._tablet_configs.get(tablet_id)
             return v[0] if v else None
 
     def addr_of(self, uuid: str):

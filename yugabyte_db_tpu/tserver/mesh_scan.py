@@ -1,0 +1,103 @@
+"""MeshScanService: multi-tablet aggregates over the device mesh.
+
+The cluster read path for aggregates: instead of one ts.scan per tablet
+with the CLIENT merging partial aggregates on host (the reference's shape
+— per-tablet EvalAggregate partials recombined by the CQL executor /
+PG FDW, src/yb/docdb/pgsql_operation.cc:473), a tserver that leads
+several tablets of a table serves them with ONE device program: tablets
+sharded over the mesh "t" axis, each tablet's blocks over "b", partials
+combined with psum / two-plane lexicographic pmax over ICI
+(parallel.sharded.sharded_aggregate). The client-side host merge remains
+only as the cross-tserver / ineligible-spec fallback.
+
+Mesh policy: built once from the visible devices — "t" gets the larger
+factor (tablet parallelism is the dominant axis), "b" gets 2 when the
+device count is even. A single-chip node degenerates to a 1x1 mesh and
+still executes the same program (collectives become identities), so the
+code path is identical from laptop to pod slice.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+
+
+class MeshScanService:
+    """Per-tserver service executing multi-tablet aggregate scans on the
+    device mesh. Stateless between calls except for a small cache of
+    stacked device residency (rebuilt whenever any tablet's run set
+    changes — flush/compaction replace ColumnarRun objects)."""
+
+    def __init__(self, max_cached_stacks: int = 2):
+        self._lock = threading.Lock()
+        self._mesh = None
+        self._stacks: dict[tuple, object] = {}
+        self._max_cached = max_cached_stacks
+        self.served = 0       # aggregates answered on the mesh
+        self.fallbacks = 0    # ineligible requests bounced to per-tablet
+
+    def _get_mesh(self):
+        if self._mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            import numpy as np
+
+            devices = jax.devices()
+            n = len(devices)
+            mesh_b = 2 if n % 2 == 0 else 1
+            mesh_t = n // mesh_b
+            self._mesh = Mesh(
+                np.array(devices[:mesh_t * mesh_b]).reshape(mesh_t, mesh_b),
+                ("t", "b"))
+        return self._mesh
+
+    def eligible_peer(self, peer, spec: ScanSpec) -> bool:
+        """Engine-state eligibility: TPU engine, exactly one run, no
+        memtable data in the scanned range (single-source — the mesh
+        program has no host-merge stage)."""
+        engine = peer.tablet.engine
+        runs = getattr(engine, "runs", None)
+        if runs is None or len(runs) != 1:
+            return False
+        if not hasattr(runs[0], "crun"):
+            return False  # cpu engine
+        if engine._memtable_in_range(spec) or runs[0].crun.num_versions == 0:
+            return False
+        return True
+
+    def aggregate(self, peers: list, spec: ScanSpec) -> ScanResult | None:
+        """Run spec's aggregates over all peers' tablets on the mesh.
+        Returns None when ineligible (caller falls back to per-tablet
+        scans + host combine)."""
+        from yugabyte_db_tpu.parallel import ShardedTablets, sharded_aggregate
+
+        if not spec.is_aggregate or spec.group_by:
+            self.fallbacks += 1
+            return None
+        if not all(self.eligible_peer(p, spec) for p in peers):
+            self.fallbacks += 1
+            return None
+        runs = [p.tablet.engine.runs[0].crun for p in peers]
+        key = tuple(id(r) for r in runs)
+        mesh = self._get_mesh()
+        with self._lock:
+            st = self._stacks.get(key)
+            if st is None:
+                schema = peers[0].tablet.meta.schema
+                try:
+                    st = ShardedTablets(schema, runs, mesh)
+                except ValueError:
+                    self.fallbacks += 1
+                    return None
+                if len(self._stacks) >= self._max_cached:
+                    self._stacks.pop(next(iter(self._stacks)))
+                self._stacks[key] = st
+        try:
+            res = sharded_aggregate(st, spec)
+        except ValueError:
+            self.fallbacks += 1
+            return None  # spec not device-exact: fallback
+        self.served += 1
+        return res

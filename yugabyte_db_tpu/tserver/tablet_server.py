@@ -37,6 +37,9 @@ class TabletServer:
             engine_options=engine_options, fsync=fsync)
         self.heartbeater = Heartbeater(self, master_uuids,
                                        interval_s=heartbeat_interval_s)
+        from yugabyte_db_tpu.tserver.mesh_scan import MeshScanService
+
+        self.mesh_scan = MeshScanService()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -100,6 +103,32 @@ class TabletServer:
             return {"code": "timed_out"}
         return {"code": "ok", "ht": ht.value}
 
+    @staticmethod
+    def _pin_read_point(peer, read_ht: int, timeout: float) -> dict | None:
+        """Pin an explicit client read point on one tablet: advance the
+        local clock past it so no later write lands at <= read_ht, then
+        wait until every in-flight write below it resolves (reference:
+        MvccManager::SafeTime wait in Tablet::DoHandleQLReadRequest).
+        Returns an error response dict, or None on success."""
+        from yugabyte_db_tpu.utils.hybrid_time import (
+            BITS_FOR_LOGICAL, MAX_CLOCK_SKEW_US, HybridTime)
+        # Never let a client-supplied read point ratchet the clock
+        # beyond the skew bound — an arbitrary far-future read_ht would
+        # poison every subsequent write HT on this tablet. (Logical
+        # clocks in tests have no wall-clock skew semantics: no bound.)
+        bound_fn = getattr(peer.tablet.clock, "max_global_now", None)
+        if bound_fn is not None and read_ht > bound_fn().value + (
+                MAX_CLOCK_SKEW_US << BITS_FOR_LOGICAL):
+            return {"code": "invalid_read_time"}
+        peer.tablet.clock.update(HybridTime(read_ht))
+        # Default below the client's 5s per-attempt transport timeout
+        # (client.py tablet_rpc) so the clean "timed_out" reply reaches
+        # the caller instead of a transport error.
+        if not peer.tablet.mvcc.wait_for_safe_time(
+                HybridTime(read_ht), timeout=timeout):
+            return {"code": "timed_out"}
+        return None
+
     def _h_ts_scan(self, p: dict):
         try:
             peer = self.tablet_manager.get(p["tablet_id"])
@@ -109,33 +138,55 @@ class TabletServer:
         if spec.read_ht == wire.MAX_HT:
             spec.read_ht = peer.read_time().value
         else:
-            # Explicit read point (a client pinning one snapshot across
-            # pages/tablets): advance the local clock past it so no later
-            # write lands at <= read_ht, then wait until every in-flight
-            # write below it resolves (reference: MvccManager::SafeTime
-            # wait in Tablet::DoHandleQLReadRequest).
-            from yugabyte_db_tpu.utils.hybrid_time import (
-                BITS_FOR_LOGICAL, MAX_CLOCK_SKEW_US, HybridTime)
-            # Never let a client-supplied read point ratchet the clock
-            # beyond the skew bound — an arbitrary far-future read_ht would
-            # poison every subsequent write HT on this tablet. (Logical
-            # clocks in tests have no wall-clock skew semantics: no bound.)
-            bound_fn = getattr(peer.tablet.clock, "max_global_now", None)
-            if bound_fn is not None and spec.read_ht > bound_fn().value + (
-                    MAX_CLOCK_SKEW_US << BITS_FOR_LOGICAL):
-                return {"code": "invalid_read_time"}
-            peer.tablet.clock.update(HybridTime(spec.read_ht))
-            # Default below the client's 5s per-attempt transport timeout
-            # (client.py tablet_rpc) so the clean "timed_out" reply reaches
-            # the caller instead of a transport error.
-            if not peer.tablet.mvcc.wait_for_safe_time(
-                    HybridTime(spec.read_ht),
-                    timeout=p.get("timeout", 4.0)):
-                return {"code": "timed_out"}
+            err = self._pin_read_point(peer, spec.read_ht,
+                                       p.get("timeout", 4.0))
+            if err is not None:
+                return err
         try:
             res = peer.scan(spec, allow_stale=p.get("allow_stale", False))
         except NotLeader as e:
             return {"code": "not_leader", "leader_hint": e.leader_hint}
+        out = wire.encode_result(res)
+        out["code"] = "ok"
+        out["read_ht"] = spec.read_ht
+        return out
+
+    def _h_ts_multi_agg_scan(self, p: dict):
+        """Aggregate over MANY tablets this server leads, as ONE device
+        program over the mesh (tablets on the "t" axis, blocks on "b",
+        psum/pmax combine over ICI — tserver.mesh_scan). The client falls
+        back to per-tablet ts.scan + host combine on any non-ok reply."""
+        peers = []
+        for tid in p["tablet_ids"]:
+            try:
+                peer = self.tablet_manager.get(tid)
+            except TabletNotFound:
+                return {"code": "not_found", "tablet_id": tid}
+            if not (peer.raft.is_leader() and peer.raft.has_lease()):
+                return {"code": "not_leader", "tablet_id": tid,
+                        "leader_hint": peer.raft.leader_uuid()}
+            peers.append(peer)
+        spec = wire.decode_spec(p["spec"])
+        if spec.read_ht == wire.MAX_HT:
+            # Every tablet can already serve its own safe time; the min is
+            # serveable by all without waiting and repeatable everywhere.
+            spec.read_ht = min(pr.read_time().value for pr in peers)
+        else:
+            # One deadline across ALL pins: serial per-peer waits must not
+            # sum past the client's single transport timeout.
+            import time as _time
+
+            deadline = _time.monotonic() + p.get("timeout", 4.0)
+            for peer in peers:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return {"code": "timed_out"}
+                err = self._pin_read_point(peer, spec.read_ht, remaining)
+                if err is not None:
+                    return err
+        res = self.mesh_scan.aggregate(peers, spec)
+        if res is None:
+            return {"code": "ineligible"}
         out = wire.encode_result(res)
         out["code"] = "ok"
         out["read_ht"] = spec.read_ht
