@@ -20,6 +20,11 @@ class MasterUnavailable(Exception):
     pass
 
 
+# Response codes no retry can change: surface immediately.
+TERMINAL_CODES = frozenset(
+    {"invalid_read_time", "conflict", "aborted", "committed", "error"})
+
+
 class TabletOpFailed(Exception):
     pass
 
@@ -151,11 +156,13 @@ class YBClient:
                                                 target)
                     loc.leader = target
                     return resp
-                if code == "invalid_read_time":
-                    # Terminal: every replica rejects a read point beyond
-                    # the clock-skew bound; retrying cannot succeed.
-                    raise TabletOpFailed(
+                if code in TERMINAL_CODES:
+                    # Retrying cannot change these outcomes (conflicts,
+                    # terminal txn states, rejected read points).
+                    err = TabletOpFailed(
                         f"{method} on {loc.tablet_id}: {resp}")
+                    err.resp = resp
+                    raise err
                 last = resp
             if not tried_refresh:
                 # Replica set may have changed (re-replication): refresh.

@@ -226,6 +226,16 @@ class Master:
         return {"code": "ok", "table_id": t.table_id, "schema": t.schema,
                 "tablets": out}
 
+    def _h_master_locate_tablet(self, p: dict):
+        """Replica set + freshest known leader of one tablet (used by the
+        transaction notifier/resolvers to route per-tablet RPCs)."""
+        info = self.catalog.tablets.get(p["tablet_id"])
+        if info is None:
+            return {"code": "not_found"}
+        return {"code": "ok", "tablet_id": info.tablet_id,
+                "replicas": list(info.replicas),
+                "leader": self.ts_manager.leader_of(info.tablet_id)}
+
     def _h_master_list_tables(self, p: dict):
         return {"code": "ok", "tables": [
             {"table_id": t.table_id, "name": t.name, "state": t.state,

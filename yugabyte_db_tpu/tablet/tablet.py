@@ -96,6 +96,17 @@ class Tablet:
         self.consensus_managed = consensus_managed
         self._last_index = self.log.last_appended.index
         self._applied_index = meta.flushed_op_index
+        # Transaction machinery: every tablet can hold intents
+        # (participant); tablets of the status table additionally run the
+        # coordinator state machine. Both rebuild from sidecar snapshots +
+        # WAL replay exactly like the engine.
+        from yugabyte_db_tpu.txn.coordinator import (TXN_STATUS_TABLE,
+                                                     TransactionCoordinator)
+        from yugabyte_db_tpu.txn.participant import TransactionParticipant
+
+        self.participant = TransactionParticipant(self.dir)
+        self.coordinator = (TransactionCoordinator(self.dir)
+                            if meta.table_name == TXN_STATUS_TABLE else None)
         self.bootstrap()
 
     # -- bootstrap ----------------------------------------------------------
@@ -128,8 +139,22 @@ class Tablet:
                 rows = _decode_rows(entry.body)
                 self.engine.apply(rows)
                 replayed += 1
+            else:
+                self._apply_txn_op(entry)
             self._applied_index = max(self._applied_index, entry.op_id.index)
         self._replayed_on_bootstrap = replayed
+
+    def _apply_txn_op(self, entry) -> None:
+        """Apply transaction ops (intents / commit-apply / abort-remove /
+        coordinator status records) from the log."""
+        if entry.op_type == "intents":
+            self.participant.apply_intents_op(entry.body)
+        elif entry.op_type == "apply_intents":
+            self.participant.apply_commit_op(entry.body, self.engine.apply)
+        elif entry.op_type == "remove_intents":
+            self.participant.apply_remove_op(entry.body)
+        elif entry.op_type == "txn_status" and self.coordinator is not None:
+            self.coordinator.apply_status_op(entry.body)
 
     # -- write path ---------------------------------------------------------
     def write(self, rows: list[RowVersion]) -> HybridTime:
@@ -171,6 +196,8 @@ class Tablet:
         with self._write_lock:
             if entry.op_type == "write":
                 self.engine.apply(_decode_rows(entry.body))
+            else:
+                self._apply_txn_op(entry)
             self._applied_index = max(self._applied_index, entry.op_id.index)
             self._last_index = max(self._last_index, entry.op_id.index)
         self.clock.update(HybridTime(entry.ht))
@@ -185,13 +212,36 @@ class Tablet:
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         """Flush memtable to a durable run, advance the replay frontier,
-        GC fully-flushed WAL segments."""
+        GC fully-flushed WAL segments. Transaction state (intents,
+        coordinator records) snapshots alongside — it too stops being
+        recoverable from the log once segments below the frontier go."""
         with self._write_lock:
             self.engine.flush()
+            self.participant.snapshot()
+            if self.coordinator is not None:
+                self.coordinator.snapshot()
             self.meta.flushed_op_index = self._applied_index
             self.meta.save(self.meta_path)
             self.log.sync()
             self.log.gc(self.meta.flushed_op_index + 1)
+
+    # -- transaction support -------------------------------------------------
+    def latest_committed_ht(self, key: bytes) -> int:
+        """Newest committed version ht of a row key (0 if none) — the
+        first-committer-wins conflict check input."""
+        eng = self.engine
+        best = 0
+        mem = getattr(eng, "memtable", None)
+        if mem is not None:
+            for v in mem.versions(key):
+                best = max(best, v.ht)
+        for run in getattr(eng, "runs", []):
+            crun = getattr(run, "crun", run)  # TpuRun wraps; CpuRun is flat
+            versions = (crun.find_versions(key) if hasattr(crun, "find_versions")
+                        else crun.get(key))
+            for v in versions:
+                best = max(best, v.ht)
+        return best
 
     def compact(self, history_cutoff_ht: int = 0) -> None:
         self.engine.compact(history_cutoff_ht)

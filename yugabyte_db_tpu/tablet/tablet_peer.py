@@ -46,6 +46,11 @@ class TabletPeer:
             preloaded_entries=self.tablet.bootstrap_entries)
         del self.tablet.bootstrap_entries  # one-shot handoff
         self._maintenance_lock = threading.Lock()
+        # Serializes conflict-check + intent replication: without it two
+        # concurrent writers to the same key both pass the check and both
+        # plant intents (the reference holds its SharedLockManager batch
+        # across the whole doc-write, shared_lock_manager.h).
+        self._intent_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -96,6 +101,75 @@ class TabletPeer:
                              args=(entry.op_id, ht), daemon=True).start()
             raise
         self.tablet.mvcc.replicated(ht)
+        return ht
+
+    # -- transaction write path ---------------------------------------------
+    def write_intents(self, txn_id: str, status_tablet: str, priority: int,
+                      read_ht: int, rows: list[RowVersion],
+                      timeout: float = 10.0) -> int:
+        """Write provisional rows for a transaction: conflict-check on the
+        leader, then replicate an "intents" entry (reference:
+        Tablet::AcquireLocksAndPerformDocOperations + the intents write of
+        PrepareTransactionWriteBatch, src/yb/docdb/docdb.h:169). Raises
+        txn.participant.IntentConflict on conflict.
+
+        Returns the entry's hybrid time. The caller MUST propagate it to
+        the transaction's commit request: the coordinator ratchets its
+        clock past every intent write before choosing commit_ht, so a
+        pinned read that advanced this tablet's clock (and therefore this
+        entry's ht) past its read time can never be overtaken by the
+        commit (the HLC-propagation half of the safe-time contract)."""
+        if not self.raft.is_leader():
+            raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        from yugabyte_db_tpu.storage.wire import encode_rows
+        with self._intent_lock:
+            self.tablet.participant.check_conflicts(
+                txn_id, [r.key for r in rows], read_ht,
+                self.tablet.latest_committed_ht)
+            body = {
+                "txn_id": txn_id, "status_tablet": status_tablet,
+                "priority": priority, "read_ht": read_ht,
+                "rows": encode_rows(rows),
+            }
+            # Tracked in MVCC like a write: a pinned read below this
+            # entry's ht must wait for the apply, or it would miss the
+            # intents entirely (they'd land after its intent-gate check).
+            return self.replicate_txn_op("intents", body, timeout,
+                                         track_mvcc=True)
+
+    def replicate_txn_op(self, op_type: str, body: dict,
+                         timeout: float = 10.0, ht: int | None = None,
+                         track_mvcc: bool = False) -> int:
+        """Replicate one transaction op through this tablet's Raft log and
+        wait until applied locally. Returns the entry hybrid time."""
+        if not self.raft.is_leader():
+            raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        if ht is None:
+            ht = self.tablet.clock.now().value
+        hto = HybridTime(ht)
+        if track_mvcc:
+            self.tablet.mvcc.add_pending(hto)
+        try:
+            entry = self.raft.append_leader(op_type, body, ht=ht)
+        except BaseException:
+            if track_mvcc:
+                self.tablet.mvcc.aborted(hto)
+            raise
+        try:
+            self.raft.wait_applied(entry.op_id, timeout)
+        except NotLeader:
+            if track_mvcc:
+                self.tablet.mvcc.aborted(hto)  # truncated: definite abort
+            raise
+        except TimeoutError:
+            if track_mvcc:
+                # Outcome unknown: keep the HT pinned until Raft resolves
+                # it (same contract as write()).
+                threading.Thread(target=self._resolve_unknown_write,
+                                 args=(entry.op_id, hto), daemon=True).start()
+            raise
+        if track_mvcc:
+            self.tablet.mvcc.replicated(hto)
         return ht
 
     def _resolve_unknown_write(self, op_id, ht: HybridTime) -> None:

@@ -38,15 +38,21 @@ class TabletServer:
         self.heartbeater = Heartbeater(self, master_uuids,
                                        interval_s=heartbeat_interval_s)
         from yugabyte_db_tpu.tserver.mesh_scan import MeshScanService
+        from yugabyte_db_tpu.tserver.txn_service import (TxnNotifier,
+                                                         TxnRpcRouter)
 
         self.mesh_scan = MeshScanService()
+        self.txn_router = TxnRpcRouter(transport, master_uuids)
+        self.txn_notifier = TxnNotifier(self, self.txn_router)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self.tablet_manager.open_existing()
         self.heartbeater.start()
+        self.txn_notifier.start()
 
     def shutdown(self) -> None:
+        self.txn_notifier.stop()
         self.heartbeater.stop()
         self.tablet_manager.shutdown()
 
@@ -95,13 +101,31 @@ class TabletServer:
         except TabletNotFound:
             return {"code": "not_found"}
         rows = wire.decode_rows(p["rows"])
-        try:
-            ht = peer.write(rows, timeout=p.get("timeout", 10.0))
-        except NotLeader as e:
-            return {"code": "not_leader", "leader_hint": e.leader_hint}
-        except TimeoutError:
-            return {"code": "timed_out"}
-        return {"code": "ok", "ht": ht.value}
+        # Non-transactional writes still resolve against pending intents:
+        # they act as a highest-priority writer and wound any pending txn
+        # holding intents on these keys (reference: single-row operations
+        # go through the same conflict resolution). The check and the
+        # write happen under the intent-admission lock, so an intent write
+        # cannot slip between them (and vice versa: an admitted intent's
+        # conflict check sees this write applied).
+        keys = [r.key for r in rows]
+        for _attempt in range(3):
+            with peer._intent_lock:
+                conflicting = peer.tablet.participant.pending_on_keys(keys)
+                if not conflicting:
+                    try:
+                        ht = peer.write(rows, timeout=p.get("timeout", 10.0))
+                    except NotLeader as e:
+                        return {"code": "not_leader",
+                                "leader_hint": e.leader_hint}
+                    except TimeoutError:
+                        return {"code": "timed_out"}
+                    return {"code": "ok", "ht": ht.value}
+            err = self._resolve_write_conflicts(
+                peer, {"priority": 1 << 62}, conflicting)
+            if err is not None:
+                return err
+        return {"code": "conflict", "message": "intents kept reappearing"}
 
     @staticmethod
     def _pin_read_point(peer, read_ht: int, timeout: float) -> dict | None:
@@ -142,6 +166,9 @@ class TabletServer:
                                        p.get("timeout", 4.0))
             if err is not None:
                 return err
+        err = self._resolve_read_intents(peer, spec)
+        if err is not None:
+            return err
         try:
             res = peer.scan(spec, allow_stale=p.get("allow_stale", False))
         except NotLeader as e:
@@ -150,6 +177,273 @@ class TabletServer:
         out["code"] = "ok"
         out["read_ht"] = spec.read_ht
         return out
+
+    def _resolve_read_intents(self, peer, spec) -> dict | None:
+        """Intent-aware read gate (the IntentAwareIterator contract,
+        src/yb/docdb/intent_aware_iterator.h:62-81, as a pre-scan gate):
+        for each foreign txn with intents in the scanned range, ask its
+        status tablet for the state AT spec.read_ht. The coordinator
+        ratchets its clock past the asker's read time first, so:
+          pending  -> any future commit lands above read_ht: ignore;
+          aborted  -> ignore (cleaned lazily);
+          committed with commit_ht <= read_ht -> the rows MUST be visible:
+                      wait for the local apply to land, then scan.
+        """
+        part = peer.tablet.participant
+        overlapping = part.txns_overlapping(spec.lower, spec.upper)
+        for txn_id, meta in overlapping.items():
+            resp = self.txn_router.tablet_rpc(
+                meta["status_tablet"], "ts.txn_status",
+                {"txn_id": txn_id, "read_ht": spec.read_ht})
+            if resp is None or resp.get("code") != "ok":
+                return {"code": "timed_out",
+                        "detail": f"cannot resolve txn {txn_id}"}
+            if resp["status"] == "committed" and \
+                    resp["commit_ht"] <= spec.read_ht:
+                if not part.wait_gone(txn_id, timeout=3.0):
+                    return {"code": "timed_out",
+                            "detail": f"txn {txn_id} apply lagging"}
+        return None
+
+    # -- transaction service --------------------------------------------------
+    def _h_ts_write_intents(self, p: dict):
+        """Provisional write with server-side conflict resolution
+        (reference: docdb::ResolveTransactionConflicts,
+        src/yb/docdb/conflict_resolution.cc)."""
+        from yugabyte_db_tpu.txn.participant import IntentConflict
+
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        rows = wire.decode_rows(p["rows"])
+        for _attempt in range(3):
+            try:
+                ht = peer.write_intents(p["txn_id"], p["status_tablet"],
+                                        p["priority"], p["read_ht"], rows)
+                return {"code": "ok", "ht": ht}
+            except NotLeader as e:
+                return {"code": "not_leader", "leader_hint": e.leader_hint}
+            except TimeoutError:
+                return {"code": "timed_out"}
+            except IntentConflict as e:
+                if not e.conflicting:
+                    return {"code": "conflict", "message": str(e)}
+                err = self._resolve_write_conflicts(peer, p, e.conflicting)
+                if err is not None:
+                    return err
+        return {"code": "conflict", "message": "conflicts kept reappearing"}
+
+    def _resolve_write_conflicts(self, peer, p, conflicting) -> dict | None:
+        """Resolve pending foreign intents blocking a write: finish
+        committed/aborted txns locally; for live ones run the priority
+        duel — the higher-priority writer wounds the lower (aborts it at
+        its coordinator), otherwise the writer loses. None = retry."""
+        for other_id, other_status_tablet, other_prio in conflicting:
+            resp = self.txn_router.tablet_rpc(
+                other_status_tablet, "ts.txn_status",
+                {"txn_id": other_id,
+                 "read_ht": peer.tablet.clock.now().value})
+            if resp is None or resp.get("code") != "ok":
+                return {"code": "timed_out",
+                        "detail": f"cannot resolve txn {other_id}"}
+            try:
+                if resp["status"] == "committed":
+                    peer.replicate_txn_op(
+                        "apply_intents",
+                        {"txn_id": other_id, "commit_ht": resp["commit_ht"]},
+                        ht=resp["commit_ht"])
+                elif resp["status"] == "aborted":
+                    peer.replicate_txn_op("remove_intents",
+                                          {"txn_id": other_id})
+                else:  # pending: the duel
+                    if p["priority"] > other_prio:
+                        ab = self.txn_router.tablet_rpc(
+                            other_status_tablet, "ts.txn_abort",
+                            {"txn_id": other_id})
+                        if ab is None or ab.get("code") not in (
+                                "ok", "aborted"):
+                            return {"code": "conflict",
+                                    "message": f"cannot wound {other_id}"}
+                        peer.replicate_txn_op("remove_intents",
+                                              {"txn_id": other_id})
+                    else:
+                        return {"code": "conflict",
+                                "message": f"blocked by higher-priority "
+                                           f"txn {other_id}"}
+            except NotLeader as e:
+                return {"code": "not_leader", "leader_hint": e.leader_hint}
+        return None
+
+    def _h_ts_apply_txn(self, p: dict):
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if not peer.raft.is_leader():
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        if peer.tablet.participant.has_intents(p["txn_id"]):
+            try:
+                peer.replicate_txn_op(
+                    "apply_intents",
+                    {"txn_id": p["txn_id"], "commit_ht": p["commit_ht"]},
+                    ht=p["commit_ht"])
+            except NotLeader as e:
+                return {"code": "not_leader", "leader_hint": e.leader_hint}
+        return {"code": "ok"}
+
+    def _h_ts_remove_txn(self, p: dict):
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if not peer.raft.is_leader():
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        if peer.tablet.participant.has_intents(p["txn_id"]):
+            try:
+                peer.replicate_txn_op("remove_intents",
+                                      {"txn_id": p["txn_id"]})
+            except NotLeader as e:
+                return {"code": "not_leader", "leader_hint": e.leader_hint}
+        return {"code": "ok"}
+
+    # -- coordinator service (status tablet) ----------------------------------
+    def _coord_peer(self, p: dict):
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return None, {"code": "not_found"}
+        if peer.tablet.coordinator is None:
+            return None, {"code": "error", "message": "not a status tablet"}
+        # leader_ready (own-term entry applied) guarantees every
+        # prior-term in-flight commit is applied before we answer status
+        # queries — a new leader must not promise "pending" while an old
+        # leader's commit entry is still committing through its log.
+        if not (peer.raft.is_leader() and peer.raft.has_lease()
+                and peer.raft.leader_ready()):
+            return None, {"code": "not_leader",
+                          "leader_hint": peer.raft.leader_uuid()}
+        return peer, None
+
+    def _h_ts_txn_create(self, p: dict):
+        peer, err = self._coord_peer(p)
+        if err is not None:
+            return err
+        try:
+            peer.replicate_txn_op("txn_status", {
+                "action": "create", "txn_id": p["txn_id"]})
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        return {"code": "ok", "read_ht": peer.tablet.clock.now().value}
+
+    def _h_ts_txn_heartbeat(self, p: dict):
+        peer, err = self._coord_peer(p)
+        if err is not None:
+            return err
+        alive = peer.tablet.coordinator.heartbeat(p["txn_id"])
+        return {"code": "ok" if alive else "aborted"}
+
+    def _h_ts_txn_status(self, p: dict):
+        peer, err = self._coord_peer(p)
+        if err is not None:
+            return err
+        # resolve_status ratchets the coordinator clock past the asker's
+        # read time and waits out in-flight commits, making a "pending"
+        # answer a promise that any later commit lands above read_ht
+        # (the StatusRequest serving contract).
+        st = peer.tablet.coordinator.resolve_status(
+            p["txn_id"], p["read_ht"], peer.tablet.clock)
+        if st is None:
+            return {"code": "timed_out"}
+        return {"code": "ok", **st}
+
+    def _h_ts_txn_commit(self, p: dict):
+        peer, err = self._coord_peer(p)
+        if err is not None:
+            return err
+        coord = peer.tablet.coordinator
+        st = coord.status(p["txn_id"])
+        if st["status"] == "committed":
+            return {"code": "ok", "commit_ht": st["commit_ht"]}  # retry
+        if st["status"] == "aborted":
+            return {"code": "aborted"}
+        # HLC propagation: every intent write's hybrid time (max'ed by the
+        # client) must ratchet this clock before the commit time is
+        # chosen, so commit_ht exceeds every intent write — and therefore
+        # every read time any participant tablet has already served past.
+        from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+
+        peer.tablet.clock.update(HybridTime(p.get("propagated_ht", 0)))
+        commit_ht = coord.choose_commit_ht(p["txn_id"], peer.tablet.clock)
+        try:
+            entry = peer.raft.append_leader("txn_status", {
+                "action": "commit", "txn_id": p["txn_id"],
+                "commit_ht": commit_ht,
+                "participants": p.get("participants", []),
+            }, ht=commit_ht)
+        except NotLeader as e:
+            coord.finish_commit_attempt(p["txn_id"])
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        try:
+            peer.raft.wait_applied(entry.op_id, 10.0)
+        except NotLeader as e:
+            # Entry truncated: the commit definitively did not happen.
+            coord.finish_commit_attempt(p["txn_id"])
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            # Outcome UNKNOWN: the entry may still commit with this
+            # commit_ht, so the in-flight marker must stay until Raft
+            # resolves it (else a status query could promise "pending").
+            import threading as _threading
+
+            def _resolve():
+                while True:
+                    try:
+                        peer.raft.wait_applied(entry.op_id, 10.0)
+                        break
+                    except NotLeader:
+                        break
+                    except TimeoutError:
+                        if not peer.raft._running:
+                            break
+                        continue
+                coord.finish_commit_attempt(p["txn_id"])
+
+            _threading.Thread(target=_resolve, daemon=True).start()
+            return {"code": "timed_out"}
+        coord.finish_commit_attempt(p["txn_id"])
+        # A racing abort may have been ordered first: report the outcome
+        # the log actually chose.
+        st = coord.status(p["txn_id"])
+        if st["status"] != "committed":
+            return {"code": "aborted"}
+        self.txn_notifier.trigger()
+        return {"code": "ok", "commit_ht": st["commit_ht"]}
+
+    def _h_ts_txn_abort(self, p: dict):
+        peer, err = self._coord_peer(p)
+        if err is not None:
+            return err
+        coord = peer.tablet.coordinator
+        st = coord.status(p["txn_id"])
+        if st["status"] == "committed":
+            return {"code": "committed", "commit_ht": st["commit_ht"]}
+        if st["status"] == "aborted":
+            return {"code": "ok"}
+        try:
+            peer.replicate_txn_op("txn_status", {
+                "action": "abort", "txn_id": p["txn_id"],
+                "participants": p.get("participants", []),
+            })
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        st = coord.status(p["txn_id"])
+        if st["status"] == "committed":
+            return {"code": "committed", "commit_ht": st["commit_ht"]}
+        self.txn_notifier.trigger()
+        return {"code": "ok"}
 
     def _h_ts_multi_agg_scan(self, p: dict):
         """Aggregate over MANY tablets this server leads, as ONE device
@@ -184,6 +478,10 @@ class TabletServer:
                 err = self._pin_read_point(peer, spec.read_ht, remaining)
                 if err is not None:
                     return err
+        for peer in peers:
+            err = self._resolve_read_intents(peer, spec)
+            if err is not None:
+                return err
         res = self.mesh_scan.aggregate(peers, spec)
         if res is None:
             return {"code": "ineligible"}

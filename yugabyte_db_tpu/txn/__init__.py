@@ -1,0 +1,30 @@
+"""Distributed transactions: coordinator, participant, client API.
+
+Reference analog: the transaction stack of src/yb/tablet/
+transaction_coordinator.cc (status-tablet state machine),
+transaction_participant.cc (per-tablet intents + apply), and
+src/yb/docdb/conflict_resolution.cc — redesigned for the TPU-first
+engine split: provisional writes (intents) live in a small host-side
+store, committed data lives in the device-resident columnar engine, and
+commit moves intents into the engine at the coordinator-chosen commit
+hybrid time (the IntentAwareIterator merge of intent_aware_iterator.h:81
+becomes a read-side gate + status resolution instead of a merge, because
+applies are local Raft ops that land promptly).
+"""
+
+from yugabyte_db_tpu.txn.client import (TransactionConflict,
+                                        TransactionManager, YBTransaction)
+from yugabyte_db_tpu.txn.coordinator import (TXN_STATUS_TABLE,
+                                             TransactionCoordinator)
+from yugabyte_db_tpu.txn.participant import (IntentConflict,
+                                             TransactionParticipant)
+
+__all__ = [
+    "IntentConflict",
+    "TransactionConflict",
+    "TransactionCoordinator",
+    "TransactionManager",
+    "TransactionParticipant",
+    "TXN_STATUS_TABLE",
+    "YBTransaction",
+]
