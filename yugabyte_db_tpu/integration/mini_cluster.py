@@ -130,6 +130,20 @@ class MiniCluster:
             return YBClient(self.transport.bind(name), self.master_uuids)
         return YBClient(self.transport, self.master_uuids)
 
+    def start_cql_server(self, host: str = "127.0.0.1", port: int = 0,
+                         **cluster_kwargs):
+        """Start a CQL native-protocol proxy over this cluster (the
+        reference shape: the tserver process spawns the CQL server on
+        port 9042, tablet_server_main.cc:211). Returns (server, (host,
+        port)); caller shuts the server down."""
+        from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+        from yugabyte_db_tpu.yql.cql.server import CQLServer
+
+        server = CQLServer(ClientCluster(self.client("cql-proxy"),
+                                         **cluster_kwargs))
+        addr = server.listen(host, port)
+        return server, addr
+
     def leader_master(self, timeout_s: float = 10.0) -> Master:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

@@ -14,6 +14,14 @@ from dataclasses import dataclass, field
 from yugabyte_db_tpu.models.datatypes import DataType
 
 
+@dataclass(frozen=True)
+class BindMarker:
+    """A ``?`` placeholder; resolved against execute-time params by
+    position (reference: PTBindVar, src/yb/yql/cql/ql/ptree/pt_expr.h)."""
+
+    index: int
+
+
 @dataclass
 class ColumnDef:
     name: str

@@ -1,0 +1,127 @@
+"""ClientCluster: the QLProcessor's Cluster seam over the distributed
+client — how the CQL proxy reaches real tservers.
+
+Reference analog: the CQL server's embedded YBClient/YBSession path
+(src/yb/yql/cql/ql/exec/executor.cc building ops routed through
+src/yb/client/batcher.cc). The processor only needs: create/drop/table
+lookup, hash->tablet routing, and per-tablet objects exposing
+write(rows) / scan(spec) / read_time() — RemoteTablet implements those
+as tserver RPCs through the client's MetaCache + TabletInvoker."""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.client.client import YBClient
+from yugabyte_db_tpu.models.partition import PartitionSchema
+from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.storage import wire
+from yugabyte_db_tpu.storage.row_version import RowVersion
+from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+from yugabyte_db_tpu.utils.hybrid_time import HybridClock, HybridTime
+from yugabyte_db_tpu.utils.status import AlreadyPresent, NotFound
+
+
+class RemoteTablet:
+    """One tablet as seen through the client: the duck-type the
+    QLProcessor drives (Tablet's read surface + write)."""
+
+    def __init__(self, client: YBClient, table_name: str, loc):
+        self.client = client
+        self.table_name = table_name
+        self.loc = loc
+
+    def read_time(self) -> HybridTime:
+        # The tserver picks its safe time when read_ht arrives as MAX
+        # (tablet_server._h_ts_scan), exactly like a fresh scan.
+        return HybridTime.max()
+
+    def write(self, rows: list[RowVersion]) -> None:
+        self.client.tablet_rpc(
+            self.table_name, self.loc, "ts.write",
+            {"rows": wire.encode_rows(rows)})
+
+    def scan(self, spec: ScanSpec) -> ScanResult:
+        resp = self.client.tablet_rpc(
+            self.table_name, self.loc, "ts.scan",
+            {"spec": wire.encode_spec(spec)})
+        res = wire.decode_result(resp)
+        # Expose the server-chosen read time so paged scans pin one
+        # snapshot (processor._run_rows reads it off the result).
+        res.read_ht = resp.get("read_ht")
+        return res
+
+
+class RemoteTable:
+    def __init__(self, client: YBClient, name: str, schema: Schema):
+        self.client = client
+        self.name = name
+        self.schema = schema
+        self.partition_schema = PartitionSchema(
+            1, hash_partitioned=schema.num_hash > 0)  # routing via MetaCache
+
+    @property
+    def tablets(self) -> list[RemoteTablet]:
+        locs = self.client.meta_cache.locations(self.name)
+        return [RemoteTablet(self.client, self.name, loc)
+                for loc in locs.tablets]
+
+
+class ClientCluster:
+    """Cluster seam over YBClient (the distributed deployment)."""
+
+    def __init__(self, client: YBClient, num_tablets: int = 4,
+                 replication_factor: int = 3, engine: str = "cpu"):
+        self.client = client
+        self.num_tablets = num_tablets
+        self.replication_factor = replication_factor
+        self.engine = engine
+        # TTL expiry hybrid times are computed proxy-side from this clock
+        # (same shape as LocalCluster's shared clock).
+        self.clock = HybridClock()
+        self._tables: dict[str, RemoteTable] = {}
+
+    @property
+    def tables(self) -> dict:
+        """Existing table names (the processor's existence checks)."""
+        return {t["name"]: t for t in self.client.list_tables()}
+
+    def create_table(self, name: str, schema: Schema,
+                     num_tablets: int | None = None) -> RemoteTable:
+        try:
+            self.client.create_table(
+                name, list(schema.columns),
+                num_tablets=num_tablets or self.num_tablets,
+                replication_factor=self.replication_factor,
+                engine=self.engine)
+        except Exception as e:  # noqa: BLE001
+            if "already_present" in str(e):
+                raise AlreadyPresent(f"table {name} exists") from e
+            raise
+        t = RemoteTable(self.client, name, schema)
+        self._tables[name] = t
+        return t
+
+    def drop_table(self, name: str) -> None:
+        self._tables.pop(name, None)
+        try:
+            self.client.delete_table(name)
+        except Exception as e:  # noqa: BLE001
+            raise NotFound(f"table {name} not found") from e
+
+    def table(self, name: str) -> RemoteTable:
+        t = self._tables.get(name)
+        if t is None:
+            try:
+                yt = self.client.open_table(name)
+            except Exception as e:  # noqa: BLE001
+                raise NotFound(f"table {name} not found") from e
+            t = RemoteTable(self.client, name, yt.schema)
+            self._tables[name] = t
+        return t
+
+    def tablet_for_hash(self, handle: RemoteTable,
+                        hash_code: int) -> RemoteTablet:
+        loc = self.client.meta_cache.lookup_by_hash(handle.name, hash_code)
+        return RemoteTablet(self.client, handle.name, loc)
+
+    def close(self) -> None:
+        self._tables.clear()

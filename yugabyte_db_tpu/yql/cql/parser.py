@@ -59,6 +59,7 @@ class Parser:
     def __init__(self, sql: str):
         self.toks = tokenize(sql)
         self.i = 0
+        self.bind_count = 0  # ``?`` markers seen, in appearance order
 
     # -- token helpers -----------------------------------------------------
     def peek(self) -> Token | None:
@@ -116,6 +117,10 @@ class Parser:
 
     def literal(self):
         t = self.next()
+        if t.kind == "sym" and t.text == "?":
+            marker = ast.BindMarker(self.bind_count)
+            self.bind_count += 1
+            return marker
         if t.kind == "string":
             return t.text[1:-1].replace("''", "'")
         if t.kind == "blob":
@@ -281,7 +286,8 @@ class Parser:
         if self.take_kw("USING"):
             self.expect_kw("TTL")
             ttl = self.literal()
-            if not isinstance(ttl, int) or ttl < 0:
+            if not isinstance(ttl, ast.BindMarker) and (
+                    not isinstance(ttl, int) or ttl < 0):
                 raise InvalidArgument("TTL must be a non-negative integer")
             return ttl
         return None
@@ -299,7 +305,8 @@ class Parser:
         limit = None
         if self.take_kw("LIMIT"):
             limit = self.literal()
-            if not isinstance(limit, int) or limit < 0:
+            if not isinstance(limit, ast.BindMarker) and (
+                    not isinstance(limit, int) or limit < 0):
                 raise InvalidArgument("LIMIT must be a non-negative integer")
         allow = False
         if self.take_kw("ALLOW"):

@@ -43,6 +43,9 @@ class ResultSet:
 
     columns: list[str] = field(default_factory=list)
     rows: list[tuple] = field(default_factory=list)
+    # Continuation token when a page filled before the scan finished
+    # (reference: QLPagingStatePB riding the RESULT message).
+    paging_state: bytes | None = None
 
     def __iter__(self):
         return iter(self.rows)
@@ -136,8 +139,18 @@ class QLProcessor:
         self.keyspaces = {"default", "system"}
 
     # -- entry points ------------------------------------------------------
-    def execute(self, sql: str) -> ResultSet | None:
-        stmt = parse_statement(sql)
+    def execute(self, sql, params: list | None = None,
+                page_size: int | None = None,
+                paging_state: bytes | None = None) -> ResultSet | None:
+        """Run one statement. ``sql`` may be a string or a pre-parsed AST
+        (the prepared-statement cache passes ASTs). ``params`` binds ``?``
+        markers by position; ``page_size``/``paging_state`` drive SELECT
+        paging (reference: QLProcessor::RunAsync with a paged
+        StatementParameters, ql_processor.h:86)."""
+        stmt = parse_statement(sql) if isinstance(sql, str) else sql
+        self._params = params or []
+        self._page_size = page_size
+        self._paging_state = paging_state
         fn = {
             ast.CreateKeyspace: self._exec_create_keyspace,
             ast.DropKeyspace: self._exec_drop_keyspace,
@@ -224,6 +237,13 @@ class QLProcessor:
 
     # -- writes ------------------------------------------------------------
     def _coerce(self, col: ColumnSchema, value):
+        if isinstance(value, ast.BindMarker):
+            try:
+                value = self._params[value.index]
+            except IndexError:
+                raise InvalidArgument(
+                    f"bind marker ${value.index} has no value "
+                    f"({len(self._params)} params supplied)") from None
         if value is None:
             return None
         dt = col.dtype
@@ -248,6 +268,16 @@ class QLProcessor:
         return key, tablet
 
     def _expire_ht(self, ttl_seconds):
+        if isinstance(ttl_seconds, ast.BindMarker):
+            try:
+                ttl_seconds = self._params[ttl_seconds.index]
+            except IndexError:
+                raise InvalidArgument(
+                    f"bind marker ${ttl_seconds.index} has no value") \
+                    from None
+            if not isinstance(ttl_seconds, int) or \
+                    isinstance(ttl_seconds, bool) or ttl_seconds < 0:
+                raise InvalidArgument("TTL must be a non-negative integer")
         if ttl_seconds is None:
             return MAX_HT
         now = self.cluster.clock.now()
@@ -477,6 +507,8 @@ class QLProcessor:
         return handle.tablets
 
     def _run_rows(self, handle: TableHandle, stmt: ast.Select, plan):
+        from yugabyte_db_tpu.utils import codec
+
         schema = handle.schema
         projection = plan.projection or [c.name for c in schema.columns]
         if stmt.items:
@@ -484,19 +516,78 @@ class QLProcessor:
         else:
             names = list(projection)
         out = ResultSet(columns=names)
-        remaining = stmt.limit
-        for tablet in self._target_tablets(handle, plan):
-            spec = ScanSpec(lower=plan.lower, upper=plan.upper,
-                            read_ht=tablet.read_time().value,
-                            predicates=plan.predicates,
-                            projection=projection, limit=remaining)
-            res = tablet.scan(spec)
-            out.rows.extend(res.rows)
-            if remaining is not None:
-                remaining -= len(res.rows)
-                if remaining <= 0:
+        tablets = self._target_tablets(handle, plan)
+        # Paging token: (tablet index, resume key, LIMIT budget left,
+        # pinned read time) — the QLPagingStatePB shape
+        # (next_partition_key + next_row_key + remaining limit +
+        # read_time, so every page reads the same snapshot).
+        start_idx = 0
+        resume = plan.lower
+        limit = self._coerce_limit(stmt.limit)
+        read_ht = None
+        if self._paging_state:
+            start_idx, resume, limit, read_ht = codec.decode(
+                self._paging_state)
+        page_left = self._page_size
+        for idx in range(start_idx, len(tablets)):
+            tablet = tablets[idx]
+            lower = resume if idx == start_idx else plan.lower
+            while True:
+                sub_limit = self._min_opt(limit, page_left)
+                spec = ScanSpec(
+                    lower=lower, upper=plan.upper,
+                    read_ht=(read_ht if read_ht is not None
+                             else tablet.read_time().value),
+                    predicates=plan.predicates,
+                    projection=projection, limit=sub_limit)
+                res = tablet.scan(spec)
+                if read_ht is None:
+                    # Pin the first sub-scan's (server-chosen) read time
+                    # for the rest of the scan and for later pages.
+                    read_ht = getattr(res, "read_ht", None) or spec.read_ht
+                out.rows.extend(res.rows)
+                n = len(res.rows)
+                if limit is not None:
+                    limit -= n
+                    if limit <= 0:
+                        return out
+                if page_left is not None:
+                    page_left -= n
+                    if page_left <= 0:
+                        # Page full: remember where the scan resumes.
+                        if res.resume_key is not None:
+                            out.paging_state = codec.encode(
+                                [idx, res.resume_key, limit, read_ht])
+                        elif idx + 1 < len(tablets):
+                            out.paging_state = codec.encode(
+                                [idx + 1, plan.lower, limit, read_ht])
+                        return out
+                if res.resume_key is None:
                     break
+                lower = res.resume_key
         return out
+
+    def _coerce_limit(self, limit):
+        if isinstance(limit, ast.BindMarker):
+            try:
+                limit = self._params[limit.index]
+            except IndexError:
+                raise InvalidArgument(
+                    f"bind marker ${limit.index} has no value "
+                    f"({len(self._params)} params supplied)") from None
+            if not isinstance(limit, int) or isinstance(limit, bool) or \
+                    limit < 0:
+                raise InvalidArgument(
+                    "LIMIT must be a non-negative integer")
+        return limit
+
+    @staticmethod
+    def _min_opt(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return min(a, b)
 
     def _run_aggregate(self, handle: TableHandle, stmt: ast.Select, plan):
         """Fan the aggregate out per tablet, combine partials host-side

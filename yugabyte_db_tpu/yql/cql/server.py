@@ -1,0 +1,335 @@
+"""CQLServer: the Cassandra native-protocol proxy over the messenger.
+
+Reference analog: src/yb/yql/cql/cqlserver/ — CQLServer (cql_server.cc)
+riding the shared rpc::Messenger through a pluggable ConnectionContext
+(CQLConnectionContext, cql_rpc.cc), CQLServiceImpl + CQLProcessor
+dispatching requests (cql_service.cc, cql_processor.cc), and the
+prepared-statement cache (cql_statement.cc).
+
+The service executes statements through yql.cql.QLProcessor against any
+Cluster seam — the in-process LocalCluster or the distributed client
+adapter (client_cluster.ClientCluster), which is how the reference's CQL
+proxy speaks to tservers through its embedded YBClient.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.rpc.messenger import ConnectionContext, Messenger
+from yugabyte_db_tpu.utils.status import (AlreadyPresent, InvalidArgument,
+                                          NotFound)
+from yugabyte_db_tpu.yql.cql import ast
+from yugabyte_db_tpu.yql.cql import wire_protocol as W
+from yugabyte_db_tpu.yql.cql.parser import Parser
+from yugabyte_db_tpu.yql.cql.processor import QLProcessor, ResultSet
+
+
+class CQLConnectionContext(ConnectionContext):
+    """Parses CQL frames off the socket. Calls are handed to the service
+    as (stream, "cql", (opcode, body)); responses are raw frame bytes."""
+
+    ordered_responses = True  # one CQL statement at a time per connection
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> list:
+        self._buf.extend(data)
+        calls = []
+        while True:
+            if len(self._buf) < W.HEADER.size:
+                return calls
+            version, flags, stream, opcode, length = W.HEADER.unpack_from(
+                self._buf, 0)
+            if length < 0 or length > 64 * 1024 * 1024:
+                raise ValueError(f"CQL frame too large: {length}")
+            end = W.HEADER.size + length
+            if len(self._buf) < end:
+                return calls
+            body = bytes(self._buf[W.HEADER.size:end])
+            del self._buf[:end]
+            calls.append((stream, "cql", (opcode, body)))
+
+    def serialize(self, response) -> bytes:
+        stream, status, body = response
+        if status == "ok":
+            return body
+        return W.error_frame(stream, W.ERR_SERVER, str(body))
+
+
+class PreparedStatement:
+    __slots__ = ("stmt_id", "query", "stmt", "bind_cols", "table",
+                 "keyspace")
+
+    def __init__(self, stmt_id, query, stmt, bind_cols, keyspace, table):
+        self.stmt_id = stmt_id
+        self.query = query
+        self.stmt = stmt
+        self.bind_cols = bind_cols
+        self.keyspace = keyspace
+        self.table = table
+
+
+class CQLServiceImpl:
+    """Executes CQL frames. One instance per server; the prepared cache
+    is shared across connections keyed by statement id (md5 of the query,
+    like cql_statement.cc). Each CONNECTION owns its QLProcessor —
+    keyspace state and in-flight bind params are per-session, and the
+    messenger runs one statement at a time per connection
+    (ordered_responses), so processor state never races across workers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prepared: dict[bytes, PreparedStatement] = {}
+
+    # -- frame dispatch ------------------------------------------------------
+    def handle_call(self, processor: QLProcessor, stream: int, opcode: int,
+                    body: bytes) -> bytes:
+        try:
+            if opcode == W.OP_STARTUP:
+                return W.frame(W.OP_READY, stream, b"")
+            if opcode == W.OP_OPTIONS:
+                w = W.Writer()
+                w.short(2)
+                w.string("CQL_VERSION").string_list(["3.4.4"])
+                w.string("COMPRESSION").string_list([])
+                return W.frame(W.OP_SUPPORTED, stream, w.getvalue())
+            if opcode == W.OP_REGISTER:
+                return W.frame(W.OP_READY, stream, b"")
+            if opcode == W.OP_QUERY:
+                return self._query(processor, stream, body)
+            if opcode == W.OP_PREPARE:
+                return self._prepare(processor, stream, body)
+            if opcode == W.OP_EXECUTE:
+                return self._execute(processor, stream, body)
+            return W.error_frame(stream, W.ERR_PROTOCOL,
+                                 f"unsupported opcode {opcode:#x}")
+        except InvalidArgument as e:
+            return W.error_frame(stream, W.ERR_INVALID, str(e))
+        except AlreadyPresent as e:
+            return W.error_frame(stream, W.ERR_ALREADY_EXISTS, str(e))
+        except NotFound as e:
+            return W.error_frame(stream, W.ERR_INVALID, str(e))
+        except Exception as e:  # noqa: BLE001 — surface as server error
+            return W.error_frame(stream, W.ERR_SERVER,
+                                 f"{type(e).__name__}: {e}")
+
+    # -- QUERY ---------------------------------------------------------------
+    def _read_query_params(self, r: W.Reader, bind_cols=None):
+        """consistency + flags + optional values/page_size/paging_state."""
+        r.short()  # consistency (ignored: the cluster owns consistency)
+        flags = r.byte()
+        params = []
+        if flags & 0x01:  # values
+            n = r.short()
+            for i in range(n):
+                raw = r.bytes_()
+                dt = (bind_cols[i][1] if bind_cols and i < len(bind_cols)
+                      else DataType.BINARY)
+                params.append(W.decode_value(dt, raw))
+        page_size = r.int32() if flags & 0x04 else None
+        paging_state = r.bytes_() if flags & 0x08 else None
+        return params, page_size, paging_state
+
+    def _query(self, processor, stream: int, body: bytes) -> bytes:
+        r = W.Reader(body)
+        query = r.long_string()
+        stmt = parse_with_markers(query)[0]
+        bind_cols = self._bind_columns(processor, stmt)
+        params, page_size, paging_state = self._read_query_params(
+            r, bind_cols)
+        return self._run(processor, stream, stmt, params, page_size,
+                         paging_state)
+
+    # -- PREPARE / EXECUTE ---------------------------------------------------
+    def _prepare(self, processor, stream: int, body: bytes) -> bytes:
+        query = W.Reader(body).long_string()
+        stmt, _n = parse_with_markers(query)
+        bind_cols = self._bind_columns(processor, stmt)
+        stmt_id = hashlib.md5(query.encode()).digest()[:16]
+        ks, table = self._stmt_target(stmt)
+        with self._lock:
+            self._prepared[stmt_id] = PreparedStatement(
+                stmt_id, query, stmt, bind_cols, ks, table)
+        return W.prepared_result(stream, stmt_id, ks, table, bind_cols)
+
+    def _execute(self, processor, stream: int, body: bytes) -> bytes:
+        r = W.Reader(body)
+        stmt_id = r.short_bytes()
+        with self._lock:
+            ps = self._prepared.get(stmt_id)
+        if ps is None:
+            return W.error_frame(stream, W.ERR_UNPREPARED,
+                                 "unknown prepared statement")
+        params, page_size, paging_state = self._read_query_params(
+            r, ps.bind_cols)
+        return self._run(processor, stream, ps.stmt, params, page_size,
+                         paging_state)
+
+    # -- execution -----------------------------------------------------------
+    def _run(self, processor, stream: int, stmt, params, page_size,
+             paging_state) -> bytes:
+        res = processor.execute(stmt, params=params,
+                                page_size=page_size,
+                                paging_state=paging_state)
+        if isinstance(stmt, ast.UseKeyspace):
+            return W.set_keyspace_result(stream, stmt.name)
+        if isinstance(stmt, (ast.CreateKeyspace, ast.DropKeyspace)):
+            change = ("CREATED" if isinstance(stmt, ast.CreateKeyspace)
+                      else "DROPPED")
+            return W.schema_change_result(stream, change, "KEYSPACE",
+                                          stmt.name)
+        if isinstance(stmt, ast.CreateTable):
+            return W.schema_change_result(stream, "CREATED", "TABLE",
+                                          processor.keyspace, stmt.name)
+        if isinstance(stmt, ast.DropTable):
+            return W.schema_change_result(stream, "DROPPED", "TABLE",
+                                          processor.keyspace, stmt.name)
+        if res is None:
+            return W.void_result(stream)
+        return self._rows(processor, stream, stmt, res)
+
+    def _rows(self, processor, stream: int, stmt, res: ResultSet) -> bytes:
+        table = getattr(stmt, "table", "") or ""
+        dts = self._result_types(processor, stmt, res)
+        return W.rows_result(
+            stream, processor.keyspace, table.split(".")[-1],
+            list(zip(res.columns, dts)), res.rows,
+            paging_state=res.paging_state)
+
+    def _result_types(self, processor, stmt,
+                      res: ResultSet) -> list[DataType]:
+        table = getattr(stmt, "table", None)
+        schema = None
+        if table:
+            try:
+                handle = processor.cluster.table(processor._qualify(table))
+                schema = handle.schema
+            except Exception:  # noqa: BLE001
+                schema = None
+        out = []
+        items = getattr(stmt, "items", None) or []
+        for i, name in enumerate(res.columns):
+            dt = None
+            col = items[i].column if i < len(items) and \
+                hasattr(items[i], "column") else name
+            agg = items[i].agg_fn if i < len(items) and \
+                hasattr(items[i], "agg_fn") else None
+            if agg == "count":
+                dt = DataType.INT64
+            elif agg == "avg":
+                dt = DataType.DOUBLE
+            elif schema is not None and col and schema.has_column(col):
+                dt = schema.column(col).dtype
+                if agg == "sum":
+                    # Sums widen: narrow ints overflow their own width.
+                    dt = (DataType.DOUBLE
+                          if dt in (DataType.FLOAT, DataType.DOUBLE)
+                          else DataType.INT64)
+            if dt is None and schema is not None and \
+                    schema.has_column(name):
+                dt = schema.column(name).dtype
+            if dt is None:
+                # Unresolvable columns degrade to text.
+                dt = DataType.STRING
+            out.append(dt)
+        return out
+
+    # -- bind metadata -------------------------------------------------------
+    def _bind_columns(self, processor,
+                      stmt) -> list[tuple[str, DataType]]:
+        """(name, type) per ``?`` marker, in marker order, resolved from
+        the statement's target table schema."""
+        markers: dict[int, tuple[str, DataType]] = {}
+        table = getattr(stmt, "table", None)
+        schema = None
+        if table:
+            try:
+                handle = processor.cluster.table(processor._qualify(table))
+                schema = handle.schema
+            except Exception:  # noqa: BLE001
+                schema = None
+
+        def col_dt(col_name):
+            if schema is not None and schema.has_column(col_name):
+                return schema.column(col_name).dtype
+            return DataType.BINARY
+
+        def note(value, col_name):
+            if isinstance(value, ast.BindMarker):
+                markers[value.index] = (col_name, col_dt(col_name))
+            elif isinstance(value, (list, tuple)):
+                for v in value:
+                    note(v, col_name)
+
+        if isinstance(stmt, ast.Insert):
+            for cname, v in zip(stmt.columns, stmt.values):
+                note(v, cname)
+        if isinstance(stmt, ast.Update):
+            for cname, v in stmt.assignments:
+                note(v, cname)
+        for rel in getattr(stmt, "where", None) or []:
+            note(rel.value, rel.column)
+        lim = getattr(stmt, "limit", None)
+        if isinstance(lim, ast.BindMarker):
+            markers[lim.index] = ("[limit]", DataType.INT32)
+        return [markers.get(i, (f"p{i}", DataType.BINARY))
+                for i in range(len(markers))]
+
+    @staticmethod
+    def _stmt_target(stmt) -> tuple[str, str]:
+        table = getattr(stmt, "table", "") or ""
+        if "." in table:
+            ks, t = table.split(".", 1)
+            return ks, t
+        return "default", table
+
+
+def parse_with_markers(query: str):
+    """Parse one statement, returning (ast, number of ? markers)."""
+    p = Parser(query)
+    stmt = p.parse()
+    return stmt, p.bind_count
+
+
+class CQLServer:
+    """Standalone CQL wire server: owns a messenger listener and a
+    service over a Cluster seam. Each accepted connection gets its own
+    QLProcessor (session keyspace + bind state), sharing the cluster and
+    the prepared-statement cache."""
+
+    def __init__(self, cluster, messenger: Messenger | None = None):
+        self.cluster = cluster
+        self.service = CQLServiceImpl()
+        self._own_messenger = messenger is None
+        self.messenger = messenger or Messenger(name="cql")
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0):
+        # The messenger hands the handler (method, body) with the call id
+        # (== CQL stream id) kept aside for response pairing; the stream
+        # and the connection's processor also matter INSIDE the handler,
+        # so the context tags both onto the body tuple.
+        cluster = self.cluster
+
+        def handler(_method, payload):
+            processor, stream, opcode, body = payload
+            return self.service.handle_call(processor, stream, opcode, body)
+
+        class _Ctx(CQLConnectionContext):
+            def __init__(self):
+                super().__init__()
+                self.processor = QLProcessor(cluster)
+
+            def feed(self, data):
+                return [(stream, "cql", (self.processor, stream, op, body))
+                        for stream, _m, (op, body) in super().feed(data)]
+
+        return self.messenger.listen(host, port, handler,
+                                     context_factory=_Ctx)
+
+    def shutdown(self) -> None:
+        if self._own_messenger:
+            self.messenger.shutdown()
