@@ -101,6 +101,15 @@ class YBClient:
             raise RuntimeError(f"create_table {name}: {resp}")
         return self.open_table(name)
 
+    def create_index(self, table: str, column: str,
+                     index_name: str | None = None) -> str:
+        """Create a secondary index; returns the index table's name."""
+        resp = self.master_rpc("master.create_index", {
+            "table": table, "column": column, "index_name": index_name})
+        if resp.get("code") not in ("ok", "already_present"):
+            raise RuntimeError(f"create_index on {table}.{column}: {resp}")
+        return resp["index_table"]
+
     def delete_table(self, name: str) -> None:
         resp = self.master_rpc("master.delete_table", {"name": name})
         if resp.get("code") not in ("ok", "not_found"):

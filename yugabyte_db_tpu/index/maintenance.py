@@ -1,0 +1,99 @@
+"""Index schema derivation and write-path mutation computation.
+
+An index on column C of base table T is itself a table:
+
+    hash key:   C (the indexed column)
+    range keys: T's primary key columns, in order
+    values:     none (rows are liveness markers)
+
+so an equality lookup on C is a hash-routed scan of the index table whose
+rows decode straight back into base-table primary keys (reference:
+IndexInfo's mapping of indexed + covered columns, src/yb/common/index.h).
+
+Maintenance (Tablet::UpdateQLIndexes, tablet.cc:1015): on a base-table
+write the leader compares old vs new indexed values; a changed value
+yields a tombstone for the old index row and an insert of the new one.
+NULL values have no index entry (CQL semantics).
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage.row_version import RowVersion
+
+
+def index_table_name(base_table: str, column: str,
+                     index_name: str | None = None) -> str:
+    if index_name:
+        if "." in base_table and "." not in index_name:
+            ks = base_table.rsplit(".", 1)[0]
+            return f"{ks}.{index_name}"
+        return index_name
+    return f"{base_table}__idx__{column}"
+
+
+def index_schema(base_schema: Schema, column: str,
+                 index_table: str) -> Schema:
+    """Derive the index table's schema from the base schema."""
+    idx_col = base_schema.column(column)
+    if idx_col.is_key:
+        raise ValueError(f"cannot index key column {column}")
+    cols = [ColumnSchema(column, idx_col.dtype, ColumnKind.HASH)]
+    for kc in base_schema.key_columns:
+        cols.append(ColumnSchema(kc.name, kc.dtype, ColumnKind.RANGE))
+    return Schema(cols, table_id=index_table)
+
+
+def index_entry(index_schema_: Schema, indexed_value,
+                base_key_values: dict) -> tuple[int, RowVersion]:
+    """A liveness index row for (value, base PK) — backfill's unit."""
+    return _entry(index_schema_, indexed_value, base_key_values,
+                  tombstone=False)
+
+
+def _entry(index_schema_: Schema, indexed_value, base_key_values: dict,
+           tombstone: bool) -> tuple[int, RowVersion]:
+    """One index-table row: returns (hash_code, RowVersion)."""
+    idx_name = index_schema_.hash_columns[0].name
+    kv = {idx_name: indexed_value}
+    kv.update(base_key_values)
+    hash_code = compute_hash_code(index_schema_, kv)
+    key = index_schema_.encode_primary_key(kv, hash_code)
+    if tombstone:
+        return hash_code, RowVersion(key, ht=0, tombstone=True)
+    return hash_code, RowVersion(key, ht=0, liveness=True, columns={})
+
+
+def index_mutations(base_schema: Schema, indexes: list[dict],
+                    base_key_values: dict, old_values: dict | None,
+                    new_row: RowVersion):
+    """Index-table writes for one base-table write.
+
+    ``indexes``: [{"column", "index_table"}...]; ``old_values``: the
+    row's current merged column values by NAME (None if the row didn't
+    exist); ``new_row``: the incoming base write. Yields
+    (index_table, index_schema, hash_code, RowVersion)."""
+    col_by_id = {c.col_id: c.name for c in base_schema.value_columns}
+    for idx in indexes:
+        column = idx["column"]
+        ischema = index_schema(base_schema, column, idx["index_table"])
+        old_v = (old_values or {}).get(column)
+        if new_row.tombstone:
+            new_v = None          # whole-row delete: drop the entry
+        else:
+            cid = base_schema.column(column).col_id
+            if cid in new_row.columns:
+                new_v = new_row.columns[cid]
+            else:
+                new_v = old_v     # write doesn't touch the indexed column
+        if old_v == new_v:
+            continue
+        if old_v is not None:
+            hc, rv = _entry(ischema, old_v, base_key_values, tombstone=True)
+            yield idx["index_table"], ischema, hc, rv
+        if new_v is not None:
+            hc, rv = _entry(ischema, new_v, base_key_values,
+                            tombstone=False)
+            yield idx["index_table"], ischema, hc, rv
+    _ = col_by_id  # (kept for future covered-column support)

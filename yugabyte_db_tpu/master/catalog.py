@@ -30,6 +30,9 @@ class TableInfo:
     tablet_ids: list[str] = field(default_factory=list)
     state: str = "RUNNING"
     engine: str = "cpu"
+    # Secondary indexes ON this table: [{"name", "column", "index_table"}]
+    # (reference: IndexInfo entries in SysTablesEntryPB, common/index.h).
+    indexes: list[dict] = field(default_factory=list)
 
 
 class CatalogState:
@@ -66,6 +69,17 @@ class CatalogState:
                 info = self.tablets.get(op["tablet_id"])
                 if info is not None:
                     info.replicas = list(op["replicas"])
+            elif kind == "create_index":
+                t = self.tables.get(op["table_id"])
+                if t is not None and not any(
+                        i["name"] == op["index"]["name"]
+                        for i in t.indexes):
+                    t.indexes.append(dict(op["index"]))
+            elif kind == "drop_index":
+                t = self.tables.get(op["table_id"])
+                if t is not None:
+                    t.indexes = [i for i in t.indexes
+                                 if i["name"] != op["name"]]
             else:
                 raise ValueError(f"unknown catalog op {kind!r}")
 

@@ -151,13 +151,14 @@ class Master:
     @staticmethod
     def _create_tablet_req(tablet_id: str, table_name: str, schema,
                            partition_start, partition_end, engine: str,
-                           peers: list[str]) -> dict:
+                           peers: list[str],
+                           indexes: list | None = None) -> dict:
         """The one canonical ts.create_tablet payload (built in three
         places: initial dispatch, dead-TS re-replication, create retry)."""
         return {"tablet_id": tablet_id, "table_name": table_name,
                 "schema": schema, "partition_start": partition_start,
                 "partition_end": partition_end, "engine": engine,
-                "peers": peers}
+                "peers": peers, "indexes": list(indexes or [])}
 
     def _dispatch_tablet_creates(self, op: dict) -> list[str]:
         errors = []
@@ -174,6 +175,88 @@ class Master:
                     self._failed_creates.add((td["tablet_id"], replica))
                     errors.append(f"{td['tablet_id']}@{replica}: {e}")
         return errors
+
+    def _h_master_create_index(self, p: dict):
+        """Create a secondary index: an index TABLE (hash = the indexed
+        column, range = the base PK) plus an IndexInfo record on the base
+        table; base-tablet leaders learn the index set via ts.set_indexes
+        and maintain it in their write path (reference:
+        CatalogManager::CreateTable's index branch + Tablet::UpdateQLIndexes)."""
+        if not self.raft.is_leader():
+            return self._not_leader()
+        from yugabyte_db_tpu.index import index_schema, index_table_name
+
+        base = self.catalog.table_by_name(p["table"])
+        if base is None:
+            return {"code": "not_found"}
+        column = p["column"]
+        name = p.get("index_name") or f"{p['table']}_{column}_idx"
+        if any(i["name"] == name for i in base.indexes):
+            return {"code": "already_present", "index_table":
+                    next(i["index_table"] for i in base.indexes
+                         if i["name"] == name)}
+        base_schema = Schema.from_dict(base.schema)
+        itable = index_table_name(p["table"], column, p.get("index_name"))
+        try:
+            ischema = index_schema(base_schema, column, itable)
+        except (ValueError, KeyError) as e:
+            return {"code": "error", "message": str(e)}
+        # Inherit the base table's replication factor (its tablets'
+        # replica count) unless the caller overrides it.
+        base_tablets = self.catalog.tablets_of(base.table_id)
+        base_rf = (len(base_tablets[0].replicas) if base_tablets else 3)
+        create = self._h_master_create_table({
+            "name": itable, "schema": ischema.to_dict(),
+            "num_tablets": p.get("num_tablets", base.num_tablets),
+            "replication_factor": p.get("replication_factor", base_rf),
+            "engine": base.engine,
+        })
+        if create["code"] not in ("ok", "partial", "already_present"):
+            return create
+        op = {"op": "create_index", "table_id": base.table_id,
+              "index": {"name": name, "column": column,
+                        "index_table": itable}}
+        try:
+            self.raft.replicate("catalog", op)
+        except NotLeader:
+            return self._not_leader()
+        self._push_index_sets(base.table_id)
+        return {"code": "ok", "index_table": itable}
+
+    def _push_index_sets(self, table_id: str) -> None:
+        """Tell every replica of the base table its current index set."""
+        t = self.catalog.tables.get(table_id)
+        if t is None:
+            return
+        for info in self.catalog.tablets_of(table_id):
+            for replica in info.replicas:
+                try:
+                    self.transport.send(replica, "ts.set_indexes", {
+                        "tablet_id": info.tablet_id,
+                        "indexes": list(t.indexes),
+                    }, timeout=5.0)
+                except Exception:  # noqa: BLE001 — replicas recover the
+                    pass           # set from ts.create_tablet on restart
+
+    def _h_master_drop_index(self, p: dict):
+        if not self.raft.is_leader():
+            return self._not_leader()
+        base = self.catalog.table_by_name(p["table"])
+        if base is None:
+            return {"code": "not_found"}
+        idx = next((i for i in base.indexes if i["name"] == p["name"]),
+                   None)
+        if idx is None:
+            return {"code": "not_found"}
+        try:
+            self.raft.replicate("catalog", {
+                "op": "drop_index", "table_id": base.table_id,
+                "name": p["name"]})
+        except NotLeader:
+            return self._not_leader()
+        self._push_index_sets(base.table_id)
+        self._h_master_delete_table({"name": idx["index_table"]})
+        return {"code": "ok"}
 
     def _h_master_delete_table(self, p: dict):
         if not self.raft.is_leader():
@@ -204,7 +287,7 @@ class Master:
             return {"code": "not_found"}
         return {"code": "ok", "table_id": t.table_id, "name": t.name,
                 "schema": t.schema, "num_tablets": t.num_tablets,
-                "engine": t.engine}
+                "engine": t.engine, "indexes": list(t.indexes)}
 
     def _h_master_get_table_locations(self, p: dict):
         t = self.catalog.table_by_name(p["name"])
@@ -280,6 +363,21 @@ class Master:
                 info = self.catalog.tablets.get(tid)
                 if info is not None and p["ts_uuid"] not in info.replicas:
                     to_delete.append(tid)
+                # Index-set reconciliation: a lost ts.set_indexes push
+                # must not leave a replica maintaining a stale index set.
+                if info is not None and "index_names" in t:
+                    table = self.catalog.tables.get(info.table_id)
+                    if table is not None:
+                        want = sorted(i["name"] for i in table.indexes)
+                        if want != t["index_names"]:
+                            try:
+                                self.transport.send(
+                                    p["ts_uuid"], "ts.set_indexes", {
+                                        "tablet_id": tid,
+                                        "indexes": list(table.indexes),
+                                    }, timeout=2.0)
+                            except Exception:  # noqa: BLE001 — next beat
+                                pass
             resp["tablets_to_delete"] = sorted(to_delete)
         return resp
 
@@ -344,7 +442,8 @@ class Master:
                                  self._create_tablet_req(
                                      info.tablet_id, t.name, t.schema,
                                      info.partition_start, info.partition_end,
-                                     t.engine, without_dead), timeout=5.0)
+                                     t.engine, without_dead,
+                                     indexes=t.indexes), timeout=5.0)
                     self._rpc_ok(leader, "ts.change_config", {
                         "tablet_id": info.tablet_id,
                         "peers": with_new,
@@ -429,7 +528,8 @@ class Master:
                                          info.tablet_id, t.name, t.schema,
                                          info.partition_start,
                                          info.partition_end, t.engine,
-                                         others), timeout=5.0)
+                                         others, indexes=t.indexes),
+                                     timeout=5.0)
                         self._rpc_ok(leader, "ts.change_config", {
                             "tablet_id": info.tablet_id,
                             "peers": info.replicas,
@@ -474,7 +574,8 @@ class Master:
                                         tablet_id, t.name, t.schema,
                                         info.partition_start,
                                         info.partition_end, t.engine,
-                                        info.replicas), timeout=5.0)
+                                        info.replicas, indexes=t.indexes),
+                                    timeout=5.0)
                 self._failed_creates.discard((tablet_id, replica))
             except Exception:  # noqa: BLE001 — next tick retries
                 pass

@@ -41,6 +41,14 @@ class TabletMetadata:
     partition_end: int
     engine: str = "cpu"              # tablet_storage_engine option
     flushed_op_index: int = 0        # WAL replay frontier
+    # Secondary indexes the leader maintains on writes:
+    # [{"name", "column", "index_table"}] (reference: the IndexMap the
+    # tablet consults in UpdateQLIndexes, tablet.cc:1015).
+    indexes: list = None
+
+    def __post_init__(self):
+        if self.indexes is None:
+            self.indexes = []
 
     def save(self, path: str) -> None:
         tmp = path + ".tmp"
@@ -53,6 +61,7 @@ class TabletMetadata:
                 "partition_end": self.partition_end,
                 "engine": self.engine,
                 "flushed_op_index": self.flushed_op_index,
+                "indexes": self.indexes,
             }, f)
             f.flush()
             os.fsync(f.fileno())
@@ -65,7 +74,7 @@ class TabletMetadata:
         return TabletMetadata(
             d["tablet_id"], d["table_name"], Schema.from_dict(d["schema"]),
             d["partition_start"], d["partition_end"], d["engine"],
-            d["flushed_op_index"],
+            d["flushed_op_index"], d.get("indexes") or [],
         )
 
 
@@ -224,6 +233,18 @@ class Tablet:
             self.meta.save(self.meta_path)
             self.log.sync()
             self.log.gc(self.meta.flushed_op_index + 1)
+
+    def current_row_values(self, key: bytes) -> dict | None:
+        """Merged value-column values of one row by name (None if the row
+        doesn't exist) — the old-state read of index maintenance."""
+        names = [c.name for c in self.meta.schema.value_columns]
+        spec = ScanSpec(lower=key, upper=key + b"\x00",
+                        read_ht=self.read_time().value,
+                        projection=names, limit=1)
+        res = self.engine.scan(spec)
+        if not res.rows:
+            return None
+        return dict(zip(names, res.rows[0]))
 
     # -- transaction support -------------------------------------------------
     def latest_committed_ht(self, key: bytes) -> int:

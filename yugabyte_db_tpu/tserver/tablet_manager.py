@@ -123,5 +123,10 @@ class TSTabletManager:
                 "term": rs["term"],
                 "leader": rs["leader"],
                 "peers": rs["config"]["peers"],
+                # index names this replica maintains: the master compares
+                # against the catalog and re-pushes ts.set_indexes on
+                # mismatch (a lost push must not disable maintenance).
+                "index_names": sorted(i["name"]
+                                      for i in p.tablet.meta.indexes),
             })
         return out

@@ -82,7 +82,7 @@ class TabletServer:
         meta = TabletMetadata(
             p["tablet_id"], p["table_name"], Schema.from_dict(p["schema"]),
             p["partition_start"], p["partition_end"],
-            p.get("engine", "cpu"))
+            p.get("engine", "cpu"), indexes=p.get("indexes") or [])
         try:
             self.tablet_manager.create_tablet(meta, p["peers"])
         except Exception as e:  # includes TabletAlreadyExists (idempotent)
@@ -94,6 +94,81 @@ class TabletServer:
     def _h_ts_delete_tablet(self, p: dict):
         self.tablet_manager.delete_tablet(p["tablet_id"])
         return {"code": "ok"}
+
+    def _h_ts_set_indexes(self, p: dict):
+        """Install the base table's current index set on one tablet (the
+        master pushes this after CREATE INDEX)."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        peer.tablet.meta.indexes = list(p["indexes"])
+        peer.tablet.meta.save(peer.tablet.meta_path)
+        return {"code": "ok"}
+
+    def _maintain_indexes(self, peer, rows) -> dict | None:
+        """Leader-side secondary-index maintenance for a base write
+        (reference: Tablet::UpdateQLIndexes, tablet.cc:1015). Index
+        entries are written FIRST: on a mid-flight failure the index may
+        temporarily hold extra entries (lookups verify against the base
+        row) but never misses one. Returns an error dict or None."""
+        from yugabyte_db_tpu.index import index_mutations
+        from yugabyte_db_tpu.models.encoding import decode_doc_key
+
+        schema = peer.tablet.meta.schema
+        key_names = [c.name for c in schema.key_columns]
+        for row in rows:
+            _, hashed, ranges = decode_doc_key(row.key)
+            base_kv = dict(zip(key_names, hashed + ranges))
+            old = peer.tablet.current_row_values(row.key)
+            for itable, _ischema, hc, rv in index_mutations(
+                    schema, peer.tablet.meta.indexes, base_kv, old, row):
+                loc = self._locate_by_hash(itable, hc)
+                if loc is None:
+                    return {"code": "error",
+                            "message": f"cannot locate index {itable}"}
+                resp = self.txn_router.tablet_rpc(
+                    loc["tablet_id"], "ts.write",
+                    {"rows": wire.encode_rows([rv])},
+                    hint=loc.get("leader"))
+                if resp is None or resp.get("code") != "ok":
+                    return {"code": "error",
+                            "message": f"index write failed: {resp}"}
+        return None
+
+    def _locate_by_hash(self, table_name: str, hash_code: int) -> dict | None:
+        """Tablet of ``table_name`` owning ``hash_code`` (master lookup,
+        briefly cached)."""
+        import time as _time
+
+        cached = getattr(self, "_tbl_loc_cache", None)
+        if cached is None:
+            cached = self._tbl_loc_cache = {}
+        ent = cached.get(table_name)
+        if ent is None or _time.monotonic() - ent[1] > 5.0:
+            resp = None
+            targets = list(self.heartbeater.master_uuids)
+            for target in targets:
+                try:
+                    resp = self.transport.send(
+                        target, "master.get_table_locations",
+                        {"name": table_name}, timeout=2.0)
+                except Exception:  # noqa: BLE001
+                    continue
+                if resp.get("code") == "not_leader":
+                    hint = resp.get("leader_hint")
+                    if hint and hint not in targets:
+                        targets.append(hint)
+                    continue
+                break
+            if resp is None or resp.get("code") != "ok":
+                return None
+            ent = (resp["tablets"], _time.monotonic())
+            cached[table_name] = ent
+        for t in ent[0]:
+            if t["partition_start"] <= hash_code < t["partition_end"]:
+                return t
+        return ent[0][-1] if ent[0] else None
 
     def _h_ts_write(self, p: dict):
         try:
@@ -108,6 +183,10 @@ class TabletServer:
         # write happen under the intent-admission lock, so an intent write
         # cannot slip between them (and vice versa: an admitted intent's
         # conflict check sees this write applied).
+        if peer.tablet.meta.indexes and peer.raft.is_leader():
+            err = self._maintain_indexes(peer, rows)
+            if err is not None:
+                return err
         keys = [r.key for r in rows]
         for _attempt in range(3):
             with peer._intent_lock:

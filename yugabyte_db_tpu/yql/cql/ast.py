@@ -63,6 +63,20 @@ class DropTable:
 
 
 @dataclass
+class CreateIndex:
+    name: str                      # index name
+    table: str                     # base table (possibly qualified)
+    column: str                    # single indexed column
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropIndex:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class Relation:
     """column <op> literal (op: = != < <= > >= IN)."""
 

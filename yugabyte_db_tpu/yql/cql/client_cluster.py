@@ -51,10 +51,12 @@ class RemoteTablet:
 
 
 class RemoteTable:
-    def __init__(self, client: YBClient, name: str, schema: Schema):
+    def __init__(self, client: YBClient, name: str, schema: Schema,
+                 indexes: list | None = None):
         self.client = client
         self.name = name
         self.schema = schema
+        self.indexes = list(indexes or [])
         self.partition_schema = PartitionSchema(
             1, hash_partitioned=schema.num_hash > 0)  # routing via MetaCache
 
@@ -102,21 +104,46 @@ class ClientCluster:
 
     def drop_table(self, name: str) -> None:
         self._tables.pop(name, None)
-        try:
-            self.client.delete_table(name)
-        except Exception as e:  # noqa: BLE001
-            raise NotFound(f"table {name} not found") from e
+        resp = self.client.master_rpc("master.delete_table",
+                                      {"name": name})
+        if resp.get("code") == "not_found":
+            raise NotFound(f"table {name} not found")
+        if resp.get("code") != "ok":
+            raise RuntimeError(f"drop_table {name}: {resp}")
+        self.client.meta_cache.invalidate(name)
 
     def table(self, name: str) -> RemoteTable:
         t = self._tables.get(name)
         if t is None:
-            try:
-                yt = self.client.open_table(name)
-            except Exception as e:  # noqa: BLE001
-                raise NotFound(f"table {name} not found") from e
-            t = RemoteTable(self.client, name, yt.schema)
+            resp = self.client.master_rpc("master.get_table",
+                                          {"name": name})
+            if resp.get("code") != "ok":
+                raise NotFound(f"table {name} not found")
+            t = RemoteTable(self.client, name,
+                            Schema.from_dict(resp["schema"]),
+                            resp.get("indexes"))
             self._tables[name] = t
         return t
+
+    def create_index(self, base: RemoteTable, name: str,
+                     column: str) -> str:
+        itable = self.client.create_index(base.name, column, name)
+        base.indexes.append({"name": name, "column": column,
+                             "index_table": itable})
+        return itable
+
+    def drop_index(self, base: RemoteTable, name: str) -> None:
+        idx = next(i for i in base.indexes if i["name"] == name)
+        resp = self.client.master_rpc("master.drop_index", {
+            "table": base.name, "name": name})
+        if resp.get("code") != "ok":
+            raise NotFound(f"index {name}: {resp}")
+        base.indexes.remove(idx)
+
+    # On the distributed path the base tablet's LEADER maintains indexes
+    # in its write handler (tablet_server._maintain_indexes) — the
+    # reference's placement — so the processor-side hook is absent.
+    maintain_indexes = None
 
     def tablet_for_hash(self, handle: RemoteTable,
                         hash_code: int) -> RemoteTablet:

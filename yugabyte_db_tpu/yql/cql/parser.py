@@ -182,6 +182,15 @@ class Parser:
             name = self.ident()
             self._skip_with()
             return ast.CreateKeyspace(name, ine)
+        if self.take_kw("INDEX"):
+            ine = self._if_not_exists()
+            iname = self.ident()
+            self.expect_kw("ON")
+            table = self.qualified_name()
+            self.expect_sym("(")
+            column = self.ident()
+            self.expect_sym(")")
+            return ast.CreateIndex(iname, table, column, ine)
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         name = self.qualified_name()
@@ -253,6 +262,9 @@ class Parser:
         if self.take_kw("KEYSPACE", "SCHEMA"):
             ie = self._if_exists()
             return ast.DropKeyspace(self.ident(), ie)
+        if self.take_kw("INDEX"):
+            ie = self._if_exists()
+            return ast.DropIndex(self.ident(), ie)
         self.expect_kw("TABLE")
         ie = self._if_exists()
         return ast.DropTable(self.qualified_name(), ie)

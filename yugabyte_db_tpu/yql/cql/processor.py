@@ -62,6 +62,7 @@ class TableHandle:
     schema: Schema
     partition_schema: PartitionSchema
     tablets: list[Tablet]
+    indexes: list = field(default_factory=list)  # [{"name","column","index_table"}]
 
 
 class LocalCluster:
@@ -119,6 +120,34 @@ class LocalCluster:
         idx = handle.partition_schema.partition_index_for_hash(hash_code)
         return handle.tablets[idx]
 
+    def create_index(self, base: TableHandle, name: str,
+                     column: str) -> str:
+        from yugabyte_db_tpu.index import index_schema, index_table_name
+
+        itable = index_table_name(base.name, column, name)
+        ischema = index_schema(base.schema, column, itable)
+        self.create_table(itable, ischema, num_tablets=len(base.tablets))
+        base.indexes.append({"name": name, "column": column,
+                             "index_table": itable})
+        return itable
+
+    def drop_index(self, base: TableHandle, name: str) -> None:
+        idx = next(i for i in base.indexes if i["name"] == name)
+        base.indexes.remove(idx)
+        self.drop_table(idx["index_table"])
+
+    def maintain_indexes(self, handle: TableHandle, base_key_values: dict,
+                         old_values: dict | None, row) -> None:
+        """Apply index mutations for one base write (the LocalCluster
+        analog of the tserver leader's Tablet::UpdateQLIndexes hook)."""
+        from yugabyte_db_tpu.index import index_mutations
+
+        for itable, _is, hc, rv in index_mutations(
+                handle.schema, handle.indexes, base_key_values,
+                old_values, row):
+            ih = self.table(itable)
+            self.tablet_for_hash(ih, hc).write([rv])
+
     def close(self) -> None:
         for h in list(self.tables.values()):
             for t in h.tablets:
@@ -157,6 +186,8 @@ class QLProcessor:
             ast.UseKeyspace: self._exec_use,
             ast.CreateTable: self._exec_create_table,
             ast.DropTable: self._exec_drop_table,
+            ast.CreateIndex: self._exec_create_index,
+            ast.DropIndex: self._exec_drop_index,
             ast.Insert: self._exec_insert,
             ast.Update: self._exec_update,
             ast.Delete: self._exec_delete,
@@ -235,15 +266,128 @@ class QLProcessor:
                 raise
         return None
 
-    # -- writes ------------------------------------------------------------
-    def _coerce(self, col: ColumnSchema, value):
+    # -- secondary indexes --------------------------------------------------
+    def _exec_create_index(self, stmt: ast.CreateIndex):
+        handle = self.cluster.table(self._qualify(stmt.table))
+        if any(i["name"] == stmt.name
+               for i in getattr(handle, "indexes", [])):
+            if stmt.if_not_exists:
+                return None
+            raise AlreadyPresent(f"index {stmt.name} exists")
+        if not handle.schema.has_column(stmt.column):
+            raise InvalidArgument(f"unknown column {stmt.column}")
+        if handle.schema.column(stmt.column).is_key:
+            raise InvalidArgument(f"cannot index key column {stmt.column}")
+        itable = self.cluster.create_index(handle, stmt.name, stmt.column)
+        self._backfill_index(handle, stmt.column, itable)
+        return None
+
+    def _exec_drop_index(self, stmt: ast.DropIndex):
+        for name in list(self.cluster.tables):
+            try:
+                handle = self.cluster.table(name)
+            except NotFound:
+                continue
+            for idx in getattr(handle, "indexes", []):
+                if idx["name"] == stmt.name:
+                    self.cluster.drop_index(handle, stmt.name)
+                    return None
+        if not stmt.if_exists:
+            raise NotFound(f"index {stmt.name} not found")
+        return None
+
+    def _backfill_index(self, handle: TableHandle, column: str,
+                        itable: str) -> None:
+        """Populate the index from existing base rows. Writes land
+        through the normal index-table write path; concurrent base
+        writes during the scan are covered by their own maintenance."""
+        from yugabyte_db_tpu.index import index_entry
+
+        ih = self.cluster.table(itable)
+        key_names = [c.name for c in handle.schema.key_columns]
+        proj = key_names + [column]
+        for tablet in handle.tablets:
+            spec = ScanSpec(read_ht=tablet.read_time().value,
+                            projection=proj)
+            res = tablet.scan(spec)
+            for row in res.rows:
+                value = row[-1]
+                if value is None:
+                    continue
+                base_kv = dict(zip(key_names, row[:-1]))
+                hc, rv = index_entry(ih.schema, value, base_kv)
+                self.cluster.tablet_for_hash(ih, hc).write([rv])
+
+    def _index_for_predicates(self, handle, predicates):
+        """(index info, eq predicate) when an '='-bound column is indexed."""
+        for pred in predicates:
+            if pred.op != "=":
+                continue
+            for idx in getattr(handle, "indexes", []):
+                if idx["column"] == pred.column:
+                    return idx, pred
+        return None, None
+
+    def _run_index_lookup(self, handle, stmt, plan, idx, pred):
+        """Index-driven SELECT: hash-routed scan of the index table for
+        base PKs, then base-row point reads re-verifying predicates (a
+        stale index entry — possible while an index write has landed but
+        its base write failed — filters out here). Reference:
+        the SELECT planning that routes through an index table
+        (src/yb/yql/cql/ql/ptree/pt_select.cc index selection)."""
+        ih = self.cluster.table(idx["index_table"])
+        ischema = ih.schema
+        value = self._coerce(handle.schema.column(pred.column), pred.value)
+        hc = compute_hash_code(ischema, {pred.column: value})
+        prefix = encode_doc_key_prefix(
+            hc, [(value, ischema.hash_columns[0].dtype)], [])
+        key_names = [c.name for c in handle.schema.key_columns]
+        itablet = self.cluster.tablet_for_hash(ih, hc)
+        ires = itablet.scan(ScanSpec(
+            lower=prefix, upper=prefix_successor(prefix),
+            read_ht=itablet.read_time().value, projection=key_names))
+
+        projection = plan.projection or [c.name for c in
+                                         handle.schema.columns]
+        names = ([it.output_name for it in stmt.items] if stmt.items
+                 else list(projection))
+        out = ResultSet(columns=names)
+        limit = self._coerce_limit(stmt.limit)
+        for irow in ires.rows:
+            base_kv = dict(zip(key_names, irow))
+            bkey, btablet = self._key_and_tablet(handle, base_kv)
+            bres = btablet.scan(ScanSpec(
+                lower=bkey, upper=bkey + b"\x00",
+                read_ht=btablet.read_time().value,
+                predicates=plan.predicates, projection=projection,
+                limit=1))
+            out.rows.extend(bres.rows)
+            if limit is not None and len(out.rows) >= limit:
+                break
+        return out
+
+    # -- bind markers --------------------------------------------------------
+    def _resolve_marker(self, value):
+        """BindMarker -> the positional param; other values pass through."""
         if isinstance(value, ast.BindMarker):
             try:
-                value = self._params[value.index]
+                return self._params[value.index]
             except IndexError:
                 raise InvalidArgument(
                     f"bind marker ${value.index} has no value "
                     f"({len(self._params)} params supplied)") from None
+        return value
+
+    @staticmethod
+    def _require_nonneg_int(value, what: str):
+        if value is not None and (not isinstance(value, int)
+                                  or isinstance(value, bool) or value < 0):
+            raise InvalidArgument(f"{what} must be a non-negative integer")
+        return value
+
+    # -- writes ------------------------------------------------------------
+    def _coerce(self, col: ColumnSchema, value):
+        value = self._resolve_marker(value)
         if value is None:
             return None
         dt = col.dtype
@@ -268,16 +412,8 @@ class QLProcessor:
         return key, tablet
 
     def _expire_ht(self, ttl_seconds):
-        if isinstance(ttl_seconds, ast.BindMarker):
-            try:
-                ttl_seconds = self._params[ttl_seconds.index]
-            except IndexError:
-                raise InvalidArgument(
-                    f"bind marker ${ttl_seconds.index} has no value") \
-                    from None
-            if not isinstance(ttl_seconds, int) or \
-                    isinstance(ttl_seconds, bool) or ttl_seconds < 0:
-                raise InvalidArgument("TTL must be a non-negative integer")
+        ttl_seconds = self._require_nonneg_int(
+            self._resolve_marker(ttl_seconds), "TTL")
         if ttl_seconds is None:
             return MAX_HT
         now = self.cluster.clock.now()
@@ -310,12 +446,13 @@ class QLProcessor:
                             read_ht=tablet.read_time().value, limit=1)
             if tablet.scan(spec).rows:
                 return ResultSet(columns=["[applied]"], rows=[(False,)])
-            tablet.write([RowVersion(
+            self._write_row(handle, key_values, key, tablet, RowVersion(
                 key, ht=0, liveness=True, columns=columns,
-                expire_ht=self._expire_ht(stmt.ttl_seconds))])
+                expire_ht=self._expire_ht(stmt.ttl_seconds)))
             return ResultSet(columns=["[applied]"], rows=[(True,)])
-        tablet.write([RowVersion(key, ht=0, liveness=True, columns=columns,
-                                 expire_ht=self._expire_ht(stmt.ttl_seconds))])
+        self._write_row(handle, key_values, key, tablet, RowVersion(
+            key, ht=0, liveness=True, columns=columns,
+            expire_ht=self._expire_ht(stmt.ttl_seconds)))
         return None
 
     def _bound_key_values(self, schema: Schema, where: list[ast.Relation],
@@ -359,8 +496,9 @@ class QLProcessor:
         # CQL UPDATE is an upsert of the SET columns (no liveness marker:
         # the row exists only while some column is live — reference
         # semantics of UPDATE vs INSERT in DocDB).
-        tablet.write([RowVersion(key, ht=0, columns=columns,
-                                 expire_ht=self._expire_ht(stmt.ttl_seconds))])
+        self._write_row(handle, key_values, key, tablet, RowVersion(
+            key, ht=0, columns=columns,
+            expire_ht=self._expire_ht(stmt.ttl_seconds)))
         return None
 
     def _exec_delete(self, stmt: ast.Delete):
@@ -377,10 +515,25 @@ class QLProcessor:
                 if col.is_key:
                     raise InvalidArgument(f"cannot DELETE key column {cname}")
                 columns[col.col_id] = None   # column tombstone
-            tablet.write([RowVersion(key, ht=0, columns=columns)])
+            self._write_row(handle, key_values, key, tablet,
+                            RowVersion(key, ht=0, columns=columns))
         else:
-            tablet.write([RowVersion(key, ht=0, tombstone=True)])
+            self._write_row(handle, key_values, key, tablet,
+                            RowVersion(key, ht=0, tombstone=True))
         return None
+
+    def _write_row(self, handle, key_values: dict, key: bytes, tablet,
+                   row: RowVersion) -> None:
+        """Write one row, maintaining secondary indexes when the cluster
+        seam does maintenance locally (LocalCluster); the distributed
+        seam's tserver leaders maintain indexes in their own write path."""
+        if getattr(handle, "indexes", None) and \
+                getattr(self.cluster, "maintain_indexes", None):
+            # Local maintenance only runs over real in-process Tablets,
+            # which own the canonical old-state read.
+            old = tablet.current_row_values(key)
+            self.cluster.maintain_indexes(handle, key_values, old, row)
+        tablet.write([row])
 
     # -- reads -------------------------------------------------------------
     def _exec_select(self, stmt: ast.Select):
@@ -389,6 +542,10 @@ class QLProcessor:
         plan = self._plan_select(handle, stmt)
         if plan.aggregates:
             return self._run_aggregate(handle, stmt, plan)
+        if not plan.single:
+            idx, pred = self._index_for_predicates(handle, plan.predicates)
+            if idx is not None:
+                return self._run_index_lookup(handle, stmt, plan, idx, pred)
         return self._run_rows(handle, stmt, plan)
 
     def _plan_select(self, handle: TableHandle, stmt: ast.Select):
@@ -568,18 +725,8 @@ class QLProcessor:
         return out
 
     def _coerce_limit(self, limit):
-        if isinstance(limit, ast.BindMarker):
-            try:
-                limit = self._params[limit.index]
-            except IndexError:
-                raise InvalidArgument(
-                    f"bind marker ${limit.index} has no value "
-                    f"({len(self._params)} params supplied)") from None
-            if not isinstance(limit, int) or isinstance(limit, bool) or \
-                    limit < 0:
-                raise InvalidArgument(
-                    "LIMIT must be a non-negative integer")
-        return limit
+        return self._require_nonneg_int(self._resolve_marker(limit),
+                                        "LIMIT")
 
     @staticmethod
     def _min_opt(a, b):

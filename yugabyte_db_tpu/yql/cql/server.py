@@ -137,8 +137,8 @@ class CQLServiceImpl:
     def _query(self, processor, stream: int, body: bytes) -> bytes:
         r = W.Reader(body)
         query = r.long_string()
-        stmt = parse_with_markers(query)[0]
-        bind_cols = self._bind_columns(processor, stmt)
+        stmt, nmarkers = parse_with_markers(query)
+        bind_cols = self._bind_columns(processor, stmt, nmarkers)
         params, page_size, paging_state = self._read_query_params(
             r, bind_cols)
         return self._run(processor, stream, stmt, params, page_size,
@@ -147,8 +147,8 @@ class CQLServiceImpl:
     # -- PREPARE / EXECUTE ---------------------------------------------------
     def _prepare(self, processor, stream: int, body: bytes) -> bytes:
         query = W.Reader(body).long_string()
-        stmt, _n = parse_with_markers(query)
-        bind_cols = self._bind_columns(processor, stmt)
+        stmt, nmarkers = parse_with_markers(query)
+        bind_cols = self._bind_columns(processor, stmt, nmarkers)
         stmt_id = hashlib.md5(query.encode()).digest()[:16]
         ks, table = self._stmt_target(stmt)
         with self._lock:
@@ -239,10 +239,11 @@ class CQLServiceImpl:
         return out
 
     # -- bind metadata -------------------------------------------------------
-    def _bind_columns(self, processor,
-                      stmt) -> list[tuple[str, DataType]]:
+    def _bind_columns(self, processor, stmt,
+                      nmarkers: int) -> list[tuple[str, DataType]]:
         """(name, type) per ``?`` marker, in marker order, resolved from
-        the statement's target table schema."""
+        the statement's target table schema. Sized by the parser's true
+        marker count so unnoted positions still get a (blob) slot."""
         markers: dict[int, tuple[str, DataType]] = {}
         table = getattr(stmt, "table", None)
         schema = None
@@ -276,8 +277,11 @@ class CQLServiceImpl:
         lim = getattr(stmt, "limit", None)
         if isinstance(lim, ast.BindMarker):
             markers[lim.index] = ("[limit]", DataType.INT32)
+        ttl = getattr(stmt, "ttl_seconds", None)
+        if isinstance(ttl, ast.BindMarker):
+            markers[ttl.index] = ("[ttl]", DataType.INT32)
         return [markers.get(i, (f"p{i}", DataType.BINARY))
-                for i in range(len(markers))]
+                for i in range(nmarkers)]
 
     @staticmethod
     def _stmt_target(stmt) -> tuple[str, str]:
