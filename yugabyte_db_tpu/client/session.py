@@ -139,8 +139,8 @@ class YBSession:
             if a.fn == "avg":
                 mapping.append(("avg", len(partial_aggs),
                                 len(partial_aggs) + 1))
-                partial_aggs.append(AggSpec("sum", a.column))
-                partial_aggs.append(AggSpec("count", a.column))
+                partial_aggs.append(AggSpec("sum", a.column, expr=a.expr))
+                partial_aggs.append(AggSpec("count", a.column, expr=a.expr))
             else:
                 mapping.append((a.fn, len(partial_aggs), None))
                 partial_aggs.append(a)
@@ -228,7 +228,7 @@ class YBSession:
             out_rows.append(tuple(row))
         names = list(gb)
         for a in spec.aggregates:
-            names.append(f"{a.fn}({a.column or '*'})")
+            names.append(a.output_name)
         return ScanResult(names, out_rows, None, scanned)
 
 

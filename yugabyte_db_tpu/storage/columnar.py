@@ -80,6 +80,10 @@ class ColumnarRun:
         # row is its own group, so device kernels can skip the segmented
         # MVCC merge machinery entirely (the common post-compaction shape).
         self.max_group_versions = 0
+        # Longest varlen value per column (bytes): values <= 8 are fully
+        # captured by the device prefix planes, making prefix equality
+        # EXACT — the device GROUP BY eligibility check for strings.
+        self.varlen_max_len: dict[int, int] = {}
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -212,6 +216,8 @@ class ColumnarRun:
             col.cmp_planes[b, r, 0] = hi[0]
             col.cmp_planes[b, r, 1] = lo[0]
             col.varlen[b][r] = val
+            if len(raw) > self.varlen_max_len.get(cid, 0):
+                self.varlen_max_len[cid] = len(raw)
 
     # -- host-side access (compaction input, materialization) -------------
     def iter_entries(self):

@@ -98,16 +98,24 @@ class Aggregator:
         return [None] * len(self.aggs)
 
     def add(self, get_value) -> None:
+        from yugabyte_db_tpu.storage.expr import eval_expr
+
         gkey = tuple(get_value(c) for c in self.group_by)
         acc = self.groups.get(gkey)
         if acc is None:
             acc = self.groups[gkey] = self._new_acc()
         for i, a in enumerate(self.aggs):
             if a.fn == "count":
-                if a.column is None or get_value(a.column) is not None:
+                if a.column is None and a.expr is None:
                     acc[i] = (acc[i] or 0) + 1
+                else:
+                    v = (eval_expr(a.expr, get_value)
+                         if a.expr is not None else get_value(a.column))
+                    if v is not None:
+                        acc[i] = (acc[i] or 0) + 1
                 continue
-            v = get_value(a.column)
+            v = (eval_expr(a.expr, get_value) if a.expr is not None
+                 else get_value(a.column))
             if v is None:
                 continue
             if a.fn == "sum":
@@ -140,7 +148,7 @@ class Aggregator:
     def column_names(self) -> list[str]:
         names = list(self.group_by)
         for a in self.aggs:
-            names.append(f"{a.fn}({a.column or '*'})")
+            names.append(a.output_name)
         return names
 
 

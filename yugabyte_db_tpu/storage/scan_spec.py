@@ -53,13 +53,25 @@ class Predicate:
 @dataclass(frozen=True)
 class AggSpec:
     fn: str          # count | sum | min | max | avg
-    column: str | None  # None for count(*)
+    column: str | None  # None for count(*) / expression aggregates
+    # Optional pushed-down scalar expression (storage.expr tree) the
+    # aggregate runs over instead of a bare column — the TPC-H
+    # sum(l_extendedprice * (1 - l_discount)) shape
+    # (reference: PgsqlExpressionPB trees, pgsql_operation.cc:473).
+    expr: object = None
+    label: str | None = None  # output column label override
 
     def __post_init__(self):
         if self.fn not in AGG_FNS:
             raise ValueError(f"bad aggregate {self.fn!r}")
-        if self.fn != "count" and self.column is None:
-            raise ValueError(f"{self.fn} needs a column")
+        if self.fn != "count" and self.column is None and self.expr is None:
+            raise ValueError(f"{self.fn} needs a column or expression")
+
+    @property
+    def output_name(self) -> str:
+        if self.label:
+            return self.label
+        return f"{self.fn}({self.column or ('<expr>' if self.expr else '*')})"
 
 
 @dataclass

@@ -354,8 +354,14 @@ class TpuStorageEngine(StorageEngine):
         single_source = len(runs) == 1 and not mem_live
 
         if spec.is_aggregate:
+            has_expr = any(a.expr is not None for a in spec.aggregates)
+            if single_source and runs and not superset and not host_only \
+                    and (spec.group_by or has_expr):
+                plan = self._plan_grouped_aggregate(runs[0], spec, exact)
+                if plan is not None:
+                    return plan
             eligible = (single_source and not superset and not host_only
-                        and not spec.group_by
+                        and not spec.group_by and not has_expr
                         and self._aggs_device_eligible(spec))
             if eligible and runs:
                 outs, fin = self._plan_device_aggregate(runs[0], spec, exact)
@@ -515,9 +521,14 @@ class TpuStorageEngine(StorageEngine):
         projection = spec.projection or [c.name for c in self.schema.columns]
         verify_preds = superset + host_only
         if aggregate:
+            from yugabyte_db_tpu.storage.expr import columns_of
+
             agg = Aggregator(spec.aggregates or [], spec.group_by or [])
             out_names = ([a.column for a in (spec.aggregates or [])
                           if a.column is not None]
+                         + [c for a in (spec.aggregates or [])
+                            if a.expr is not None
+                            for c in columns_of(a.expr)]
                          + list(spec.group_by or []))
         else:
             agg = None
@@ -682,6 +693,200 @@ class TpuStorageEngine(StorageEngine):
         return ScanResult(ctx["projection"], rows, resume, scanned)
 
     # (gather round execution lives in _GatherScan below)
+
+    # -- device grouped/expression aggregates --------------------------------
+    def _dtype_of(self, name: str):
+        cid = self._name_to_id.get(name)
+        if cid is None:
+            raise ValueError(f"{name} is not a value column")
+        return self._dtypes[cid]
+
+    def _encode_factor(self, node):
+        """storage.expr tree -> the kernel's static factor tuples."""
+        from yugabyte_db_tpu.storage import expr as X
+
+        if isinstance(node, X.Col):
+            return ("c", self._name_to_id[node.name])
+        if isinstance(node, X.Const):
+            return ("k", int(node.value))
+        return (node.op, self._encode_factor(node.left),
+                self._encode_factor(node.right))
+
+    def _plan_grouped_aggregate(self, trun: TpuRun, spec: ScanSpec,
+                                exact_preds):
+        """Device GROUP BY / expression aggregates (ops.group_agg) — the
+        TPC-H Q1/Q6 path. Returns an ("issued", ...) plan or None when
+        the spec isn't device-lowerable (caller falls back)."""
+        from yugabyte_db_tpu.ops import group_agg, row_gather
+        from yugabyte_db_tpu.storage import expr as X
+
+        crun = trun.crun
+        group_cols = []
+        for name in (spec.group_by or []):
+            cid = self._name_to_id.get(name)
+            if cid is None:
+                return None  # key column: host path
+            kind = self._kinds[cid]
+            if kind == "str":
+                if crun.varlen_max_len.get(cid, 0) > 8:
+                    return None  # prefix equality not exact
+                planes = 2
+            elif kind in ("i64", "f64"):
+                planes = 2
+            elif kind == "f32":
+                return None  # raw-bit equality conflates -0.0/0.0
+            else:
+                planes = 1
+            group_cols.append((cid, planes))
+
+        gaggs = []
+        for a in spec.aggregates:
+            if a.fn == "count" and a.expr is None:
+                cid = self._name_to_id.get(a.column) if a.column else None
+                if a.column and cid is None:
+                    return None
+                gaggs.append(group_agg.GAgg(
+                    "count", cid,
+                    need_cols=(cid,) if cid is not None else ()))
+            elif a.fn == "sum":
+                if a.expr is None:
+                    cid = self._name_to_id.get(a.column)
+                    if cid is None or self._kinds[cid] not in ("i32", "i64"):
+                        return None
+                    gaggs.append(group_agg.GAgg(
+                        "sum_prod", cid,
+                        planes=1 if self._kinds[cid] == "i32" else 2,
+                        factors=(), need_cols=(cid,)))
+                else:
+                    lowered = X.lower_product(a.expr, self._dtype_of)
+                    if lowered is None:
+                        return None
+                    base, factors = lowered
+                    # (negative factor VALUES are caught at runtime by the
+                    # kernel's negs counter -> host fallback)
+                    base_cid = self._name_to_id[base]
+                    need = [base_cid]
+                    for f in factors:
+                        for cname in X.columns_of(f):
+                            need.append(self._name_to_id[cname])
+                    gaggs.append(group_agg.GAgg(
+                        "sum_prod", base_cid,
+                        planes=1 if self._kinds[base_cid] == "i32" else 2,
+                        factors=tuple(self._encode_factor(f)
+                                      for f in factors),
+                        need_cols=tuple(dict.fromkeys(need))))
+            else:
+                return None  # min/max/avg: lowered by callers or host
+
+        pred_sigs = self._pred_sigs_only(exact_preds)
+        int_lits, f32_lits = self._pred_host_literals(exact_preds)
+        row_lo = crun.lower_row(spec.lower)
+        row_hi = crun.upper_row(spec.upper)
+        sig = group_agg.GroupAggSig(
+            B=trun.dev.B, R=crun.R, K=WINDOW_BLOCKS,
+            NB=group_agg.NUM_BUCKETS, cols=self._col_sigs(),
+            preds=pred_sigs, apply_preds=True,
+            flat=crun.max_group_versions <= 1,
+            group_cols=tuple(group_cols), aggs=tuple(gaggs))
+
+        def fallback():
+            return self._row_scan(spec, [trun], False,
+                                  (exact_preds, [], []), aggregate=True)
+
+        if row_lo >= row_hi:
+            agg = Aggregator(spec.aggregates, spec.group_by or [])
+            empty = ScanResult(agg.column_names(), agg.results(), None, 0)
+            return ("issued", [], lambda _f: empty)
+        K = WINDOW_BLOCKS
+        R = crun.R
+        w_first = row_lo // (K * R)
+        w_last = (row_hi - 1) // (K * R)
+        ip, fp = row_gather.pack_params(
+            w_first, w_last, row_lo, row_hi, self._read_plane_ints(spec),
+            int_lits, f32_lits)
+        fn = group_agg.compiled_grouped(sig)
+        out = fn(trun.dev.arrays, ip, fp)
+        return ("issued", out,
+                lambda f: self._finish_grouped(crun, spec, sig, f,
+                                               fallback))
+
+    def _finish_grouped(self, crun, spec, sig, res, fallback):
+        NB = sig.NB
+        count = np.asarray(res["count"])[:NB]
+        live = np.nonzero(count > 0)[0]
+        if int(res["negs"]) > 0:
+            return fallback()  # negative base values: digits invalid
+        km = np.asarray(res["keymin"])[:NB]
+        kM = np.asarray(res["keymax"])[:NB]
+        if live.size and sig.group_cols and \
+                not (km[live] == kM[live]).all():
+            return fallback()  # bucket collision: rehash on host
+
+        group_names = list(spec.group_by or [])
+        rows = []
+        reps = np.asarray(res["rep"])[:NB]
+        for b in live:
+            gvals = self._decode_group(crun, spec, sig, km[b], int(reps[b]))
+            if gvals is None:
+                return fallback()
+            aggs = []
+            for i, (a, ga) in enumerate(zip(spec.aggregates, sig.aggs)):
+                if ga.kind == "count":
+                    aggs.append(int(np.asarray(res[f"a{i}"])[b]))
+                else:
+                    digits = np.asarray(res[f"a{i}"])[b]
+                    v = sum(int(d) << (16 * k)
+                            for k, d in enumerate(digits))
+                    # SQL sum over zero non-null inputs is NULL.
+                    n_in = int(np.asarray(res[f"n{i}"])[b])
+                    aggs.append(v if n_in else None)
+            rows.append(tuple(gvals) + tuple(aggs))
+        if not rows and not spec.group_by:
+            agg = Aggregator(spec.aggregates, [])
+            return ScanResult(agg.column_names(), agg.results(), None,
+                              int(res["scanned"]))
+        rows.sort(key=lambda r: tuple(
+            (v is None, v) for v in r[:len(group_names)]))
+        names = group_names + [a.output_name for a in spec.aggregates]
+        return ScanResult(names, rows, None, int(res["scanned"]))
+
+    def _decode_group(self, crun, spec, sig, key_planes, rep):
+        """Bucket key planes (verified min==max) -> python group values.
+        Strings decode from the representative row's merged state."""
+        from yugabyte_db_tpu.storage.merge import merge_versions
+
+        out = []
+        off = 0
+        for (cid, planes), name in zip(sig.group_cols,
+                                       spec.group_by or []):
+            vals = key_planes[off:off + planes]
+            null = key_planes[off + planes]
+            off += planes + 1
+            if null:
+                out.append(None)
+                continue
+            kind = self._kinds[cid]
+            dt = self._dtypes[cid]
+            if kind == "i32":
+                v = int(vals[0])
+                out.append(bool(v) if dt == DataType.BOOL else v)
+            elif kind == "i64":
+                v = int(P.ordered_planes_to_i64(
+                    np.array([vals[0]], np.int32),
+                    np.array([vals[1]], np.int32))[0])
+                out.append(v)
+            elif kind == "f64":
+                out.append(float(P.ordered_planes_to_f64(
+                    np.array([vals[0]], np.int32),
+                    np.array([vals[1]], np.int32))[0]))
+            else:  # str: exact via the representative row's merged value
+                if rep >= crun.total_rows():
+                    return None
+                b_, r_ = divmod(rep, crun.R)
+                key, versions = crun.group_versions(b_, r_)
+                merged = merge_versions(key, versions, spec.read_ht)
+                out.append(merged.get(cid))
+        return out
 
     # -- device aggregate path ---------------------------------------------
     def _plan_device_aggregate(self, trun: TpuRun, spec: ScanSpec,

@@ -44,10 +44,35 @@ def encode_spec(spec: ScanSpec) -> dict:
                        for p in spec.predicates],
         "projection": spec.projection,
         "limit": spec.limit,
-        "aggregates": ([[a.fn, a.column] for a in spec.aggregates]
+        "aggregates": ([[a.fn, a.column, _encode_expr(a.expr), a.label]
+                        for a in spec.aggregates]
                        if spec.aggregates else None),
         "group_by": spec.group_by,
     }
+
+
+def _encode_expr(e):
+    from yugabyte_db_tpu.storage import expr as X
+
+    if e is None:
+        return None
+    if isinstance(e, X.Col):
+        return ["c", e.name]
+    if isinstance(e, X.Const):
+        return ["k", e.value]
+    return ["b", e.op, _encode_expr(e.left), _encode_expr(e.right)]
+
+
+def _decode_expr(d):
+    from yugabyte_db_tpu.storage import expr as X
+
+    if d is None:
+        return None
+    if d[0] == "c":
+        return X.Col(d[1])
+    if d[0] == "k":
+        return X.Const(d[1])
+    return X.BinOp(d[1], _decode_expr(d[2]), _decode_expr(d[3]))
 
 
 def decode_spec(d: dict) -> ScanSpec:
@@ -61,7 +86,10 @@ def decode_spec(d: dict) -> ScanSpec:
         ],
         projection=d.get("projection"),
         limit=d.get("limit"),
-        aggregates=([AggSpec(fn, col) for fn, col in d["aggregates"]]
+        aggregates=([AggSpec(a[0], a[1],
+                             expr=_decode_expr(a[2]) if len(a) > 2 else None,
+                             label=a[3] if len(a) > 3 else None)
+                     for a in d["aggregates"]]
                     if d.get("aggregates") else None),
         group_by=d.get("group_by"),
     )

@@ -117,7 +117,13 @@ class TabletServer:
 
         schema = peer.tablet.meta.schema
         key_names = [c.name for c in schema.key_columns]
+        indexed_cids = {schema.column(i["column"]).col_id
+                        for i in peer.tablet.meta.indexes}
         for row in rows:
+            # Writes that can't change any indexed value skip the old-row
+            # read entirely (the hot non-indexed-update path).
+            if not row.tombstone and not (indexed_cids & row.columns.keys()):
+                continue
             _, hashed, ranges = decode_doc_key(row.key)
             base_kv = dict(zip(key_names, hashed + ranges))
             old = peer.tablet.current_row_values(row.key)
@@ -363,6 +369,18 @@ class TabletServer:
             return {"code": "not_leader",
                     "leader_hint": peer.raft.leader_uuid()}
         if peer.tablet.participant.has_intents(p["txn_id"]):
+            # Transactional writes maintain secondary indexes at APPLY
+            # time, before the rows become readable — the same
+            # index-before-base ordering as plain writes. (The reference
+            # writes index intents inside the txn; this simpler commit-
+            # time maintenance trades a txn-atomic index for the same
+            # never-miss-once-visible invariant.)
+            if peer.tablet.meta.indexes:
+                rec = peer.tablet.participant.txns.get(p["txn_id"])
+                if rec is not None:
+                    err = self._maintain_indexes(peer, rec["rows"])
+                    if err is not None:
+                        return err
             try:
                 peer.replicate_txn_op(
                     "apply_intents",

@@ -87,18 +87,21 @@ class Relation:
 
 @dataclass
 class SelectItem:
-    """A projection item: a column, or an aggregate fn over a column/'*'."""
+    """A projection item: a column, or an aggregate over a column/'*'/
+    an arithmetic expression (storage.expr tree) — the TPC-H
+    sum(price * (1 - disc)) shape."""
 
-    column: str | None          # None for fn(*)
+    column: str | None          # None for fn(*) / expression aggregates
     agg_fn: str | None = None   # count/sum/min/max/avg or None for plain col
     alias: str | None = None
+    expr: object = None         # storage.expr tree for fn(<arith expr>)
 
     @property
     def output_name(self) -> str:
         if self.alias:
             return self.alias
         if self.agg_fn:
-            return f"{self.agg_fn}({self.column or '*'})"
+            return f"{self.agg_fn}({self.column or ('<expr>' if self.expr else '*')})"
         return self.column
 
 
@@ -109,6 +112,8 @@ class Select:
     where: list[Relation] = field(default_factory=list)
     limit: int | None = None
     allow_filtering: bool = False
+    group_by: list[str] = field(default_factory=list)
+    order_by: list[tuple] = field(default_factory=list)  # (name, desc)
 
 
 @dataclass

@@ -25,7 +25,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<number>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\.\d+|-?\d+[eE][+-]?\d+|-?\d+)
   | (?P<name>[A-Za-z_][A-Za-z_0-9]*|"(?:[^"]|"")*")
   | (?P<op><=|>=|!=|=|<|>)
-  | (?P<sym>[(),.;*?{}:])
+  | (?P<sym>[(),.;*?{}:+-])
 """, re.VERBOSE)
 
 
@@ -314,6 +314,25 @@ class Parser:
         self.expect_kw("FROM")
         table = self.qualified_name()
         where = self._where_opt()
+        group_by = []
+        if self.take_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.ident())
+            while self.take_sym(","):
+                group_by.append(self.ident())
+        order_by = []
+        if self.take_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                name = self.ident()
+                desc = False
+                if self.take_kw("DESC"):
+                    desc = True
+                else:
+                    self.take_kw("ASC")
+                order_by.append((name, desc))
+                if not self.take_sym(","):
+                    break
         limit = None
         if self.take_kw("LIMIT"):
             limit = self.literal()
@@ -324,20 +343,74 @@ class Parser:
         if self.take_kw("ALLOW"):
             self.expect_kw("FILTERING")
             allow = True
-        return ast.Select(table, items, where, limit, allow)
+        return ast.Select(table, items, where, limit, allow,
+                          group_by, order_by)
 
     def _select_item(self) -> ast.SelectItem:
         name = self.ident()
         if name in AGG_FNS and self.at_sym("("):
             self.next()
-            col = None if self.take_sym("*") else self.ident()
+            if self.take_sym("*"):
+                item = ast.SelectItem(None, agg_fn=name)
+            else:
+                expr = self._arith_expr()
+                from yugabyte_db_tpu.storage.expr import Col
+                if isinstance(expr, Col):
+                    item = ast.SelectItem(expr.name, agg_fn=name)
+                else:
+                    item = ast.SelectItem(None, agg_fn=name, expr=expr)
             self.expect_sym(")")
-            item = ast.SelectItem(col, agg_fn=name)
         else:
             item = ast.SelectItem(name)
         if self.take_kw("AS"):
             item.alias = self.ident()
         return item
+
+    def _arith_expr(self):
+        """Arithmetic over columns and integer constants: + - * with the
+        usual precedence and parentheses (storage.expr tree)."""
+        from yugabyte_db_tpu.storage.expr import BinOp, Const
+
+        left = self._arith_term()
+        while True:
+            if self.take_sym("+"):
+                left = BinOp("+", left, self._arith_term())
+            elif self.take_sym("-"):
+                left = BinOp("-", left, self._arith_term())
+            else:
+                t = self.peek()
+                # "a -5": the lexer folds the sign into the number.
+                if t is not None and t.kind == "number" and \
+                        t.text.startswith("-") and "." not in t.text:
+                    self.next()
+                    left = BinOp("+", left, Const(int(t.text)))
+                else:
+                    return left
+
+    def _arith_term(self):
+        from yugabyte_db_tpu.storage.expr import BinOp
+
+        left = self._arith_factor()
+        while self.take_sym("*"):
+            left = BinOp("*", left, self._arith_factor())
+        return left
+
+    def _arith_factor(self):
+        from yugabyte_db_tpu.storage.expr import Col, Const
+
+        if self.take_sym("("):
+            e = self._arith_expr()
+            self.expect_sym(")")
+            return e
+        t = self.peek()
+        if t is not None and t.kind == "number":
+            self.next()
+            if any(c in t.text for c in ".eE"):
+                raise InvalidArgument(
+                    "only integer constants in pushed-down expressions")
+            return Const(int(t.text))
+        return Col(self.ident())
+
 
     def _where_opt(self) -> list[ast.Relation]:
         if not self.take_kw("WHERE"):

@@ -529,10 +529,13 @@ class QLProcessor:
         seam's tserver leaders maintain indexes in their own write path."""
         if getattr(handle, "indexes", None) and \
                 getattr(self.cluster, "maintain_indexes", None):
-            # Local maintenance only runs over real in-process Tablets,
-            # which own the canonical old-state read.
-            old = tablet.current_row_values(key)
-            self.cluster.maintain_indexes(handle, key_values, old, row)
+            indexed_cids = {handle.schema.column(i["column"]).col_id
+                            for i in handle.indexes}
+            if row.tombstone or (indexed_cids & row.columns.keys()):
+                # Local maintenance only runs over real in-process
+                # Tablets, which own the canonical old-state read.
+                old = tablet.current_row_values(key)
+                self.cluster.maintain_indexes(handle, key_values, old, row)
         tablet.write([row])
 
     # -- reads -------------------------------------------------------------
@@ -540,13 +543,31 @@ class QLProcessor:
         handle = self.cluster.table(self._qualify(stmt.table))
         schema = handle.schema
         plan = self._plan_select(handle, stmt)
+        ordered = bool(getattr(stmt, "order_by", None))
+        if ordered and self._page_size:
+            raise InvalidArgument("ORDER BY cannot combine with paging")
         if plan.aggregates:
             return self._run_aggregate(handle, stmt, plan)
+        # SQL order of operations: ORDER BY sorts the FULL result, LIMIT
+        # truncates afterwards — so an ordered select scans unlimited and
+        # slices post-sort.
+        import dataclasses as _dc
+        scan_stmt = _dc.replace(stmt, limit=None) if ordered else stmt
         if not plan.single:
             idx, pred = self._index_for_predicates(handle, plan.predicates)
             if idx is not None:
-                return self._run_index_lookup(handle, stmt, plan, idx, pred)
-        return self._run_rows(handle, stmt, plan)
+                res = self._apply_order_by(stmt, self._run_index_lookup(
+                    handle, scan_stmt, plan, idx, pred))
+                return self._slice_limit(stmt, res) if ordered else res
+        res = self._apply_order_by(
+            stmt, self._run_rows(handle, scan_stmt, plan))
+        return self._slice_limit(stmt, res) if ordered else res
+
+    def _slice_limit(self, stmt, rs: ResultSet) -> ResultSet:
+        limit = self._coerce_limit(stmt.limit)
+        if limit is not None:
+            rs.rows = rs.rows[:limit]
+        return rs
 
     def _plan_select(self, handle: TableHandle, stmt: ast.Select):
         schema = handle.schema
@@ -628,15 +649,34 @@ class QLProcessor:
                      if rel.op == "IN" else self._coerce(col, rel.value))
             predicates.append(Predicate(rel.column, rel.op, value))
 
+        group_by = list(getattr(stmt, "group_by", []) or [])
+        for g in group_by:
+            if not schema.has_column(g):
+                raise InvalidArgument(f"unknown GROUP BY column {g}")
         aggregates = []
         if stmt.items and any(it.agg_fn for it in stmt.items):
-            if not all(it.agg_fn for it in stmt.items):
-                raise InvalidArgument(
-                    "cannot mix aggregates and plain columns without GROUP BY")
+            from yugabyte_db_tpu.storage.expr import columns_of
             for it in stmt.items:
+                if it.agg_fn:
+                    continue
+                if it.column not in group_by:
+                    raise InvalidArgument(
+                        "plain columns in an aggregate SELECT must appear "
+                        "in GROUP BY")
+            for it in stmt.items:
+                if not it.agg_fn:
+                    continue
                 if it.column and not schema.has_column(it.column):
                     raise InvalidArgument(f"unknown column {it.column}")
-                aggregates.append(AggSpec(it.agg_fn, it.column))
+                if it.expr is not None:
+                    for cname in columns_of(it.expr):
+                        if not schema.has_column(cname):
+                            raise InvalidArgument(f"unknown column {cname}")
+                aggregates.append(AggSpec(it.agg_fn, it.column,
+                                          expr=it.expr,
+                                          label=it.output_name))
+        elif group_by:
+            raise InvalidArgument("GROUP BY requires aggregate items")
 
         projection = None
         if stmt.items and not aggregates:
@@ -654,9 +694,10 @@ class QLProcessor:
             predicates: list
             projection: list | None
             aggregates: list
+            group_by: list
 
         return Plan(bool(single), hash_code, lower, upper, predicates,
-                    projection, aggregates)
+                    projection, aggregates, group_by)
 
     def _target_tablets(self, handle: TableHandle, plan):
         if plan.single and handle.schema.num_hash:
@@ -737,40 +778,84 @@ class QLProcessor:
         return min(a, b)
 
     def _run_aggregate(self, handle: TableHandle, stmt: ast.Select, plan):
-        """Fan the aggregate out per tablet, combine partials host-side
-        (reference: per-tablet partial agg merged above the scan,
-        src/yb/docdb/pgsql_operation.cc:473 + exec/eval_aggr.cc). avg
-        lowers to sum+count so the combine stays exact."""
+        """Fan the aggregate out per tablet, combine partials host-side —
+        grouped or not (reference: per-tablet partial agg merged above the
+        scan, src/yb/docdb/pgsql_operation.cc:473 + exec/eval_aggr.cc).
+        avg lowers to sum+count so the combine stays exact."""
         lowered: list[AggSpec] = []
-        avg_map = {}
+        shape = []  # ("plain", idx) | ("avg", sum_idx, count_idx)
         for a in plan.aggregates:
             if a.fn == "avg":
-                avg_map[len(lowered)] = a
-                lowered.append(AggSpec("sum", a.column))
-                lowered.append(AggSpec("count", a.column))
+                shape.append(("avg", len(lowered), len(lowered) + 1))
+                lowered.append(AggSpec("sum", a.column, expr=a.expr))
+                lowered.append(AggSpec("count", a.column, expr=a.expr))
             else:
+                shape.append(("plain", len(lowered), None))
                 lowered.append(a)
 
-        partials = []
+        gb = plan.group_by
+        ngb = len(gb)
+        groups: dict[tuple, list[list]] = {}
         for tablet in self._target_tablets(handle, plan):
             spec = ScanSpec(lower=plan.lower, upper=plan.upper,
                             read_ht=tablet.read_time().value,
-                            predicates=plan.predicates, aggregates=lowered)
-            partials.append(tablet.scan(spec).rows[0])
+                            predicates=plan.predicates, aggregates=lowered,
+                            group_by=gb or None)
+            for row in tablet.scan(spec).rows:
+                gkey = tuple(row[:ngb])
+                groups.setdefault(gkey, []).append(list(row[ngb:]))
+        if not groups and not gb:
+            groups[()] = []
 
-        combined = []
-        i = 0
-        for a in plan.aggregates:
-            if a.fn == "avg":
-                s = self._combine([p[i] for p in partials], "sum")
-                n = self._combine([p[i + 1] for p in partials], "count")
-                combined.append(None if not n else s / n)
-                i += 2
-            else:
-                combined.append(self._combine([p[i] for p in partials], a.fn))
-                i += 1
-        names = [it.output_name for it in stmt.items]
-        return ResultSet(columns=names, rows=[tuple(combined)])
+        out_rows = []
+        for gkey in sorted(groups, key=lambda g: tuple(
+                (v is None, v) for v in g)):
+            partials = groups[gkey]
+            row = list(gkey)
+            for kind, i, j in shape:
+                if kind == "avg":
+                    s = self._combine([p[i] for p in partials], "sum")
+                    n = self._combine([p[j] for p in partials], "count")
+                    row.append(None if not n else s / n)
+                else:
+                    fn = lowered[i].fn
+                    row.append(self._combine([p[i] for p in partials], fn))
+            out_rows.append(tuple(row))
+        # Column order follows the SELECT items; group values prepend in
+        # GROUP BY order, then reorder to the projection if it differs.
+        names = gb + [it.output_name for it in stmt.items if it.agg_fn]
+        rs = ResultSet(columns=names, rows=out_rows)
+        rs = self._project_grouped(stmt, gb, rs)
+        return self._slice_limit(stmt, self._apply_order_by(stmt, rs))
+
+    @staticmethod
+    def _project_grouped(stmt, gb, rs: ResultSet) -> ResultSet:
+        """Reorder (group cols + aggs) into the SELECT item order."""
+        if not stmt.items:
+            return rs
+        want = [it.output_name for it in stmt.items]
+        if want == rs.columns:
+            return rs
+        try:
+            idxs = [rs.columns.index(
+                it.output_name if it.agg_fn else it.column)
+                for it in stmt.items]
+        except ValueError:
+            return rs
+        return ResultSet(columns=want,
+                         rows=[tuple(r[i] for i in idxs) for r in rs.rows])
+
+    def _apply_order_by(self, stmt, rs: ResultSet) -> ResultSet:
+        order = list(getattr(stmt, "order_by", []) or [])
+        if not order:
+            return rs
+        for name, _d in order:
+            if name not in rs.columns:
+                raise InvalidArgument(f"ORDER BY column {name} not in output")
+        for name, desc in reversed(order):
+            i = rs.columns.index(name)
+            rs.rows.sort(key=lambda r: (r[i] is None, r[i]), reverse=desc)
+        return rs
 
     @staticmethod
     def _combine(vals, fn):

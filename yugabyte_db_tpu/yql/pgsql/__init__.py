@@ -1,0 +1,26 @@
+"""YSQL-shaped analytics path: pgsql-style read operations + TPC-H.
+
+Reference analog: the pggate -> PgsqlReadOperation pipeline
+(src/yb/yql/pggate/pggate.h:58, src/yb/docdb/pgsql_operation.cc:345) —
+reads with WHERE pushdown, expression aggregates, and GROUP BY evaluated
+per tablet inside the scan, combined above it. The SQL surface rides the
+shared SELECT frontend (yql.cql.parser grew GROUP BY / ORDER BY /
+arithmetic aggregate expressions); this package adds the pgsql-flavored
+operation objects and the TPC-H Q1/Q6 workload (schema, datagen,
+runners) measured by bench.py.
+"""
+
+from yugabyte_db_tpu.yql.pgsql.operations import PgsqlReadOp
+from yugabyte_db_tpu.yql.pgsql.tpch import (LINEITEM_COLUMNS,
+                                            generate_lineitem, q1_result,
+                                            q1_spec, q6_result, q6_spec)
+
+__all__ = [
+    "LINEITEM_COLUMNS",
+    "PgsqlReadOp",
+    "generate_lineitem",
+    "q1_result",
+    "q1_spec",
+    "q6_result",
+    "q6_spec",
+]
