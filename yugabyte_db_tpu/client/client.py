@@ -50,13 +50,28 @@ class YBTable:
 
 
 class YBClient:
+    def next_request_id(self) -> int:
+        """Monotonic per-client write request id (exactly-once dedup:
+        retryable_requests.h:34 — retries reuse the SAME id)."""
+        with self._req_lock:
+            self._req_counter += 1
+            return self._req_counter
+
     def __init__(self, transport, master_uuids: list[str],
                  default_rpc_timeout_s: float = 10.0):
+        import threading
+        import uuid as uuid_mod
+
         self.transport = transport
         self.master_uuids = list(master_uuids)
         self.default_rpc_timeout_s = default_rpc_timeout_s
         self.meta_cache = MetaCache(self)
         self._master_leader_hint: str | None = None
+        # Exactly-once write identity: every write carries
+        # (client_id, request_id); servers dedup replayed ids.
+        self.client_id = uuid_mod.uuid4().hex
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
 
     # -- master path ---------------------------------------------------------
     def master_rpc(self, method: str, payload: dict,

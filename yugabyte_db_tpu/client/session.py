@@ -69,7 +69,12 @@ class YBSession:
         for table, loc, rows in by_tablet.values():
             resp = self.client.tablet_rpc(
                 table.name, loc, "ts.write",
-                {"rows": wire.encode_rows(rows)}, timeout_s=timeout_s)
+                {"rows": wire.encode_rows(rows),
+                 # Exactly-once across retries: tablet_rpc resends the
+                 # SAME payload, so the id survives every retry attempt.
+                 "client_id": self.client.client_id,
+                 "request_id": self.client.next_request_id()},
+                timeout_s=timeout_s)
             written += len(rows)
         return written
 

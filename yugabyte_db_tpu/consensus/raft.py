@@ -137,6 +137,9 @@ class RaftConsensus:
 
         self._peers: dict[str, _PeerState] = {}
         self._threads: list[threading.Thread] = []
+        # Invoked (tablet_id, peer_uuid) when a peer needs entries evicted
+        # from the cache — wired by the tserver to kick remote bootstrap.
+        self.on_needs_bootstrap = None
 
     # ------------------------------------------------------------------ api
     def start(self) -> None:
@@ -432,11 +435,21 @@ class RaftConsensus:
                 term = self.cmeta.current_term
                 min_cached = min(self._entries, default=self._last_index + 1)
                 if peer.next_index < min_cached:
-                    # The peer needs entries already GC'd from the log: it
-                    # must be re-seeded by remote bootstrap (§5.3); keep
-                    # heartbeating from the cache floor so it stays quiet.
+                    # The peer needs entries already evicted from the
+                    # cache: it must be re-seeded by remote bootstrap
+                    # (§5.3); keep heartbeating from the cache floor so it
+                    # stays quiet, and nudge the bootstrap notifier
+                    # (rate-limited) so the re-seed actually happens.
                     peer.needs_remote_bootstrap = True
                     peer.next_index = min_cached
+                    now = time.monotonic()
+                    if self.on_needs_bootstrap is not None and \
+                            now - getattr(peer, "last_rb_request", 0) > 5.0:
+                        peer.last_rb_request = now
+                        cb, target = self.on_needs_bootstrap, peer.uuid
+                        threading.Thread(
+                            target=cb, args=(self.tablet_id, target),
+                            daemon=True).start()
                 prev_index = peer.next_index - 1
                 pe = self._entries.get(prev_index)
                 prev_term = pe.op_id.term if pe else 0
@@ -474,6 +487,7 @@ class RaftConsensus:
                         peer.match_index = max(peer.match_index,
                                                batch[-1][1])
                         peer.next_index = peer.match_index + 1
+                        peer.needs_remote_bootstrap = False
                     self._advance_commit_locked()
                     if peer.next_index <= self._last_index:
                         peer.signal.set()  # keep streaming the backlog
@@ -521,6 +535,43 @@ class RaftConsensus:
         for p in self._peers.values():
             p.signal.set()
 
+    # -- log cache eviction + bootstrap handoff ------------------------------
+    def evict_cache(self, up_to: int) -> int:
+        """Bound the in-memory entry cache: drop entries strictly below
+        min(up_to, applied) — the floor entry itself is retained as the
+        prev-term anchor for peer probing. Lagging peers whose next entry
+        was evicted are re-seeded via remote bootstrap instead of log
+        catchup (reference: LogCache eviction + the remote-bootstrap
+        trigger in consensus_queue.cc)."""
+        with self._lock:
+            limit = min(up_to, self._applied_index)
+            # Keep TWO anchors (limit-1 and limit): a peer whose next
+            # entry is the floor still needs prev_term of floor-1 for its
+            # consistency probe — evicting it would bounce that peer into
+            # a needless full bootstrap.
+            victims = [i for i in self._entries if i < limit - 1]
+            for i in victims:
+                del self._entries[i]
+            return len(victims)
+
+    def log_tail_snapshot(self) -> dict:
+        """Everything a lagging peer needs beyond a storage snapshot:
+        the cached log tail (with the commit watermark stamped on the
+        records), current term, and the committed config — the payload
+        of a remote-bootstrap session (remote_bootstrap_session.cc)."""
+        with self._lock:
+            records = []
+            for i in sorted(self._entries):
+                rec = self._entries[i].to_record()
+                rec[5] = min(self._commit_index, i)  # stamp committed
+                records.append(rec)
+            return {
+                "log": records,
+                "term": self.cmeta.current_term,
+                "config": self.cmeta.committed_config.to_dict(),
+                "commit_index": self._commit_index,
+            }
+
     # -- apply ---------------------------------------------------------------
     def _apply_loop(self) -> None:
         while True:
@@ -556,7 +607,11 @@ class RaftConsensus:
         with self._lock:
             while True:
                 e = self._entries.get(op_id.index)
-                if e is None or e.op_id.term != op_id.term:
+                if e is None:
+                    if op_id.index <= self._applied_index:
+                        return  # applied, then evicted from the cache
+                    raise NotLeader(self.uuid, self._leader_uuid)  # truncated
+                if e.op_id.term != op_id.term:
                     raise NotLeader(self.uuid, self._leader_uuid)  # truncated
                 if self._applied_index >= op_id.index:
                     return

@@ -247,6 +247,11 @@ class CpuStorageEngine(StorageEngine):
         ]
         return kept  # tombstones <= cutoff drop: nothing older remains to shadow
 
+    def dump_entries(self):
+        """All flushed (key, versions ht-desc) pairs, key-merged across
+        runs — the storage payload of a remote-bootstrap session."""
+        return list(self._merge_runs_by_key())
+
     def stats(self) -> dict:
         return {
             "num_runs": len(self.runs),

@@ -93,3 +93,21 @@ def combine_merged(a: MergedRow, b: MergedRow) -> MergedRow:
                 out.values[cid] = val
                 out.value_hts[cid] = ht
     return out
+
+
+def merge_entry_streams(streams):
+    """K-way merge of (key, versions ht-desc) streams into grouped
+    (key, versions ht-desc) pairs in key order — the shared inner loop of
+    compaction and remote-bootstrap dumps (reference: the MergingIterator
+    under CompactionJob::Run, src/yb/rocksdb/db/compaction_job.cc:622)."""
+    import heapq
+
+    current, bucket = None, []
+    for key, versions in heapq.merge(*streams, key=lambda p: p[0]):
+        if key != current:
+            if current is not None:
+                yield current, sorted(bucket, key=lambda r: -r.ht)
+            current, bucket = key, []
+        bucket.extend(versions)
+    if current is not None:
+        yield current, sorted(bucket, key=lambda r: -r.ht)

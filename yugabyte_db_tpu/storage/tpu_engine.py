@@ -104,39 +104,29 @@ class TpuStorageEngine(StorageEngine):
         """Merge all runs into one. Host-side k-way merge + shared GC for
         now; the device sort-merge path (ops.merge) takes over for large
         runs once wired in."""
-        import heapq
-
         from yugabyte_db_tpu.storage.cpu_engine import CpuStorageEngine
+        from yugabyte_db_tpu.storage.merge import merge_entry_streams
 
         if len(self.runs) <= 1 and history_cutoff_ht == 0:
             return
-
-        def run_iter(trun):
-            return ((k, vs) for k, vs in trun.crun.iter_entries())
-
         merged = []
-        current, bucket = None, []
-        for key, versions in heapq.merge(*[run_iter(t) for t in self.runs],
-                                         key=lambda p: p[0]):
-            if key != current:
-                if current is not None:
-                    self._emit_group(merged, current, bucket, history_cutoff_ht,
-                                     CpuStorageEngine)
-                current, bucket = key, []
-            bucket.extend(versions)
-        if current is not None:
-            self._emit_group(merged, current, bucket, history_cutoff_ht,
-                             CpuStorageEngine)
+        for key, versions in merge_entry_streams(
+                [t.crun.iter_entries() for t in self.runs]):
+            kept = CpuStorageEngine._gc_versions(key, versions,
+                                                 history_cutoff_ht)
+            if kept:
+                merged.append((key, kept))
         self.persist.replace_all(merged)
         crun = ColumnarRun.build(self.schema, merged, self.rows_per_block)
         self.runs = [TpuRun(crun)] if merged else []
 
-    @staticmethod
-    def _emit_group(out, key, versions, cutoff, cpu_cls):
-        versions = sorted(versions, key=lambda r: -r.ht)
-        kept = cpu_cls._gc_versions(key, versions, cutoff)
-        if kept:
-            out.append((key, kept))
+    def dump_entries(self):
+        """All flushed (key, versions ht-desc) pairs, key-merged across
+        runs — the storage payload of a remote-bootstrap session."""
+        from yugabyte_db_tpu.storage.merge import merge_entry_streams
+
+        return list(merge_entry_streams(
+            [t.crun.iter_entries() for t in self.runs]))
 
     def stats(self) -> dict:
         return {

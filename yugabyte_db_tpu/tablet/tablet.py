@@ -109,11 +109,13 @@ class Tablet:
         # (participant); tablets of the status table additionally run the
         # coordinator state machine. Both rebuild from sidecar snapshots +
         # WAL replay exactly like the engine.
+        from yugabyte_db_tpu.tablet.retryable import RetryableRequests
         from yugabyte_db_tpu.txn.coordinator import (TXN_STATUS_TABLE,
                                                      TransactionCoordinator)
         from yugabyte_db_tpu.txn.participant import TransactionParticipant
 
         self.participant = TransactionParticipant(self.dir)
+        self.retryable = RetryableRequests(self.dir)
         self.coordinator = (TransactionCoordinator(self.dir)
                             if meta.table_name == TXN_STATUS_TABLE else None)
         self.bootstrap()
@@ -145,13 +147,25 @@ class Tablet:
                     entry.op_id.index > committed_frontier:
                 continue
             if entry.op_type == "write":
-                rows = _decode_rows(entry.body)
-                self.engine.apply(rows)
+                self._apply_write_body(entry)
                 replayed += 1
             else:
                 self._apply_txn_op(entry)
             self._applied_index = max(self._applied_index, entry.op_id.index)
         self._replayed_on_bootstrap = replayed
+
+    def _apply_write_body(self, entry) -> None:
+        """Apply a "write" entry; bodies are either the legacy raw row
+        list or {"rows":..., "rid":[client_id, request_id]} — the rid is
+        recorded for exactly-once retry dedup (retryable.py)."""
+        body = entry.body
+        if isinstance(body, dict):
+            self.engine.apply(_decode_rows(body["rows"]))
+            rid = body.get("rid")
+            if rid:
+                self.retryable.record(rid[0], rid[1], entry.ht)
+        else:
+            self.engine.apply(_decode_rows(body))
 
     def _apply_txn_op(self, entry) -> None:
         """Apply transaction ops (intents / commit-apply / abort-remove /
@@ -204,7 +218,7 @@ class Tablet:
         vanish while the replay frontier still advances past it."""
         with self._write_lock:
             if entry.op_type == "write":
-                self.engine.apply(_decode_rows(entry.body))
+                self._apply_write_body(entry)
             else:
                 self._apply_txn_op(entry)
             self._applied_index = max(self._applied_index, entry.op_id.index)
@@ -227,6 +241,7 @@ class Tablet:
         with self._write_lock:
             self.engine.flush()
             self.participant.snapshot()
+            self.retryable.snapshot()
             if self.coordinator is not None:
                 self.coordinator.snapshot()
             self.meta.flushed_op_index = self._applied_index

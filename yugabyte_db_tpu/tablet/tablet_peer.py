@@ -68,11 +68,29 @@ class TabletPeer:
         return self.raft.is_leader()
 
     # -- write path ---------------------------------------------------------
-    def write(self, rows: list[RowVersion], timeout: float = 10.0) -> HybridTime:
+    def write(self, rows: list[RowVersion], timeout: float = 10.0,
+              client_id: str | None = None,
+              request_id: int | None = None) -> HybridTime:
         """Leader-side write: stamp a hybrid time, replicate through Raft,
-        return once applied on this replica."""
-        if not self.raft.is_leader():
+        return once applied on this replica.
+
+        A (client_id, request_id) pair makes the write EXACTLY-ONCE under
+        client retries: a replayed id returns the original write's hybrid
+        time without re-applying (retryable_requests.h:34). Callers must
+        serialize writes sharing an id (the tserver's write handler holds
+        the intent-admission lock across the check + append). Writes also
+        require leader_ready() — an own-term entry applied — which
+        guarantees every prior-term entry (including any original of a
+        retried id) has already applied into the dedup registry before a
+        new leader accepts writes."""
+        if not (self.raft.is_leader() and self.raft.leader_ready()):
             raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        rid = None
+        if client_id is not None and request_id is not None:
+            prev = self.tablet.retryable.seen(client_id, request_id)
+            if prev is not None:
+                return HybridTime(prev)  # duplicate retry: original result
+            rid = [client_id, request_id]
         ht = self.tablet.clock.now()
         stamped = [
             RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
@@ -82,8 +100,9 @@ class TabletPeer:
         ]
         self.tablet.mvcc.add_pending(ht)
         try:
-            entry = self.raft.append_leader("write", _encode_rows(stamped),
-                                            ht=ht.value)
+            body = ({"rows": _encode_rows(stamped), "rid": rid}
+                    if rid else _encode_rows(stamped))
+            entry = self.raft.append_leader("write", body, ht=ht.value)
         except BaseException:
             self.tablet.mvcc.aborted(ht)  # never entered the log
             raise
@@ -119,7 +138,7 @@ class TabletPeer:
         pinned read that advanced this tablet's clock (and therefore this
         entry's ht) past its read time can never be overtaken by the
         commit (the HLC-propagation half of the safe-time contract)."""
-        if not self.raft.is_leader():
+        if not (self.raft.is_leader() and self.raft.leader_ready()):
             raise NotLeader(self.node_uuid, self.raft.leader_uuid())
         from yugabyte_db_tpu.storage.wire import encode_rows
         with self._intent_lock:
@@ -208,6 +227,25 @@ class TabletPeer:
     def flush(self) -> None:
         with self._maintenance_lock:
             self.tablet.flush()
+            # Everything at/below the flushed frontier is durable in the
+            # engine's runs: bound the in-memory Raft entry cache too.
+            # Lagging peers past the eviction floor are re-seeded via
+            # remote bootstrap.
+            self.raft.evict_cache(self.tablet.meta.flushed_op_index)
+
+    def snapshot_for_bootstrap(self) -> dict:
+        """Consistent remote-bootstrap payload pieces: flush, dump the
+        runs, and capture the log tail under ONE maintenance-lock hold —
+        a concurrent flush between the dump and the tail capture would
+        otherwise evict entries out of both."""
+        with self._maintenance_lock:
+            self.tablet.flush()
+            self.raft.evict_cache(self.tablet.meta.flushed_op_index)
+            entries = self.tablet.engine.dump_entries()
+            tail = self.raft.log_tail_snapshot()
+            flushed = self.tablet.meta.flushed_op_index
+        return {"entries": entries, "tail": tail,
+                "flushed_op_index": flushed}
 
     def compact(self, history_cutoff_ht: int = 0) -> None:
         with self._maintenance_lock:

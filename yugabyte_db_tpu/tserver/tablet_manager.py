@@ -37,6 +37,10 @@ class TSTabletManager:
         self.fsync = fsync
         self._lock = threading.Lock()
         self._peers: dict[str, TabletPeer] = {}
+        # Wired by the TabletServer: called (tablet_id, peer_uuid) when a
+        # leader here finds a peer lagging past the log-cache floor.
+        self.bootstrap_notifier = None
+        self.bootstrap_installs = 0  # observability / tests
         # tablet_ids with a create in flight: reserved atomically under the
         # lock so two concurrent ts.create_tablet RPCs (master dispatch
         # racing the balancer's retry) can never both start a peer on the
@@ -77,10 +81,99 @@ class TSTabletManager:
                           self.transport, initial_peers,
                           engine_options=self.engine_options,
                           fsync=self.fsync, raft_opts=self.raft_opts)
+        peer.raft.on_needs_bootstrap = self._notify_bootstrap
         with self._lock:
             self._peers[meta.tablet_id] = peer
         peer.start()
         return peer
+
+    def _notify_bootstrap(self, tablet_id: str, peer_uuid: str) -> None:
+        cb = self.bootstrap_notifier
+        if cb is not None:
+            cb(tablet_id, peer_uuid)
+
+    def install_snapshot(self, tablet_id: str, payload: dict) -> None:
+        """Rebuild one tablet from a remote-bootstrap payload: runs +
+        sidecars + WAL tail + consensus metadata written to disk, then
+        reopened through the NORMAL open path (bootstrap replays the tail
+        over the flushed frontier) — reference:
+        remote_bootstrap_client.cc installing the downloaded session."""
+        from yugabyte_db_tpu.consensus.metadata import (ConsensusMetadata,
+                                                        RaftConfig)
+        from yugabyte_db_tpu.models.schema import Schema
+        from yugabyte_db_tpu.storage import wire
+        from yugabyte_db_tpu.storage.run_io import RunPersistence
+        from yugabyte_db_tpu.tablet.wal import Log, LogEntry
+        from yugabyte_db_tpu.utils import codec
+
+        with self._lock:
+            if tablet_id in self._creating:
+                return
+            self._creating.add(tablet_id)
+            peer = self._peers.get(tablet_id)
+            # Term fencing: a STALE ex-leader may still believe peers lag
+            # and push a snapshot; destroying a healthy replica and
+            # regressing its durable term would un-commit acknowledged
+            # entries. Only install snapshots from the replica's present
+            # or a newer term.
+            if peer is not None and \
+                    payload["term"] < peer.raft.cmeta.current_term:
+                self._creating.discard(tablet_id)
+                return
+            self._peers.pop(tablet_id, None)
+        try:
+            if peer is not None:
+                peer.shutdown()
+            tdir = os.path.join(self.data_root, tablet_id)
+            shutil.rmtree(tdir, ignore_errors=True)
+            os.makedirs(tdir, exist_ok=True)
+
+            meta = TabletMetadata(
+                tablet_id, payload["table_name"],
+                Schema.from_dict(payload["schema"]),
+                payload["partition_start"], payload["partition_end"],
+                payload["engine"], payload["flushed_op_index"],
+                payload.get("indexes") or [])
+            meta.save(os.path.join(tdir, "tablet-meta.json"))
+
+            entries = [(key, wire.decode_rows(vers))
+                       for key, vers in payload["runs"]]
+            if entries:
+                RunPersistence(os.path.join(tdir, "runs")).save_new(entries)
+            for name, blob in (("intents.bin", payload.get("intents")),
+                               ("retryable.bin", payload.get("retryable"))):
+                if blob is not None:
+                    with open(os.path.join(tdir, name), "wb") as f:
+                        f.write(codec.encode(blob))
+            if payload.get("txn_state") is not None:
+                import json as _json
+
+                with open(os.path.join(tdir, "txn_state.json"), "w") as f:
+                    _json.dump(payload["txn_state"], f)
+
+            log = Log(os.path.join(tdir, "wal"), fsync=self.fsync)
+            for rec in payload["log"]:
+                log.append(LogEntry.from_record(rec))
+            log.sync()
+            log.close()
+
+            cmeta = ConsensusMetadata(
+                os.path.join(tdir, "consensus-meta.json"), self.node_uuid,
+                RaftConfig.from_dict(payload["config"]))
+            cmeta.set_term(payload["term"])
+            cmeta.flush()
+            with self._lock:
+                self.bootstrap_installs += 1
+            # The peer starts while the tablet id is still reserved, so a
+            # racing ts.create_tablet cannot start a second peer on the
+            # same WAL directory in the gap.
+            self._start_peer(
+                TabletMetadata.load(
+                    os.path.join(tdir, "tablet-meta.json")),
+                initial_peers=[])
+        finally:
+            with self._lock:
+                self._creating.discard(tablet_id)
 
     def delete_tablet(self, tablet_id: str) -> None:
         with self._lock:

@@ -41,12 +41,18 @@ class TabletServer:
         from yugabyte_db_tpu.tserver.txn_service import (TxnNotifier,
                                                          TxnRpcRouter)
 
+        import threading as _threading
+
         self.mesh_scan = MeshScanService()
         self.txn_router = TxnRpcRouter(transport, master_uuids)
         self.txn_notifier = TxnNotifier(self, self.txn_router)
+        self._rb_lock = _threading.Lock()
+        self._rb_in_flight: set[str] = set()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
+        self.tablet_manager.bootstrap_notifier = \
+            self._request_remote_bootstrap
         self.tablet_manager.open_existing()
         self.heartbeater.start()
         self.txn_notifier.start()
@@ -94,6 +100,82 @@ class TabletServer:
     def _h_ts_delete_tablet(self, p: dict):
         self.tablet_manager.delete_tablet(p["tablet_id"])
         return {"code": "ok"}
+
+    # -- remote bootstrap -----------------------------------------------------
+    def _request_remote_bootstrap(self, tablet_id: str,
+                                  peer_uuid: str) -> None:
+        """Leader side: tell a lagging peer to re-seed itself from us
+        (reference: the StartRemoteBootstrap RPC the leader's consensus
+        queue fires, consensus_queue.cc -> remote_bootstrap_service.cc)."""
+        try:
+            self.transport.send(peer_uuid, "ts.start_remote_bootstrap", {
+                "tablet_id": tablet_id, "source": self.uuid,
+            }, timeout=5.0)
+        except Exception:  # noqa: BLE001 — retried by the next trigger
+            pass
+
+    def _h_ts_start_remote_bootstrap(self, p: dict):
+        import threading as _threading
+
+        tid = p["tablet_id"]
+        with self._rb_lock:
+            if tid in self._rb_in_flight:
+                return {"code": "ok", "detail": "already running"}
+            self._rb_in_flight.add(tid)
+
+        def run():
+            try:
+                resp = self.transport.send(
+                    p["source"], "ts.rb_snapshot", {"tablet_id": tid},
+                    timeout=60.0)
+                if resp.get("code") == "ok":
+                    self.tablet_manager.install_snapshot(tid,
+                                                         resp["payload"])
+            except Exception:  # noqa: BLE001 — leader re-triggers
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "remote bootstrap of %s from %s failed", tid,
+                    p["source"])
+            finally:
+                with self._rb_lock:
+                    self._rb_in_flight.discard(tid)
+
+        _threading.Thread(target=run, daemon=True,
+                          name=f"rb-{tid[:12]}").start()
+        return {"code": "ok"}
+
+    def _h_ts_rb_snapshot(self, p: dict):
+        """Source side of a remote-bootstrap session: flush (so the runs
+        capture everything and the log tail is short), then ship runs +
+        sidecars + log tail + consensus metadata
+        (remote_bootstrap_session.cc)."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if not (peer.raft.is_leader() and peer.raft.leader_ready()):
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        snap = peer.snapshot_for_bootstrap()
+        t = peer.tablet
+        payload = {
+            "table_name": t.meta.table_name,
+            "schema": t.meta.schema.to_dict(),
+            "partition_start": t.meta.partition_start,
+            "partition_end": t.meta.partition_end,
+            "engine": t.meta.engine,
+            "flushed_op_index": snap["flushed_op_index"],
+            "indexes": t.meta.indexes,
+            "runs": [[key, wire.encode_rows(vers)]
+                     for key, vers in snap["entries"]],
+            "intents": t.participant.dump(),
+            "retryable": t.retryable.dump(),
+            "txn_state": (t.coordinator.dump()
+                          if t.coordinator is not None else None),
+        }
+        payload.update(snap["tail"])
+        return {"code": "ok", "payload": payload}
 
     def _h_ts_set_indexes(self, p: dict):
         """Install the base table's current index set on one tablet (the
@@ -199,7 +281,9 @@ class TabletServer:
                 conflicting = peer.tablet.participant.pending_on_keys(keys)
                 if not conflicting:
                     try:
-                        ht = peer.write(rows, timeout=p.get("timeout", 10.0))
+                        ht = peer.write(rows, timeout=p.get("timeout", 10.0),
+                                        client_id=p.get("client_id"),
+                                        request_id=p.get("request_id"))
                     except NotLeader as e:
                         return {"code": "not_leader",
                                 "leader_hint": e.leader_hint}

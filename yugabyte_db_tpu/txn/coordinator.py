@@ -67,9 +67,15 @@ class TransactionCoordinator:
             with open(self.path) as f:
                 self.txns = json.load(f)
 
-    def snapshot(self) -> None:
+    def dump(self) -> dict:
         with self._lock:
-            d = dict(self.txns)
+            return {k: {**v,
+                        "participants": [list(p) for p in v["participants"]],
+                        "unacked": [list(u) for u in v["unacked"]]}
+                    for k, v in self.txns.items()}
+
+    def snapshot(self) -> None:
+        d = self.dump()
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(d, f)

@@ -68,13 +68,11 @@ class TransactionParticipant:
             self._add_locked(txn_id, rec["status_tablet"], rec["priority"],
                              rec["read_ht"], rows)
 
-    def snapshot(self) -> None:
-        """Durably snapshot current intents (called under the tablet's
-        write lock by flush(), before the WAL frontier advances)."""
-        from yugabyte_db_tpu.utils import codec
-
+    def dump(self) -> dict:
+        """Serializable snapshot of every txn's intents (sidecar format,
+        also the remote-bootstrap payload)."""
         with self._lock:
-            d = {
+            return {
                 txn_id: {
                     "rows": encode_rows(rec["rows"]),
                     "status_tablet": rec["status_tablet"],
@@ -83,6 +81,13 @@ class TransactionParticipant:
                 }
                 for txn_id, rec in self.txns.items()
             }
+
+    def snapshot(self) -> None:
+        """Durably snapshot current intents (called under the tablet's
+        write lock by flush(), before the WAL frontier advances)."""
+        from yugabyte_db_tpu.utils import codec
+
+        d = self.dump()
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(codec.encode(d))
