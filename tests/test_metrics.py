@@ -1,0 +1,97 @@
+"""Metrics/flags/webserver: /metrics scrapeable on every daemon.
+
+Reference analog: metrics-test.cc + the PrometheusWriter endpoint
+(src/yb/util/metrics.h:584) and the per-daemon webservers.
+"""
+
+import json
+import urllib.request
+
+from yugabyte_db_tpu.client import YBSession
+from yugabyte_db_tpu.integration import MiniCluster
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
+from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.metrics import MetricRegistry
+
+COLUMNS = [
+    ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+    ColumnSchema("v", DataType.INT64),
+]
+
+
+def _get(addr, path):
+    host, port = addr
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=10) as r:
+        return r.read().decode()
+
+
+def test_registry_prometheus_text():
+    reg = MetricRegistry()
+    ent = reg.entity(daemon="x")
+    ent.counter("reqs_total").increment(3)
+    ent.gauge("temp").set(42)
+    h = ent.histogram("lat_us")
+    for v in (100, 1000, 100000):
+        h.observe(v)
+    text = reg.prometheus_text()
+    assert '# TYPE reqs_total counter' in text
+    assert 'reqs_total{daemon="x"} 3' in text
+    assert 'temp{daemon="x"} 42' in text
+    assert 'lat_us_count{daemon="x"} 3' in text
+    assert 'lat_us_sum{daemon="x"} 101100' in text
+    assert 'le="+Inf"' in text
+    assert h.percentile(0.5) >= 100
+
+
+def test_flags_registry():
+    FLAGS.define("test_only_flag", 7, "testing", ("runtime",))
+    assert FLAGS.get("test_only_flag") == 7
+    FLAGS.set("test_only_flag", 9)
+    assert FLAGS.get("test_only_flag") == 9
+    FLAGS.define("test_unsafe_flag", 1, "danger", ("unsafe",))
+    import pytest
+    with pytest.raises(PermissionError):
+        FLAGS.set("test_unsafe_flag", 2)
+    FLAGS.set("test_unsafe_flag", 2, force=True)
+    assert FLAGS.get("test_unsafe_flag") == 2
+
+
+def test_every_daemon_scrapeable(tmp_path):
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3).start()
+    try:
+        c.wait_tservers_registered()
+        addrs = c.start_webservers()
+        assert len(addrs) == 4
+        client = c.client()
+        table = client.create_table("m", COLUMNS, num_tablets=2)
+        s = YBSession(client)
+        for i in range(20):
+            s.insert(table, {"k": f"k{i}", "v": i})
+        s.flush()
+        s.scan(table, ScanSpec(projection=["k"]))
+        for uuid, addr in addrs.items():
+            text = _get(addr, "/metrics")
+            assert "rpc_requests_total" in text, uuid
+            assert "rpc_latency_us_bucket" in text, uuid
+            health = json.loads(_get(addr, "/healthz"))
+            assert health["status"] == "ok"
+            varz = json.loads(_get(addr, "/varz"))
+            assert "compaction_trigger" in varz
+        # tserver tablet gauges + master catalog gauges present
+        ts_uuid = next(u for u in addrs if u in c.tservers)
+        ts_text = _get(addrs[ts_uuid], "/metrics")
+        assert "tablet_is_leader" in ts_text
+        assert "tablet_run_versions" in ts_text
+        tablets = json.loads(_get(addrs[ts_uuid], "/tablets"))
+        assert any(t["table"] == "m" for t in tablets)
+        m_uuid = next(u for u in addrs if u in c.masters)
+        m_text = _get(addrs[m_uuid], "/metrics")
+        assert "master_is_leader" in m_text
+        assert "master_num_tablets" in m_text
+        tables = json.loads(_get(addrs[m_uuid], "/tables"))
+        assert any(t["name"] == "m" for t in tables)
+    finally:
+        c.shutdown()

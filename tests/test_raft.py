@@ -204,7 +204,18 @@ def test_rf1_instant_leadership(tmp_path):
     g = Group(tmp_path, n=1)
     try:
         leader = g.leader()
-        leader.write([g.row("solo", 1)])
+        # Writes are accepted once the own-term no-op applies
+        # (leader_ready) — the exactly-once dedup registry completeness
+        # guarantee; briefly rejected writes surface as NotLeader, which
+        # cluster clients retry.
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                leader.write([g.row("solo", 1)])
+                break
+            except NotLeader:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
         assert len(g.read_all(leader)) == 1
     finally:
         g.shutdown()

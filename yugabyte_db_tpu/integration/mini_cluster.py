@@ -130,6 +130,17 @@ class MiniCluster:
             return YBClient(self.transport.bind(name), self.master_uuids)
         return YBClient(self.transport, self.master_uuids)
 
+    def start_webservers(self) -> dict:
+        """Start an embedded HTTP server (metrics/varz/tablets) on every
+        daemon; returns {uuid: (host, port)}."""
+        addrs = {}
+        for uuid, m in self.masters.items():
+            addrs[uuid] = m.start_webserver()
+        for uuid, ts in self.tservers.items():
+            addrs[uuid] = ts.start_webserver()
+        self.web_addrs = addrs
+        return addrs
+
     def start_cql_server(self, host: str = "127.0.0.1", port: int = 0,
                          **cluster_kwargs):
         """Start a CQL native-protocol proxy over this cluster (the

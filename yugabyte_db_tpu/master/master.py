@@ -37,7 +37,15 @@ class Master:
                  ts_unresponsive_timeout_s: float = 5.0,
                  balance_interval_s: float = 1.0,
                  missing_replica_grace_s: float = 10.0,
-                 advertised_addr=None):
+                 advertised_addr=None, options=None):
+        # Structured options (server.options.MasterOptions) override the
+        # loose kwargs when provided.
+        if options is not None:
+            fsync = options.fsync
+            ts_unresponsive_timeout_s = options.resolved_ts_timeout()
+            balance_interval_s = options.balance_interval_s
+            missing_replica_grace_s = options.missing_replica_grace_s
+        self.options = options
         self.uuid = uuid
         self.transport = transport
         self.advertised_addr = advertised_addr
@@ -69,10 +77,26 @@ class Master:
         # (tablet_id, replica) -> first time a live tserver's heartbeat was
         # seen not reporting a replica the catalog assigns to it.
         self._missing_seen: dict[tuple[str, str], float] = {}
+        from yugabyte_db_tpu.utils.metrics import MetricRegistry
+
+        self.metrics = MetricRegistry()
+        self._rpc_entities: dict = {}
+        ent = self.metrics.entity(daemon="master", uuid=uuid)
+        ent.gauge("master_is_leader", lambda: int(self.is_leader()))
+        ent.gauge("master_num_tables",
+                  lambda: len(self.catalog.list_tables()))
+        ent.gauge("master_num_tablets",
+                  lambda: len(self.catalog.known_tablet_ids()))
+        ent.gauge("master_live_tservers",
+                  lambda: len(self.ts_manager.live_tservers()))
+        self.webserver = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._running = True
+        if self.options is not None and self.options.webserver:
+            self.start_webserver(self.options.webserver_host,
+                                 self.options.webserver_port)
         self.raft.start()
         self._balancer_thread = threading.Thread(
             target=self._balancer_loop, name=f"balancer-{self.uuid}",
@@ -81,6 +105,8 @@ class Master:
 
     def shutdown(self) -> None:
         self._running = False
+        if self.webserver is not None:
+            self.webserver.stop()
         self.raft.shutdown()
         if self._balancer_thread is not None:
             self._balancer_thread.join(timeout=5.0)
@@ -93,8 +119,42 @@ class Master:
         if entry.op_type == "catalog":
             self.catalog.apply(entry.body)
 
+    def start_webserver(self, host: str = "127.0.0.1", port: int = 0):
+        from yugabyte_db_tpu.server.webserver import Webserver
+
+        self.webserver = Webserver(self.metrics, f"master-{self.uuid}")
+        self.webserver.add_json_handler("/tables", lambda: [
+            {"table_id": t.table_id, "name": t.name, "state": t.state,
+             "num_tablets": t.num_tablets,
+             "indexes": [i["name"] for i in t.indexes]}
+            for t in self.catalog.list_tables()])
+        self.webserver.add_json_handler("/tablets", lambda: [
+            {"tablet_id": i.tablet_id, "table_id": i.table_id,
+             "replicas": i.replicas,
+             "leader": self.ts_manager.leader_of(i.tablet_id)}
+            for t in self.catalog.list_tables()
+            for i in self.catalog.tablets_of(t.table_id)])
+        return self.webserver.start(host, port)
+
+    def _rpc_entity(self, method: str):
+        ent = self._rpc_entities.get(method)
+        if ent is None:
+            ent = self.metrics.entity(daemon="master", uuid=self.uuid,
+                                      method=method)
+            self._rpc_entities[method] = ent
+        return ent
+
     # -- rpc dispatch --------------------------------------------------------
     def handle(self, method: str, payload: dict):
+        start = time.monotonic()
+        try:
+            return self._dispatch(method, payload)
+        finally:
+            ent = self._rpc_entity(method)
+            ent.counter("rpc_requests_total").increment()
+            ent.histogram("rpc_latency_us").observe_duration_us(start)
+
+    def _dispatch(self, method: str, payload: dict):
         if method.startswith("raft."):
             return self.raft.handle(method, payload)
         handler = getattr(self, "_h_" + method.replace(".", "_"), None)

@@ -23,7 +23,12 @@ class TSDescriptor:
 
 
 class TSManager:
-    def __init__(self, unresponsive_timeout_s: float = 5.0):
+    def __init__(self, unresponsive_timeout_s: float | None = None):
+        if unresponsive_timeout_s is None:
+            from yugabyte_db_tpu.utils.flags import FLAGS
+
+            unresponsive_timeout_s = FLAGS.get(
+                "follower_unavailable_considered_failed_sec")
         self._lock = threading.Lock()
         self._descs: dict[str, TSDescriptor] = {}
         # tablet_id -> (leader uuid, term): freshest leadership seen.

@@ -1,0 +1,14 @@
+"""Shared daemon scaffolding: webserver, options, daemon metrics.
+
+Reference analog: src/yb/server/ — RpcAndWebServerBase (server_base.cc),
+the embedded Webserver with its path handlers (/metrics, /varz,
+/tablets, default-path-handlers.cc), and the structured option objects
+(server_base_options.h) layered over flags.
+"""
+
+from yugabyte_db_tpu.server.options import (MasterOptions, ServerOptions,
+                                            TabletServerOptions)
+from yugabyte_db_tpu.server.webserver import Webserver
+
+__all__ = ["MasterOptions", "ServerOptions", "TabletServerOptions",
+           "Webserver"]

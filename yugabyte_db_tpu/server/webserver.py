@@ -1,0 +1,82 @@
+"""Embedded HTTP server: /metrics (Prometheus), /varz, /healthz, /tablets.
+
+Reference analog: src/yb/server/webserver.cc + the path handlers
+(default-path-handlers.cc, tserver-path-handlers.cc): every daemon
+exposes its metrics registry and flag table over HTTP for scraping and
+debugging.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.metrics import MetricRegistry
+
+
+class Webserver:
+    def __init__(self, registry: MetricRegistry, daemon_name: str = ""):
+        self.registry = registry
+        self.daemon_name = daemon_name
+        self._handlers = {}
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.add_json_handler("/healthz", lambda: {"status": "ok"})
+        self.add_json_handler("/varz", lambda: {
+            f.name: {"value": f.value, "default": f.default,
+                     "help": f.help, "tags": sorted(f.tags)}
+            for f in FLAGS.all()})
+
+    def add_handler(self, path: str, fn, content_type="text/plain"):
+        """fn() -> str served at ``path``."""
+        self._handlers[path] = (fn, content_type)
+
+    def add_json_handler(self, path: str, fn):
+        self.add_handler(path, lambda: json.dumps(fn(), indent=1,
+                                                  default=str),
+                         content_type="application/json")
+
+    def start(self, host: str = "127.0.0.1",
+              port: int = 0) -> tuple[str, int]:
+        ws = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = ws.registry.prometheus_text()
+                    ctype = "text/plain; version=0.0.4"
+                elif path in ws._handlers:
+                    fn, ctype = ws._handlers[path]
+                    try:
+                        body = fn()
+                    except Exception as e:  # noqa: BLE001
+                        self.send_error(500, str(e))
+                        return
+                else:
+                    self.send_error(404)
+                    return
+                data = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"webserver-{self.daemon_name}", daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[:2]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

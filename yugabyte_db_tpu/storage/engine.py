@@ -63,7 +63,10 @@ class StorageEngine(abc.ABC):
         """Universal-compaction trigger: compact when run count reaches the
         threshold (reference: universal style with num_levels=1,
         docdb_rocksdb_util.cc:476-482)."""
-        trigger = self.options.get("compaction_trigger", 4)
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        trigger = self.options.get("compaction_trigger",
+                                   FLAGS.get("compaction_trigger"))
         if self.stats().get("num_runs", 0) >= trigger:
             self.compact(history_cutoff_ht)
             return True

@@ -82,7 +82,10 @@ class TpuStorageEngine(StorageEngine):
     # -- writes ------------------------------------------------------------
     def apply(self, rows: list[RowVersion]) -> None:
         self.memtable.apply(rows)
-        limit = self.options.get("memtable_flush_versions", 1 << 60)
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        limit = self.options.get("memtable_flush_versions",
+                                 FLAGS.get("memtable_flush_versions"))
         if self.memtable.num_versions >= limit:
             self.flush()
             self.maybe_compact()

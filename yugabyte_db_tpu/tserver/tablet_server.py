@@ -28,7 +28,15 @@ class TabletServer:
                  engine_options: dict | None = None,
                  fsync: bool = True,
                  heartbeat_interval_s: float = 0.5,
-                 advertised_addr=None):
+                 advertised_addr=None, options=None):
+        # Structured options (server.options.TabletServerOptions) override
+        # the loose kwargs when provided (reference:
+        # TabletServerOptions over gflags, server_base_options.h).
+        if options is not None:
+            fsync = options.fsync
+            heartbeat_interval_s = options.heartbeat_interval_s
+            engine_options = options.engine_options or engine_options
+        self.options = options
         self.uuid = uuid
         self.transport = transport
         self.advertised_addr = advertised_addr  # (host, port) when on TCP
@@ -48,6 +56,17 @@ class TabletServer:
         self.txn_notifier = TxnNotifier(self, self.txn_router)
         self._rb_lock = _threading.Lock()
         self._rb_in_flight: set[str] = set()
+        # Observability: per-RPC counters/latency + per-tablet gauges
+        # (reference: the protoc-gen-yrpc per-RPC metrics and
+        # tablet_metrics.cc), scraped via the embedded webserver.
+        from yugabyte_db_tpu.utils.metrics import MetricRegistry
+
+        self.metrics = MetricRegistry()
+        self._rpc_entities: dict = {}
+        self._tablet_entities: dict = {}
+        self._collect_lock = _threading.Lock()
+        self.metrics.add_collector(self._collect_tablet_metrics)
+        self.webserver = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -56,8 +75,13 @@ class TabletServer:
         self.tablet_manager.open_existing()
         self.heartbeater.start()
         self.txn_notifier.start()
+        if self.options is not None and self.options.webserver:
+            self.start_webserver(self.options.webserver_host,
+                                 self.options.webserver_port)
 
     def shutdown(self) -> None:
+        if self.webserver is not None:
+            self.webserver.stop()
         self.txn_notifier.stop()
         self.heartbeater.stop()
         self.tablet_manager.shutdown()
@@ -69,8 +93,74 @@ class TabletServer:
             except Exception:  # noqa: BLE001 — deletion retried next beat
                 pass
 
+    def start_webserver(self, host: str = "127.0.0.1", port: int = 0):
+        """Expose /metrics, /varz, /healthz, /tablets over HTTP
+        (reference: RpcAndWebServerBase, tserver-path-handlers.cc)."""
+        from yugabyte_db_tpu.server.webserver import Webserver
+
+        self.webserver = Webserver(self.metrics, f"tserver-{self.uuid}")
+        self.webserver.add_json_handler("/tablets", lambda: [
+            {"tablet_id": p.tablet_id,
+             "table": p.tablet.meta.table_name,
+             "leader": p.is_leader(),
+             **{k: v for k, v in p.stats().items()
+                if not isinstance(v, dict)}}
+            for p in self.tablet_manager.peers()])
+        return self.webserver.start(host, port)
+
+    def _rpc_entity(self, method: str):
+        ent = self._rpc_entities.get(method)
+        if ent is None:
+            ent = self.metrics.entity(daemon="tserver", uuid=self.uuid,
+                                      method=method)
+            self._rpc_entities[method] = ent
+        return ent
+
+    def _collect_tablet_metrics(self) -> None:
+        """Pre-scrape sync of per-tablet gauge entities with live peers.
+        Serialized (concurrent scrapes would race entity registration)
+        and snapshot-style: each tablet's stats dicts are built ONCE and
+        the plain values stored, instead of callback fan-out re-taking
+        the consensus lock per gauge."""
+        with self._collect_lock:
+            live = {p.tablet_id: p for p in self.tablet_manager.peers()}
+            for tid in list(self._tablet_entities):
+                if tid not in live:
+                    self.metrics.remove_entity(
+                        self._tablet_entities.pop(tid))
+            for tid, peer in live.items():
+                ent = self._tablet_entities.get(tid)
+                if ent is None:
+                    ent = self.metrics.entity(
+                        daemon="tserver", uuid=self.uuid, tablet_id=tid)
+                    self._tablet_entities[tid] = ent
+                rs = peer.raft.stats()
+                es = peer.tablet.engine.stats()
+                ent.gauge("tablet_is_leader").set(
+                    int(rs["role"] == "LEADER"))
+                ent.gauge("tablet_last_index").set(rs["last_index"])
+                ent.gauge("tablet_commit_index").set(rs["commit_index"])
+                ent.gauge("tablet_run_versions").set(
+                    es.get("run_versions", 0))
+                ent.gauge("tablet_memtable_versions").set(
+                    es.get("memtable_versions", 0))
+                ent.gauge("tablet_num_runs").set(es.get("num_runs", 0))
+                ent.gauge("tablet_intent_txns").set(
+                    peer.tablet.participant.stats()["txns_with_intents"])
+
     # -- rpc dispatch --------------------------------------------------------
     def handle(self, method: str, payload: dict):
+        import time as _time
+
+        start = _time.monotonic()
+        try:
+            return self._dispatch(method, payload)
+        finally:
+            ent = self._rpc_entity(method)
+            ent.counter("rpc_requests_total").increment()
+            ent.histogram("rpc_latency_us").observe_duration_us(start)
+
+    def _dispatch(self, method: str, payload: dict):
         if method.startswith("raft."):
             try:
                 peer = self.tablet_manager.get(payload["tablet_id"])
@@ -303,15 +393,16 @@ class TabletServer:
         wait until every in-flight write below it resolves (reference:
         MvccManager::SafeTime wait in Tablet::DoHandleQLReadRequest).
         Returns an error response dict, or None on success."""
-        from yugabyte_db_tpu.utils.hybrid_time import (
-            BITS_FOR_LOGICAL, MAX_CLOCK_SKEW_US, HybridTime)
+        from yugabyte_db_tpu.utils.flags import FLAGS
+        from yugabyte_db_tpu.utils.hybrid_time import (BITS_FOR_LOGICAL,
+                                                       HybridTime)
         # Never let a client-supplied read point ratchet the clock
         # beyond the skew bound — an arbitrary far-future read_ht would
         # poison every subsequent write HT on this tablet. (Logical
         # clocks in tests have no wall-clock skew semantics: no bound.)
         bound_fn = getattr(peer.tablet.clock, "max_global_now", None)
         if bound_fn is not None and read_ht > bound_fn().value + (
-                MAX_CLOCK_SKEW_US << BITS_FOR_LOGICAL):
+                FLAGS.get("max_clock_skew_us") << BITS_FOR_LOGICAL):
             return {"code": "invalid_read_time"}
         peer.tablet.clock.update(HybridTime(read_ht))
         # Default below the client's 5s per-attempt transport timeout

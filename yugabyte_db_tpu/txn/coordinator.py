@@ -31,14 +31,15 @@ TXN_STATUS_TABLE = "sys.transactions"
 
 # Txns whose client stops heartbeating are presumed dead and aborted by
 # the coordinator so conflicting writers / waiting readers make progress
-# (reference: FLAGS_transaction_check_interval_ms + expiration).
-DEFAULT_EXPIRY_S = 10.0
+# (reference: FLAGS_transaction_check_interval_ms + expiration). The
+# live default comes from the txn_expiry_s runtime flag.
+DEFAULT_EXPIRY_S = None
 
 
 class TransactionCoordinator:
     """State machine + notifier for one status tablet."""
 
-    def __init__(self, tablet_dir: str, expiry_s: float = DEFAULT_EXPIRY_S):
+    def __init__(self, tablet_dir: str, expiry_s: float | None = None):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # Leader-side soft state: commits whose Raft entry is in flight.
@@ -47,6 +48,10 @@ class TransactionCoordinator:
         # read time, breaking the "pending means any future commit lands
         # above your read time" promise.
         self._committing: dict[str, int] = {}
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        if expiry_s is None:
+            expiry_s = FLAGS.get("txn_expiry_s")
         self.path = os.path.join(tablet_dir, "txn_state.json")
         # txn_id -> local time its record became fully applied (soft
         # state driving the replicated GC after the retention window).

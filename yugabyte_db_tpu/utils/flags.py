@@ -1,0 +1,84 @@
+"""Flags: a typed runtime-settable configuration registry with tags.
+
+Reference analog: the gflags + flag-tags system (src/yb/util/flag_tags.h
+— stable/evolving/advanced/unsafe/runtime) and the SetFlag RPC of
+GenericService (src/yb/server/generic_service.cc). Flags tagged
+``runtime`` may change on a live process; ``unsafe`` flags require
+explicit unlocking, mirroring --unlock_unsafe_flags.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+VALID_TAGS = {"stable", "evolving", "advanced", "runtime", "unsafe",
+              "hidden"}
+
+
+@dataclass
+class FlagInfo:
+    name: str
+    default: object
+    help: str
+    tags: frozenset = frozenset()
+    value: object = None
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flags: dict[str, FlagInfo] = {}
+        self.unsafe_unlocked = False
+
+    def define(self, name: str, default, help_: str = "",
+               tags=()) -> None:
+        tags = frozenset(tags)
+        bad = tags - VALID_TAGS
+        if bad:
+            raise ValueError(f"unknown flag tags {sorted(bad)}")
+        with self._lock:
+            if name in self._flags:
+                return  # idempotent re-import
+            self._flags[name] = FlagInfo(name, default, help_, tags,
+                                         default)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._flags[name].value
+
+    def set(self, name: str, value, force: bool = False) -> None:
+        with self._lock:
+            f = self._flags[name]
+            if "unsafe" in f.tags and not (self.unsafe_unlocked or force):
+                raise PermissionError(
+                    f"flag {name} is tagged unsafe; unlock unsafe flags "
+                    "first")
+            if not isinstance(value, type(f.default)) and \
+                    f.default is not None:
+                value = type(f.default)(value)
+            f.value = value
+
+    def all(self) -> list[FlagInfo]:
+        with self._lock:
+            return [FlagInfo(f.name, f.default, f.help, f.tags, f.value)
+                    for f in self._flags.values()]
+
+
+FLAGS = FlagRegistry()
+
+# Core flags (grown as subsystems adopt them).
+FLAGS.define("memtable_flush_versions", 1 << 60,
+             "versions buffered before an automatic flush",
+             ("stable", "runtime"))
+FLAGS.define("compaction_trigger", 4,
+             "sorted-run count triggering universal compaction",
+             ("stable", "runtime"))
+FLAGS.define("txn_expiry_s", 10.0,
+             "seconds without heartbeat before a txn is auto-aborted",
+             ("evolving", "runtime"))
+FLAGS.define("max_clock_skew_us", 500_000,
+             "bound on tolerated inter-node clock skew",
+             ("stable",))
+FLAGS.define("follower_unavailable_considered_failed_sec", 5.0,
+             "tserver liveness timeout", ("stable",))

@@ -1,0 +1,211 @@
+"""Metrics: counters, gauges, histograms + Prometheus text exposition.
+
+Reference analog: src/yb/util/metrics.h — MetricRegistry/MetricEntity
+with METRIC_DEFINE_* metrics attached to entities (server, tablet), HDR
+histograms for latencies, and the PrometheusWriter (metrics.h:584) that
+renders the registry for scraping.
+
+Shapes:
+- Counter: monotonically increasing int.
+- Gauge: set() directly, or constructed with a callback sampled at
+  scrape time (how per-tablet row counts surface without bookkeeping).
+- Histogram: exponential buckets (powers of 2 in microseconds by
+  default) with count/sum — the Prometheus histogram contract; covers
+  the reference's HDR-histogram latency use.
+
+Entities carry label sets (e.g. tablet_id); the registry renders
+everything in one pass, grouping series by metric name.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, by: int = 1) -> None:
+        with self._lock:
+            self.value += by
+
+    def get(self) -> int:
+        return self.value
+
+
+class Gauge:
+    __slots__ = ("_value", "_fn")
+
+    def __init__(self, fn=None):
+        self._value = 0
+        self._fn = fn
+
+    def set(self, v) -> None:
+        self._value = v
+
+    def get(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # noqa: BLE001 — scrape must not die
+                return 0
+        return self._value
+
+
+# Exponential bucket bounds (microseconds): 64us .. ~67s
+DEFAULT_BUCKETS = tuple(64 * (2 ** i) for i in range(21))
+
+
+class Histogram:
+    __slots__ = ("buckets", "counts", "count", "sum", "_lock")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.count = 0
+        self.sum = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v) -> None:
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += v
+
+    def observe_duration_us(self, start_monotonic: float) -> None:
+        self.observe(int((time.monotonic() - start_monotonic) * 1e6))
+
+    def percentile(self, p: float):
+        """Approximate percentile from bucket upper bounds."""
+        with self._lock:
+            if self.count == 0:
+                return 0
+            target = self.count * p
+            acc = 0
+            for i, n in enumerate(self.counts):
+                acc += n
+                if acc >= target:
+                    return (self.buckets[i] if i < len(self.buckets)
+                            else self.buckets[-1])
+            return self.buckets[-1]
+
+
+class MetricEntity:
+    """One labeled owner of metrics (server / tablet / table)."""
+
+    def __init__(self, registry: "MetricRegistry", labels: dict):
+        self.registry = registry
+        self.labels = dict(labels)
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str, fn=None) -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Gauge(fn)
+            elif fn is not None:
+                m._fn = fn
+            return m
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def _get(self, name, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls()
+            return m
+
+
+class MetricRegistry:
+    """All of one process's metrics; renders Prometheus text."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entities: list[MetricEntity] = []
+        self._collectors: list = []  # callables refreshing gauges pre-scrape
+
+    def entity(self, **labels) -> MetricEntity:
+        e = MetricEntity(self, labels)
+        with self._lock:
+            self._entities.append(e)
+        return e
+
+    def remove_entity(self, entity: MetricEntity) -> None:
+        with self._lock:
+            try:
+                self._entities.remove(entity)
+            except ValueError:
+                pass
+
+    def add_collector(self, fn) -> None:
+        """fn() runs before each scrape (register/refresh dynamic
+        entities, e.g. per-tablet gauges after tablets move)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — scrape must not die
+                pass
+        with self._lock:
+            entities = list(self._entities)
+        by_name: dict[str, list] = {}
+        for e in entities:
+            with e._lock:
+                metrics = dict(e._metrics)
+            for name, m in metrics.items():
+                by_name.setdefault(name, []).append((e.labels, m))
+        out = []
+        for name in sorted(by_name):
+            series = by_name[name]
+            kind = ("counter" if isinstance(series[0][1], Counter)
+                    else "histogram" if isinstance(series[0][1], Histogram)
+                    else "gauge")
+            out.append(f"# TYPE {name} {kind}")
+            for labels, m in series:
+                ls = _labels(labels)
+                if isinstance(m, Histogram):
+                    with m._lock:
+                        counts = list(m.counts)
+                        total, s = m.count, m.sum
+                    acc = 0
+                    for i, b in enumerate(m.buckets):
+                        acc += counts[i]
+                        out.append(
+                            f"{name}_bucket{_labels(labels, le=b)} {acc}")
+                    out.append(
+                        f'{name}_bucket{_labels(labels, le="+Inf")} {total}')
+                    out.append(f"{name}_sum{ls} {s}")
+                    out.append(f"{name}_count{ls} {total}")
+                else:
+                    out.append(f"{name}{ls} {m.get()}")
+        return "\n".join(out) + "\n"
+
+
+def _labels(labels: dict, **extra) -> str:
+    items = {**labels, **extra}
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+    return "{" + inner + "}"
