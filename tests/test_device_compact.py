@@ -1,0 +1,134 @@
+"""Device compaction (ops.compact) vs the host merge: identical output.
+
+Pins the device lexsort + vectorized history GC to
+CpuStorageEngine._gc_versions / merge_entry_streams semantics —
+BASELINE config 4's correctness contract (byte-identical results).
+"""
+
+import random
+
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import ScanSpec, make_engine
+from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+
+
+def _mk_engines(rows_per_block=64):
+    schema = Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("a", DataType.INT64),
+        ColumnSchema("b", DataType.STRING),
+        ColumnSchema("c", DataType.DOUBLE),
+    ], table_id="dc")
+    opts = {"rows_per_block": rows_per_block}
+    return (schema, make_engine("cpu", schema, opts),
+            make_engine("tpu", schema, opts))
+
+
+def _random_load(schema, engines, num_keys=300, writes=1200, seed=5,
+                 flushes=4):
+    rng = random.Random(seed)
+    cid = {c.name: c.col_id for c in schema.columns}
+    ht = 10
+    for w in range(writes):
+        i = rng.randrange(num_keys)
+        key = schema.encode_primary_key(
+            {"k": f"u{i:04d}"}, compute_hash_code(schema, {"k": f"u{i:04d}"}))
+        ht += rng.randrange(1, 3)
+        roll = rng.random()
+        if roll < 0.08:
+            rv = RowVersion(key, ht=ht, tombstone=True)
+        elif roll < 0.16:
+            rv = RowVersion(key, ht=ht, liveness=True,
+                            columns={cid["a"]: rng.randrange(100)},
+                            expire_ht=ht + rng.randrange(1, 50))
+        else:
+            cols = {}
+            if rng.random() < 0.8:
+                cols[cid["a"]] = rng.randrange(10**9)
+            if rng.random() < 0.5:
+                cols[cid["b"]] = rng.choice(["x", "yy", None])
+            if rng.random() < 0.4:
+                cols[cid["c"]] = rng.uniform(-5, 5)
+            rv = RowVersion(key, ht=ht, liveness=rng.random() < 0.5,
+                            columns=cols)
+        for e in engines:
+            e.apply([rv])
+        if w and w % (writes // flushes) == 0:
+            for e in engines:
+                e.flush()
+    for e in engines:
+        e.flush()
+    return ht
+
+
+def _entries_signature(engine):
+    out = []
+    for key, versions in engine.dump_entries():
+        out.append((key, [(v.ht, v.tombstone, v.liveness,
+                           tuple(sorted(v.columns.items(),
+                                        key=lambda kv: kv[0])),
+                           v.expire_ht)
+                          for v in versions]))
+    return out
+
+
+@pytest.mark.parametrize("cutoff_frac", [0.0, 0.5, 1.0])
+def test_device_compact_identical(cutoff_frac):
+    schema, cpu, tpu = _mk_engines()
+    ht = _random_load(schema, (cpu, tpu))
+    cutoff = int(ht * cutoff_frac)
+    assert all(t.crun.max_key_len <= 32 for t in tpu.runs)
+    cpu.compact(cutoff)
+    tpu.compact(cutoff)
+    assert _entries_signature(cpu) == _entries_signature(tpu)
+    # post-compaction reads agree at several read points
+    for read_ht in (cutoff or 1, ht // 2 + cutoff // 2, ht + 1):
+        if read_ht < cutoff:
+            continue
+        a = cpu.scan(ScanSpec(read_ht=read_ht))
+        b = tpu.scan(ScanSpec(read_ht=read_ht))
+        assert a.rows == b.rows, read_ht
+
+
+def test_device_compact_repeated_and_ttl():
+    schema, cpu, tpu = _mk_engines(rows_per_block=32)
+    ht = _random_load(schema, (cpu, tpu), num_keys=80, writes=600, seed=9)
+    for cutoff in (ht // 4, ht // 2, ht):
+        cpu.compact(cutoff)
+        tpu.compact(cutoff)
+        assert _entries_signature(cpu) == _entries_signature(tpu), cutoff
+    a = cpu.scan(ScanSpec(read_ht=ht + 1))
+    b = tpu.scan(ScanSpec(read_ht=ht + 1))
+    assert a.rows == b.rows
+
+
+def test_long_keys_fall_back_to_host():
+    schema = Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("v", DataType.INT64),
+    ], table_id="lk")
+    cpu = make_engine("cpu", schema)
+    tpu = make_engine("tpu", schema)
+    cid = {c.name: c.col_id for c in schema.columns}
+    ht = 0
+    for i in range(40):
+        name = f"very-long-key-{'x' * 40}-{i:03d}"
+        key = schema.encode_primary_key(
+            {"k": name}, compute_hash_code(schema, {"k": name}))
+        ht += 1
+        rv = RowVersion(key, ht=ht, liveness=True, columns={cid["v"]: i})
+        cpu.apply([rv])
+        tpu.apply([rv])
+        if i % 13 == 12:
+            cpu.flush()
+            tpu.flush()
+    cpu.flush()
+    tpu.flush()
+    assert any(t.crun.max_key_len > 32 for t in tpu.runs)
+    cpu.compact(ht)
+    tpu.compact(ht)
+    assert _entries_signature(cpu) == _entries_signature(tpu)

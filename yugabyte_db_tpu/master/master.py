@@ -34,7 +34,7 @@ class Master:
                  master_uuids: list[str],
                  raft_opts: RaftOptions | None = None,
                  fsync: bool = True,
-                 ts_unresponsive_timeout_s: float = 5.0,
+                 ts_unresponsive_timeout_s: float | None = None,
                  balance_interval_s: float = 1.0,
                  missing_replica_grace_s: float = 10.0,
                  advertised_addr=None, options=None):
@@ -81,6 +81,7 @@ class Master:
 
         self.metrics = MetricRegistry()
         self._rpc_entities: dict = {}
+        self._rpc_lock = threading.Lock()
         ent = self.metrics.entity(daemon="master", uuid=uuid)
         ent.gauge("master_is_leader", lambda: int(self.is_leader()))
         ent.gauge("master_num_tables",
@@ -139,9 +140,13 @@ class Master:
     def _rpc_entity(self, method: str):
         ent = self._rpc_entities.get(method)
         if ent is None:
-            ent = self.metrics.entity(daemon="master", uuid=self.uuid,
-                                      method=method)
-            self._rpc_entities[method] = ent
+            with self._rpc_lock:
+                ent = self._rpc_entities.get(method)
+                if ent is None:
+                    ent = self.metrics.entity(daemon="master",
+                                              uuid=self.uuid,
+                                              method=method)
+                    self._rpc_entities[method] = ent
         return ent
 
     # -- rpc dispatch --------------------------------------------------------

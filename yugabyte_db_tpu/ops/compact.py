@@ -1,0 +1,92 @@
+"""Device compaction filter: vectorized history GC over a merged order.
+
+Reference analog: DocDBCompactionFilter inside CompactionJob::Run — the
+per-version retention decision (drop overwritten / TTL-expired /
+history-GC'd versions) made while merging K sorted runs
+(src/yb/rocksdb/db/compaction_job.cc:622,
+src/yb/docdb/docdb_compaction_filter.cc).
+
+Division of labor (measured): XLA's variadic sort compiles catastrophically
+slowly for 10-key lexsorts, while numpy's np.lexsort is vectorized C — so
+the engine computes the merge ORDER host-side (exact whenever keys fit the
+32-byte prefix planes) and this kernel computes the RETENTION MASK over
+the sorted union in one dispatch: visibility at the cutoff, tombstone
+shadowing, per-column/liveness contributors, and equal-hybrid-time span
+propagation — mirroring CpuStorageEngine._gc_versions exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from yugabyte_db_tpu.ops.scan import I32_MAX, le2
+
+
+def _seg_min(vals, gid, n):
+    return jax.ops.segment_min(vals, gid, num_segments=n,
+                               indices_are_sorted=True)
+
+
+def _seg_max(vals, gid, n):
+    return jax.ops.segment_max(vals, gid, num_segments=n,
+                               indices_are_sorted=True)
+
+
+def gc_mask(num_cols: int, N: int, s, cutoff_planes):
+    """Retention mask over the SORTED union (key asc, ht desc).
+
+    ``s`` = {new_group, tomb, live: [N] bool; ht_hi, ht_lo, exp_hi,
+    exp_lo: [N] i32; set_: [num_cols, N] bool}. Returns keep[N] bool.
+    """
+    ht_hi, ht_lo = s["ht_hi"], s["ht_lo"]
+    gid = jnp.cumsum(s["new_group"].astype(jnp.int32)) - 1
+    ridx = jnp.arange(N, dtype=jnp.int32)
+    c_hi, c_lo, ce_hi, ce_lo = cutoff_planes
+
+    # Visibility + tombstone shadowing AT THE CUTOFF.
+    visible = le2(ht_hi, ht_lo, c_hi, c_lo)
+    sentinel = jnp.int32(-2**31)
+    t_hi = _seg_max(jnp.where(visible & s["tomb"], ht_hi, sentinel), gid, N)
+    t_hi_r = t_hi[gid]
+    t_lo = _seg_max(jnp.where(visible & s["tomb"] & (ht_hi == t_hi_r),
+                              ht_lo, sentinel), gid, N)
+    t_lo_r = t_lo[gid]
+    has_tomb = t_hi_r != sentinel
+    shadowed = has_tomb & le2(ht_hi, ht_lo, t_hi_r, t_lo_r)
+    alive = visible & ~s["tomb"] & ~shadowed
+
+    # Contributors at the cutoff: first alive setter per column (expiry
+    # does NOT matter for contribution — an expired value still shadows),
+    # plus the first alive NON-expired liveness.
+    is_contrib = jnp.zeros((N,), jnp.bool_)
+    for c in range(num_cols):
+        set_c = s["set_"][c]
+        first = _seg_min(jnp.where(alive & set_c, ridx, I32_MAX), gid, N)
+        is_contrib = is_contrib | (first[gid] == ridx)
+    expired = le2(s["exp_hi"], s["exp_lo"], ce_hi, ce_lo)
+    lfirst = _seg_min(jnp.where(alive & s["live"] & ~expired, ridx,
+                                I32_MAX), gid, N)
+    is_contrib = is_contrib | (lfirst[gid] == ridx)
+
+    # The CPU GC keys its contributing set by hybrid time: versions
+    # sharing a contributor's ht are kept together. Equal-ht rows of a
+    # group are adjacent in the sorted order — propagate over spans.
+    prev_hi = jnp.concatenate([ht_hi[:1], ht_hi[:-1]])
+    prev_lo = jnp.concatenate([ht_lo[:1], ht_lo[:-1]])
+    new_span = s["new_group"] | (ht_hi != prev_hi) | (ht_lo != prev_lo)
+    sid = jnp.cumsum(new_span.astype(jnp.int32)) - 1
+    span_contrib = jax.ops.segment_max(is_contrib.astype(jnp.int32), sid,
+                                       num_segments=N,
+                                       indices_are_sorted=True)
+    kept_contrib = span_contrib[sid] > 0
+
+    newer = ~visible  # ht > cutoff: always retained
+    return newer | (kept_contrib & ~le2(ht_hi, ht_lo, t_hi_r, t_lo_r))
+
+
+@functools.lru_cache(maxsize=32)
+def compiled_gc_mask(num_cols: int, N: int):
+    return jax.jit(functools.partial(gc_mask, num_cols, N))

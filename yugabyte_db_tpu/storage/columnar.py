@@ -84,6 +84,10 @@ class ColumnarRun:
         # captured by the device prefix planes, making prefix equality
         # EXACT — the device GROUP BY eligibility check for strings.
         self.varlen_max_len: dict[int, int] = {}
+        # Longest encoded key (bytes): keys <= 32 are fully captured by
+        # the KEY_WORDS prefix planes, so plane equality/order is EXACT —
+        # the device compaction eligibility check.
+        self.max_key_len = 0
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -100,6 +104,8 @@ class ColumnarRun:
             n = len(versions)
             if n > run.max_group_versions:
                 run.max_group_versions = n
+            if len(key) > run.max_key_len:
+                run.max_key_len = len(key)
             if n > R:
                 raise ValueError(
                     f"key has {n} versions > rows_per_block={R}; "

@@ -104,24 +104,254 @@ class TpuStorageEngine(StorageEngine):
         self.memtable = MemTable()
 
     def compact(self, history_cutoff_ht: int = 0) -> None:
-        """Merge all runs into one. Host-side k-way merge + shared GC for
-        now; the device sort-merge path (ops.merge) takes over for large
-        runs once wired in."""
-        from yugabyte_db_tpu.storage.cpu_engine import CpuStorageEngine
-        from yugabyte_db_tpu.storage.merge import merge_entry_streams
-
+        """Merge all runs into one, GCing history at the cutoff. The
+        k-way merge ORDER and the GC decisions run as one device dispatch
+        (ops.compact: lexsort by key planes + vectorized retention mask)
+        whenever every key fits the exact 32-byte device prefix; the host
+        then materializes the merged run with a single linear pass. Falls
+        back to the host heap merge otherwise (BASELINE config 4;
+        reference hot loop: CompactionJob::Run,
+        src/yb/rocksdb/db/compaction_job.cc:622)."""
         if len(self.runs) <= 1 and history_cutoff_ht == 0:
             return
-        merged = []
-        for key, versions in merge_entry_streams(
-                [t.crun.iter_entries() for t in self.runs]):
-            kept = CpuStorageEngine._gc_versions(key, versions,
-                                                 history_cutoff_ht)
-            if kept:
-                merged.append((key, kept))
+        result = None
+        if self.runs and all(t.crun.max_key_len <= 32 for t in self.runs) \
+                and sum(t.crun.num_versions for t in self.runs) > 0:
+            result = self._device_compact_entries(history_cutoff_ht)
+        if result is None:
+            from yugabyte_db_tpu.storage.cpu_engine import CpuStorageEngine
+            from yugabyte_db_tpu.storage.merge import merge_entry_streams
+
+            merged = []
+            for key, versions in merge_entry_streams(
+                    [t.crun.iter_entries() for t in self.runs]):
+                kept = CpuStorageEngine._gc_versions(key, versions,
+                                                     history_cutoff_ht)
+                if kept:
+                    merged.append((key, kept))
+            crun = (ColumnarRun.build(self.schema, merged,
+                                      self.rows_per_block)
+                    if merged else None)
+        else:
+            merged, crun = result
         self.persist.replace_all(merged)
-        crun = ColumnarRun.build(self.schema, merged, self.rows_per_block)
         self.runs = [TpuRun(crun)] if merged else []
+
+    def _device_compact_entries(self, cutoff: int):
+        """Device merge+GC -> (entries, merged ColumnarRun), or None when
+        the union is empty. The merged run is assembled by GATHERING the
+        surviving rows' existing planes (numpy) instead of re-encoding
+        every version through ColumnarRun.build — the whole pipeline is
+        vectorized except one linear grouping pass."""
+        from yugabyte_db_tpu.ops import compact as dcompact
+
+        crs = [t.crun for t in self.runs]
+        parts_kw, parts = [], {k: [] for k in
+                               ("ht_hi", "ht_lo", "exp_hi", "exp_lo",
+                                "tomb", "live")}
+        col_ids = [c.col_id for c in self.schema.value_columns]
+        set_parts = {cid: [] for cid in col_ids}
+        null_parts = {cid: [] for cid in col_ids}
+        cmp_parts = {cid: [] for cid in col_ids}
+        arith_parts = {cid: [] for cid in col_ids}
+        varlen_all = {cid: [] for cid in col_ids}
+        all_keys: list[bytes] = []
+        all_vers: list = []
+        all_kvs: list = []
+        for cr in crs:
+            for b in range(cr.B):
+                nv = cr.blocks[b].num_valid
+                if nv == 0:
+                    continue
+                parts_kw.append(cr.key_planes[b, :nv])
+                parts["ht_hi"].append(cr.ht_hi[b, :nv])
+                parts["ht_lo"].append(cr.ht_lo[b, :nv])
+                parts["exp_hi"].append(cr.exp_hi[b, :nv])
+                parts["exp_lo"].append(cr.exp_lo[b, :nv])
+                parts["tomb"].append(cr.tomb[b, :nv])
+                parts["live"].append(cr.live[b, :nv])
+                for cid in col_ids:
+                    col = cr.cols[cid]
+                    set_parts[cid].append(col.set_[b, :nv])
+                    null_parts[cid].append(col.isnull[b, :nv])
+                    cmp_parts[cid].append(col.cmp_planes[b, :nv])
+                    if col.arith is not None:
+                        arith_parts[cid].append(col.arith[b, :nv])
+                    if col.varlen is not None:
+                        varlen_all[cid].extend(col.varlen[b][:nv])
+                all_keys.extend(cr.row_keys[b][:nv])
+                all_vers.extend(cr.row_versions[b][:nv])
+                all_kvs.extend(cr.row_key_vals[b][:nv])
+        if not parts_kw:
+            return None
+        N = len(all_keys)
+        # Pad to a size bucket so the compiled program is reused; pad rows
+        # carry max key planes (sort last) and ht 0 (never kept).
+        Np = 1 << max(10, (N - 1).bit_length())
+        pad = Np - N
+
+        def cat(lst, fill):
+            arr = np.concatenate(lst)
+            if pad:
+                shape = (pad,) + arr.shape[1:]
+                arr = np.concatenate(
+                    [arr, np.full(shape, fill, dtype=arr.dtype)])
+            return arr
+
+        kw = cat(parts_kw, np.iinfo(np.int32).max)
+        ht_hi = cat(parts["ht_hi"], 0)
+        ht_lo = cat(parts["ht_lo"], 0)
+
+        # Merge ORDER host-side: np.lexsort is vectorized C, while XLA's
+        # variadic sort compiles catastrophically slowly (measured); the
+        # retention decisions run on device (ops.compact docstring).
+        perm = np.lexsort(
+            tuple([~ht_lo, ~ht_hi]
+                  + [kw[:, w] for w in range(kw.shape[1] - 1, -1, -1)]))
+        skw = kw[perm]
+        s_ht_hi = ht_hi[perm]
+        s_ht_lo = ht_lo[perm]
+        new_group = np.empty(Np, dtype=bool)
+        new_group[0] = True
+        new_group[1:] = (skw[1:] != skw[:-1]).any(axis=1)
+
+        exp_hi = cat(parts["exp_hi"], 0)
+        exp_lo = cat(parts["exp_lo"], 0)
+        tomb = cat(parts["tomb"], False)
+        live = cat(parts["live"], False)
+        cat_set = {cid: cat(set_parts[cid], False) for cid in col_ids}
+        sorted_union = {
+            "new_group": new_group,
+            "ht_hi": s_ht_hi,
+            "ht_lo": s_ht_lo,
+            "exp_hi": exp_hi[perm],
+            "exp_lo": exp_lo[perm],
+            "tomb": tomb[perm],
+            "live": live[perm],
+            "set_": np.stack([cat_set[cid][perm] for cid in col_ids])
+            if col_ids else np.zeros((0, Np), dtype=bool),
+        }
+        c_hi, c_lo = P.scalar_ht_planes(max(cutoff, 0))
+        cutoff_planes = (jnp.int32(c_hi), jnp.int32(c_lo),
+                         jnp.int32(c_hi), jnp.int32(c_lo))
+        fn = dcompact.compiled_gc_mask(len(col_ids), Np)
+        keep = np.asarray(jax.device_get(fn(sorted_union, cutoff_planes)))
+
+        kept_pos = np.nonzero(keep[:].astype(bool) & (perm < N))[0]
+        kept_src = perm[kept_pos]
+        if kept_src.size == 0:
+            return [], None
+        # Group boundaries among KEPT rows (still key-sorted).
+        gid_sorted = np.cumsum(new_group.astype(np.int64)) - 1
+        kept_gids = gid_sorted[kept_pos]
+        kept_new_group = np.empty(kept_src.size, dtype=bool)
+        kept_new_group[0] = True
+        kept_new_group[1:] = kept_gids[1:] != kept_gids[:-1]
+
+        entries: list[tuple[bytes, list]] = []
+        srcs = kept_src.tolist()
+        starts = kept_new_group.tolist()
+        for oi, is_new in zip(srcs, starts):
+            if is_new:
+                entries.append((all_keys[oi], []))
+            entries[-1][1].append(all_vers[oi])
+
+        planes = {
+            "ht_hi": ht_hi, "ht_lo": ht_lo, "exp_hi": exp_hi,
+            "exp_lo": exp_lo, "tomb": tomb, "live": live,
+            "set": cat_set,
+        }
+        crun = self._gather_run(kept_src, kept_new_group, all_keys,
+                                all_vers, all_kvs, kw, planes, col_ids,
+                                null_parts, cmp_parts, arith_parts,
+                                varlen_all, crs)
+        return entries, crun
+
+    def _gather_run(self, kept_src, kept_new_group, all_keys, all_vers,
+                    all_kvs, kw, planes, col_ids, null_parts, cmp_parts,
+                    arith_parts, varlen_all, crs):
+        """Assemble the merged ColumnarRun by numpy-gathering surviving
+        rows' planes (no per-version re-encoding)."""
+        R = self.rows_per_block
+        nk = kept_src.size
+        # Greedy block packing over group sizes (groups never split).
+        bounds = np.nonzero(kept_new_group)[0].tolist() + [nk]
+        ranges = []  # (kept start, nrows) per block
+        blk_start, fill = 0, 0
+        max_group = 0
+        for gi in range(len(bounds) - 1):
+            gsz = bounds[gi + 1] - bounds[gi]
+            if gsz > R:
+                raise ValueError(
+                    f"key has {gsz} versions > rows_per_block={R}; "
+                    "compact with a history cutoff before flushing this")
+            if gsz > max_group:
+                max_group = gsz
+            if fill + gsz > R and fill > 0:
+                ranges.append((blk_start, fill))
+                blk_start, fill = bounds[gi], 0
+            fill += gsz
+        ranges.append((blk_start, fill))
+
+        run = ColumnarRun(self.schema, R)
+        B = len(ranges)
+        run.B = B
+        run._alloc(B)
+        from yugabyte_db_tpu.storage.columnar import BlockMeta
+
+        cat_null = {cid: np.concatenate(null_parts[cid])
+                    for cid in col_ids}
+        cat_cmp = {cid: np.concatenate(cmp_parts[cid]) for cid in col_ids}
+        cat_set = planes["set"]
+        cat_arith = {cid: (np.concatenate(arith_parts[cid])
+                           if arith_parts[cid] else None)
+                     for cid in col_ids}
+        ht_hi_u = planes["ht_hi"]
+        ht_lo_u = planes["ht_lo"]
+        exp_hi_u = planes["exp_hi"]
+        exp_lo_u = planes["exp_lo"]
+        tomb_u = planes["tomb"]
+        live_u = planes["live"]
+
+        for b, (s0, n) in enumerate(ranges):
+            sel = kept_src[s0:s0 + n]
+            run.key_planes[b, :n] = kw[sel]
+            run.ht_hi[b, :n] = ht_hi_u[sel]
+            run.ht_lo[b, :n] = ht_lo_u[sel]
+            run.exp_hi[b, :n] = exp_hi_u[sel]
+            run.exp_lo[b, :n] = exp_lo_u[sel]
+            run.tomb[b, :n] = tomb_u[sel]
+            run.live[b, :n] = live_u[sel]
+            run.valid[b, :n] = True
+            run.group_start[b, :n] = kept_new_group[s0:s0 + n]
+            for cid in col_ids:
+                col = run.cols[cid]
+                col.set_[b, :n] = cat_set[cid][sel]
+                col.isnull[b, :n] = cat_null[cid][sel]
+                col.cmp_planes[b, :n] = cat_cmp[cid][sel]
+                if col.arith is not None and cat_arith[cid] is not None:
+                    col.arith[b, :n] = cat_arith[cid][sel]
+                if col.varlen is not None:
+                    vl = varlen_all[cid]
+                    col.varlen[b][:n] = [vl[i] for i in sel.tolist()]
+            idxs = sel.tolist()
+            run.row_keys[b][:n] = [all_keys[i] for i in idxs]
+            run.row_versions[b][:n] = [all_vers[i] for i in idxs]
+            run.row_key_vals[b][:n] = [all_kvs[i] for i in idxs]
+            run.blocks[b] = BlockMeta(run.row_keys[b][0],
+                                      run.row_keys[b][n - 1], n)
+        run.min_key = run.row_keys[0][0]
+        run.max_key = run.blocks[B - 1].max_key
+        run.num_versions = nk
+        run.max_ht = int(P.planes_to_u64(ht_hi_u[kept_src],
+                                         ht_lo_u[kept_src]).max())
+        run.max_group_versions = max_group
+        for cr in crs:
+            for cid, ln in cr.varlen_max_len.items():
+                if ln > run.varlen_max_len.get(cid, 0):
+                    run.varlen_max_len[cid] = ln
+            run.max_key_len = max(run.max_key_len, cr.max_key_len)
+        return run
 
     def dump_entries(self):
         """All flushed (key, versions ht-desc) pairs, key-merged across

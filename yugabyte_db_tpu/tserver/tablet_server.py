@@ -55,6 +55,7 @@ class TabletServer:
         self.txn_router = TxnRpcRouter(transport, master_uuids)
         self.txn_notifier = TxnNotifier(self, self.txn_router)
         self._rb_lock = _threading.Lock()
+        self._rpc_lock = _threading.Lock()
         self._rb_in_flight: set[str] = set()
         # Observability: per-RPC counters/latency + per-tablet gauges
         # (reference: the protoc-gen-yrpc per-RPC metrics and
@@ -111,9 +112,13 @@ class TabletServer:
     def _rpc_entity(self, method: str):
         ent = self._rpc_entities.get(method)
         if ent is None:
-            ent = self.metrics.entity(daemon="tserver", uuid=self.uuid,
-                                      method=method)
-            self._rpc_entities[method] = ent
+            with self._rpc_lock:
+                ent = self._rpc_entities.get(method)
+                if ent is None:
+                    ent = self.metrics.entity(daemon="tserver",
+                                              uuid=self.uuid,
+                                              method=method)
+                    self._rpc_entities[method] = ent
         return ent
 
     def _collect_tablet_metrics(self) -> None:

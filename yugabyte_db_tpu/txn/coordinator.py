@@ -31,9 +31,8 @@ TXN_STATUS_TABLE = "sys.transactions"
 
 # Txns whose client stops heartbeating are presumed dead and aborted by
 # the coordinator so conflicting writers / waiting readers make progress
-# (reference: FLAGS_transaction_check_interval_ms + expiration). The
-# live default comes from the txn_expiry_s runtime flag.
-DEFAULT_EXPIRY_S = None
+# (reference: FLAGS_transaction_check_interval_ms + expiration); the
+# default expiry comes from the txn_expiry_s runtime flag.
 
 
 class TransactionCoordinator:
