@@ -97,26 +97,17 @@ class ColumnarRun:
         compaction merge. Packs key groups into blocks without splitting."""
         run = ColumnarRun(schema, rows_per_block)
         R = run.R
-        # Greedy block packing, key groups kept whole.
-        blocks: list[list[tuple[bytes, list[RowVersion]]]] = [[]]
-        fill = 0
         for key, versions in entries:
             n = len(versions)
             if n > run.max_group_versions:
                 run.max_group_versions = n
             if len(key) > run.max_key_len:
                 run.max_key_len = len(key)
-            if n > R:
-                raise ValueError(
-                    f"key has {n} versions > rows_per_block={R}; "
-                    "compact with a history cutoff before flushing this")
-            if fill + n > R:
-                blocks.append([])
-                fill = 0
-            blocks[-1].append((key, versions))
-            fill += n
-        if blocks == [[]]:
-            blocks = []
+        # Greedy block packing, key groups kept whole (shared with the
+        # device-compaction gather path).
+        ranges = ColumnarRun.pack_group_ranges(
+            [len(v) for _, v in entries], R)
+        blocks = [entries[g0:g0 + gn] for g0, gn, _rows in ranges]
         B = max(1, len(blocks))
         run.B = B
         run._alloc(B)
@@ -126,6 +117,29 @@ class ColumnarRun:
         run.max_key = blocks[-1][-1][0] if blocks else b""
         run.num_versions = sum(len(v) for _, v in entries)
         return run
+
+    @staticmethod
+    def pack_group_ranges(sizes: list[int], R: int):
+        """Greedy packing of whole key groups into R-row blocks:
+        [(first_group_index, group_count, row_count)] per block. The ONE
+        packing implementation — build() and device compaction share it,
+        so their block layouts always agree."""
+        ranges = []
+        g0, gn, fill = 0, 0, 0
+        for gi, n in enumerate(sizes):
+            if n > R:
+                raise ValueError(
+                    f"key has {n} versions > rows_per_block={R}; "
+                    "GC history (compact with a cutoff) to shrink it")
+            if fill + n > R and fill > 0:
+                ranges.append((g0, gn, fill))
+                g0, gn, fill = gi, 0, 0
+            gn += 1
+            fill += n
+        if fill > 0 or not ranges:
+            if gn > 0:
+                ranges.append((g0, gn, fill))
+        return ranges
 
     def _alloc(self, B: int) -> None:
         R = self.R

@@ -186,9 +186,12 @@ class TpuStorageEngine(StorageEngine):
             return None
         N = len(all_keys)
         # Pad to a size bucket so the compiled program is reused; pad rows
-        # carry max key planes (sort last) and ht 0 (never kept).
+        # carry max key planes (sort last) and the plane encoding of
+        # hybrid time 0 (visible, never a contributor), and are dropped by
+        # the perm < N filter regardless.
         Np = 1 << max(10, (N - 1).bit_length())
         pad = Np - N
+        ZLO = -(1 << 31)  # low plane of value 0 (bias-flipped)
 
         def cat(lst, fill):
             arr = np.concatenate(lst)
@@ -200,7 +203,7 @@ class TpuStorageEngine(StorageEngine):
 
         kw = cat(parts_kw, np.iinfo(np.int32).max)
         ht_hi = cat(parts["ht_hi"], 0)
-        ht_lo = cat(parts["ht_lo"], 0)
+        ht_lo = cat(parts["ht_lo"], ZLO)
 
         # Merge ORDER host-side: np.lexsort is vectorized C, while XLA's
         # variadic sort compiles catastrophically slowly (measured); the
@@ -216,7 +219,7 @@ class TpuStorageEngine(StorageEngine):
         new_group[1:] = (skw[1:] != skw[:-1]).any(axis=1)
 
         exp_hi = cat(parts["exp_hi"], 0)
-        exp_lo = cat(parts["exp_lo"], 0)
+        exp_lo = cat(parts["exp_lo"], ZLO)
         tomb = cat(parts["tomb"], False)
         live = cat(parts["live"], False)
         cat_set = {cid: cat(set_parts[cid], False) for cid in col_ids}
@@ -264,34 +267,24 @@ class TpuStorageEngine(StorageEngine):
         crun = self._gather_run(kept_src, kept_new_group, all_keys,
                                 all_vers, all_kvs, kw, planes, col_ids,
                                 null_parts, cmp_parts, arith_parts,
-                                varlen_all, crs)
+                                varlen_all)
         return entries, crun
 
     def _gather_run(self, kept_src, kept_new_group, all_keys, all_vers,
                     all_kvs, kw, planes, col_ids, null_parts, cmp_parts,
-                    arith_parts, varlen_all, crs):
+                    arith_parts, varlen_all):
         """Assemble the merged ColumnarRun by numpy-gathering surviving
         rows' planes (no per-version re-encoding)."""
         R = self.rows_per_block
         nk = kept_src.size
-        # Greedy block packing over group sizes (groups never split).
         bounds = np.nonzero(kept_new_group)[0].tolist() + [nk]
-        ranges = []  # (kept start, nrows) per block
-        blk_start, fill = 0, 0
-        max_group = 0
-        for gi in range(len(bounds) - 1):
-            gsz = bounds[gi + 1] - bounds[gi]
-            if gsz > R:
-                raise ValueError(
-                    f"key has {gsz} versions > rows_per_block={R}; "
-                    "compact with a history cutoff before flushing this")
-            if gsz > max_group:
-                max_group = gsz
-            if fill + gsz > R and fill > 0:
-                ranges.append((blk_start, fill))
-                blk_start, fill = bounds[gi], 0
-            fill += gsz
-        ranges.append((blk_start, fill))
+        sizes = [bounds[gi + 1] - bounds[gi]
+                 for gi in range(len(bounds) - 1)]
+        max_group = max(sizes) if sizes else 0
+        # (kept start row, row count) per block via the SHARED packing.
+        ranges = [(bounds[g0], rows)
+                  for g0, _gn, rows in ColumnarRun.pack_group_ranges(
+                      sizes, R)]
 
         run = ColumnarRun(self.schema, R)
         B = len(ranges)
@@ -346,11 +339,24 @@ class TpuStorageEngine(StorageEngine):
         run.max_ht = int(P.planes_to_u64(ht_hi_u[kept_src],
                                          ht_lo_u[kept_src]).max())
         run.max_group_versions = max_group
-        for cr in crs:
-            for cid, ln in cr.varlen_max_len.items():
-                if ln > run.varlen_max_len.get(cid, 0):
-                    run.varlen_max_len[cid] = ln
-            run.max_key_len = max(run.max_key_len, cr.max_key_len)
+        # Exact (not inherited) maxima over SURVIVING rows, so GC'd long
+        # values/keys don't disable device-exact paths forever.
+        for b in range(run.B):
+            n = run.blocks[b].num_valid
+            for key in run.row_keys[b][:n]:
+                if len(key) > run.max_key_len:
+                    run.max_key_len = len(key)
+            for cid in col_ids:
+                vl = run.cols[cid].varlen
+                if vl is None:
+                    continue
+                for v in vl[b][:n]:
+                    if v is None:
+                        continue
+                    raw = (v.encode("utf-8") if isinstance(v, str)
+                           else bytes(v))
+                    if len(raw) > run.varlen_max_len.get(cid, 0):
+                        run.varlen_max_len[cid] = len(raw)
         return run
 
     def dump_entries(self):
