@@ -26,12 +26,14 @@ class YBSession:
 
     # -- write ops -----------------------------------------------------------
     def insert(self, table: YBTable, values: dict,
-               ttl_expire_ht: int = MAX_HT) -> None:
+               ttl_expire_ht: int = MAX_HT,
+               ttl_us: int | None = None) -> None:
         key_values = {c.name: values[c.name] for c in table.schema.key_columns}
         cols = {table.col_id[c.name]: values[c.name]
                 for c in table.schema.value_columns if c.name in values}
         row = RowVersion(table.encode_key(key_values), ht=0, liveness=True,
-                         columns=cols, expire_ht=ttl_expire_ht)
+                         columns=cols, expire_ht=ttl_expire_ht,
+                         ttl_us=ttl_us)
         self._ops.append((table, table.hash_code(key_values), row))
 
     def update(self, table: YBTable, key_values: dict, set_values: dict,

@@ -114,7 +114,8 @@ def encode_key_component(value, dtype: DataType) -> bytes:
     if dtype in (DataType.FLOAT, DataType.DOUBLE):
         return bytes([TAG_DOUBLE]) + _encode_double(float(value))
     if dtype == DataType.STRING:
-        return bytes([TAG_STRING]) + _encode_str_bytes(value.encode("utf-8"))
+        return bytes([TAG_STRING]) + _encode_str_bytes(
+            value.encode("utf-8", "surrogateescape"))
     if dtype == DataType.BINARY:
         return bytes([TAG_BINARY]) + _encode_str_bytes(bytes(value))
     raise ValueError(f"type {dtype} not valid in a key")
@@ -136,7 +137,7 @@ def decode_key_component(buf: bytes, pos: int) -> tuple[object, int]:
         return _decode_double(buf[pos:pos + 8]), pos + 8
     if tag == TAG_STRING:
         raw, pos = _decode_str_bytes(buf, pos)
-        return raw.decode("utf-8"), pos
+        return raw.decode("utf-8", "surrogateescape"), pos
     if tag == TAG_BINARY:
         return _decode_str_bytes(buf, pos)
     raise ValueError(f"unknown key tag 0x{tag:02x} at {pos - 1}")

@@ -283,7 +283,8 @@ def pred_literal(kind: str, value):
     if kind == "f64":
         hi, lo = PL.f64_to_ordered_planes(np.array([value], dtype=np.float64))
         return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
-    raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+    raw = (value.encode("utf-8", "surrogateescape")
+           if isinstance(value, str) else bytes(value))
     hi, lo = PL.varlen_prefix_planes([raw])
     return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
 

@@ -231,7 +231,8 @@ class ColumnarRun:
             col.cmp_planes[b, r, 1] = lo[0]
             col.arith[b, r] = np.float32(val)
         else:  # STRING / BINARY
-            raw = val.encode("utf-8") if isinstance(val, str) else bytes(val)
+            raw = (val.encode("utf-8", "surrogateescape")
+                   if isinstance(val, str) else bytes(val))
             hi, lo = P.varlen_prefix_planes([raw])
             col.cmp_planes[b, r, 0] = hi[0]
             col.cmp_planes[b, r, 1] = lo[0]

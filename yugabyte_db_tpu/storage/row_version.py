@@ -32,10 +32,24 @@ class RowVersion:
     liveness: bool = False     # INSERT liveness marker
     columns: dict = field(default_factory=dict)  # col_id -> value (None = null)
     expire_ht: int = MAX_HT    # TTL expiry as a hybrid time; MAX_HT = no TTL
+    # RELATIVE TTL in microseconds: resolved into expire_ht against the
+    # write's own stamped hybrid time by the leader (tablet clocks can
+    # legitimately run ahead of wall time, so clients must not compute
+    # absolute expiry from their wall clock — the reference stores TTLs
+    # relative to the value's write time for the same reason).
+    ttl_us: int | None = None
 
     def __post_init__(self):
         if self.tombstone and (self.liveness or self.columns):
             raise ValueError("tombstone carries no columns or liveness")
+
+    def resolve_ttl(self, ht: int) -> int:
+        """Absolute expire_ht for a write stamped at ``ht``."""
+        if self.ttl_us is not None:
+            from yugabyte_db_tpu.utils.hybrid_time import BITS_FOR_LOGICAL
+
+            return ht + (self.ttl_us << BITS_FOR_LOGICAL)
+        return self.expire_ht
 
     @property
     def has_ttl(self) -> bool:

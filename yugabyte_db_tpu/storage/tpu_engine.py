@@ -343,20 +343,21 @@ class TpuStorageEngine(StorageEngine):
         # values/keys don't disable device-exact paths forever.
         for b in range(run.B):
             n = run.blocks[b].num_valid
-            for key in run.row_keys[b][:n]:
-                if len(key) > run.max_key_len:
-                    run.max_key_len = len(key)
+            run.max_key_len = max(run.max_key_len,
+                                  max(map(len, run.row_keys[b][:n])))
             for cid in col_ids:
                 vl = run.cols[cid].varlen
                 if vl is None:
                     continue
-                for v in vl[b][:n]:
-                    if v is None:
-                        continue
-                    raw = (v.encode("utf-8") if isinstance(v, str)
-                           else bytes(v))
-                    if len(raw) > run.varlen_max_len.get(cid, 0):
-                        run.varlen_max_len[cid] = len(raw)
+                # ASCII-dominant workloads: len(str) == encoded length; only
+                # re-measure the (rare) non-ASCII cells byte-exactly.
+                lens = [len(v) if (isinstance(v, str) and v.isascii())
+                        else len(v.encode("utf-8", "surrogateescape"))
+                        if isinstance(v, str) else len(v)
+                        for v in vl[b][:n] if v is not None]
+                if lens:
+                    run.varlen_max_len[cid] = max(
+                        run.varlen_max_len.get(cid, 0), max(lens))
         return run
 
     def dump_entries(self):
@@ -724,7 +725,8 @@ class TpuStorageEngine(StorageEngine):
                     np.array([p.value], dtype=np.float64))
                 int_lits += [int(hi[0]), int(lo[0])]
             else:
-                raw = (p.value.encode("utf-8") if isinstance(p.value, str)
+                raw = (p.value.encode("utf-8", "surrogateescape")
+                       if isinstance(p.value, str)
                        else bytes(p.value))
                 hi, lo = P.varlen_prefix_planes([raw])
                 int_lits += [int(hi[0]), int(lo[0])]

@@ -18,17 +18,18 @@ from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
 def encode_rows(rows: list[RowVersion]) -> list:
     return [
         [r.key, r.ht, r.tombstone, r.liveness,
-         {str(c): v for c, v in r.columns.items()}, r.expire_ht]
+         {str(c): v for c, v in r.columns.items()}, r.expire_ht, r.ttl_us]
         for r in rows
     ]
 
 
 def decode_rows(body: list) -> list[RowVersion]:
     return [
-        RowVersion(key, ht=ht, tombstone=tomb, liveness=live,
-                   columns={int(c): v for c, v in cols.items()},
-                   expire_ht=exp)
-        for key, ht, tomb, live, cols, exp in body
+        RowVersion(rec[0], ht=rec[1], tombstone=rec[2], liveness=rec[3],
+                   columns={int(c): v for c, v in rec[4].items()},
+                   expire_ht=rec[5],
+                   ttl_us=rec[6] if len(rec) > 6 else None)
+        for rec in body
     ]
 
 

@@ -193,7 +193,7 @@ class Tablet:
                 stamped = [
                     RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
                                liveness=r.liveness, columns=r.columns,
-                               expire_ht=r.expire_ht)
+                               expire_ht=r.resolve_ttl(ht.value))
                     for r in rows
                 ]
                 self._last_index += 1

@@ -95,7 +95,7 @@ class TabletPeer:
         stamped = [
             RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
                        liveness=r.liveness, columns=r.columns,
-                       expire_ht=r.expire_ht)
+                       expire_ht=r.resolve_ttl(ht.value))
             for r in rows
         ]
         self.tablet.mvcc.add_pending(ht)

@@ -127,7 +127,7 @@ class TransactionParticipant:
             rows = [
                 RowVersion(r.key, ht=commit_ht, tombstone=r.tombstone,
                            liveness=r.liveness, columns=r.columns,
-                           expire_ht=r.expire_ht)
+                           expire_ht=r.resolve_ttl(commit_ht))
                 for r in rec["rows"]
             ]
         engine_apply(rows)

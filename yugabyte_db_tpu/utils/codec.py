@@ -64,7 +64,7 @@ def _encode_into(out: bytearray, v) -> None:
         out.append(_T_F64)
         out += struct.pack("<d", v)
     elif isinstance(v, str):
-        raw = v.encode("utf-8")
+        raw = v.encode("utf-8", "surrogateescape")
         out.append(_T_STR)
         _write_varint(out, len(raw))
         out += raw
@@ -109,7 +109,7 @@ def _decode_from(buf: bytes, pos: int):
         return struct.unpack_from("<d", buf, pos)[0], pos + 8
     if tag == _T_STR:
         n, pos = _read_varint(buf, pos)
-        return buf[pos:pos + n].decode("utf-8"), pos + n
+        return buf[pos:pos + n].decode("utf-8", "surrogateescape"), pos + n
     if tag == _T_BYTES:
         n, pos = _read_varint(buf, pos)
         return bytes(buf[pos:pos + n]), pos + n

@@ -1,0 +1,18 @@
+"""YEDIS: the Redis-compatible frontend.
+
+Reference analog: src/yb/yql/redis/redisserver/ — RedisServer riding the
+shared rpc::Messenger through RedisConnectionContext (redis_rpc.cc), a
+RESP parser (redis_parser.cc), and the command registry
+(redis_commands.cc:69-154) lowering commands onto DocDB rows
+(redis_operation.cc). Here Redis data maps onto one framework table:
+
+    (rkey STRING hash, field STRING range) -> value STRING (+ type tag)
+
+so strings are (rkey, "") rows, hash fields (rkey, f) rows, and set
+members (rkey, m) marker rows; TTL rides the storage engine's native
+per-version expiry.
+"""
+
+from yugabyte_db_tpu.yql.redis.server import RedisServer
+
+__all__ = ["RedisServer"]

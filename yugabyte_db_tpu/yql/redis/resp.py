@@ -1,0 +1,137 @@
+"""RESP (REdis Serialization Protocol) codec + connection context.
+
+Reference analog: src/yb/yql/redis/redisserver/redis_parser.cc and the
+RedisConnectionContext of redis_rpc.cc. Implements RESP2: commands
+arrive as arrays of bulk strings (plus the inline-command form); replies
+are simple strings, errors, integers, bulk strings, and arrays.
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.rpc.messenger import ConnectionContext
+
+CRLF = b"\r\n"
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def parse_commands(buf: bytearray):
+    """Consume complete commands from ``buf``; yields lists of bytes.
+    Leaves partial data in place."""
+    out = []
+    while buf:
+        if buf[:1] == b"*":
+            end = buf.find(CRLF)
+            if end < 0:
+                break
+            try:
+                n = int(buf[1:end])
+            except ValueError:
+                raise ProtocolError("bad array length")
+            pos = end + 2
+            args = []
+            ok = True
+            for _ in range(max(n, 0)):
+                if buf[pos:pos + 1] != b"$":
+                    if pos >= len(buf):
+                        ok = False
+                        break
+                    raise ProtocolError("expected bulk string")
+                lend = buf.find(CRLF, pos)
+                if lend < 0:
+                    ok = False
+                    break
+                try:
+                    ln = int(buf[pos + 1:lend])
+                except ValueError:
+                    raise ProtocolError("bad bulk length")
+                if ln < 0:
+                    # RESP2 commands carry no null bulk strings; a negative
+                    # length here would desynchronize the parse offset.
+                    raise ProtocolError("negative bulk length in command")
+                start = lend + 2
+                if len(buf) < start + ln + 2:
+                    ok = False
+                    break
+                args.append(bytes(buf[start:start + ln]))
+                pos = start + ln + 2
+            if not ok:
+                break
+            del buf[:pos]
+            if args:
+                out.append(args)
+        else:
+            # inline command form: "PING\r\n"
+            end = buf.find(CRLF)
+            if end < 0:
+                break
+            line = bytes(buf[:end])
+            del buf[:end + 2]
+            parts = line.split()
+            if parts:
+                out.append(parts)
+    return out
+
+
+# -- reply encoding ----------------------------------------------------------
+
+def simple(s: str) -> bytes:
+    return b"+" + s.encode() + CRLF
+
+
+def error(msg: str) -> bytes:
+    return b"-ERR " + msg.encode() + CRLF
+
+
+def integer(n: int) -> bytes:
+    return b":" + str(n).encode() + CRLF
+
+
+def bulk(v) -> bytes:
+    if v is None:
+        return b"$-1" + CRLF
+    if isinstance(v, str):
+        v = v.encode("utf-8", "surrogateescape")
+    return b"$" + str(len(v)).encode() + CRLF + v + CRLF
+
+
+def array(items) -> bytes:
+    if items is None:
+        return b"*-1" + CRLF
+    out = [b"*" + str(len(items)).encode() + CRLF]
+    for it in items:
+        if isinstance(it, int):
+            out.append(integer(it))
+        elif isinstance(it, (list, tuple)):
+            out.append(array(it))
+        else:
+            out.append(bulk(it))
+    return b"".join(out)
+
+
+class RedisConnectionContext(ConnectionContext):
+    """RESP over the shared messenger: replies pair with commands by
+    ORDER, so handlers run one at a time per connection."""
+
+    ordered_responses = True
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._seq = 0
+
+    def feed(self, data: bytes) -> list:
+        self._buf.extend(data)
+        cmds = parse_commands(self._buf)
+        calls = []
+        for args in cmds:
+            calls.append((self._seq, "redis", args))
+            self._seq += 1
+        return calls
+
+    def serialize(self, response) -> bytes:
+        _seq, status, body = response
+        if status == "ok":
+            return body
+        return error(str(body))

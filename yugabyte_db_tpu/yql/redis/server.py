@@ -1,0 +1,368 @@
+"""RedisServer: RESP commands lowered onto framework rows.
+
+Reference analog: src/yb/yql/redis/redisserver/redis_service.cc + the
+per-command handlers of redis_commands.cc (~85 commands there; the core
+string/hash/set/TTL/server families here) executing as DocDB operations
+(redis_operation.cc).
+
+Data model (module docstring of yql.redis): one table keyed
+(rkey hash, field range) with a value column; strings use field "",
+hashes their field names, sets their members (value ignored). TTL maps
+to the engine's native per-version expiry, so expiration needs no
+background reaper — exactly the reference's DocDB TTL reuse.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+
+from yugabyte_db_tpu.client import YBSession
+from yugabyte_db_tpu.client.client import YBClient
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.encoding import prefix_successor
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
+from yugabyte_db_tpu.rpc.messenger import Messenger
+from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+from yugabyte_db_tpu.yql.redis import resp
+
+REDIS_TABLE = "sys.redis"
+
+COLUMNS = [
+    ColumnSchema("rkey", DataType.STRING, ColumnKind.HASH),
+    ColumnSchema("field", DataType.STRING, ColumnKind.RANGE),
+    ColumnSchema("value", DataType.STRING),
+]
+
+
+class RedisServiceImpl:
+    def __init__(self, client: YBClient, num_tablets: int = 4,
+                 replication_factor: int = 3):
+        self.client = client
+        try:
+            self.table = client.create_table(
+                REDIS_TABLE, COLUMNS, num_tablets=num_tablets,
+                replication_factor=replication_factor)
+        except Exception as e:  # noqa: BLE001
+            if "exist" not in str(e).lower():
+                raise
+            self.table = client.open_table(REDIS_TABLE)
+        self.session = YBSession(client)
+        self.commands_served = 0
+        # Redis guarantees per-command atomicity; the messenger runs
+        # handlers for DIFFERENT connections concurrently on a worker
+        # pool, and one session's op buffer is shared — so commands are
+        # serialized here (the single-shard execution model of the
+        # reference's redis proxy, one op per batcher flush).
+        self._lock = threading.Lock()
+
+    # -- row helpers ---------------------------------------------------------
+    def _get(self, rkey: str, field: str):
+        row = self.session.get(self.table, {"rkey": rkey, "field": field})
+        return None if row is None else row[2]
+
+    def _put(self, rkey: str, field: str, value: str,
+             ttl_us: int | None = None):
+        # TTLs ride as RELATIVE microseconds; the tablet leader resolves
+        # them against the write's own stamped hybrid time (client wall
+        # clocks and tablet hybrid clocks legitimately disagree).
+        self.session.insert(self.table, {
+            "rkey": rkey, "field": field, "value": value,
+        }, ttl_us=ttl_us)
+        self.session.flush()
+
+    def _del(self, rkey: str, field: str):
+        self.session.delete(self.table, {"rkey": rkey, "field": field})
+        self.session.flush()
+
+    def _fields(self, rkey: str):
+        """All (field, value) rows of one redis key (one hash-routed
+        range scan over the key's row group)."""
+        from yugabyte_db_tpu.models.encoding import encode_doc_key_prefix
+
+        hc = self.table.hash_code({"rkey": rkey})
+        lower = encode_doc_key_prefix(hc, [(rkey, DataType.STRING)], [])
+        spec = ScanSpec(lower=lower, upper=prefix_successor(lower),
+                        projection=["field", "value"])
+        return self.session.scan(self.table, spec).rows
+
+    # -- dispatch ------------------------------------------------------------
+    def handle(self, args: list[bytes]) -> bytes:
+        self.commands_served += 1
+        name = args[0].decode().upper()
+        fn = getattr(self, "cmd_" + name.lower(), None)
+        if fn is None:
+            return resp.error(f"unknown command '{name}'")
+        try:
+            with self._lock:
+                try:
+                    return fn([a.decode("utf-8", "surrogateescape")
+                               for a in args[1:]])
+                finally:
+                    # A handler that errored mid-buffer must not leak its
+                    # partial ops into the next command's flush.
+                    self.session._ops.clear()
+        except IndexError:
+            return resp.error(
+                f"wrong number of arguments for '{name.lower()}' command")
+
+    # -- server commands -----------------------------------------------------
+    def cmd_ping(self, a):
+        return resp.bulk(a[0]) if a else resp.simple("PONG")
+
+    def cmd_echo(self, a):
+        return resp.bulk(a[0])
+
+    def cmd_select(self, a):
+        return resp.simple("OK")  # single logical database
+
+    def cmd_command(self, a):
+        return resp.array([])
+
+    def cmd_info(self, a):
+        return resp.bulk(f"# Server\nredis_compat:yedis\n"
+                         f"commands_served:{self.commands_served}\n")
+
+    # -- strings -------------------------------------------------------------
+    def cmd_set(self, a):
+        key, value = a[0], a[1]
+        ttl_us = None
+        i = 2
+        nx = xx = False
+        while i < len(a):
+            opt = a[i].upper()
+            if opt == "EX":
+                ttl_us = int(float(a[i + 1]) * 1_000_000)
+                i += 2
+            elif opt == "PX":
+                ttl_us = int(float(a[i + 1]) * 1_000)
+                i += 2
+            elif opt == "NX":
+                nx = True
+                i += 1
+            elif opt == "XX":
+                xx = True
+                i += 1
+            else:
+                return resp.error("syntax error")
+        if nx or xx:
+            cur = self._get(key, "")
+            if (nx and cur is not None) or (xx and cur is None):
+                return resp.bulk(None)
+        self._put(key, "", value, ttl_us)
+        return resp.simple("OK")
+
+    def cmd_setex(self, a):
+        self._put(a[0], "", a[2], int(float(a[1]) * 1_000_000))
+        return resp.simple("OK")
+
+    def cmd_setnx(self, a):
+        if self._get(a[0], "") is not None:
+            return resp.integer(0)
+        self._put(a[0], "", a[1])
+        return resp.integer(1)
+
+    def cmd_get(self, a):
+        return resp.bulk(self._get(a[0], ""))
+
+    def cmd_getset(self, a):
+        old = self._get(a[0], "")
+        self._put(a[0], "", a[1])
+        return resp.bulk(old)
+
+    def cmd_append(self, a):
+        cur = self._get(a[0], "") or ""
+        new = cur + a[1]
+        self._put(a[0], "", new)
+        return resp.integer(len(new))
+
+    def cmd_strlen(self, a):
+        v = self._get(a[0], "")
+        return resp.integer(len(v) if v else 0)
+
+    def cmd_mget(self, a):
+        return resp.array([self._get(k, "") for k in a])
+
+    def cmd_mset(self, a):
+        if not a or len(a) % 2:
+            return resp.error("wrong number of arguments for 'mset' command")
+        for i in range(0, len(a), 2):
+            self.session.insert(self.table, {
+                "rkey": a[i], "field": "", "value": a[i + 1]})
+        self.session.flush()
+        return resp.simple("OK")
+
+    def cmd_incr(self, a):
+        return self._incrby(a[0], 1)
+
+    def cmd_incrby(self, a):
+        return self._incrby(a[0], int(a[1]))
+
+    def cmd_decr(self, a):
+        return self._incrby(a[0], -1)
+
+    def cmd_decrby(self, a):
+        return self._incrby(a[0], -int(a[1]))
+
+    def _incrby(self, key, by):
+        cur = self._get(key, "")
+        if cur is not None:
+            try:
+                cur = int(cur)
+            except ValueError:
+                return resp.error(
+                    "value is not an integer or out of range")
+        new = (cur or 0) + by
+        self._put(key, "", str(new))
+        return resp.integer(new)
+
+    def cmd_del(self, a):
+        n = 0
+        for key in a:
+            rows = self._fields(key)
+            for field, _v in rows:
+                self.session.delete(self.table,
+                                    {"rkey": key, "field": field})
+            if rows:
+                n += 1
+        self.session.flush()
+        return resp.integer(n)
+
+    def cmd_exists(self, a):
+        return resp.integer(sum(1 for k in a if self._fields(k)))
+
+    def cmd_expire(self, a):
+        key = a[0]
+        rows = self._fields(key)
+        if not rows:
+            return resp.integer(0)
+        ttl_us = int(float(a[1]) * 1_000_000)
+        for field, value in rows:
+            self._put(key, field, value, ttl_us)
+        return resp.integer(1)
+
+    def cmd_ttl(self, a):
+        # Without surfacing expire_ht through the read path this reports
+        # -1 (no TTL) for live keys, -2 for missing (reference's contract
+        # subset).
+        return resp.integer(-1 if self._fields(a[0]) else -2)
+
+    def cmd_keys(self, a):
+        pattern = a[0] if a else "*"
+        spec = ScanSpec(projection=["rkey"])
+        rows = self.session.scan(self.table, spec).rows
+        keys = sorted({r[0] for r in rows})
+        return resp.array([k for k in keys
+                           if fnmatch.fnmatchcase(k, pattern)])
+
+    # -- hashes --------------------------------------------------------------
+    def cmd_hset(self, a):
+        key = a[0]
+        if len(a) < 3 or len(a) % 2 == 0:
+            return resp.error("wrong number of arguments for 'hset' command")
+        n = 0
+        for i in range(1, len(a), 2):
+            if self._get(key, "\x01" + a[i]) is None:
+                n += 1
+            self.session.insert(self.table, {
+                "rkey": key, "field": "\x01" + a[i], "value": a[i + 1]})
+        self.session.flush()
+        return resp.integer(n)
+
+    def cmd_hmset(self, a):
+        self.cmd_hset(a)
+        return resp.simple("OK")
+
+    def cmd_hget(self, a):
+        return resp.bulk(self._get(a[0], "\x01" + a[1]))
+
+    def cmd_hmget(self, a):
+        return resp.array([self._get(a[0], "\x01" + f) for f in a[1:]])
+
+    def cmd_hdel(self, a):
+        n = 0
+        for f in a[1:]:
+            if self._get(a[0], "\x01" + f) is not None:
+                self._del(a[0], "\x01" + f)
+                n += 1
+        return resp.integer(n)
+
+    def cmd_hexists(self, a):
+        return resp.integer(
+            0 if self._get(a[0], "\x01" + a[1]) is None else 1)
+
+    def _hash_rows(self, key):
+        return [(f[1:], v) for f, v in self._fields(key)
+                if f.startswith("\x01")]
+
+    def cmd_hgetall(self, a):
+        out = []
+        for f, v in self._hash_rows(a[0]):
+            out.extend([f, v])
+        return resp.array(out)
+
+    def cmd_hkeys(self, a):
+        return resp.array([f for f, _v in self._hash_rows(a[0])])
+
+    def cmd_hvals(self, a):
+        return resp.array([v for _f, v in self._hash_rows(a[0])])
+
+    def cmd_hlen(self, a):
+        return resp.integer(len(self._hash_rows(a[0])))
+
+    # -- sets ----------------------------------------------------------------
+    def cmd_sadd(self, a):
+        key = a[0]
+        n = 0
+        for m in a[1:]:
+            if self._get(key, "\x02" + m) is None:
+                n += 1
+            self.session.insert(self.table, {
+                "rkey": key, "field": "\x02" + m, "value": ""})
+        self.session.flush()
+        return resp.integer(n)
+
+    def cmd_srem(self, a):
+        n = 0
+        for m in a[1:]:
+            if self._get(a[0], "\x02" + m) is not None:
+                self._del(a[0], "\x02" + m)
+                n += 1
+        return resp.integer(n)
+
+    def cmd_smembers(self, a):
+        return resp.array(sorted(
+            f[1:] for f, _v in self._fields(a[0])
+            if f.startswith("\x02")))
+
+    def cmd_sismember(self, a):
+        return resp.integer(
+            0 if self._get(a[0], "\x02" + a[1]) is None else 1)
+
+    def cmd_scard(self, a):
+        return resp.integer(len([1 for f, _v in self._fields(a[0])
+                                 if f.startswith("\x02")]))
+
+
+class RedisServer:
+    """RESP wire server over the messenger (the yb-tserver's port-6379
+    proxy, tablet_server_main.cc:191)."""
+
+    def __init__(self, client: YBClient, messenger: Messenger | None = None,
+                 **kwargs):
+        self.service = RedisServiceImpl(client, **kwargs)
+        self._own_messenger = messenger is None
+        self.messenger = messenger or Messenger(name="redis")
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0):
+        def handler(_method, args):
+            return self.service.handle(args)
+
+        from yugabyte_db_tpu.yql.redis.resp import RedisConnectionContext
+
+        return self.messenger.listen(host, port, handler,
+                                     context_factory=RedisConnectionContext)
+
+    def shutdown(self) -> None:
+        if self._own_messenger:
+            self.messenger.shutdown()
