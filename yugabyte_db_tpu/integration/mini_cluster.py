@@ -61,15 +61,16 @@ class MiniCluster:
             return self.transport.bind(uuid)
         return self.transport
 
-    def _wire_handler(self, uuid: str, handler) -> None:
+    def _wire_handler(self, uuid: str, handler) -> tuple | None:
         if self.transport_kind == "local":
             self.transport.register(uuid, handler)
-        else:
-            from yugabyte_db_tpu.rpc import Messenger
-            m = Messenger(uuid)
-            host, port = m.listen("127.0.0.1", 0, handler)
-            self.transport.set_address(uuid, host, port)
-            self._messengers[uuid] = m
+            return None
+        from yugabyte_db_tpu.rpc import Messenger
+        m = Messenger(uuid)
+        host, port = m.listen("127.0.0.1", 0, handler)
+        self.transport.set_address(uuid, host, port)
+        self._messengers[uuid] = m
+        return (host, port)
 
     def start_master(self, uuid: str) -> Master:
         master = Master(uuid, os.path.join(self.data_root, uuid),
@@ -77,7 +78,7 @@ class MiniCluster:
                         raft_opts=self.raft_opts, fsync=self.fsync,
                         ts_unresponsive_timeout_s=self.ts_unresponsive_timeout_s,
                         balance_interval_s=0.3)
-        self._wire_handler(uuid, master.handle)
+        master.advertised_addr = self._wire_handler(uuid, master.handle)
         self.masters[uuid] = master
         master.start()
         return master
@@ -89,7 +90,7 @@ class MiniCluster:
                           engine_options=self.engine_options,
                           fsync=self.fsync,
                           heartbeat_interval_s=self.heartbeat_interval_s)
-        self._wire_handler(uuid, ts.handle)
+        ts.advertised_addr = self._wire_handler(uuid, ts.handle)
         self.tservers[uuid] = ts
         ts.start()
         return ts

@@ -1,0 +1,11 @@
+"""Operator tooling: admin client/CLI (yb-admin), consistency checker
+(ysck), offline fs/WAL inspection.
+
+Reference analog: src/yb/tools/ (yb-admin_cli.cc, ysck.cc, fs_tool.cc)
++ src/yb/consensus/log-dump.cc.
+"""
+
+from yugabyte_db_tpu.tools.admin_client import AdminClient
+from yugabyte_db_tpu.tools.ysck import Ysck, YsckReport
+
+__all__ = ["AdminClient", "Ysck", "YsckReport"]

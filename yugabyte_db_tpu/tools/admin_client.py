@@ -1,0 +1,185 @@
+"""AdminClient: the cluster-administration RPC surface behind yb-admin.
+
+Reference analog: src/yb/tools/yb-admin_client.cc (ClusterAdminClient) —
+list tables/tablets/tservers, change a tablet's Raft config, leader
+stepdown, flush/compact, delete table — over the same master/tserver
+RPCs the regular client uses.
+"""
+
+from __future__ import annotations
+
+import time
+
+from yugabyte_db_tpu.consensus.transport import TransportError
+
+
+class AdminError(Exception):
+    pass
+
+
+class AdminClient:
+    """Thin admin wrapper over a cluster Transport.
+
+    Works over both the in-process LocalTransport (tests) and
+    SocketTransport (real daemons); ``connect()`` bootstraps the latter
+    from a master address the way yb-admin's -master_addresses does.
+    """
+
+    def __init__(self, transport, master_uuids: list[str]):
+        self.transport = transport
+        self.master_uuids = list(master_uuids)
+
+    @classmethod
+    def connect(cls, master_addr: str) -> "AdminClient":
+        """Bootstrap over TCP from ``host:port`` of any master. Tserver
+        addresses are learned from the master's tserver registry."""
+        from yugabyte_db_tpu.rpc import SocketTransport
+
+        host, port = master_addr.rsplit(":", 1)
+        transport = SocketTransport()
+        boot_uuid = f"master@{master_addr}"
+        transport.set_address(boot_uuid, host, int(port))
+        c = cls(transport, [boot_uuid])
+        c.refresh_addresses()
+        return c
+
+    def refresh_addresses(self) -> None:
+        """Learn tserver uuid -> address mappings (socket mode)."""
+        if not hasattr(self.transport, "set_address"):
+            return
+        for d in self.list_tservers():
+            addr = d.get("addr")
+            if isinstance(addr, (list, tuple)) and len(addr) == 2:
+                self.transport.set_address(d["uuid"], addr[0], int(addr[1]))
+
+    # -- master RPCs ---------------------------------------------------------
+    def master_rpc(self, method: str, payload: dict | None = None,
+                   timeout_s: float = 10.0) -> dict:
+        """Try masters until one answers as leader (yb-admin's leader
+        master discovery loop)."""
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            for m in self.master_uuids:
+                try:
+                    resp = self.transport.send(m, method, payload or {},
+                                               timeout=2.0)
+                except TransportError as e:
+                    last = str(e)
+                    continue
+                if resp.get("code") == "not_leader":
+                    hint = resp.get("leader_hint")
+                    if hint and hint in self.master_uuids:
+                        self.master_uuids.remove(hint)
+                        self.master_uuids.insert(0, hint)
+                    last = "not_leader"
+                    continue
+                return resp
+            time.sleep(0.1)
+        raise AdminError(f"no leader master answered {method}: {last}")
+
+    def list_tables(self) -> list[dict]:
+        return self.master_rpc("master.list_tables")["tables"]
+
+    def list_tservers(self) -> list[dict]:
+        return self.master_rpc("master.list_tservers")["tservers"]
+
+    def table_locations(self, table: str) -> list[dict]:
+        resp = self.master_rpc("master.get_table_locations",
+                               {"name": table})
+        if resp.get("code") != "ok":
+            raise AdminError(f"table {table}: {resp.get('code')}")
+        # Socket mode: keep the address book current with the replica
+        # addresses the master reports (covers tservers that joined after
+        # connect()).
+        if hasattr(self.transport, "set_address"):
+            for t in resp["tablets"]:
+                for r in t["replicas"]:
+                    addr = r.get("addr")
+                    if isinstance(addr, (list, tuple)) and len(addr) == 2:
+                        self.transport.set_address(r["uuid"], addr[0],
+                                                   int(addr[1]))
+        return resp["tablets"]
+
+    def delete_table(self, table: str) -> None:
+        resp = self.master_rpc("master.delete_table", {"name": table})
+        if resp.get("code") != "ok":
+            raise AdminError(f"delete {table}: {resp.get('code')}")
+
+    def locate_tablet(self, tablet_id: str) -> dict:
+        resp = self.master_rpc("master.locate_tablet",
+                               {"tablet_id": tablet_id})
+        if resp.get("code") != "ok":
+            raise AdminError(f"tablet {tablet_id}: {resp.get('code')}")
+        return resp
+
+    # -- tserver RPCs --------------------------------------------------------
+    def _leader_rpc(self, tablet_id: str, method: str, payload: dict,
+                    timeout_s: float = 10.0) -> dict:
+        """Send to the tablet's leader, following not_leader hints."""
+        loc = self.locate_tablet(tablet_id)
+        target = loc.get("leader") or loc["replicas"][0]
+        deadline = time.monotonic() + timeout_s
+        tried = set()
+        while time.monotonic() < deadline:
+            try:
+                resp = self.transport.send(target, method, payload,
+                                           timeout=3.0)
+            except TransportError:
+                resp = {"code": "error"}
+            if resp.get("code") == "not_leader":
+                tried.add(target)
+                hint = resp.get("leader_hint")
+                candidates = [hint] if hint else []
+                candidates += [r for r in loc["replicas"] if r not in tried]
+                if not candidates:
+                    tried.clear()
+                    candidates = loc["replicas"]
+                target = candidates[0]
+                time.sleep(0.1)
+                continue
+            if resp.get("code") == "error":
+                time.sleep(0.2)
+                continue
+            return resp
+        raise AdminError(f"{method} on {tablet_id}: no leader reachable")
+
+    def change_config(self, tablet_id: str, peers: list[str]) -> None:
+        resp = self._leader_rpc(tablet_id, "ts.change_config",
+                                {"tablet_id": tablet_id, "peers": peers})
+        if resp.get("code") != "ok":
+            raise AdminError(f"change_config: {resp.get('code')}")
+
+    def leader_stepdown(self, tablet_id: str, target: str) -> None:
+        resp = self._leader_rpc(tablet_id, "ts.transfer_leadership",
+                                {"tablet_id": tablet_id, "target": target})
+        if resp.get("code") != "ok":
+            raise AdminError(f"leader_stepdown: {resp.get('code')}")
+
+    def flush_table(self, table: str) -> int:
+        n = 0
+        for t in self.table_locations(table):
+            self._leader_rpc(t["tablet_id"], "ts.flush",
+                             {"tablet_id": t["tablet_id"]})
+            n += 1
+        return n
+
+    def compact_table(self, table: str, history_cutoff_ht: int = 0) -> int:
+        n = 0
+        for t in self.table_locations(table):
+            self._leader_rpc(t["tablet_id"], "ts.compact",
+                             {"tablet_id": t["tablet_id"],
+                              "history_cutoff_ht": history_cutoff_ht})
+            n += 1
+        return n
+
+    def tserver_status(self, uuid: str) -> dict:
+        return self.transport.send(uuid, "ts.status", {}, timeout=3.0)
+
+    def checksum(self, tablet_id: str, replica: str,
+                 read_ht: int | None = None) -> dict:
+        payload = {"tablet_id": tablet_id}
+        if read_ht is not None:
+            payload["read_ht"] = read_ht
+        return self.transport.send(replica, "ts.checksum", payload,
+                                   timeout=15.0)

@@ -1,0 +1,174 @@
+"""fs_tool / log-dump: offline inspection of daemon data directories.
+
+Reference analog: src/yb/tools/fs_tool.cc + fs_{list,dump}-tool.cc
+(walk a server's data root, list tablets/SSTables, dump rows) and
+src/yb/consensus/log-dump.cc (decode WAL segments record by record).
+
+Operates purely on files — no running daemon required — so it is the
+tool of last resort for a server that won't start.
+
+Usage:
+  python -m yugabyte_db_tpu.tools.fs_tool list <data_root>
+  python -m yugabyte_db_tpu.tools.fs_tool dump_run <run-file.dat> [-n N]
+  python -m yugabyte_db_tpu.tools.fs_tool dump_wal <wal-file.seg|wal-dir> [-n N]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+from yugabyte_db_tpu.utils import codec
+
+_WAL_HEADER = struct.Struct("<II")
+
+
+# -- listing -----------------------------------------------------------------
+
+def list_tablet_dirs(data_root: str) -> list[dict]:
+    """Inventory of every tablet directory under a daemon data root
+    (tserver ``tablet-data/`` children or a master ``sys-catalog``)."""
+    out = []
+    candidates = []
+    for dirpath, dirnames, filenames in os.walk(data_root):
+        if "tablet-meta.json" in filenames or "consensus-meta.json" \
+                in filenames:
+            candidates.append(dirpath)
+            dirnames[:] = [d for d in dirnames if d not in ("wal", "runs")]
+    for tdir in sorted(candidates):
+        info: dict = {"dir": tdir, "tablet_id": os.path.basename(tdir)}
+        meta_path = os.path.join(tdir, "tablet-meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            info["table"] = meta.get("table_name", meta.get("table_id"))
+            info["engine"] = meta.get("engine")
+        wal_dir = os.path.join(tdir, "wal")
+        segs = sorted(os.listdir(wal_dir)) if os.path.isdir(wal_dir) else []
+        info["wal_segments"] = len(segs)
+        info["wal_bytes"] = sum(
+            os.path.getsize(os.path.join(wal_dir, s)) for s in segs)
+        runs_dir = os.path.join(tdir, "runs")
+        runs = sorted(os.listdir(runs_dir)) if os.path.isdir(runs_dir) else []
+        info["runs"] = len(runs)
+        info["run_bytes"] = sum(
+            os.path.getsize(os.path.join(runs_dir, r)) for r in runs)
+        out.append(info)
+    return out
+
+
+# -- run dump ----------------------------------------------------------------
+
+def iter_run_entries(path: str):
+    """Yield (key, [version-record, ...]) from one sorted-run file
+    (storage.run_io format)."""
+    with open(path, "rb") as f:
+        magic, payload = codec.decode(f.read())
+    if magic != "run1":
+        raise ValueError(f"{path}: not a run file (magic {magic!r})")
+    yield from payload
+
+
+# -- wal dump ----------------------------------------------------------------
+
+def iter_wal_records(path: str):
+    """Yield (record, error) from one WAL segment; decoding stops at the
+    first torn/corrupt record exactly as recovery does, but the tool also
+    REPORTS it (log-dump's role)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos + _WAL_HEADER.size <= len(data):
+        ln, crc = _WAL_HEADER.unpack_from(data, pos)
+        body = data[pos + _WAL_HEADER.size:pos + _WAL_HEADER.size + ln]
+        if len(body) < ln:
+            yield None, f"torn record at offset {pos} " \
+                        f"(want {ln} bytes, have {len(body)})"
+            return
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            yield None, f"CRC mismatch at offset {pos}"
+            return
+        yield codec.decode(body), None
+        pos += _WAL_HEADER.size + ln
+
+
+def wal_segment_paths(path: str) -> list[str]:
+    if os.path.isdir(path):
+        return [os.path.join(path, n) for n in sorted(os.listdir(path))
+                if n.startswith("wal-") and n.endswith(".seg")]
+    return [path]
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _preview(v, limit=80) -> str:
+    s = repr(v)
+    return s if len(s) <= limit else s[:limit] + "..."
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="fs_tool")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list")
+    p.add_argument("data_root")
+    p = sub.add_parser("dump_run")
+    p.add_argument("path")
+    p.add_argument("-n", type=int, default=20, help="max entries")
+    p = sub.add_parser("dump_wal")
+    p.add_argument("path")
+    p.add_argument("-n", type=int, default=50, help="max records")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "list":
+        infos = list_tablet_dirs(args.data_root)
+        for i in infos:
+            print(f"{i['tablet_id']}  table={i.get('table', '-')} "
+                  f"engine={i.get('engine', '-')} "
+                  f"wal={i['wal_segments']}seg/{i['wal_bytes']}B "
+                  f"runs={i['runs']}/{i['run_bytes']}B")
+        print(f"{len(infos)} tablet dir(s)")
+        return 0
+
+    if args.cmd == "dump_run":
+        n = 0
+        for key, versions in iter_run_entries(args.path):
+            print(f"key={key.hex()} versions={len(versions)}")
+            for v in versions:
+                ht, tomb, live, cols, exp = v[0], v[1], v[2], v[3], v[4]
+                kind = ("DEL" if tomb else "PUT" if live else "UPD")
+                print(f"  ht={ht} {kind} cols={_preview(cols)}"
+                      + (f" expire_ht={exp}" if exp != (1 << 63) - 1
+                         else ""))
+            n += 1
+            if n >= args.n:
+                print("...")
+                break
+        return 0
+
+    # dump_wal
+    shown = 0
+    rc = 0
+    for seg in wal_segment_paths(args.path):
+        print(f"-- {seg}")
+        for rec, err in iter_wal_records(seg):
+            if err is not None:
+                print(f"  !! {err}")
+                rc = 1
+                break
+            term, index, ht, op_type, body = rec[0], rec[1], rec[2], \
+                rec[3], rec[4]
+            print(f"  {term}.{index} ht={ht} {op_type} "
+                  f"{_preview(body)}")
+            shown += 1
+            if shown >= args.n:
+                print("  ...")
+                return rc
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

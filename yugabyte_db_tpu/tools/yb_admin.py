@@ -1,0 +1,131 @@
+"""yb-admin: cluster administration CLI.
+
+Reference analog: src/yb/tools/yb-admin_cli.cc — the operator commands
+(list_tables, list_tablets, list_all_tablet_servers, change_config,
+leader_stepdown, flush/compact, delete_table) over AdminClient.
+
+Usage: python -m yugabyte_db_tpu.tools.yb_admin --master host:port CMD ...
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from yugabyte_db_tpu.tools.admin_client import AdminClient
+
+
+def _fmt_table(rows: list[list], header: list[str]) -> str:
+    cols = [header] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in cols) for i in range(len(header))]
+    out = []
+    for i, r in enumerate(cols):
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+        if i == 0:
+            out.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    return "\n".join(out)
+
+
+def cmd_list_tables(admin: AdminClient, args) -> int:
+    rows = [[t["name"], t["table_id"], t["state"], t["num_tablets"]]
+            for t in admin.list_tables()]
+    print(_fmt_table(rows, ["name", "table_id", "state", "tablets"]))
+    return 0
+
+
+def cmd_list_tablets(admin: AdminClient, args) -> int:
+    rows = []
+    for t in admin.table_locations(args.table):
+        rows.append([t["tablet_id"], t["partition_start"],
+                     t["partition_end"],
+                     ",".join(r["uuid"] for r in t["replicas"]),
+                     t.get("leader") or "?"])
+    print(_fmt_table(rows, ["tablet_id", "start", "end", "replicas",
+                            "leader"]))
+    return 0
+
+
+def cmd_list_tablet_servers(admin: AdminClient, args) -> int:
+    rows = [[d["uuid"], d.get("addr"), "ALIVE" if d.get("alive") else "DEAD",
+             d.get("num_live_tablets", 0)]
+            for d in admin.list_tservers()]
+    print(_fmt_table(rows, ["uuid", "addr", "state", "tablets"]))
+    return 0
+
+
+def cmd_change_config(admin: AdminClient, args) -> int:
+    admin.change_config(args.tablet_id, args.peers.split(","))
+    print("config changed")
+    return 0
+
+
+def cmd_leader_stepdown(admin: AdminClient, args) -> int:
+    admin.leader_stepdown(args.tablet_id, args.target)
+    print("stepdown requested")
+    return 0
+
+
+def cmd_flush_table(admin: AdminClient, args) -> int:
+    n = admin.flush_table(args.table)
+    print(f"flushed {n} tablet(s)")
+    return 0
+
+
+def cmd_compact_table(admin: AdminClient, args) -> int:
+    n = admin.compact_table(args.table, args.history_cutoff_ht)
+    print(f"compacted {n} tablet(s)")
+    return 0
+
+
+def cmd_delete_table(admin: AdminClient, args) -> int:
+    admin.delete_table(args.table)
+    print(f"deleted {args.table}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="yb-admin")
+    ap.add_argument("--master", required=True, help="host:port of any master")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("list_tables").set_defaults(fn=cmd_list_tables)
+
+    p = sub.add_parser("list_tablets")
+    p.add_argument("table")
+    p.set_defaults(fn=cmd_list_tablets)
+
+    sub.add_parser("list_all_tablet_servers").set_defaults(
+        fn=cmd_list_tablet_servers)
+
+    p = sub.add_parser("change_config")
+    p.add_argument("tablet_id")
+    p.add_argument("peers", help="comma-separated peer uuids")
+    p.set_defaults(fn=cmd_change_config)
+
+    p = sub.add_parser("leader_stepdown")
+    p.add_argument("tablet_id")
+    p.add_argument("target")
+    p.set_defaults(fn=cmd_leader_stepdown)
+
+    p = sub.add_parser("flush_table")
+    p.add_argument("table")
+    p.set_defaults(fn=cmd_flush_table)
+
+    p = sub.add_parser("compact_table")
+    p.add_argument("table")
+    p.add_argument("--history_cutoff_ht", type=int, default=0)
+    p.set_defaults(fn=cmd_compact_table)
+
+    p = sub.add_parser("delete_table")
+    p.add_argument("table")
+    p.set_defaults(fn=cmd_delete_table)
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    admin = AdminClient.connect(args.master)
+    return args.fn(admin, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

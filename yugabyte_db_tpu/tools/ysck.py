@@ -1,0 +1,140 @@
+"""ysck: cluster consistency checker.
+
+Reference analog: src/yb/tools/ysck.cc + ysck_remote.cc — walk the
+master's table/tablet/replica topology, health-check every tserver, and
+run checksum scans on EVERY replica of every tablet at one pinned read
+hybrid time, flagging replicas whose data diverges. ClusterVerifier
+(src/yb/integration-tests/cluster_verifier.cc) runs this after every
+integration test; tests here use it the same way.
+
+Divergence that heals itself (a follower still applying) is not
+corruption: checksums are retried with backoff until they agree or the
+deadline passes — only a mismatch that PERSISTS is reported.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.consensus.transport import TransportError
+from yugabyte_db_tpu.tools.admin_client import AdminClient
+
+
+@dataclass
+class TabletCheck:
+    tablet_id: str
+    table: str
+    consistent: bool
+    rows: int = 0
+    read_ht: int = 0
+    detail: str = ""
+    replica_checksums: dict = field(default_factory=dict)
+
+
+@dataclass
+class YsckReport:
+    ok: bool
+    tservers_alive: int = 0
+    tservers_dead: list = field(default_factory=list)
+    tables_checked: int = 0
+    tablet_checks: list = field(default_factory=list)
+
+    def summary(self) -> str:
+        lines = [f"tservers: {self.tservers_alive} alive"
+                 + (f", DEAD: {self.tservers_dead}"
+                    if self.tservers_dead else ""),
+                 f"tables checked: {self.tables_checked}"]
+        bad = [c for c in self.tablet_checks if not c.consistent]
+        for c in self.tablet_checks:
+            mark = "OK " if c.consistent else "BAD"
+            lines.append(f"  [{mark}] {c.table}/{c.tablet_id} "
+                         f"rows={c.rows}{' ' + c.detail if c.detail else ''}")
+        lines.append("ysck: " + ("OK" if self.ok
+                                 else f"{len(bad)} inconsistent tablet(s)"))
+        return "\n".join(lines)
+
+
+class Ysck:
+    def __init__(self, admin: AdminClient):
+        self.admin = admin
+
+    def check_cluster(self, tables: list[str] | None = None,
+                      timeout_s: float = 20.0) -> YsckReport:
+        report = YsckReport(ok=True)
+        for d in self.admin.list_tservers():
+            if d.get("alive", True):
+                report.tservers_alive += 1
+            else:
+                report.tservers_dead.append(d["uuid"])
+                report.ok = False
+        names = tables if tables is not None else \
+            [t["name"] for t in self.admin.list_tables()]
+        for name in names:
+            report.tables_checked += 1
+            for t in self.admin.table_locations(name):
+                check = self._check_tablet(name, t, timeout_s)
+                report.tablet_checks.append(check)
+                if not check.consistent:
+                    report.ok = False
+        return report
+
+    def _check_tablet(self, table: str, t: dict,
+                      timeout_s: float) -> TabletCheck:
+        tid = t["tablet_id"]
+        replicas = [r["uuid"] for r in t["replicas"]]
+        leader = t.get("leader") or (replicas[0] if replicas else None)
+        if leader is None:
+            return TabletCheck(tid, table, False, detail="no replicas")
+        deadline = time.monotonic() + timeout_s
+        last: dict = {}
+        while True:
+            try:
+                # The leader (or first replica) picks the read point; the
+                # rest of the group is checksummed AT that point.
+                head = self.admin.checksum(tid, leader)
+                if head.get("code") != "ok":
+                    raise TransportError(head.get("code", "error"))
+                read_ht = head["read_ht"]
+                last = {leader: head["checksum"]}
+                rows = head["rows"]
+                agree = True
+                for r in replicas:
+                    if r == leader:
+                        continue
+                    resp = self.admin.checksum(tid, r, read_ht=read_ht)
+                    if resp.get("code") != "ok":
+                        raise TransportError(resp.get("code", "error"))
+                    last[r] = resp["checksum"]
+                    agree = agree and resp["checksum"] == head["checksum"]
+                if agree:
+                    return TabletCheck(tid, table, True, rows=rows,
+                                       read_ht=read_ht,
+                                       replica_checksums=last)
+                detail = "checksum mismatch"
+            except TransportError as e:
+                detail = f"replica unreachable: {e}"
+            if time.monotonic() >= deadline:
+                return TabletCheck(tid, table, False, detail=detail,
+                                   replica_checksums=last)
+            time.sleep(0.5)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="ysck", description="cluster consistency checker")
+    ap.add_argument("--master", required=True,
+                    help="host:port of any master")
+    ap.add_argument("--tables", nargs="*", default=None)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+    admin = AdminClient.connect(args.master)
+    report = Ysck(admin).check_cluster(args.tables, timeout_s=args.timeout)
+    print(report.summary())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,6 +15,7 @@ from __future__ import annotations
 from yugabyte_db_tpu.consensus.raft import NotLeader, RaftOptions
 from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.storage import wire
+from yugabyte_db_tpu.storage.scan_spec import ScanSpec
 from yugabyte_db_tpu.tablet.tablet import TabletMetadata
 from yugabyte_db_tpu.tserver.heartbeater import Heartbeater
 from yugabyte_db_tpu.tserver.tablet_manager import (TabletNotFound,
@@ -788,6 +789,52 @@ class TabletServer:
         peer = self.tablet_manager.get(p["tablet_id"])
         peer.raft.transfer_leadership(p["target"])
         return {"code": "ok"}
+
+    def _h_ts_checksum(self, p: dict):
+        """Checksum of this replica's visible rows at a read hybrid time
+        (reference: ChecksumService / ysck checksum scans,
+        src/yb/tserver/tserver_service.proto Checksum). Reads LOCALLY
+        (leader or follower) — the caller pins one read_ht across all
+        replicas and retries transient divergence while appliers catch
+        up. Without read_ht the replica picks its safe time and returns
+        it so the caller can pin the rest of the group to it."""
+        import hashlib
+
+        from yugabyte_db_tpu.utils import codec
+
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        read_ht = p.get("read_ht")
+        if read_ht is None:
+            read_ht = peer.read_time().value
+        else:
+            # Same consistency gates as ts.scan: wait out in-flight writes
+            # below the pinned point and committed-but-unapplied intents,
+            # so applier lag isn't misreported as corruption.
+            err = self._pin_read_point(peer, read_ht, p.get("timeout", 4.0))
+            if err is not None:
+                return err
+        spec = ScanSpec(lower=b"", upper=b"", read_ht=read_ht)
+        err = self._resolve_read_intents(peer, spec)
+        if err is not None:
+            return err
+        h = hashlib.sha256()
+        total = 0
+        resume = b""
+        while True:
+            page = ScanSpec(lower=resume, upper=b"", read_ht=read_ht,
+                            limit=4096)
+            res = peer.scan(page, allow_stale=True)
+            for row in res.rows:
+                h.update(codec.encode(row))
+            total += len(res.rows)
+            if res.resume_key is None:
+                break
+            resume = res.resume_key
+        return {"code": "ok", "read_ht": read_ht, "rows": total,
+                "checksum": h.hexdigest()}
 
     def _h_ts_status(self, p: dict):
         return {
