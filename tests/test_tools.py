@@ -177,6 +177,13 @@ def test_fs_tool_offline_inspection(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "CRC mismatch" in out or "torn record" in out
 
+    # a truncated run file is reported, not a traceback
+    with open(run_file, "r+b") as f:
+        f.truncate(30)
+    assert fs_tool.main(["dump_run", run_file]) == 1
+    out = capsys.readouterr().out
+    assert "corrupt run file" in out
+
 
 def test_yb_admin_and_ysck_cli_over_sockets(tmp_path, capsys):
     c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3,

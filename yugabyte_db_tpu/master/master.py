@@ -25,6 +25,7 @@ from yugabyte_db_tpu.models.partition import PartitionSchema
 from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.tablet.wal import Log
 from yugabyte_db_tpu.utils.hybrid_time import HybridClock
+from yugabyte_db_tpu.utils.trace import RpczStore, trace_request
 
 SYS_CATALOG_ID = "sys.catalog"
 
@@ -91,6 +92,7 @@ class Master:
         ent.gauge("master_live_tservers",
                   lambda: len(self.ts_manager.live_tservers()))
         self.webserver = None
+        self.rpcz = RpczStore()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -135,6 +137,7 @@ class Master:
              "leader": self.ts_manager.leader_of(i.tablet_id)}
             for t in self.catalog.list_tables()
             for i in self.catalog.tablets_of(t.table_id)])
+        self.webserver.add_json_handler("/rpcz", self.rpcz.dump)
         return self.webserver.start(host, port)
 
     def _rpc_entity(self, method: str):
@@ -152,12 +155,15 @@ class Master:
     # -- rpc dispatch --------------------------------------------------------
     def handle(self, method: str, payload: dict):
         start = time.monotonic()
-        try:
-            return self._dispatch(method, payload)
-        finally:
-            ent = self._rpc_entity(method)
-            ent.counter("rpc_requests_total").increment()
-            ent.histogram("rpc_latency_us").observe_duration_us(start)
+        with trace_request(method) as t:
+            try:
+                return self._dispatch(method, payload)
+            finally:
+                ent = self._rpc_entity(method)
+                ent.counter("rpc_requests_total").increment()
+                ent.histogram("rpc_latency_us").observe_duration_us(start)
+                t.finish()  # duration must be final before sampling
+                self.rpcz.record(t)
 
     def _dispatch(self, method: str, payload: dict):
         if method.startswith("raft."):

@@ -20,6 +20,7 @@ from yugabyte_db_tpu.consensus.metadata import ConsensusMetadata, RaftConfig
 from yugabyte_db_tpu.consensus.raft import (NotLeader, RaftConsensus,
                                             RaftOptions)
 from yugabyte_db_tpu.storage.row_version import RowVersion
+from yugabyte_db_tpu.utils.trace import TRACE
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.tablet.tablet import (Tablet, TabletMetadata,
                                            _encode_rows)
@@ -92,6 +93,7 @@ class TabletPeer:
                 return HybridTime(prev)  # duplicate retry: original result
             rid = [client_id, request_id]
         ht = self.tablet.clock.now()
+        TRACE("write: %d row(s) stamped at ht=%d", len(rows), ht.value)
         stamped = [
             RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
                        liveness=r.liveness, columns=r.columns,
@@ -103,6 +105,8 @@ class TabletPeer:
             body = ({"rows": _encode_rows(stamped), "rid": rid}
                     if rid else _encode_rows(stamped))
             entry = self.raft.append_leader("write", body, ht=ht.value)
+            TRACE("write: appended %d.%d", entry.op_id.term,
+                  entry.op_id.index)
         except BaseException:
             self.tablet.mvcc.aborted(ht)  # never entered the log
             raise
@@ -221,7 +225,11 @@ class TabletPeer:
                 raise NotLeader(self.node_uuid, self.raft.leader_uuid())
             if not self.raft.has_lease():
                 raise NotLeader(self.node_uuid, None)
-        return self.tablet.scan(spec)
+        TRACE("scan: read_ht=%d", spec.read_ht)
+        res = self.tablet.scan(spec)
+        TRACE("scan: %d row(s), %d scanned", len(res.rows),
+              res.rows_scanned)
+        return res
 
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:

@@ -30,16 +30,22 @@ class AdminClient:
         self.master_uuids = list(master_uuids)
 
     @classmethod
-    def connect(cls, master_addr: str) -> "AdminClient":
-        """Bootstrap over TCP from ``host:port`` of any master. Tserver
-        addresses are learned from the master's tserver registry."""
+    def connect(cls, master_addrs: str) -> "AdminClient":
+        """Bootstrap over TCP from comma-separated master ``host:port``
+        addresses (yb-admin's -master_addresses). Pass ALL masters of a
+        multi-master cluster so the leader is reachable whichever node
+        holds it; tserver addresses are learned from the master's
+        registry."""
         from yugabyte_db_tpu.rpc import SocketTransport
 
-        host, port = master_addr.rsplit(":", 1)
         transport = SocketTransport()
-        boot_uuid = f"master@{master_addr}"
-        transport.set_address(boot_uuid, host, int(port))
-        c = cls(transport, [boot_uuid])
+        uuids = []
+        for addr in master_addrs.split(","):
+            host, port = addr.strip().rsplit(":", 1)
+            boot_uuid = f"master@{addr.strip()}"
+            transport.set_address(boot_uuid, host, int(port))
+            uuids.append(boot_uuid)
+        c = cls(transport, uuids)
         c.refresh_addresses()
         return c
 
@@ -60,7 +66,7 @@ class AdminClient:
         deadline = time.monotonic() + timeout_s
         last = None
         while time.monotonic() < deadline:
-            for m in self.master_uuids:
+            for m in list(self.master_uuids):
                 try:
                     resp = self.transport.send(m, method, payload or {},
                                                timeout=2.0)
@@ -116,33 +122,39 @@ class AdminClient:
     # -- tserver RPCs --------------------------------------------------------
     def _leader_rpc(self, tablet_id: str, method: str, payload: dict,
                     timeout_s: float = 10.0) -> dict:
-        """Send to the tablet's leader, following not_leader hints."""
-        loc = self.locate_tablet(tablet_id)
-        target = loc.get("leader") or loc["replicas"][0]
+        """Send to the tablet's leader, following not_leader hints and
+        failing over to other replicas when the reported leader is down
+        (re-fetching the location each round — it may have moved)."""
         deadline = time.monotonic() + timeout_s
-        tried = set()
-        while time.monotonic() < deadline:
-            try:
-                resp = self.transport.send(target, method, payload,
-                                           timeout=3.0)
-            except TransportError:
-                resp = {"code": "error"}
-            if resp.get("code") == "not_leader":
-                tried.add(target)
-                hint = resp.get("leader_hint")
-                candidates = [hint] if hint else []
-                candidates += [r for r in loc["replicas"] if r not in tried]
-                if not candidates:
-                    tried.clear()
-                    candidates = loc["replicas"]
-                target = candidates[0]
-                time.sleep(0.1)
-                continue
-            if resp.get("code") == "error":
-                time.sleep(0.2)
-                continue
-            return resp
-        raise AdminError(f"{method} on {tablet_id}: no leader reachable")
+        last = "unreachable"
+        while True:
+            loc = self.locate_tablet(tablet_id)
+            hint = loc.get("leader")
+            candidates = ([hint] if hint else []) +                 [r for r in loc["replicas"] if r != hint]
+            for target in candidates:
+                try:
+                    resp = self.transport.send(target, method, payload,
+                                               timeout=3.0)
+                except TransportError as e:
+                    last = str(e)
+                    continue
+                if resp.get("code") == "not_leader":
+                    last = "not_leader"
+                    h = resp.get("leader_hint")
+                    if h and h != target and h in loc["replicas"] and                             h not in candidates[:candidates.index(target)]:
+                        try:
+                            resp = self.transport.send(h, method, payload,
+                                                       timeout=3.0)
+                            if resp.get("code") != "not_leader":
+                                return resp
+                        except TransportError as e:
+                            last = str(e)
+                    continue
+                return resp
+            if time.monotonic() >= deadline:
+                raise AdminError(
+                    f"{method} on {tablet_id}: no leader reachable ({last})")
+            time.sleep(0.2)
 
     def change_config(self, tablet_id: str, peers: list[str]) -> None:
         resp = self._leader_rpc(tablet_id, "ts.change_config",

@@ -20,6 +20,7 @@ import os
 import struct
 import zlib
 
+from yugabyte_db_tpu.storage.row_version import MAX_HT
 from yugabyte_db_tpu.utils import codec
 
 _WAL_HEADER = struct.Struct("<II")
@@ -135,18 +136,21 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "dump_run":
         n = 0
-        for key, versions in iter_run_entries(args.path):
-            print(f"key={key.hex()} versions={len(versions)}")
-            for v in versions:
-                ht, tomb, live, cols, exp = v[0], v[1], v[2], v[3], v[4]
-                kind = ("DEL" if tomb else "PUT" if live else "UPD")
-                print(f"  ht={ht} {kind} cols={_preview(cols)}"
-                      + (f" expire_ht={exp}" if exp != (1 << 63) - 1
-                         else ""))
-            n += 1
-            if n >= args.n:
-                print("...")
-                break
+        try:
+            for key, versions in iter_run_entries(args.path):
+                print(f"key={key.hex()} versions={len(versions)}")
+                for v in versions:
+                    ht, tomb, live, cols, exp = v[0], v[1], v[2], v[3], v[4]
+                    kind = ("DEL" if tomb else "PUT" if live else "UPD")
+                    print(f"  ht={ht} {kind} cols={_preview(cols)}"
+                          + (f" expire_ht={exp}" if exp != MAX_HT else ""))
+                n += 1
+                if n >= args.n:
+                    print("...")
+                    break
+        except Exception as e:  # noqa: BLE001 — corrupt file is the use case
+            print(f"!! corrupt run file: {type(e).__name__}: {e}")
+            return 1
         return 0
 
     # dump_wal

@@ -20,6 +20,7 @@ from yugabyte_db_tpu.tablet.tablet import TabletMetadata
 from yugabyte_db_tpu.tserver.heartbeater import Heartbeater
 from yugabyte_db_tpu.tserver.tablet_manager import (TabletNotFound,
                                                     TSTabletManager)
+from yugabyte_db_tpu.utils.trace import TRACE, RpczStore, trace_request
 
 
 class TabletServer:
@@ -69,6 +70,7 @@ class TabletServer:
         self._collect_lock = _threading.Lock()
         self.metrics.add_collector(self._collect_tablet_metrics)
         self.webserver = None
+        self.rpcz = RpczStore()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -108,6 +110,7 @@ class TabletServer:
              **{k: v for k, v in p.stats().items()
                 if not isinstance(v, dict)}}
             for p in self.tablet_manager.peers()])
+        self.webserver.add_json_handler("/rpcz", self.rpcz.dump)
         return self.webserver.start(host, port)
 
     def _rpc_entity(self, method: str):
@@ -159,12 +162,15 @@ class TabletServer:
         import time as _time
 
         start = _time.monotonic()
-        try:
-            return self._dispatch(method, payload)
-        finally:
-            ent = self._rpc_entity(method)
-            ent.counter("rpc_requests_total").increment()
-            ent.histogram("rpc_latency_us").observe_duration_us(start)
+        with trace_request(method) as t:
+            try:
+                return self._dispatch(method, payload)
+            finally:
+                ent = self._rpc_entity(method)
+                ent.counter("rpc_requests_total").increment()
+                ent.histogram("rpc_latency_us").observe_duration_us(start)
+                t.finish()  # duration must be final before sampling
+                self.rpcz.record(t)
 
     def _dispatch(self, method: str, payload: dict):
         if method.startswith("raft."):
@@ -435,6 +441,7 @@ class TabletServer:
         err = self._resolve_read_intents(peer, spec)
         if err is not None:
             return err
+        TRACE("read point resolved")
         try:
             res = peer.scan(spec, allow_stale=p.get("allow_stale", False))
         except NotLeader as e:
