@@ -1,0 +1,56 @@
+"""Native (C++) runtime components, with build-on-first-import.
+
+The reference's runtime serialization/framing is C++ (protobuf +
+src/yb/rpc); here the codec hot path lives in native/codec.cc, compiled
+into the extension module ``yb_codec`` next to this package. If the
+extension is missing, we try ONE quiet `make -C native` (the toolchain
+is a build requirement, not a runtime one — pure-Python fallbacks exist
+for every native component), gated by YB_NO_NATIVE=1.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import subprocess
+import sys
+
+_MOD = "yugabyte_db_tpu.native.yb_codec"
+
+
+def _load():
+    if os.environ.get("YB_NO_NATIVE") == "1":
+        return None
+    try:
+        return importlib.import_module(_MOD)
+    except ImportError:
+        pass
+    src = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "native")
+    if not os.path.isdir(src):
+        return None
+    # Negative cache: one failed build attempt per source version, not one
+    # per process (a doomed `make` at import time would tax every CLI run).
+    stamp = os.path.join(src, ".build_failed")
+    codec_src = os.path.join(src, "codec.cc")
+    try:
+        if os.path.exists(stamp) and \
+                os.path.getmtime(stamp) >= os.path.getmtime(codec_src):
+            return None
+    except OSError:
+        return None
+    try:
+        subprocess.run(["make", "-C", src, f"PY={sys.executable}"],
+                       capture_output=True, timeout=120, check=True)
+        return importlib.import_module(_MOD)
+    except Exception:  # noqa: BLE001 — fall back to pure Python
+        try:
+            with open(stamp, "w") as f:
+                f.write("native build failed; delete to retry\n")
+        except OSError:
+            pass
+        return None
+
+
+yb_codec = _load()

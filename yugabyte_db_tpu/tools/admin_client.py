@@ -41,10 +41,18 @@ class AdminClient:
         transport = SocketTransport()
         uuids = []
         for addr in master_addrs.split(","):
-            host, port = addr.strip().rsplit(":", 1)
-            boot_uuid = f"master@{addr.strip()}"
+            addr = addr.strip()
+            if not addr:
+                continue
+            if ":" not in addr:
+                raise AdminError(f"bad master address {addr!r} "
+                                 "(want host:port)")
+            host, port = addr.rsplit(":", 1)
+            boot_uuid = f"master@{addr}"
             transport.set_address(boot_uuid, host, int(port))
             uuids.append(boot_uuid)
+        if not uuids:
+            raise AdminError("no master addresses given")
         c = cls(transport, uuids)
         c.refresh_addresses()
         return c
@@ -130,7 +138,9 @@ class AdminClient:
         while True:
             loc = self.locate_tablet(tablet_id)
             hint = loc.get("leader")
-            candidates = ([hint] if hint else []) +                 [r for r in loc["replicas"] if r != hint]
+            candidates = ([hint] if hint else []) + [
+                r for r in loc["replicas"] if r != hint
+            ]
             for target in candidates:
                 try:
                     resp = self.transport.send(target, method, payload,
@@ -141,7 +151,9 @@ class AdminClient:
                 if resp.get("code") == "not_leader":
                     last = "not_leader"
                     h = resp.get("leader_hint")
-                    if h and h != target and h in loc["replicas"] and                             h not in candidates[:candidates.index(target)]:
+                    already = candidates[:candidates.index(target)]
+                    if (h and h != target and h in loc["replicas"]
+                            and h not in already):
                         try:
                             resp = self.transport.send(h, method, payload,
                                                        timeout=3.0)

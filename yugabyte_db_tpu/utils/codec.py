@@ -87,10 +87,19 @@ def _encode_into(out: bytearray, v) -> None:
         raise TypeError(f"codec cannot encode {type(v).__name__}")
 
 
-def encode(v) -> bytes:
+def _py_encode(v) -> bytes:
     out = bytearray()
     _encode_into(out, v)
     return bytes(out)
+
+
+def encode(v) -> bytes:
+    if _native is not None:
+        try:
+            return _native.encode(v)
+        except OverflowError:
+            pass  # >64-bit int somewhere in v: arbitrary-precision path
+    return _py_encode(v)
 
 
 def _decode_from(buf: bytes, pos: int):
@@ -131,8 +140,25 @@ def _decode_from(buf: bytes, pos: int):
     raise ValueError(f"codec: bad tag 0x{tag:02x} at {pos - 1}")
 
 
-def decode(buf: bytes):
+def _py_decode(buf: bytes):
     v, pos = _decode_from(buf, 0)
     if pos != len(buf):
         raise ValueError(f"codec: {len(buf) - pos} trailing bytes")
     return v
+
+
+def decode(buf: bytes):
+    if _native is not None:
+        try:
+            return _native.decode(buf)
+        except OverflowError:
+            pass  # varint beyond uint64: arbitrary-precision path
+    return _py_decode(buf)
+
+
+# Resolved LAST: yugabyte_db_tpu.native may build the extension on first
+# import, and its fallback path needs this module fully defined.
+try:
+    from yugabyte_db_tpu.native import yb_codec as _native
+except Exception:  # noqa: BLE001 — pure-Python fallback
+    _native = None

@@ -34,7 +34,7 @@ MAX_MESSAGES = 64
 
 class Trace:
     __slots__ = ("method", "start_wall", "start", "entries", "duration_us",
-                 "dropped")
+                 "dropped", "_done")
 
     def __init__(self, method: str):
         self.method = method
@@ -43,6 +43,7 @@ class Trace:
         self.entries: list[tuple[float, str]] = []
         self.duration_us: int = 0
         self.dropped = 0
+        self._done = False
 
     def trace(self, msg: str) -> None:
         if len(self.entries) >= MAX_MESSAGES:
@@ -51,7 +52,11 @@ class Trace:
         self.entries.append((time.monotonic() - self.start, msg))
 
     def finish(self) -> None:
-        self.duration_us = int((time.monotonic() - self.start) * 1e6)
+        """Idempotent: the first call fixes the duration (the sample may
+        already be recorded when a later finish runs)."""
+        if not self._done:
+            self._done = True
+            self.duration_us = int((time.monotonic() - self.start) * 1e6)
 
     def dump(self) -> dict:
         out = {
