@@ -88,6 +88,10 @@ class ColumnarRun:
         # the KEY_WORDS prefix planes, so plane equality/order is EXACT —
         # the device compaction eligibility check.
         self.max_key_len = 0
+        # Lazily-built per-key-column object arrays (global row index ->
+        # decoded key value) for C-speed fancy-indexed materialization of
+        # key columns on the batched scan path.
+        self._kv_cols: list[np.ndarray] | None = None
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -323,6 +327,33 @@ class ColumnarRun:
             _, hashed, ranges = decode_doc_key(self.row_keys[b][r])
             kv = self.row_key_vals[b][r] = hashed + ranges
         return kv
+
+    def key_col_arrays(self) -> list[np.ndarray]:
+        """One object ndarray per key column, indexed by global row index
+        (b*R + r), holding the decoded key value for every valid row.
+        Built once per run (one linear decode pass, memoized into
+        row_key_vals); batched scans then materialize key columns with a
+        single numpy fancy-index per page instead of per-row Python."""
+        if self._kv_cols is None:
+            from yugabyte_db_tpu.models.encoding import decode_doc_key
+
+            nk = len(self.schema.key_columns)
+            cols = [np.empty(self.B * self.R, dtype=object)
+                    for _ in range(nk)]
+            for b in range(self.B):
+                n = self.blocks[b].num_valid
+                rk = self.row_keys[b]
+                kvs = self.row_key_vals[b]
+                base = b * self.R
+                for r in range(n):
+                    kv = kvs[r]
+                    if kv is None:
+                        _, hashed, ranges = decode_doc_key(rk[r])
+                        kv = kvs[r] = hashed + ranges
+                    for p in range(nk):
+                        cols[p][base + r] = kv[p]
+            self._kv_cols = cols
+        return self._kv_cols
 
     # -- block pruning -----------------------------------------------------
     def block_range(self, lower: bytes, upper: bytes) -> tuple[int, int]:

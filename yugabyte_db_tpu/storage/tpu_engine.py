@@ -71,6 +71,10 @@ class TpuStorageEngine(StorageEngine):
         self._dtypes = {c.col_id: c.dtype for c in schema.value_columns}
         self._name_to_id = {c.name: c.col_id for c in schema.value_columns}
         self._key_col_names = {c.name for c in schema.key_columns}
+        # Structural gather-plan cache; invalidated whenever the run set
+        # changes (flush/compact). Holds strong refs to its TpuRuns, so
+        # id(trun) keys can't be reused while cached.
+        self._plan_cache: dict = {}
         from yugabyte_db_tpu.storage.run_io import RunPersistence
 
         self.persist = RunPersistence(self.options.get("data_dir"))
@@ -102,6 +106,7 @@ class TpuStorageEngine(StorageEngine):
         crun = ColumnarRun.build(self.schema, entries, self.rows_per_block)
         self.runs.append(TpuRun(crun))
         self.memtable = MemTable()
+        self._plan_cache.clear()
 
     def compact(self, history_cutoff_ht: int = 0) -> None:
         """Merge all runs into one, GCing history at the cutoff. The
@@ -136,6 +141,7 @@ class TpuStorageEngine(StorageEngine):
             merged, crun = result
         self.persist.replace_all(merged)
         self.runs = [TpuRun(crun)] if merged else []
+        self._plan_cache.clear()
 
     def _device_compact_entries(self, cutoff: int):
         """Device merge+GC -> (entries, merged ColumnarRun), or None when
@@ -390,6 +396,8 @@ class TpuStorageEngine(StorageEngine):
         return out
 
     def _memtable_in_range(self, spec: ScanSpec) -> bool:
+        if self.memtable.is_empty:
+            return False
         return next(self.memtable.scan_keys(spec.lower, spec.upper), None) is not None
 
     def _split_predicates(self, spec: ScanSpec):
@@ -495,8 +503,17 @@ class TpuStorageEngine(StorageEngine):
     _G_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
     def scan_batch(self, specs: list[ScanSpec]) -> list[ScanResult]:
-        from yugabyte_db_tpu.ops import row_gather
+        return self.scan_batch_async(specs).finish()
 
+    def scan_batch_async(self, specs: list[ScanSpec]) -> "_AsyncBatch":
+        """Plan every scan, issue all round-1 device work, and start the
+        outputs streaming host-ward (copy_to_host_async) WITHOUT waiting.
+        The caller finishes the batch later with .finish().
+
+        This is the server shape for the tunnel link: one synchronous
+        fetch cycle costs ~1 link RTT regardless of size, but dispatches
+        and async copies pipeline — so overlapping batches (issue N+1
+        before finishing N) amortizes the RTT across whole batches."""
         plans = [self._plan_scan(s) for s in specs]
 
         results: list = [None] * len(plans)
@@ -511,73 +528,145 @@ class TpuStorageEngine(StorageEngine):
             else:
                 gathers.append((pi, plan[1]))
 
-        # Round-based batched execution: each round groups every active
-        # gather's pending param-rows by (signature, run) into vmapped
-        # dispatches, fetches all outputs in ONE device_get (plus any
-        # one-shot issued outputs on round 1), and feeds buffers back;
-        # gathers that need more windows contribute rows to the next round.
-        pending = {pi: st.pending for pi, st in gathers if st.pending}
         states = dict(gathers)
-        first_round = True
-        while pending or first_round:
-            by_sig: dict = {}
-            for pi, rows in pending.items():
+        pending = {pi: st.pending for pi, st in gathers if st.pending}
+        dispatches = self._issue_round(states, pending) if pending else []
+        for leaf in jax.tree.leaves([[d for _c, d in dispatches],
+                                     [o for _pi, o, _f in issued_outs]]):
+            leaf.copy_to_host_async()
+        return _AsyncBatch(self, results, host_plans, issued_outs,
+                           gathers, states, pending, dispatches)
+
+    def _issue_round(self, states, pending):
+        """Group every active gather's pending param-rows by (signature,
+        run) into vmapped dispatches; returns [(chunk, out_array)]."""
+        from yugabyte_db_tpu.ops import row_gather
+
+        by_sig: dict = {}
+        for pi, rows in pending.items():
+            st = states[pi]
+            for ri, (ip, fp) in enumerate(rows):
+                by_sig.setdefault((st.sig, id(st.trun)),
+                                  (st.trun, []))[1].append(
+                    (pi, ri, ip, fp))
+        dispatches = []
+        for (sig, _tid), (trun, members) in by_sig.items():
+            for c0 in range(0, len(members), self._G_BUCKETS[-1]):
+                chunk = members[c0:c0 + self._G_BUCKETS[-1]]
+                G = next(g for g in self._G_BUCKETS if g >= len(chunk))
+                ip = np.zeros((G, len(chunk[0][2])), dtype=np.int32)
+                fp = np.zeros((G, len(chunk[0][3])), dtype=np.float32)
+                ip[:, 1] = -1  # padding: w_last < w_first -> no work
+                for j, (_pi, _ri, ipj, fpj) in enumerate(chunk):
+                    ip[j] = ipj
+                    fp[j] = fpj
+                fn = row_gather.compiled_gather_batch(sig, G)
+                dispatches.append((chunk, fn(trun.dev.arrays, ip, fp)))
+        return dispatches
+
+    def _feed_round(self, states, pending, dispatches, disp_bufs):
+        """Feed fetched buffers back to their gathers; returns the next
+        round's pending param-rows ({} when every gather completed).
+
+        Lanes that are provably complete after round 1 (paged LIMIT scans
+        with no host verification: the while_loop either filled M >= limit
+        matches or exhausted the range) are decoded in one vectorized pass
+        per plan structure instead of page-by-page — per-page Python cost
+        is what caps server throughput once fetches are pipelined."""
+        groups: dict = {}
+        handled: set[int] = set()
+        for (chunk, _out), bufs in zip(dispatches, disp_bufs):
+            tails = None
+            for j, (pi, ri, _ip, _fp) in enumerate(chunk):
                 st = states[pi]
-                for ri, (ip, fp) in enumerate(rows):
-                    by_sig.setdefault((st.sig, id(st.trun)),
-                                      (st.trun, []))[1].append(
-                        (pi, ri, ip, fp))
-            dispatches = []
-            for (sig, _tid), (trun, members) in by_sig.items():
-                for c0 in range(0, len(members), self._G_BUCKETS[-1]):
-                    chunk = members[c0:c0 + self._G_BUCKETS[-1]]
-                    G = next(g for g in self._G_BUCKETS if g >= len(chunk))
-                    ip = np.zeros((G, len(chunk[0][2])), dtype=np.int32)
-                    fp = np.zeros((G, len(chunk[0][3])), dtype=np.float32)
-                    ip[:, 1] = -1  # padding: w_last < w_first -> no work
-                    for j, (_pi, _ri, ipj, fpj) in enumerate(chunk):
-                        ip[j] = ipj
-                        fp[j] = fpj
-                    fn = row_gather.compiled_gather_batch(sig, G)
-                    dispatches.append((chunk, fn(trun.dev.arrays, ip, fp)))
+                ctx = st.ctx
+                if (ri != 0 or len(pending[pi]) != 1 or st.rows or
+                        st.mode != "paged" or ctx["aggregate"] or
+                        ctx["verify_preds"] or ctx["limit"] is None or
+                        ctx.get("struct_key") is None):
+                    continue
+                if tails is None:  # one vectorized read per chunk
+                    tails = bufs[:, ctx["M"], :2].tolist()
+                groups.setdefault(ctx["struct_key"], []).append(
+                    (pi, st, bufs[j], tails[j]))
+        for members in groups.values():
+            self._batch_emit(members)
+            handled.update(pi for pi, _st, _b, _t in members)
 
-            one_shot = [outs for _pi, outs, _fin in issued_outs] \
-                if first_round else []
-            if first_round:
-                # Device dispatches are in flight; overlap the host-path
-                # scans (multi-source merges) with device execution.
-                for pi, fin in host_plans:
-                    results[pi] = fin()
-            fetched = jax.device_get(
-                [[d for _c, d in dispatches], one_shot])
-            disp_bufs, issued_np = fetched
-            if first_round:
-                for (pi, _outs, fin), f in zip(issued_outs, issued_np):
-                    results[pi] = fin(f)
-                first_round = False
+        plan_bufs: dict[int, dict[int, np.ndarray]] = {}
+        for (chunk, _out), bufs in zip(dispatches, disp_bufs):
+            for j, (pi, ri, _ip, _fp) in enumerate(chunk):
+                if pi in handled:
+                    continue
+                plan_bufs.setdefault(pi, {})[ri] = bufs[j]
+        next_pending = {}
+        for pi, rows in pending.items():
+            st = states[pi]
+            if pi in handled:
+                st.pending = []
+                continue
+            bufs = [plan_bufs[pi][ri] for ri in range(len(rows))]
+            more = st.consume(bufs)
+            if more:
+                next_pending[pi] = more
+        return next_pending
 
-            plan_bufs: dict[int, dict[int, np.ndarray]] = {}
-            for (chunk, _out), bufs in zip(dispatches, disp_bufs):
-                for j, (pi, ri, _ip, _fp) in enumerate(chunk):
-                    plan_bufs.setdefault(pi, {})[ri] = bufs[j]
+    def _batch_emit(self, members):
+        """Vectorized decode of many completed LIMIT pages that share one
+        plan structure: one concatenate + one decode per column for the
+        whole group, then per-page list slices."""
+        from yugabyte_db_tpu.ops import row_gather
 
-            next_pending = {}
-            for pi, rows in pending.items():
-                st = states[pi]
-                bufs = [plan_bufs[pi][ri] for ri in range(len(rows))]
-                more = st.consume(bufs)
-                if more:
-                    next_pending[pi] = more
-            pending = next_pending
-
-        for pi, st in gathers:
-            results[pi] = st.result()
-        return results
+        st0 = members[0][1]
+        ctx = st0.ctx
+        M, limit, crun = ctx["M"], ctx["limit"], ctx["crun"]
+        projection = ctx["projection"]
+        key_col_pos = ctx["key_col_pos"]
+        _w, col_offs = row_gather.out_layout(ctx["sig"])
+        parts, metas = [], []
+        for _pi, st, buf, (count, scanned) in members:
+            n_take = min(count, M, limit)
+            st.scanned += scanned
+            if n_take:
+                parts.append(buf[:n_take])
+            metas.append((st, n_take))
+        if parts:
+            flat = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            starts = flat[:, 0]
+            kv_cols = (crun.key_col_arrays()
+                       if any(nm in key_col_pos for nm in projection)
+                       else None)
+            cols_out = []
+            for nm in projection:
+                if nm in key_col_pos:
+                    cols_out.append(
+                        kv_cols[key_col_pos[nm]][starts].tolist())
+                else:
+                    cols_out.append(self._decode_col(
+                        self._name_to_id[nm], flat, flat.shape[0], crun,
+                        col_offs))
+            rows_all = list(zip(*cols_out))
+        else:
+            rows_all = []
+            starts = None
+        off = 0
+        for st, n_take in metas:
+            st.rows = rows_all[off:off + n_take]
+            if n_take >= limit:
+                st.resume = crun.key_at(
+                    int(starts[off + n_take - 1])) + b"\x00"
+            off += n_take
 
     def _plan_scan(self, spec: ScanSpec):
         """-> ("host", finish()) | ("issued", outs, finish(fetched))
            | ("gather", _GatherScan)."""
         runs = self._overlapping_runs(spec)
+        # Snapshot the memtable object NOW: host-path closures may run at
+        # _AsyncBatch.finish() time, after a concurrent flush swapped
+        # self.memtable for an empty one (the flushed rows would then be
+        # in neither captured source). flush() never mutates the old
+        # MemTable, so plan-time (runs, mem) is a consistent snapshot.
+        mem = self.memtable
         mem_live = self._memtable_in_range(spec)
         exact, superset, host_only = self._split_predicates(spec)
         pred_split = (exact, superset, host_only)
@@ -600,16 +689,17 @@ class TpuStorageEngine(StorageEngine):
                 return ("gather", self._plan_gather(
                     runs[0], spec, pred_split, aggregate=True))
             return ("host", lambda: self._row_scan(
-                spec, runs, mem_live, pred_split, aggregate=True))
+                spec, runs, mem_live, pred_split, aggregate=True, mem=mem))
         if single_source and runs:
             return ("gather", self._plan_gather(
                 runs[0], spec, pred_split, aggregate=False))
         return ("host", lambda: self._row_scan(
-            spec, runs, mem_live, pred_split, aggregate=False))
+            spec, runs, mem_live, pred_split, aggregate=False, mem=mem))
 
     def _row_scan(self, spec: ScanSpec, runs, mem_live, pred_split,
-                  aggregate: bool):
+                  aggregate: bool, mem: MemTable | None = None):
         exact, superset, host_only = pred_split
+        mem = self.memtable if mem is None else mem
         single_source = len(runs) == 1 and not mem_live
         apply_preds = single_source
         pred_sigs, pred_lits = (
@@ -620,8 +710,8 @@ class TpuStorageEngine(StorageEngine):
             self._device_candidates(t, spec, pred_sigs, pred_lits, apply_preds)
             for t in runs
         ]
-        if mem_live or not self.memtable.is_empty:
-            key_streams.append(self.memtable.scan_keys(spec.lower, spec.upper))
+        if mem_live or not mem.is_empty:
+            key_streams.append(mem.scan_keys(spec.lower, spec.upper))
 
         import heapq
 
@@ -641,7 +731,7 @@ class TpuStorageEngine(StorageEngine):
             versions: list[RowVersion] = []
             for t in runs:
                 versions.extend(t.crun.find_versions(key))
-            versions.extend(self.memtable.versions(key))
+            versions.extend(mem.versions(key))
             merged = merge_versions(key, versions, spec.read_ht)
             if not merged.exists:
                 continue
@@ -705,7 +795,11 @@ class TpuStorageEngine(StorageEngine):
         dt = self._dtypes[cid]
         if dt == DataType.BOOL:
             return [None if null[i] else bool(raw[i]) for i in range(n)]
-        return [None if null[i] else raw[i] for i in range(n)]
+        if not null.any():
+            return raw
+        for i in np.nonzero(null)[0].tolist():
+            raw[i] = None
+        return raw
 
     def _pred_host_literals(self, preds):
         """Predicate literals -> (int32 plane list, f32 list), host values."""
@@ -749,6 +843,23 @@ class TpuStorageEngine(StorageEngine):
 
         exact, superset, host_only = pred_split
         crun = trun.crun
+        # Structural plan cache: a server runs thousands of pages with
+        # the same shape (projection/predicates/limit) per batch; the
+        # per-spec parts (row bounds, read point, params) are cheap, the
+        # structure (out cols, sigs, literal encodings) is not.
+        cache_key = None
+        if not aggregate:
+            try:
+                cache_key = (id(trun), spec.limit,
+                             tuple(spec.projection or ()),
+                             tuple((p.column, p.op, p.value)
+                                   for p in spec.predicates))
+                cached = self._plan_cache.get(cache_key)
+            except TypeError:
+                cache_key = cached = None  # unhashable literal: no cache
+            if cached is not None:
+                ctx = dict(cached)
+                return self._finish_plan_gather(trun, spec, ctx)
         projection = spec.projection or [c.name for c in self.schema.columns]
         verify_preds = superset + host_only
         if aggregate:
@@ -776,7 +887,7 @@ class TpuStorageEngine(StorageEngine):
         R = crun.R
 
         ctx = {
-            "crun": crun, "trun": trun, "spec": spec, "agg": agg,
+            "crun": crun, "trun": trun, "agg": agg,
             "aggregate": aggregate, "projection": projection,
             "verify_preds": verify_preds, "decode_ids": decode_ids,
             "limit": limit, "out_cols": out_cols, "pred_sigs": pred_sigs,
@@ -784,54 +895,65 @@ class TpuStorageEngine(StorageEngine):
             "key_col_pos": {c.name: i
                             for i, c in enumerate(self.schema.key_columns)},
         }
-
-        row_lo = crun.lower_row(spec.lower)
-        row_hi = crun.upper_row(spec.upper)
-        read_planes = self._read_plane_ints(spec)
-        ctx["read_planes"] = read_planes
-        if row_lo >= row_hi:
-            ctx["M"], ctx["sig"] = 256, self._gather_sig(ctx, 256)
-            return _GatherScan(self, ctx, "paged", [], 0, 0, None)
-
-        if limit is not None:
-            # Small windows (K=1) capped per round: a batch of pages stays
-            # in vmap lockstep only for the few windows a page actually
-            # needs; lanes needing more continue in the next batched round.
-            K = 1
-            cap = max(2, -(-2 * limit // R))
-            M = 256 if (not verify_preds and limit + 32 <= 256) else 4096
-        elif device_preds or verify_preds:
-            # Unlimited selective scan: one while_loop over the whole
-            # range; transfers stay proportional to the (selective) result.
-            K = WINDOW_BLOCKS
-            cap = None
-            M = 4096
-        else:
+        if limit is None and not device_preds and not verify_preds:
             # Unbounded, unpredicated: one param-row per window, emitted
             # in place (every row is a result row; the host compacts).
-            K = WINDOW_BLOCKS
-            M = K * R
-            sig = self._gather_sig(ctx, M, packed=False, K=K)
-            ctx["M"], ctx["sig"] = M, sig
-            w_first = row_lo // (K * R)
-            w_last = (row_hi - 1) // (K * R)
+            ctx["mode"] = "chunks"
+            ctx["M"] = M = K * R
+            ctx["sig"] = self._gather_sig(ctx, M, packed=False, K=K)
+        else:
+            # One definitive round, LIMIT page or selective scan: the
+            # while_loop walks windows to the range end, early-exiting
+            # once the buffer holds M matches. A LIMIT page (M > limit)
+            # never needs a second dispatch — every synchronous fetch
+            # cycle costs ~1 link round trip (~100ms on the tunnel), so
+            # round count, not device compute, is the price that matters.
+            ctx["mode"] = "paged"
+            # The tunnel link moves ~30MB/s device->host: the output
+            # buffer M is the page's wire cost, so use the smallest
+            # bucket that guarantees one-round completion (M >= limit).
+            M = 4096
+            if limit is not None and not verify_preds:
+                M = next((m for m in (104, 256, 1024, 4096) if m >= limit),
+                         -(-limit // 8) * 8)
+            ctx["M"] = M
+            ctx["sig"] = self._gather_sig(ctx, M, K=K)
+        if cache_key is not None:
+            if len(self._plan_cache) >= 1024:  # distinct literals bound it
+                self._plan_cache.pop(next(iter(self._plan_cache)))
+            ctx["struct_key"] = cache_key
+            self._plan_cache[cache_key] = dict(ctx)
+        return self._finish_plan_gather(trun, spec, ctx)
+
+    def _finish_plan_gather(self, trun: TpuRun, spec: ScanSpec, ctx):
+        """Per-spec completion of a (possibly cached) gather plan:
+        row bounds, read point, param rows."""
+        from yugabyte_db_tpu.ops import row_gather
+
+        crun = trun.crun
+        read_planes = self._read_plane_ints(spec)
+        ctx["read_planes"] = read_planes
+        row_lo = crun.lower_row(spec.lower)
+        row_hi = crun.upper_row(spec.upper)
+        if row_lo >= row_hi:
+            return _GatherScan(self, ctx, "paged", [], 0, 0)
+        K = ctx["sig"].K
+        R = crun.R
+        w_first = row_lo // (K * R)
+        w_last = (row_hi - 1) // (K * R)
+        if ctx["mode"] == "chunks":
             param_rows = [
                 row_gather.pack_params(w, w, row_lo, row_hi, read_planes,
-                                       int_lits, f32_lits)
+                                       ctx["int_lits"], ctx["f32_lits"])
                 for w in range(w_first, w_last + 1)
             ]
             return _GatherScan(self, ctx, "chunks", param_rows,
-                               w_last, row_hi, None)
-
-        sig = self._gather_sig(ctx, M, K=K)
-        ctx["M"], ctx["sig"] = M, sig
-        w_first = row_lo // (K * R)
-        w_last = (row_hi - 1) // (K * R)
-        w_cap = w_last if cap is None else min(w_last, w_first + cap - 1)
+                               w_last, row_hi)
         ip, fp = row_gather.pack_params(
-            w_first, w_cap, row_lo, row_hi, read_planes, int_lits, f32_lits)
+            w_first, w_last, row_lo, row_hi, read_planes,
+            ctx["int_lits"], ctx["f32_lits"])
         return _GatherScan(self, ctx, "paged", [(ip, fp)],
-                           w_last, row_hi, cap)
+                           w_last, row_hi)
 
     def _read_plane_ints(self, spec: ScanSpec):
         r_hi, r_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT))
@@ -876,6 +998,28 @@ class TpuStorageEngine(StorageEngine):
             return 0, 0, False, None
         _w, col_offs = row_gather.out_layout(ctx["sig"])
         starts = buf[:n, 0]
+
+        hit_limit = False
+        if not verify_preds and not aggregate:
+            # Columnar fast path: decode only the rows the page will
+            # emit; key columns come from the run's per-column object
+            # arrays via one fancy-index (no per-row Python decode).
+            n_take = n if limit is None else min(n, limit - len(rows))
+            sel = starts[:n_take]
+            kv_cols = (crun.key_col_arrays()
+                       if any(nm in key_col_pos for nm in projection)
+                       else None)
+            cols_out = []
+            for nm in projection:
+                if nm in key_col_pos:
+                    cols_out.append(kv_cols[key_col_pos[nm]][sel].tolist())
+                else:
+                    cols_out.append(self._decode_col(
+                        self._name_to_id[nm], buf, n_take, crun, col_offs))
+            rows.extend(zip(*cols_out))
+            hit_limit = limit is not None and len(rows) >= limit
+            return count, n, hit_limit, int(starts[n_take - 1])
+
         colvals = {cid: self._decode_col(cid, buf, n, crun, col_offs)
                    for cid in ctx["decode_ids"]}
 
@@ -883,22 +1027,6 @@ class TpuStorageEngine(StorageEngine):
             if name in _kp:
                 return crun.key_vals_at(int(_s[i]))[_kp[name]]
             return _cv[self._name_to_id[name]][i]
-
-        hit_limit = False
-        if not verify_preds and not aggregate:
-            # Columnar fast path: per-column lists, tuples built by zip.
-            n_take = n if limit is None else min(n, limit - len(rows))
-            cols_out = []
-            for nm in projection:
-                if nm in key_col_pos:
-                    p = key_col_pos[nm]
-                    cols_out.append([crun.key_vals_at(int(s))[p]
-                                     for s in starts[:n_take]])
-                else:
-                    cols_out.append(colvals[self._name_to_id[nm]][:n_take])
-            rows.extend(zip(*cols_out))
-            hit_limit = limit is not None and len(rows) >= limit
-            return count, n, hit_limit, int(starts[n_take - 1])
         taken_i = -1
         for i in range(n):
             if verify_preds and not all(
@@ -1159,6 +1287,54 @@ class TpuStorageEngine(StorageEngine):
         return [ivec, fvec], finish
 
 
+class _AsyncBatch:
+    """An in-flight scan_batch: round-1 device work is issued and its
+    outputs are streaming host-ward; .finish() consumes them (one fetch
+    cycle worst case, free when the copies already landed), runs any host
+    fallback scans, and drives the (rare) continuation rounds."""
+
+    def __init__(self, eng, results, host_plans, issued_outs, gathers,
+                 states, pending, dispatches):
+        self.eng = eng
+        self.results = results
+        self.host_plans = host_plans
+        self.issued_outs = issued_outs
+        self.gathers = gathers
+        self.states = states
+        self.pending = pending
+        self.dispatches = dispatches
+        self._done = False
+
+    def finish(self) -> list[ScanResult]:
+        if self._done:
+            return self.results
+        eng = self.eng
+        results = self.results
+        # Host-path scans first: device work is already in flight.
+        for pi, fin in self.host_plans:
+            results[pi] = fin()
+        # One fetch for everything issued in round 1 (device_get reuses
+        # buffers the async copies already landed).
+        disp_bufs, issued_np = jax.device_get(
+            [[d for _c, d in self.dispatches],
+             [o for _pi, o, _f in self.issued_outs]])
+        for (pi, _outs, fin), f in zip(self.issued_outs, issued_np):
+            results[pi] = fin(f)
+        pending = eng._feed_round(self.states, self.pending,
+                                  self.dispatches, disp_bufs)
+        # Continuation rounds (overflow/verification shortfalls): plain
+        # synchronous cycles.
+        while pending:
+            dispatches = eng._issue_round(self.states, pending)
+            disp_bufs = jax.device_get([d for _c, d in dispatches])
+            pending = eng._feed_round(self.states, pending, dispatches,
+                                      disp_bufs)
+        for pi, st in self.gathers:
+            results[pi] = st.result()
+        self._done = True
+        return self.results
+
+
 class _GatherScan:
     """State of one in-flight device scan across scan_batch rounds.
 
@@ -1168,7 +1344,7 @@ class _GatherScan:
     index only — no host key lookups on the continuation path."""
 
     def __init__(self, eng: TpuStorageEngine, ctx, mode: str, pending,
-                 w_last: int, row_hi: int, cap: int | None):
+                 w_last: int, row_hi: int):
         self.eng = eng
         self.ctx = ctx
         self.mode = mode          # "paged" | "chunks"
@@ -1177,7 +1353,6 @@ class _GatherScan:
         self.trun = ctx["trun"]
         self.w_last = w_last
         self.row_hi = row_hi
-        self.cap = cap
         self.rows: list[tuple] = []
         self.scanned = 0
         self.resume: bytes | None = None
@@ -1220,17 +1395,11 @@ class _GatherScan:
             self.pending = []
             return []
         w_first2 = row_lo2 // (K * R)
-        if self.cap is not None:
-            # Geometric growth: a page over a sparse region converges in
-            # O(log windows) rounds instead of O(windows).
-            self.cap = min(self.cap * 4, 4096)
-        w_cap2 = self.w_last if self.cap is None else \
-            min(self.w_last, w_first2 + self.cap - 1)
         # Windows up to w_end were already counted toward rows_scanned;
         # a mid-window resume must not re-count them.
         scan_from = max(row_lo2, w_end * K * R)
         ip, fp = row_gather.pack_params(
-            w_first2, w_cap2, row_lo2, self.row_hi, ctx["read_planes"],
+            w_first2, self.w_last, row_lo2, self.row_hi, ctx["read_planes"],
             ctx["int_lits"], ctx["f32_lits"], scan_from=scan_from)
         self.pending = [(ip, fp)]
         return self.pending

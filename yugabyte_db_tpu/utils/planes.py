@@ -53,8 +53,13 @@ def ht_to_planes(ht_values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return u64_to_planes(ht_values.astype(np.int64).view(np.uint64))
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def scalar_ht_planes(ht_value: int) -> tuple[int, int]:
-    """A single hybrid time -> (hi, lo) python ints suitable as jnp.int32."""
+    """A single hybrid time -> (hi, lo) python ints suitable as jnp.int32.
+    Cached: servers resolve the same read points (and MAX_HT) constantly."""
     hi, lo = ht_to_planes(np.array([ht_value], dtype=np.int64))
     return int(hi[0]), int(lo[0])
 
