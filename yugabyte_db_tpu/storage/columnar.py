@@ -90,8 +90,9 @@ class ColumnarRun:
         self.max_key_len = 0
         # Lazily-built per-key-column object arrays (global row index ->
         # decoded key value) for C-speed fancy-indexed materialization of
-        # key columns on the batched scan path.
+        # key columns on the batched scan path; decoded block-by-block.
         self._kv_cols: list[np.ndarray] | None = None
+        self._kv_blocks_done: set[int] = set()
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -176,73 +177,117 @@ class ColumnarRun:
         self.blocks = [BlockMeta(b"", b"", 0) for _ in range(B)]
 
     def _fill_block(self, b: int, group_list) -> None:
-        R = self.R
-        r = 0
+        """Encode one block's rows. One cheap Python pass collects parallel
+        per-plane lists; every plane then encodes with a single vectorized
+        numpy call (the per-row scalar encode was the write-path
+        bottleneck: ~15 tiny numpy ops per version)."""
         keys_flat: list[bytes] = []
+        vers_flat: list[RowVersion] = []
+        gs: list[bool] = []
+        hts: list[int] = []
+        tombs: list[bool] = []
+        lives: list[bool] = []
+        exp_idx: list[int] = []
+        exp_hts: list[int] = []
+        col_rows: dict[int, list[int]] = {cid: [] for cid in self.cols}
+        col_vals: dict[int, list] = {cid: [] for cid in self.cols}
+        r = 0
         for key, versions in group_list:
-            for j, v in enumerate(versions):
-                self.valid[b, r] = True
-                self.group_start[b, r] = (j == 0)
-                self.tomb[b, r] = v.tombstone
-                self.live[b, r] = v.liveness
-                self.row_keys[b][r] = key
-                self.row_versions[b][r] = v
+            first = True
+            for v in versions:
+                gs.append(first)
+                first = False
                 keys_flat.append(key)
-                hts = P.scalar_ht_planes(v.ht)
-                self.ht_hi[b, r], self.ht_lo[b, r] = hts
-                if v.ht > self.max_ht:
-                    self.max_ht = v.ht
+                vers_flat.append(v)
+                hts.append(v.ht)
+                tombs.append(v.tombstone)
+                lives.append(v.liveness)
                 if v.has_ttl:
-                    es = P.scalar_ht_planes(v.expire_ht)
-                    self.exp_hi[b, r], self.exp_lo[b, r] = es
+                    exp_idx.append(r)
+                    exp_hts.append(v.expire_ht)
                 for cid, val in v.columns.items():
-                    self._fill_value(b, r, cid, val)
+                    col_rows[cid].append(r)
+                    col_vals[cid].append(val)
                 r += 1
-        if keys_flat:
-            kp = P.key_prefix_planes(keys_flat, KEY_WORDS)
-            self.key_planes[b, : len(keys_flat)] = kp
+        n = r
         self.blocks[b] = BlockMeta(
             group_list[0][0] if group_list else b"",
             group_list[-1][0] if group_list else b"",
-            r,
+            n,
         )
-
-    def _fill_value(self, b: int, r: int, cid: int, val) -> None:
-        col = self.cols[cid]
-        col.set_[b, r] = True
-        if val is None:
-            col.isnull[b, r] = True
+        if n == 0:
             return
+        self.valid[b, :n] = True
+        self.group_start[b, :n] = gs
+        self.tomb[b, :n] = tombs
+        self.live[b, :n] = lives
+        self.row_keys[b][:n] = keys_flat
+        self.row_versions[b][:n] = vers_flat
+        ht_arr = np.array(hts, dtype=np.int64)
+        hi, lo = P.ht_to_planes(ht_arr)
+        self.ht_hi[b, :n] = hi
+        self.ht_lo[b, :n] = lo
+        self.max_ht = max(self.max_ht, int(ht_arr.max()))
+        if exp_idx:
+            ehi, elo = P.ht_to_planes(np.array(exp_hts, dtype=np.int64))
+            self.exp_hi[b, exp_idx] = ehi
+            self.exp_lo[b, exp_idx] = elo
+        kp = P.key_prefix_planes(keys_flat, KEY_WORDS)
+        self.key_planes[b, :n] = kp
+        for cid in self.cols:
+            if col_rows[cid]:
+                self._fill_column(b, cid, col_rows[cid], col_vals[cid])
+
+    def _fill_column(self, b: int, cid: int, rows: list[int],
+                     vals: list) -> None:
+        """Vectorized encode of one column's set values within a block."""
+        col = self.cols[cid]
+        col.set_[b, rows] = True
+        nn_rows = rows
+        nn_vals = vals
+        if any(v is None for v in vals):
+            null_rows = [r for r, v in zip(rows, vals) if v is None]
+            col.isnull[b, null_rows] = True
+            nn_rows = [r for r, v in zip(rows, vals) if v is not None]
+            nn_vals = [v for v in vals if v is not None]
+            if not nn_rows:
+                return
         dt = col.dtype
         if dt.is_integer or dt == DataType.BOOL:
-            iv = int(val)
             if dt == DataType.BOOL:
-                iv = int(bool(val))
-            if col.cmp_planes.shape[-1] == 2:
-                hi, lo = P.i64_to_ordered_planes(np.array([iv], dtype=np.int64))
-                col.cmp_planes[b, r, 0] = hi[0]
-                col.cmp_planes[b, r, 1] = lo[0]
+                arr = np.array([int(bool(v)) for v in nn_vals],
+                               dtype=np.int64)
             else:
-                col.cmp_planes[b, r, 0] = iv
-            col.arith[b, r] = np.float32(iv)
+                arr = np.array(nn_vals, dtype=np.int64)
+            if col.cmp_planes.shape[-1] == 2:
+                hi, lo = P.i64_to_ordered_planes(arr)
+                col.cmp_planes[b, nn_rows, 0] = hi
+                col.cmp_planes[b, nn_rows, 1] = lo
+            else:
+                col.cmp_planes[b, nn_rows, 0] = arr
+            col.arith[b, nn_rows] = arr.astype(np.float32)
         elif dt == DataType.FLOAT:
-            fv = np.float32(val)
-            col.cmp_planes[b, r, 0] = fv.view(np.int32)  # raw bits; compare via arith plane
-            col.arith[b, r] = fv
+            arr = np.array(nn_vals, dtype=np.float32)
+            col.cmp_planes[b, nn_rows, 0] = arr.view(np.int32)
+            col.arith[b, nn_rows] = arr
         elif dt == DataType.DOUBLE:
-            hi, lo = P.f64_to_ordered_planes(np.array([val], dtype=np.float64))
-            col.cmp_planes[b, r, 0] = hi[0]
-            col.cmp_planes[b, r, 1] = lo[0]
-            col.arith[b, r] = np.float32(val)
+            arr = np.array(nn_vals, dtype=np.float64)
+            hi, lo = P.f64_to_ordered_planes(arr)
+            col.cmp_planes[b, nn_rows, 0] = hi
+            col.cmp_planes[b, nn_rows, 1] = lo
+            col.arith[b, nn_rows] = arr.astype(np.float32)
         else:  # STRING / BINARY
-            raw = (val.encode("utf-8", "surrogateescape")
-                   if isinstance(val, str) else bytes(val))
-            hi, lo = P.varlen_prefix_planes([raw])
-            col.cmp_planes[b, r, 0] = hi[0]
-            col.cmp_planes[b, r, 1] = lo[0]
-            col.varlen[b][r] = val
-            if len(raw) > self.varlen_max_len.get(cid, 0):
-                self.varlen_max_len[cid] = len(raw)
+            raws = [v.encode("utf-8", "surrogateescape")
+                    if isinstance(v, str) else bytes(v) for v in nn_vals]
+            hi, lo = P.varlen_prefix_planes(raws)
+            col.cmp_planes[b, nn_rows, 0] = hi
+            col.cmp_planes[b, nn_rows, 1] = lo
+            vl = col.varlen[b]
+            for r, v in zip(nn_rows, nn_vals):
+                vl[r] = v
+            longest = max(map(len, raws))
+            if longest > self.varlen_max_len.get(cid, 0):
+                self.varlen_max_len[cid] = longest
 
     # -- host-side access (compaction input, materialization) -------------
     def iter_entries(self):
@@ -328,32 +373,38 @@ class ColumnarRun:
             kv = self.row_key_vals[b][r] = hashed + ranges
         return kv
 
-    def key_col_arrays(self) -> list[np.ndarray]:
+    def key_col_arrays(self, blocks=None) -> list[np.ndarray]:
         """One object ndarray per key column, indexed by global row index
-        (b*R + r), holding the decoded key value for every valid row.
-        Built once per run (one linear decode pass, memoized into
-        row_key_vals); batched scans then materialize key columns with a
-        single numpy fancy-index per page instead of per-row Python."""
-        if self._kv_cols is None:
-            from yugabyte_db_tpu.models.encoding import decode_doc_key
+        (b*R + r), holding the decoded key value. Decoded lazily PER
+        BLOCK (``blocks``: iterable of block indices a scan touched;
+        None = all) so a small page never pays an O(run) decode pass;
+        batched scans then materialize key columns with one numpy
+        fancy-index instead of per-row Python."""
+        from yugabyte_db_tpu.models.encoding import decode_doc_key
 
-            nk = len(self.schema.key_columns)
-            cols = [np.empty(self.B * self.R, dtype=object)
-                    for _ in range(nk)]
-            for b in range(self.B):
-                n = self.blocks[b].num_valid
-                rk = self.row_keys[b]
-                kvs = self.row_key_vals[b]
-                base = b * self.R
-                for r in range(n):
-                    kv = kvs[r]
-                    if kv is None:
-                        _, hashed, ranges = decode_doc_key(rk[r])
-                        kv = kvs[r] = hashed + ranges
-                    for p in range(nk):
-                        cols[p][base + r] = kv[p]
-            self._kv_cols = cols
-        return self._kv_cols
+        nk = len(self.schema.key_columns)
+        if self._kv_cols is None:
+            self._kv_cols = [np.empty(self.B * self.R, dtype=object)
+                             for _ in range(nk)]
+            self._kv_blocks_done = set()
+        cols = self._kv_cols
+        todo = range(self.B) if blocks is None else blocks
+        for b in todo:
+            if b in self._kv_blocks_done or b >= self.B:
+                continue
+            self._kv_blocks_done.add(b)
+            n = self.blocks[b].num_valid
+            rk = self.row_keys[b]
+            kvs = self.row_key_vals[b]
+            base = b * self.R
+            for r in range(n):
+                kv = kvs[r]
+                if kv is None:
+                    _, hashed, ranges = decode_doc_key(rk[r])
+                    kv = kvs[r] = hashed + ranges
+                for p in range(nk):
+                    cols[p][base + r] = kv[p]
+        return cols
 
     # -- block pruning -----------------------------------------------------
     def block_range(self, lower: bytes, upper: bytes) -> tuple[int, int]:

@@ -73,7 +73,10 @@ class MemTable:
 
     def drain_sorted(self) -> list[tuple[bytes, list[RowVersion]]]:
         """All (key, versions ht-desc) in key order — the flush input."""
-        out = []
-        for k in self._index():
-            out.append((k, sorted(self._data[k], key=lambda r: -r.ht)))
-        return out
+        from operator import attrgetter
+
+        ht_key = attrgetter("ht")
+        data = self._data
+        return [(k, vs if len(vs := data[k]) == 1
+                 else sorted(vs, key=ht_key, reverse=True))
+                for k in self._index()]

@@ -633,7 +633,8 @@ class TpuStorageEngine(StorageEngine):
         if parts:
             flat = np.concatenate(parts) if len(parts) > 1 else parts[0]
             starts = flat[:, 0]
-            kv_cols = (crun.key_col_arrays()
+            kv_cols = (crun.key_col_arrays(
+                           np.unique(starts // crun.R).tolist())
                        if any(nm in key_col_pos for nm in projection)
                        else None)
             cols_out = []
@@ -660,14 +661,16 @@ class TpuStorageEngine(StorageEngine):
     def _plan_scan(self, spec: ScanSpec):
         """-> ("host", finish()) | ("issued", outs, finish(fetched))
            | ("gather", _GatherScan)."""
-        runs = self._overlapping_runs(spec)
-        # Snapshot the memtable object NOW: host-path closures may run at
-        # _AsyncBatch.finish() time, after a concurrent flush swapped
-        # self.memtable for an empty one (the flushed rows would then be
-        # in neither captured source). flush() never mutates the old
-        # MemTable, so plan-time (runs, mem) is a consistent snapshot.
+        # Snapshot the memtable BEFORE the run list: flush() appends the
+        # new run and THEN swaps in an empty memtable, so (old mem, runs
+        # read after) can at worst see a flushed row in both sources
+        # (harmless — merge dedups by hybrid time) but never in neither.
+        # The snapshot also covers _AsyncBatch.finish()-time execution of
+        # host-path closures: flush() never mutates the old MemTable.
         mem = self.memtable
-        mem_live = self._memtable_in_range(spec)
+        runs = self._overlapping_runs(spec)
+        mem_live = (not mem.is_empty) and \
+            next(mem.scan_keys(spec.lower, spec.upper), None) is not None
         exact, superset, host_only = self._split_predicates(spec)
         pred_split = (exact, superset, host_only)
         single_source = len(runs) == 1 and not mem_live
@@ -1006,7 +1009,8 @@ class TpuStorageEngine(StorageEngine):
             # arrays via one fancy-index (no per-row Python decode).
             n_take = n if limit is None else min(n, limit - len(rows))
             sel = starts[:n_take]
-            kv_cols = (crun.key_col_arrays()
+            kv_cols = (crun.key_col_arrays(
+                           np.unique(sel // crun.R).tolist())
                        if any(nm in key_col_pos for nm in projection)
                        else None)
             cols_out = []
