@@ -22,7 +22,8 @@ class MasterUnavailable(Exception):
 
 # Response codes no retry can change: surface immediately.
 TERMINAL_CODES = frozenset(
-    {"invalid_read_time", "conflict", "aborted", "committed", "error"})
+    {"invalid_read_time", "conflict", "aborted", "committed", "error",
+     "duplicate_key"})
 
 
 class TabletOpFailed(Exception):

@@ -156,6 +156,20 @@ class MiniCluster:
         addr = server.listen(host, port)
         return server, addr
 
+    def start_pg_server(self, host: str = "127.0.0.1", port: int = 0,
+                        **cluster_kwargs):
+        """Start a PostgreSQL wire-protocol frontend over this cluster
+        (the reference shape: the tserver spawns the SQL frontend on port
+        5433, tablet_server_main.cc:160). Returns (server, (host, port));
+        caller shuts the server down."""
+        from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+        from yugabyte_db_tpu.yql.pgsql.wire import PgServer
+
+        server = PgServer(ClientCluster(self.client("pg-proxy"),
+                                        **cluster_kwargs))
+        addr = server.listen(host, port)
+        return server, addr
+
     def leader_master(self, timeout_s: float = 10.0) -> Master:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

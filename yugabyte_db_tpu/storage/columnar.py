@@ -90,9 +90,13 @@ class ColumnarRun:
         self.max_key_len = 0
         # Lazily-built per-key-column object arrays (global row index ->
         # decoded key value) for C-speed fancy-indexed materialization of
-        # key columns on the batched scan path; decoded block-by-block.
+        # key columns on the batched scan path; decoded block-by-block
+        # under a lock (concurrent scans share one tablet's run).
+        import threading
+
         self._kv_cols: list[np.ndarray] | None = None
         self._kv_blocks_done: set[int] = set()
+        self._kv_lock = threading.Lock()
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -383,27 +387,29 @@ class ColumnarRun:
         from yugabyte_db_tpu.models.encoding import decode_doc_key
 
         nk = len(self.schema.key_columns)
-        if self._kv_cols is None:
-            self._kv_cols = [np.empty(self.B * self.R, dtype=object)
-                             for _ in range(nk)]
-            self._kv_blocks_done = set()
-        cols = self._kv_cols
-        todo = range(self.B) if blocks is None else blocks
-        for b in todo:
-            if b in self._kv_blocks_done or b >= self.B:
-                continue
-            self._kv_blocks_done.add(b)
-            n = self.blocks[b].num_valid
-            rk = self.row_keys[b]
-            kvs = self.row_key_vals[b]
-            base = b * self.R
-            for r in range(n):
-                kv = kvs[r]
-                if kv is None:
-                    _, hashed, ranges = decode_doc_key(rk[r])
-                    kv = kvs[r] = hashed + ranges
-                for p in range(nk):
-                    cols[p][base + r] = kv[p]
+        with self._kv_lock:
+            if self._kv_cols is None:
+                self._kv_cols = [np.empty(self.B * self.R, dtype=object)
+                                 for _ in range(nk)]
+            cols = self._kv_cols
+            todo = range(self.B) if blocks is None else blocks
+            for b in todo:
+                if b in self._kv_blocks_done or b >= self.B:
+                    continue
+                n = self.blocks[b].num_valid
+                rk = self.row_keys[b]
+                kvs = self.row_key_vals[b]
+                base = b * self.R
+                for r in range(n):
+                    kv = kvs[r]
+                    if kv is None:
+                        _, hashed, ranges = decode_doc_key(rk[r])
+                        kv = kvs[r] = hashed + ranges
+                    for p in range(nk):
+                        cols[p][base + r] = kv[p]
+                # marked done only after the block is fully decoded, so a
+                # concurrent reader can never see half-filled rows
+                self._kv_blocks_done.add(b)
         return cols
 
     # -- block pruning -----------------------------------------------------

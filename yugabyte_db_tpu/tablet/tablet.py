@@ -180,13 +180,28 @@ class Tablet:
             self.coordinator.apply_status_op(entry.body)
 
     # -- write path ---------------------------------------------------------
-    def write(self, rows: list[RowVersion]) -> HybridTime:
+    def write(self, rows: list[RowVersion],
+              if_not_exists: bool = False) -> HybridTime:
         """Apply one write operation (a batch of row versions, HT-stamped
         here). Durable (WAL fsync) before apply, matching the reference's
-        Replicate-before-Apply invariant."""
+        Replicate-before-Apply invariant.
+
+        ``if_not_exists``: atomic uniqueness enforcement — the existence
+        check runs under the same write lock as the apply, so concurrent
+        duplicate inserts cannot both pass (the SQL INSERT contract;
+        reference: the read-modify-write inside the tablet,
+        cql_operation.cc QLWriteOperation)."""
         if self.consensus_managed:
             raise RuntimeError("writes must go through the TabletPeer")
         with self._write_lock:
+            if if_not_exists:
+                from yugabyte_db_tpu.utils.status import AlreadyPresent
+
+                for r in rows:
+                    if self.current_row_values(r.key) is not None:
+                        raise AlreadyPresent(
+                            "duplicate key value violates unique "
+                            "constraint")
             ht = self.clock.now()
             self.mvcc.add_pending(ht)
             try:

@@ -382,6 +382,16 @@ class TabletServer:
             with peer._intent_lock:
                 conflicting = peer.tablet.participant.pending_on_keys(keys)
                 if not conflicting:
+                    if p.get("if_not_exists"):
+                        # Atomic uniqueness: the intent-admission lock is
+                        # held across this check AND peer.write's
+                        # append+wait, so a concurrent duplicate insert
+                        # observes the first one applied (SQL INSERT
+                        # semantics; errcode 23505 at the frontend).
+                        if peer.raft.is_leader() and any(
+                                peer.tablet.current_row_values(k)
+                                is not None for k in keys):
+                            return {"code": "duplicate_key"}
                     try:
                         ht = peer.write(rows, timeout=p.get("timeout", 10.0),
                                         client_id=p.get("client_id"),

@@ -1,0 +1,43 @@
+"""Helpers shared by the YQL frontends (CQL processor, SQL executor).
+
+One implementation of value coercion and key->tablet routing so the two
+frontends cannot drift (they lower to the same DocDB write/read ops;
+reference: the shared QLValue coercion + partition routing both the CQL
+executor and pggate use, src/yb/common/ql_value.h, partition.h:204).
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.models.datatypes import DataType, python_value_matches
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnSchema
+from yugabyte_db_tpu.utils.status import InvalidArgument
+
+
+def coerce_value(col: ColumnSchema, value):
+    """Coerce a resolved (marker-free) literal to the column's type."""
+    if value is None:
+        return None
+    dt = col.dtype
+    if dt.is_integer and isinstance(value, bool):
+        raise InvalidArgument(f"bad value for {col.name}")
+    if dt in (DataType.DOUBLE, DataType.FLOAT) and \
+            isinstance(value, int) and not isinstance(value, bool):
+        value = float(value)
+    if dt == DataType.BINARY and isinstance(value, str):
+        value = value.encode("utf-8")
+    if not python_value_matches(dt, value):
+        raise InvalidArgument(
+            f"bad value {value!r} for {col.name} ({dt.name})")
+    return value
+
+
+def key_and_tablet(cluster, handle, key_values: dict):
+    """Encode the primary key and route to the owning tablet (hash
+    tables route by hash code; range tables have a single tablet)."""
+    schema = handle.schema
+    hash_code = compute_hash_code(schema, key_values)
+    key = schema.encode_primary_key(key_values, hash_code)
+    tablet = (cluster.tablet_for_hash(handle, hash_code)
+              if schema.num_hash else handle.tablets[0])
+    return key, tablet

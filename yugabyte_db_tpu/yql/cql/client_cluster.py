@@ -34,10 +34,22 @@ class RemoteTablet:
         # (tablet_server._h_ts_scan), exactly like a fresh scan.
         return HybridTime.max()
 
-    def write(self, rows: list[RowVersion]) -> None:
-        self.client.tablet_rpc(
-            self.table_name, self.loc, "ts.write",
-            {"rows": wire.encode_rows(rows)})
+    def write(self, rows: list[RowVersion],
+              if_not_exists: bool = False) -> None:
+        from yugabyte_db_tpu.client.client import TabletOpFailed
+
+        payload = {"rows": wire.encode_rows(rows)}
+        if if_not_exists:
+            payload["if_not_exists"] = True
+        try:
+            self.client.tablet_rpc(self.table_name, self.loc, "ts.write",
+                                   payload)
+        except TabletOpFailed as e:
+            if getattr(e, "resp", {}).get("code") == "duplicate_key":
+                raise AlreadyPresent(
+                    "duplicate key value violates unique constraint") \
+                    from None
+            raise
 
     def scan(self, spec: ScanSpec) -> ScanResult:
         resp = self.client.tablet_rpc(

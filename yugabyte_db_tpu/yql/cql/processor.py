@@ -19,7 +19,7 @@ import shutil
 import tempfile
 from dataclasses import dataclass, field
 
-from yugabyte_db_tpu.models.datatypes import DataType, python_value_matches
+from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.models.encoding import (encode_doc_key_prefix,
                                              encode_key_component,
                                              prefix_successor)
@@ -387,29 +387,14 @@ class QLProcessor:
 
     # -- writes ------------------------------------------------------------
     def _coerce(self, col: ColumnSchema, value):
-        value = self._resolve_marker(value)
-        if value is None:
-            return None
-        dt = col.dtype
-        if dt.is_integer and isinstance(value, bool):
-            raise InvalidArgument(f"bad value for {col.name}")
-        if dt == DataType.DOUBLE or dt == DataType.FLOAT:
-            if isinstance(value, int) and not isinstance(value, bool):
-                value = float(value)
-        if dt == DataType.BINARY and isinstance(value, str):
-            value = value.encode("utf-8")
-        if not python_value_matches(dt, value):
-            raise InvalidArgument(
-                f"bad value {value!r} for {col.name} ({dt.name})")
-        return value
+        from yugabyte_db_tpu.yql.common import coerce_value
+
+        return coerce_value(col, self._resolve_marker(value))
 
     def _key_and_tablet(self, handle: TableHandle, key_values: dict):
-        schema = handle.schema
-        hash_code = compute_hash_code(schema, key_values)
-        key = schema.encode_primary_key(key_values, hash_code)
-        tablet = (self.cluster.tablet_for_hash(handle, hash_code)
-                  if schema.num_hash else handle.tablets[0])
-        return key, tablet
+        from yugabyte_db_tpu.yql.common import key_and_tablet
+
+        return key_and_tablet(self.cluster, handle, key_values)
 
     def _expire_ht(self, ttl_seconds):
         ttl_seconds = self._require_nonneg_int(

@@ -1,0 +1,114 @@
+"""SQL (YSQL-dialect) statement AST.
+
+Reference analog: the parse-tree the PostgreSQL fork hands to pggate —
+statement shapes mirroring PgStatement subclasses (PgSelect/PgInsert/
+PgUpdate/PgDelete/PgCreateTable, src/yb/yql/pggate/pg_select.cc etc.).
+Scalar expressions reuse storage.expr nodes (Col/Const/BinOp) so an
+aggregate argument parses straight into the device-lowerable tree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.models.datatypes import DataType
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    dtype: DataType
+
+
+@dataclass
+class CreateTable:
+    name: str
+    columns: list[ColumnDef]
+    hash_keys: list[str]
+    range_keys: list[str]
+    if_not_exists: bool = False
+    num_tablets: int | None = None
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateIndex:
+    name: str
+    table: str
+    column: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropIndex:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class BindMarker:
+    """$N placeholder (1-based in SQL text, stored 0-based)."""
+
+    index: int
+
+
+@dataclass
+class Insert:
+    table: str
+    columns: list[str]
+    rows: list[list]           # one value list per VALUES tuple
+
+
+@dataclass
+class Rel:
+    """One WHERE conjunct: column op value (IN carries a tuple)."""
+
+    column: str
+    op: str                    # = != < <= > >= IN
+    value: object
+
+
+@dataclass
+class Update:
+    table: str
+    assignments: list[tuple]   # (column, expr-or-literal)
+    where: list[Rel]
+
+
+@dataclass
+class Delete:
+    table: str
+    where: list[Rel]
+
+
+@dataclass
+class Agg:
+    fn: str                    # count | sum | min | max | avg
+    arg: object | None         # storage.expr tree, or None for count(*)
+
+
+@dataclass
+class SelectItem:
+    expr: object               # "*" | storage.expr tree | Agg
+    alias: str | None = None
+
+
+@dataclass
+class OrderBy:
+    column: str
+    desc: bool = False
+
+
+@dataclass
+class Select:
+    items: list[SelectItem]
+    table: str
+    where: list[Rel] = field(default_factory=list)
+    group_by: list[str] = field(default_factory=list)
+    order_by: list[OrderBy] = field(default_factory=list)
+    limit: object | None = None

@@ -1,0 +1,494 @@
+"""PgProcessor: parse -> plan -> execute SQL against the cluster seam.
+
+Reference analog: the YSQL execution stack — the PostgreSQL executor's
+foreign-scan path (ybc_fdw.c:364 ybcIterateForeignScan) feeding
+PgsqlReadOperation with WHERE pushdown and per-tablet partial aggregates
+(src/yb/docdb/pgsql_operation.cc:345,473), and the DML path through
+PgDocWriteOp (src/yb/yql/pggate/pg_doc_op.h:142). Here the planner
+lowers SELECT straight to ScanSpecs on the shared Cluster seam (the
+same LocalCluster / ClientCluster objects the CQL processor drives),
+with grouped/expression aggregates pushed down to the storage engine —
+on the TPU engine that is one device dispatch per tablet (ops.group_agg)
+— and per-tablet partials combined above the scan (operations.py).
+
+SQL semantic notes (vs the CQL processor):
+- INSERT enforces primary-key uniqueness (PG errors on duplicates;
+  CQL upserts).
+- UPDATE/DELETE accept arbitrary WHERE: non-PK predicates resolve via a
+  predicate-pushdown scan, then write per matching row.
+- avg() lowers to sum+count partials and is derived after the combine
+  (partial averages cannot be merged across tablets).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import expr as X
+from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+from yugabyte_db_tpu.storage.scan_spec import AggSpec, Predicate, ScanSpec
+from yugabyte_db_tpu.utils.status import AlreadyPresent, InvalidArgument
+from yugabyte_db_tpu.yql.pgsql import ast
+from yugabyte_db_tpu.yql.pgsql.operations import combine_grouped
+from yugabyte_db_tpu.yql.pgsql.parser import parse_statement
+
+
+@dataclass
+class PgResult:
+    """Rows returned to the driver (the wire server turns this into
+    RowDescription + DataRow messages)."""
+
+    columns: list[str] = field(default_factory=list)
+    rows: list[tuple] = field(default_factory=list)
+    command: str = "SELECT"    # CommandComplete tag prefix
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def dicts(self) -> list[dict]:
+        return [dict(zip(self.columns, r)) for r in self.rows]
+
+
+class PgProcessor:
+    """One SQL session over a Cluster seam."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    # -- entry point -------------------------------------------------------
+    def execute(self, sql, params: list | None = None) -> PgResult | None:
+        stmt = parse_statement(sql) if isinstance(sql, str) else sql
+        self._params = params or []
+        fn = {
+            ast.CreateTable: self._exec_create_table,
+            ast.DropTable: self._exec_drop_table,
+            ast.CreateIndex: self._exec_create_index,
+            ast.DropIndex: self._exec_drop_index,
+            ast.Insert: self._exec_insert,
+            ast.Update: self._exec_update,
+            ast.Delete: self._exec_delete,
+            ast.Select: self._exec_select,
+        }[type(stmt)]
+        return fn(stmt)
+
+    # -- binding / coercion ------------------------------------------------
+    def _resolve(self, value):
+        if isinstance(value, ast.BindMarker):
+            try:
+                return self._params[value.index]
+            except IndexError:
+                raise InvalidArgument(
+                    f"bind marker ${value.index + 1} has no value") from None
+        return value
+
+    def _coerce(self, col: ColumnSchema, value):
+        from yugabyte_db_tpu.yql.common import coerce_value
+
+        return coerce_value(col, self._resolve(value))
+
+    # -- DDL ---------------------------------------------------------------
+    def _exec_create_table(self, stmt: ast.CreateTable):
+        if stmt.name in self.cluster.tables:
+            if stmt.if_not_exists:
+                return None
+            raise AlreadyPresent(f"relation {stmt.name} already exists")
+        by_name = {c.name for c in stmt.columns}
+        for k in stmt.hash_keys + stmt.range_keys:
+            if k not in by_name:
+                raise InvalidArgument(f"primary key column {k} not defined")
+        cols = []
+        for c in stmt.columns:
+            if c.name in stmt.hash_keys:
+                kind = ColumnKind.HASH
+            elif c.name in stmt.range_keys:
+                kind = ColumnKind.RANGE
+            else:
+                kind = ColumnKind.REGULAR
+            if kind != ColumnKind.REGULAR and \
+                    c.dtype in (DataType.FLOAT, DataType.DOUBLE):
+                raise InvalidArgument(
+                    f"floating-point column {c.name} cannot be a key")
+            cols.append(ColumnSchema(c.name, c.dtype, kind,
+                                     nullable=kind == ColumnKind.REGULAR))
+        schema = Schema(cols, table_id=stmt.name)
+        self.cluster.create_table(stmt.name, schema, stmt.num_tablets)
+        return PgResult(command="CREATE TABLE")
+
+    def _exec_drop_table(self, stmt: ast.DropTable):
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        try:
+            self.cluster.drop_table(stmt.name)
+        except NotFound:
+            if not stmt.if_exists:
+                raise
+        return PgResult(command="DROP TABLE")
+
+    def _exec_create_index(self, stmt: ast.CreateIndex):
+        handle = self.cluster.table(stmt.table)
+        if any(i["name"] == stmt.name
+               for i in getattr(handle, "indexes", [])):
+            if stmt.if_not_exists:
+                return None
+            raise AlreadyPresent(f"index {stmt.name} exists")
+        if not handle.schema.has_column(stmt.column):
+            raise InvalidArgument(f"unknown column {stmt.column}")
+        if handle.schema.column(stmt.column).is_key:
+            raise InvalidArgument(f"cannot index key column {stmt.column}")
+        itable = self.cluster.create_index(handle, stmt.name, stmt.column)
+        self._backfill_index(handle, stmt.column, itable)
+        return PgResult(command="CREATE INDEX")
+
+    def _backfill_index(self, handle, column: str, itable: str) -> None:
+        """Populate the index from existing base rows (reference: the
+        online index backfill job; here a scan + index-entry writes)."""
+        from yugabyte_db_tpu.index import index_entry
+
+        ih = self.cluster.table(itable)
+        key_names = [c.name for c in handle.schema.key_columns]
+        proj = key_names + [column]
+        for tablet in handle.tablets:
+            res = tablet.scan(ScanSpec(
+                read_ht=tablet.read_time().value, projection=proj))
+            for row in res.rows:
+                value = row[-1]
+                if value is None:
+                    continue
+                base_kv = dict(zip(key_names, row[:-1]))
+                hc, rv = index_entry(ih.schema, value, base_kv)
+                self.cluster.tablet_for_hash(ih, hc).write([rv])
+
+    def _exec_drop_index(self, stmt: ast.DropIndex):
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        for name in list(self.cluster.tables):
+            try:
+                handle = self.cluster.table(name)
+            except NotFound:
+                continue
+            for idx in getattr(handle, "indexes", []):
+                if idx["name"] == stmt.name:
+                    self.cluster.drop_index(handle, stmt.name)
+                    return PgResult(command="DROP INDEX")
+        if not stmt.if_exists:
+            raise NotFound(f"index {stmt.name} not found")
+        return PgResult(command="DROP INDEX")
+
+    # -- DML ---------------------------------------------------------------
+    def _key_and_tablet(self, handle, key_values: dict):
+        from yugabyte_db_tpu.yql.common import key_and_tablet
+
+        return key_and_tablet(self.cluster, handle, key_values)
+
+    def _write_row(self, handle, key_values: dict, key: bytes, tablet,
+                   row: RowVersion, if_not_exists: bool = False) -> None:
+        if getattr(handle, "indexes", None) and \
+                getattr(self.cluster, "maintain_indexes", None):
+            indexed_cids = {handle.schema.column(i["column"]).col_id
+                            for i in handle.indexes}
+            if row.tombstone or (indexed_cids & row.columns.keys()):
+                old = tablet.current_row_values(key)
+                self.cluster.maintain_indexes(handle, key_values, old, row)
+        tablet.write([row], if_not_exists=if_not_exists)
+
+    def _exec_insert(self, stmt: ast.Insert):
+        handle = self.cluster.table(stmt.table)
+        schema = handle.schema
+        for cname in stmt.columns:
+            if not schema.has_column(cname):
+                raise InvalidArgument(f"unknown column {cname}")
+        n = 0
+        for values in stmt.rows:
+            provided = dict(zip(stmt.columns, values))
+            key_values, columns = {}, {}
+            for c in schema.key_columns:
+                v = (self._coerce(c, provided[c.name])
+                     if c.name in provided else None)
+                if v is None:  # checked AFTER bind resolution: $N may be None
+                    raise InvalidArgument(
+                        f"null value in column {c.name} violates "
+                        f"not-null constraint")
+                key_values[c.name] = v
+            for c in schema.value_columns:
+                if c.name in provided:
+                    columns[c.col_id] = self._coerce(c, provided[c.name])
+            key, tablet = self._key_and_tablet(handle, key_values)
+            # PG semantics: duplicate key is an error (23505), not an
+            # upsert. The check is ATOMIC with the write — it runs on the
+            # tablet under the same lock as the apply (Tablet.write
+            # if_not_exists / the tserver's intent-admission lock).
+            self._write_row(handle, key_values, key, tablet, RowVersion(
+                key, ht=0, liveness=True, columns=columns),
+                if_not_exists=True)
+            n += 1
+        return PgResult(command=f"INSERT 0 {n}")
+
+    def _match_rows(self, handle, where: list[ast.Rel]):
+        """Resolve WHERE to (key_values, row-dict) pairs. Full-PK equality
+        short-circuits to a point read; anything else scans with
+        predicate pushdown."""
+        schema = handle.schema
+        key_names = [c.name for c in schema.key_columns]
+        eq = {r.column: r.value for r in where if r.op == "="}
+        if set(key_names) <= set(eq) and len(where) == len(key_names):
+            kv = {n: self._coerce(schema.column(n), eq[n])
+                  for n in key_names}
+            key, tablet = self._key_and_tablet(handle, kv)
+            res = tablet.scan(ScanSpec(
+                lower=key, upper=key + b"\x00",
+                read_ht=tablet.read_time().value, projection=None))
+            return [(kv, dict(zip(res.columns, r))) for r in res.rows]
+        preds = self._predicates(schema, where)
+        out = []
+        for tablet in handle.tablets:
+            res = tablet.scan(ScanSpec(
+                read_ht=tablet.read_time().value, predicates=preds))
+            for r in res.rows:
+                d = dict(zip(res.columns, r))
+                out.append(({n: d[n] for n in key_names}, d))
+        return out
+
+    def _predicates(self, schema: Schema, where: list[ast.Rel]):
+        preds = []
+        for rel in where:
+            if not schema.has_column(rel.column):
+                raise InvalidArgument(f"unknown column {rel.column}")
+            col = schema.column(rel.column)
+            if rel.op == "IN":
+                vals = tuple(self._coerce(col, v)
+                             for v in self._resolve(rel.value))
+                preds.append(Predicate(rel.column, "IN", vals))
+            else:
+                preds.append(Predicate(rel.column, rel.op,
+                                       self._coerce(col, rel.value)))
+        return preds
+
+    def _exec_update(self, stmt: ast.Update):
+        handle = self.cluster.table(stmt.table)
+        schema = handle.schema
+        sets = []
+        for cname, rhs in stmt.assignments:
+            if not schema.has_column(cname):
+                raise InvalidArgument(f"unknown column {cname}")
+            col = schema.column(cname)
+            if col.is_key:
+                raise InvalidArgument(f"cannot SET key column {cname}")
+            sets.append((col, rhs))
+        n = 0
+        for kv, old in self._match_rows(handle, stmt.where):
+            columns = {}
+            for col, rhs in sets:
+                if isinstance(rhs, (X.Col, X.Const, X.BinOp)):
+                    v = X.eval_expr(rhs, lambda name: old.get(name))
+                    if col.dtype in (DataType.DOUBLE, DataType.FLOAT) \
+                            and isinstance(v, int):
+                        v = float(v)
+                    columns[col.col_id] = v
+                else:
+                    columns[col.col_id] = self._coerce(col, rhs)
+            key, tablet = self._key_and_tablet(handle, kv)
+            self._write_row(handle, kv, key, tablet,
+                            RowVersion(key, ht=0, columns=columns))
+            n += 1
+        return PgResult(command=f"UPDATE {n}")
+
+    def _exec_delete(self, stmt: ast.Delete):
+        handle = self.cluster.table(stmt.table)
+        n = 0
+        for kv, _old in self._match_rows(handle, stmt.where):
+            key, tablet = self._key_and_tablet(handle, kv)
+            self._write_row(handle, kv, key, tablet,
+                            RowVersion(key, ht=0, tombstone=True))
+            n += 1
+        return PgResult(command=f"DELETE {n}")
+
+    # -- SELECT ------------------------------------------------------------
+    def _exec_select(self, stmt: ast.Select):
+        handle = self.cluster.table(stmt.table)
+        schema = handle.schema
+        has_agg = any(isinstance(it.expr, ast.Agg) for it in stmt.items)
+        if has_agg or stmt.group_by:
+            return self._select_aggregate(handle, stmt)
+        return self._select_rows(handle, stmt)
+
+    def _select_rows(self, handle, stmt: ast.Select):
+        schema = handle.schema
+        preds = self._predicates(schema, stmt.where)
+        all_names = [c.name for c in schema.columns]
+        names, exprs = [], []
+        for it in stmt.items:
+            if it.expr == "*":
+                names.extend(all_names)
+                exprs.extend(X.Col(n) for n in all_names)
+                continue
+            if isinstance(it.expr, X.Col):
+                if not schema.has_column(it.expr.name):
+                    raise InvalidArgument(f"unknown column {it.expr.name}")
+                names.append(it.alias or it.expr.name)
+            else:
+                names.append(it.alias or "?column?")
+            exprs.append(it.expr)
+        needed = sorted({c for e in exprs for c in X.columns_of(e)})
+        limit = self._limit(stmt)
+        # Engine-level LIMIT is only a safe pushdown when no later sort
+        # reorders rows and a single tablet preserves global key order.
+        push_limit = (limit if not stmt.order_by
+                      and len(handle.tablets) == 1 else None)
+        rows = []
+        for d in self._scan_dicts(handle, stmt.where, preds, needed,
+                                  push_limit):
+            rows.append(tuple(
+                X.eval_expr(e, lambda n: d.get(n)) for e in exprs))
+        rows = self._order_and_limit(stmt, names, rows, limit)
+        return PgResult(columns=names, rows=rows)
+
+    def _scan_dicts(self, handle, where, preds, needed, push_limit):
+        """Row dicts matching WHERE: index-driven when an '='-bound
+        column is indexed (index-table hash scan -> base point reads,
+        re-verifying predicates against the base row), full predicate-
+        pushdown scan otherwise."""
+        schema = handle.schema
+        idx_info = None
+        for rel in where:
+            if rel.op != "=":
+                continue
+            for idx in getattr(handle, "indexes", []):
+                if idx["column"] == rel.column:
+                    idx_info = (idx, rel)
+                    break
+            if idx_info:
+                break
+        if idx_info is None:
+            for tablet in handle.tablets:
+                res = tablet.scan(ScanSpec(
+                    read_ht=tablet.read_time().value, predicates=preds,
+                    projection=needed, limit=push_limit))
+                for r in res.rows:
+                    yield dict(zip(res.columns, r))
+            return
+        from yugabyte_db_tpu.models.encoding import (encode_doc_key_prefix,
+                                                     prefix_successor)
+        from yugabyte_db_tpu.models.partition import compute_hash_code
+
+        idx, rel = idx_info
+        ih = self.cluster.table(idx["index_table"])
+        ischema = ih.schema
+        value = self._coerce(schema.column(rel.column), rel.value)
+        hc = compute_hash_code(ischema, {rel.column: value})
+        prefix = encode_doc_key_prefix(
+            hc, [(value, ischema.hash_columns[0].dtype)], [])
+        key_names = [c.name for c in schema.key_columns]
+        itablet = self.cluster.tablet_for_hash(ih, hc)
+        ires = itablet.scan(ScanSpec(
+            lower=prefix, upper=prefix_successor(prefix),
+            read_ht=itablet.read_time().value, projection=key_names))
+        for irow in ires.rows:
+            base_kv = dict(zip(key_names, irow))
+            key, btablet = self._key_and_tablet(handle, base_kv)
+            res = btablet.scan(ScanSpec(
+                lower=key, upper=key + b"\x00",
+                read_ht=btablet.read_time().value,
+                predicates=preds, projection=needed, limit=1))
+            for r in res.rows:
+                yield dict(zip(res.columns, r))
+
+    def _select_aggregate(self, handle, stmt: ast.Select):
+        schema = handle.schema
+        preds = self._predicates(schema, stmt.where)
+        group_by = list(stmt.group_by)
+        for g in group_by:
+            if not schema.has_column(g):
+                raise InvalidArgument(f"unknown column {g}")
+
+        # Output plan: each item maps to (kind, payload) where kind is
+        # "group" (index into group_by) or "agg"; avg lowers into
+        # sum+count partial slots derived after the combine.
+        aggs: list[AggSpec] = []
+        out_plan = []
+        names = []
+        for it in stmt.items:
+            if isinstance(it.expr, ast.Agg):
+                fn, arg = it.expr.fn, it.expr.arg
+                label = it.alias or (
+                    f"{fn}({'*' if arg is None else '...'})")
+                if fn == "avg":
+                    si = len(aggs)
+                    aggs.append(self._agg_spec("sum", arg, f"_avg_s{si}"))
+                    aggs.append(self._agg_spec("count", arg, f"_avg_c{si}"))
+                    out_plan.append(("avg", si))
+                else:
+                    out_plan.append(("agg", len(aggs)))
+                    aggs.append(self._agg_spec(fn, arg, label))
+                names.append(label)
+            elif isinstance(it.expr, X.Col):
+                if it.expr.name not in group_by:
+                    raise InvalidArgument(
+                        f"column {it.expr.name} must appear in GROUP BY")
+                out_plan.append(("group", group_by.index(it.expr.name)))
+                names.append(it.alias or it.expr.name)
+            else:
+                raise InvalidArgument(
+                    "non-aggregate expressions must be GROUP BY columns")
+
+        spec = ScanSpec(read_ht=MAX_HT, predicates=preds,
+                        aggregates=aggs, group_by=group_by or None)
+        results = []
+        for tablet in handle.tablets:
+            results.append(tablet.scan(ScanSpec(
+                read_ht=tablet.read_time().value, predicates=preds,
+                aggregates=aggs, group_by=group_by or None)))
+        combined = combine_grouped(spec, results)
+        ngb = len(group_by)
+        rows = []
+        for row in combined.rows:
+            out = []
+            for kind, payload in out_plan:
+                if kind == "group":
+                    out.append(row[payload])
+                elif kind == "agg":
+                    # combined columns: group cols, then aggs in order
+                    out.append(row[ngb + payload])
+                else:  # avg: sum at payload, count at payload+1
+                    s, c = row[ngb + payload], row[ngb + payload + 1]
+                    out.append(s / c if c else None)
+            rows.append(tuple(out))
+        rows = self._order_and_limit(stmt, names, rows, self._limit(stmt))
+        return PgResult(columns=names, rows=rows)
+
+    def _agg_spec(self, fn: str, arg, label: str) -> AggSpec:
+        if arg is None:
+            return AggSpec("count", None, label=label)
+        if isinstance(arg, X.Col):
+            return AggSpec(fn, arg.name, label=label)
+        if fn not in ("sum",):
+            raise InvalidArgument(
+                f"{fn} over an expression is not supported")
+        return AggSpec(fn, None, expr=arg, label=label)
+
+    def _limit(self, stmt: ast.Select):
+        limit = self._resolve(stmt.limit)
+        if limit is not None and (not isinstance(limit, int)
+                                  or isinstance(limit, bool) or limit < 0):
+            raise InvalidArgument("LIMIT must be a non-negative integer")
+        return limit
+
+    @staticmethod
+    def _order_and_limit(stmt: ast.Select, names: list[str], rows, limit):
+        if stmt.order_by:
+            pos = {}
+            for ob in stmt.order_by:
+                if ob.column not in names:
+                    raise InvalidArgument(
+                        f"ORDER BY column {ob.column} is not in the "
+                        f"select list")
+                pos[ob.column] = names.index(ob.column)
+            for ob in reversed(stmt.order_by):
+                i = pos[ob.column]
+                rows.sort(key=lambda r: ((r[i] is None), r[i])
+                          if not ob.desc else ((r[i] is not None), r[i]),
+                          reverse=ob.desc)
+        if limit is not None:
+            rows = rows[:limit]
+        return rows

@@ -1,0 +1,514 @@
+"""SQL (YSQL dialect) recursive-descent parser.
+
+Reference analog: the PostgreSQL fork's gram.y as exercised by YSQL —
+here only the surface the executor lowers: CREATE/DROP TABLE,
+CREATE/DROP INDEX, INSERT (multi-row VALUES), UPDATE, DELETE, SELECT
+with arithmetic expressions, aggregates, GROUP BY / ORDER BY / LIMIT,
+AND-conjunct WHERE with =/!=/</<=/>/>=/IN/BETWEEN, and $N bind markers.
+Scalar expressions parse into storage.expr trees so aggregate arguments
+lower directly onto the device GROUP BY kernel (ops.group_agg).
+"""
+
+from __future__ import annotations
+
+import re
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.storage.expr import BinOp, Col, Const
+from yugabyte_db_tpu.storage.scan_spec import AGG_FNS as _AGG_FN_TUPLE
+from yugabyte_db_tpu.utils.status import InvalidArgument
+from yugabyte_db_tpu.yql.pgsql import ast
+
+AGG_FNS = frozenset(_AGG_FN_TUPLE)
+
+_TOKEN_RE = re.compile(r"""
+    \s+
+  | (?P<comment>--[^\n]*)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<number>\d+\.\d+(?:[eE][+-]?\d+)?|\.\d+|\d+[eE][+-]?\d+|\d+)
+  | (?P<param>\$\d+)
+  | (?P<name>[A-Za-z_][A-Za-z_0-9]*|"(?:[^"]|"")*")
+  | (?P<op><=|>=|<>|!=|=|<|>)
+  | (?P<sym>[(),.;*+/-])
+""", re.VERBOSE)
+
+# SQL type name (first word, with optional qualifiers) -> DataType
+_TYPES = {
+    "TINYINT": DataType.INT8,
+    "SMALLINT": DataType.INT16, "INT2": DataType.INT16,
+    "INT": DataType.INT32, "INTEGER": DataType.INT32,
+    "INT4": DataType.INT32,
+    "BIGINT": DataType.INT64, "INT8": DataType.INT64,
+    "TEXT": DataType.STRING, "VARCHAR": DataType.STRING,
+    "CHAR": DataType.STRING,
+    "REAL": DataType.FLOAT, "FLOAT4": DataType.FLOAT,
+    "FLOAT8": DataType.DOUBLE,
+    "BOOLEAN": DataType.BOOL, "BOOL": DataType.BOOL,
+    "BYTEA": DataType.BINARY,
+}
+
+
+class Token:
+    __slots__ = ("kind", "text")
+
+    def __init__(self, kind, text):
+        self.kind = kind
+        self.text = text
+
+    def __repr__(self):
+        return f"{self.kind}:{self.text}"
+
+
+def tokenize(sql: str) -> list[Token]:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise InvalidArgument(
+                f"SQL syntax error near {sql[pos:pos + 20]!r}")
+        pos = m.end()
+        for kind in ("string", "number", "param", "name", "op", "sym"):
+            text = m.group(kind)
+            if text is not None:
+                out.append(Token(kind, text))
+                break
+    return out
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self) -> Token | None:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Token:
+        t = self.peek()
+        if t is None:
+            raise InvalidArgument("unexpected end of statement")
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws) -> bool:
+        t = self.peek()
+        return (t is not None and t.kind == "name"
+                and t.text.upper() in kws)
+
+    def take_kw(self, *kws) -> bool:
+        if self.at_kw(*kws):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.take_kw(kw):
+            raise InvalidArgument(f"expected {kw}, got {self.peek()}")
+
+    def at_sym(self, s: str) -> bool:
+        t = self.peek()
+        return t is not None and t.kind in ("sym", "op") and t.text == s
+
+    def take_sym(self, s: str) -> bool:
+        if self.at_sym(s):
+            self.i += 1
+            return True
+        return False
+
+    def expect_sym(self, s: str) -> None:
+        if not self.take_sym(s):
+            raise InvalidArgument(f"expected {s!r}, got {self.peek()}")
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind != "name":
+            raise InvalidArgument(f"expected identifier, got {t}")
+        if t.text.startswith('"'):
+            return t.text[1:-1].replace('""', '"')
+        return t.text.lower()
+
+    def literal(self):
+        neg = self.take_sym("-")
+        t = self.next()
+        if t.kind == "param":
+            if neg:
+                raise InvalidArgument("cannot negate a bind marker")
+            return ast.BindMarker(int(t.text[1:]) - 1)
+        if t.kind == "string":
+            if neg:
+                raise InvalidArgument("cannot negate a string")
+            return t.text[1:-1].replace("''", "'")
+        if t.kind == "number":
+            v = (float(t.text) if any(c in t.text for c in ".eE")
+                 else int(t.text))
+            return -v if neg else v
+        if t.kind == "name" and not neg:
+            up = t.text.upper()
+            if up == "TRUE":
+                return True
+            if up == "FALSE":
+                return False
+            if up == "NULL":
+                return None
+        raise InvalidArgument(f"expected literal, got {t}")
+
+    # -- statements --------------------------------------------------------
+    def parse(self):
+        t = self.peek()
+        if t is None:
+            raise InvalidArgument("empty statement")
+        head = t.text.upper()
+        if head == "CREATE":
+            self.next()
+            if self.at_kw("TABLE"):
+                return self._create_table()
+            if self.at_kw("INDEX", "UNIQUE"):
+                return self._create_index()
+            raise InvalidArgument(f"cannot CREATE {self.peek()}")
+        if head == "DROP":
+            self.next()
+            if self.take_kw("TABLE"):
+                return ast.DropTable(*self._name_if_exists())
+            if self.take_kw("INDEX"):
+                return ast.DropIndex(*self._name_if_exists())
+            raise InvalidArgument(f"cannot DROP {self.peek()}")
+        if head == "INSERT":
+            return self._insert()
+        if head == "UPDATE":
+            return self._update()
+        if head == "DELETE":
+            return self._delete()
+        if head == "SELECT":
+            return self._select()
+        raise InvalidArgument(f"unsupported statement {head}")
+
+    def _name_if_exists(self):
+        if_exists = False
+        if self.take_kw("IF"):
+            self.expect_kw("EXISTS")
+            if_exists = True
+        name = self.ident()
+        self.take_sym(";")
+        return name, if_exists
+
+    # -- DDL ---------------------------------------------------------------
+    def _type(self) -> DataType:
+        name = self.ident().upper()
+        if name == "DOUBLE":
+            self.take_kw("PRECISION")
+            return DataType.DOUBLE
+        if name == "FLOAT":
+            return DataType.DOUBLE  # SQL FLOAT defaults to float8
+        dt = _TYPES.get(name)
+        if dt is None:
+            raise InvalidArgument(f"unknown type {name}")
+        if self.take_sym("("):  # VARCHAR(n) / CHAR(n): length ignored
+            self.literal()
+            self.expect_sym(")")
+        return dt
+
+    def _create_table(self) -> ast.CreateTable:
+        self.expect_kw("TABLE")
+        if_not_exists = False
+        if self.take_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            if_not_exists = True
+        name = self.ident()
+        self.expect_sym("(")
+        columns: list[ast.ColumnDef] = []
+        hash_keys: list[str] = []
+        range_keys: list[str] = []
+        while True:
+            if self.take_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                self.expect_sym("(")
+                # YSQL shape: PRIMARY KEY ((h1, h2), r1, r2 [ASC|DESC]).
+                # A plain list makes the FIRST column the hash column
+                # (YSQL's default for the leading PK column).
+                if self.take_sym("("):
+                    while not self.take_sym(")"):
+                        hash_keys.append(self.ident())
+                        self.take_sym(",")
+                else:
+                    hash_keys.append(self.ident())
+                    self.take_kw("HASH")
+                while self.take_sym(","):
+                    range_keys.append(self.ident())
+                    self.take_kw("ASC") or self.take_kw("DESC")
+                self.expect_sym(")")
+            else:
+                cname = self.ident()
+                dtype = self._type()
+                columns.append(ast.ColumnDef(cname, dtype))
+                if self.take_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    hash_keys.append(cname)
+                self.take_kw("NOT") and self.expect_kw("NULL")
+            if not self.take_sym(","):
+                break
+        self.expect_sym(")")
+        num_tablets = None
+        if self.take_kw("SPLIT"):
+            self.expect_kw("INTO")
+            num_tablets = int(self.literal())
+            self.expect_kw("TABLETS")
+        self.take_sym(";")
+        if not hash_keys:
+            raise InvalidArgument("table has no primary key")
+        return ast.CreateTable(name, columns, hash_keys, range_keys,
+                               if_not_exists, num_tablets)
+
+    def _create_index(self) -> ast.CreateIndex:
+        self.take_kw("UNIQUE")  # accepted, enforced as a plain index
+        self.expect_kw("INDEX")
+        if_not_exists = False
+        if self.take_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            if_not_exists = True
+        name = self.ident()
+        self.expect_kw("ON")
+        table = self.ident()
+        self.expect_sym("(")
+        column = self.ident()
+        self.expect_sym(")")
+        self.take_sym(";")
+        return ast.CreateIndex(name, table, column, if_not_exists)
+
+    # -- DML ---------------------------------------------------------------
+    def _insert(self) -> ast.Insert:
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.ident()
+        self.expect_sym("(")
+        columns = [self.ident()]
+        while self.take_sym(","):
+            columns.append(self.ident())
+        self.expect_sym(")")
+        self.expect_kw("VALUES")
+        rows = []
+        while True:
+            self.expect_sym("(")
+            vals = [self.literal()]
+            while self.take_sym(","):
+                vals.append(self.literal())
+            self.expect_sym(")")
+            if len(vals) != len(columns):
+                raise InvalidArgument(
+                    f"{len(columns)} columns but {len(vals)} values")
+            rows.append(vals)
+            if not self.take_sym(","):
+                break
+        self.take_sym(";")
+        return ast.Insert(table, columns, rows)
+
+    def _update(self) -> ast.Update:
+        self.expect_kw("UPDATE")
+        table = self.ident()
+        self.expect_kw("SET")
+        assignments = []
+        while True:
+            cname = self.ident()
+            self.expect_sym("=")
+            assignments.append((cname, self._scalar_or_literal()))
+            if not self.take_sym(","):
+                break
+        where = self._where()
+        self.take_sym(";")
+        return ast.Update(table, assignments, where)
+
+    def _delete(self) -> ast.Delete:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        table = self.ident()
+        where = self._where()
+        self.take_sym(";")
+        return ast.Delete(table, where)
+
+    # -- SELECT ------------------------------------------------------------
+    def _select(self) -> ast.Select:
+        self.expect_kw("SELECT")
+        items = [self._select_item()]
+        while self.take_sym(","):
+            items.append(self._select_item())
+        self.expect_kw("FROM")
+        table = self.ident()
+        where = self._where()
+        group_by: list[str] = []
+        if self.take_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.ident())
+            while self.take_sym(","):
+                group_by.append(self.ident())
+        order_by: list[ast.OrderBy] = []
+        if self.take_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                col = self.ident()
+                desc = bool(self.take_kw("DESC"))
+                if not desc:
+                    self.take_kw("ASC")
+                order_by.append(ast.OrderBy(col, desc))
+                if not self.take_sym(","):
+                    break
+        limit = None
+        if self.take_kw("LIMIT"):
+            limit = self.literal()
+        self.take_sym(";")
+        return ast.Select(items, table, where, group_by, order_by, limit)
+
+    def _select_item(self) -> ast.SelectItem:
+        if self.take_sym("*"):
+            return ast.SelectItem("*")
+        expr = self._item_expr()
+        alias = None
+        if self.take_kw("AS"):
+            alias = self.ident()
+        elif (self.peek() is not None and self.peek().kind == "name"
+              and self.peek().text.upper() not in
+              ("FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS")):
+            alias = self.ident()
+        return ast.SelectItem(expr, alias)
+
+    def _item_expr(self):
+        t = self.peek()
+        if (t is not None and t.kind == "name"
+                and t.text.lower() in AGG_FNS
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1].text == "("):
+            fn = self.ident().lower()
+            self.expect_sym("(")
+            if self.take_sym("*"):
+                if fn != "count":
+                    raise InvalidArgument(f"{fn}(*) is not valid")
+                arg = None
+            else:
+                arg = self._scalar()
+            self.expect_sym(")")
+            return ast.Agg(fn, arg)
+        return self._scalar()
+
+    # -- scalar expressions (storage.expr trees) ---------------------------
+    def _scalar(self):
+        node = self._term()
+        while self.at_sym("+") or self.at_sym("-"):
+            op = self.next().text
+            node = BinOp(op, node, self._term())
+        return node
+
+    def _term(self):
+        node = self._factor()
+        while self.at_sym("*"):
+            self.next()
+            node = BinOp("*", node, self._factor())
+        return node
+
+    def _factor(self):
+        if self.take_sym("("):
+            node = self._scalar()
+            self.expect_sym(")")
+            return node
+        t = self.peek()
+        if t is not None and t.kind == "number":
+            v = self.literal()
+            if not isinstance(v, int):
+                raise InvalidArgument(
+                    "only integer constants are allowed in expressions")
+            return Const(v)
+        if t is not None and self.at_sym("-"):
+            v = self.literal()
+            if not isinstance(v, int):
+                raise InvalidArgument(
+                    "only integer constants are allowed in expressions")
+            return Const(v)
+        return Col(self.ident())
+
+    def _scalar_or_literal(self):
+        """UPDATE SET rhs: a literal (any type) or a column expression."""
+        t = self.peek()
+        if t is not None and (t.kind in ("string", "param")
+                              or (t.kind == "name" and t.text.upper()
+                                  in ("TRUE", "FALSE", "NULL"))):
+            return self.literal()
+        if t is not None and t.kind == "number":
+            return self.literal()
+        if t is not None and self.at_sym("-"):
+            return self.literal()
+        return self._scalar()
+
+    # -- WHERE -------------------------------------------------------------
+    def _where(self) -> list[ast.Rel]:
+        rels: list[ast.Rel] = []
+        if not self.take_kw("WHERE"):
+            return rels
+        while True:
+            col = self.ident()
+            if self.take_kw("BETWEEN"):
+                lo = self.literal()
+                self.expect_kw("AND")
+                hi = self.literal()
+                rels.append(ast.Rel(col, ">=", lo))
+                rels.append(ast.Rel(col, "<=", hi))
+            elif self.take_kw("IN"):
+                self.expect_sym("(")
+                vals = [self.literal()]
+                while self.take_sym(","):
+                    vals.append(self.literal())
+                self.expect_sym(")")
+                rels.append(ast.Rel(col, "IN", tuple(vals)))
+            else:
+                t = self.next()
+                if t.kind != "op":
+                    raise InvalidArgument(f"expected operator, got {t}")
+                op = "!=" if t.text == "<>" else t.text
+                rels.append(ast.Rel(col, op, self.literal()))
+            if not self.take_kw("AND"):
+                break
+        return rels
+
+
+def parse_statement(sql: str):
+    p = Parser(sql)
+    stmt = p.parse()
+    if p.peek() is not None:
+        raise InvalidArgument(f"trailing tokens at {p.peek()}")
+    return stmt
+
+
+def parse_script(sql: str):
+    """Split a multi-statement string on top-level ';' and parse each
+    (the simple-query wire message may carry several statements).
+    Comment-only fragments are skipped, not syntax errors."""
+    stmts = []
+    for part in _split_statements(sql):
+        if part.strip() and tokenize(part):
+            stmts.append(parse_statement(part))
+    return stmts
+
+
+def _split_statements(sql: str):
+    out, depth, start, i = [], 0, 0, 0
+    in_str = False
+    while i < len(sql):
+        c = sql[i]
+        if in_str:
+            if c == "'":
+                in_str = False
+        elif c == "-" and sql[i:i + 2] == "--":
+            nl = sql.find("\n", i)
+            i = len(sql) if nl < 0 else nl
+            continue
+        elif c == "'":
+            in_str = True
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == ";" and depth == 0:
+            out.append(sql[start:i])
+            start = i + 1
+        i += 1
+    out.append(sql[start:])
+    return out

@@ -1,0 +1,258 @@
+"""PgServer: a PostgreSQL v3 wire-protocol frontend (simple query flow).
+
+Reference analog: in the reference, YSQL IS a postgres process
+(pgwrapper spawns it, src/yb/tserver/tablet_server_main.cc:160) and the
+backend's FE/BE protocol handling is PostgreSQL's own. The TPU-native
+redesign keeps the framework single-runtime: this server speaks the
+same FE/BE v3 protocol (startup, AuthenticationOk, simple Query,
+RowDescription/DataRow/CommandComplete, ErrorResponse) directly on the
+shared rpc Messenger via a pluggable ConnectionContext — the exact seam
+the CQL and Redis frontends ride (src/yb/rpc/connection_context.h).
+
+Covered: SSLRequest (refused with 'N'), StartupMessage, simple Query
+('Q', multi-statement), Terminate ('X'). Not covered (extended
+protocol): Parse/Bind/Execute — the in-process pggate API serves
+prepared statements instead.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from yugabyte_db_tpu.rpc.messenger import ConnectionContext, Messenger
+from yugabyte_db_tpu.utils.status import (AlreadyPresent, InvalidArgument,
+                                          NotFound)
+from yugabyte_db_tpu.yql.pgsql.executor import PgProcessor, PgResult
+from yugabyte_db_tpu.yql.pgsql.parser import parse_script
+
+_U32 = struct.Struct(">I")
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+_PROTO_V3 = 196608
+
+# type OIDs (pg_type.h)
+_OID_BOOL, _OID_BYTEA, _OID_INT8, _OID_INT4 = 16, 17, 20, 23
+_OID_TEXT, _OID_FLOAT8 = 25, 701
+
+
+# -- message builders --------------------------------------------------------
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + _U32.pack(len(payload) + 4) + payload
+
+
+def auth_ok() -> bytes:
+    return _msg(b"R", _U32.pack(0))
+
+
+def parameter_status(key: str, value: str) -> bytes:
+    return _msg(b"S", key.encode() + b"\x00" + value.encode() + b"\x00")
+
+
+def ready_for_query() -> bytes:
+    return _msg(b"Z", b"I")
+
+
+def command_complete(tag: str) -> bytes:
+    return _msg(b"C", tag.encode() + b"\x00")
+
+
+def empty_query_response() -> bytes:
+    return _msg(b"I", b"")
+
+
+def error_response(message: str, code: str = "XX000") -> bytes:
+    fields = (b"SERROR\x00" + b"C" + code.encode() + b"\x00"
+              + b"M" + message.encode("utf-8", "replace") + b"\x00\x00")
+    return _msg(b"E", fields)
+
+
+def _infer_oid(rows, col: int) -> int:
+    for r in rows:
+        v = r[col]
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return _OID_BOOL
+        if isinstance(v, int):
+            return _OID_INT8
+        if isinstance(v, float):
+            return _OID_FLOAT8
+        if isinstance(v, (bytes, bytearray)):
+            return _OID_BYTEA
+        return _OID_TEXT
+    return _OID_TEXT
+
+
+def row_description(res: PgResult) -> bytes:
+    parts = [struct.pack(">H", len(res.columns))]
+    for i, name in enumerate(res.columns):
+        oid = _infer_oid(res.rows, i)
+        parts.append(name.encode() + b"\x00"
+                     + struct.pack(">IHIhih", 0, 0, oid, -1, -1, 0))
+    return _msg(b"T", b"".join(parts))
+
+
+def _text(v) -> bytes:
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, (bytes, bytearray)):
+        return b"\\x" + bytes(v).hex().encode()
+    return str(v).encode("utf-8", "replace")
+
+
+def data_row(row: tuple) -> bytes:
+    parts = [struct.pack(">H", len(row))]
+    for v in row:
+        if v is None:
+            parts.append(struct.pack(">i", -1))
+        else:
+            b = _text(v)
+            parts.append(struct.pack(">i", len(b)) + b)
+    return _msg(b"D", b"".join(parts))
+
+
+# -- connection context ------------------------------------------------------
+
+class PgConnectionContext(ConnectionContext):
+    """Stateful FE/BE framing: a connection starts in the startup phase
+    (untyped length-prefixed packet), then switches to typed messages.
+    Calls carry the context itself so the service keeps per-connection
+    sessions without the messenger knowing about them."""
+
+    ordered_responses = True
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._started = False
+        self.session = None  # attached by the service on startup
+
+    def feed(self, data: bytes) -> list:
+        self._buf.extend(data)
+        calls = []
+        while True:
+            if not self._started:
+                if len(self._buf) < 4:
+                    return calls
+                (length,) = _U32.unpack_from(self._buf, 0)
+                if length < 8 or length > 1 << 20:
+                    raise ValueError(f"bad startup packet length {length}")
+                if len(self._buf) < length:
+                    return calls
+                payload = bytes(self._buf[4:length])
+                del self._buf[:length]
+                (proto,) = _U32.unpack_from(payload, 0)
+                if proto == _SSL_REQUEST:
+                    calls.append((0, "pg", (self, "ssl", None)))
+                    continue  # stay in startup phase
+                if proto == _CANCEL_REQUEST:
+                    continue  # no cancel support: ignore
+                params = {}
+                kv = payload[4:].split(b"\x00")
+                for k, v in zip(kv[::2], kv[1::2]):
+                    if k:
+                        params[k.decode()] = v.decode()
+                self._started = True
+                calls.append((0, "pg", (self, "startup", params)))
+                continue
+            if len(self._buf) < 5:
+                return calls
+            tag = bytes(self._buf[:1])
+            (length,) = _U32.unpack_from(self._buf, 1)
+            if length < 4 or length > 64 * 1024 * 1024:
+                raise ValueError(f"bad message length {length}")
+            end = 1 + length
+            if len(self._buf) < end:
+                return calls
+            payload = bytes(self._buf[5:end])
+            del self._buf[:end]
+            calls.append((0, "pg", (self, tag.decode(), payload)))
+
+    def serialize(self, response) -> bytes:
+        _tag, status, body = response
+        if status == "ok":
+            return body
+        # Handler raised outside the per-statement guard: wire-level error.
+        return error_response(str(body)) + ready_for_query()
+
+
+class PgServiceImpl:
+    """Executes FE messages. Each connection gets its own PgProcessor
+    (mirroring one backend per connection)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def handle(self, _method: str, call) -> bytes:
+        ctx, kind, payload = call
+        if kind == "ssl":
+            return b"N"  # SSL refused; client retries in cleartext
+        if kind == "startup":
+            ctx.session = PgProcessor(self.cluster)
+            return (auth_ok()
+                    + parameter_status("server_version", "11.2-yb-tpu")
+                    + parameter_status("client_encoding", "UTF8")
+                    + parameter_status("integer_datetimes", "on")
+                    + ready_for_query())
+        if kind == "Q":
+            return self._query(ctx, payload)
+        if kind == "X":
+            return b""  # client closes after Terminate
+        return error_response(f"unsupported message {kind!r}",
+                              code="0A000") + ready_for_query()
+
+    def _query(self, ctx, payload: bytes) -> bytes:
+        sql = payload.rstrip(b"\x00").decode("utf-8", "replace")
+        out = bytearray()
+        try:
+            stmts = parse_script(sql)
+        except Exception as e:  # noqa: BLE001 - parse error to client
+            return bytes(error_response(str(e), "42601")
+                         + ready_for_query())
+        if not stmts:
+            return bytes(empty_query_response() + ready_for_query())
+        for stmt in stmts:
+            try:
+                res = (ctx.session or PgProcessor(self.cluster)).execute(
+                    stmt)
+            except InvalidArgument as e:
+                out += error_response(str(e), "42601")
+                break
+            except AlreadyPresent as e:
+                out += error_response(str(e), "23505")
+                break
+            except NotFound as e:
+                out += error_response(str(e), "42P01")
+                break
+            except Exception as e:  # noqa: BLE001
+                out += error_response(str(e))
+                break
+            if res is None:
+                out += command_complete("OK")
+            elif res.command.startswith(("SELECT", "select")) or res.columns:
+                out += row_description(res)
+                for r in res.rows:
+                    out += data_row(r)
+                out += command_complete(f"SELECT {len(res.rows)}")
+            else:
+                out += command_complete(res.command)
+        out += ready_for_query()
+        return bytes(out)
+
+
+class PgServer:
+    """The YSQL frontend daemon: a Messenger listener with the PG
+    connection context (the reference shape: tserver spawns the SQL
+    frontend on port 5433)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.service = PgServiceImpl(cluster)
+        self.messenger = Messenger("pg-server")
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0):
+        return self.messenger.listen(host, port, self.service.handle,
+                                     context_factory=PgConnectionContext)
+
+    def shutdown(self) -> None:
+        self.messenger.shutdown()
