@@ -1,0 +1,216 @@
+"""ALTER TABLE schema evolution + CQL BATCH.
+
+Reference analogs: stable-ColumnId schema evolution
+(src/yb/common/schema.h ColumnId, catalog_manager.cc AlterTable, the
+AlterSchema tablet operation) and batch statement execution
+(executor.cc PTListNode batches).
+"""
+
+import tempfile
+
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.utils.status import InvalidArgument
+from yugabyte_db_tpu.yql.cql.processor import LocalCluster, QLProcessor
+
+
+def _schema():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("a", DataType.INT64),
+        ColumnSchema("b", DataType.STRING),
+    ], table_id="t")
+
+
+# -- schema helpers ----------------------------------------------------------
+
+def test_schema_evolution_ids_stable_and_never_reused():
+    s0 = _schema()
+    ids0 = {c.name: c.col_id for c in s0.columns}
+    s1 = s0.with_added_column("c", DataType.INT32)
+    assert s1.version == 1
+    assert {c.name: c.col_id for c in s1.columns} == {
+        **ids0, "c": s0.next_col_id}
+    # drop the HIGHEST-id column, then add: the id must NOT be reused
+    s2 = s1.with_dropped_column("c")
+    s3 = s2.with_added_column("d", DataType.INT32)
+    assert s3.column("d").col_id > s1.column("c").col_id
+    # round-trips preserve the allocator
+    s4 = Schema.from_dict(s3.to_dict())
+    assert s4.next_col_id == s3.next_col_id and s4.version == s3.version
+    with pytest.raises(ValueError):
+        s0.with_dropped_column("k")      # key column
+    with pytest.raises(ValueError):
+        s1.with_added_column("a", DataType.INT8)  # duplicate
+    s5 = s0.with_renamed_column("a", "aa")
+    assert s5.column("aa").col_id == ids0["a"]
+
+
+# -- engines -----------------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_engine_alter_schema(engine):
+    if engine == "tpu":
+        import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401
+    from yugabyte_db_tpu.models.partition import compute_hash_code
+    from yugabyte_db_tpu.storage import ScanSpec, make_engine
+    from yugabyte_db_tpu.storage.row_version import RowVersion
+
+    schema = _schema()
+    cid = {c.name: c.col_id for c in schema.columns}
+    eng = make_engine(engine, schema, {"rows_per_block": 8})
+
+    def key(i):
+        return schema.encode_primary_key(
+            {"k": f"u{i:03d}"}, compute_hash_code(schema, {"k": f"u{i:03d}"}))
+
+    eng.apply([RowVersion(key(i), ht=10 + i, liveness=True,
+                          columns={cid["a"]: i, cid["b"]: f"s{i}"})
+               for i in range(40)])
+    eng.flush()
+
+    new_schema = schema.with_added_column("c", DataType.INT64)
+    eng.alter_schema(new_schema)
+    ncid = new_schema.column("c").col_id
+    # old rows: c IS NULL; write new rows with c set
+    eng.apply([RowVersion(key(i), ht=100 + i, liveness=True,
+                          columns={cid["a"]: -i, ncid: i * 7})
+               for i in range(40, 50)])
+    eng.flush()
+    res = eng.scan(ScanSpec(read_ht=10_000, projection=["k", "a", "c"]))
+    got = {r[0]: (r[1], r[2]) for r in res.rows}
+    assert got["u005"] == (5, None)
+    assert got["u045"] == (-45, 45 * 7)
+    # predicate on the added column
+    res = eng.scan(ScanSpec(read_ht=10_000,
+                            predicates=[__import__(
+                                "yugabyte_db_tpu.storage",
+                                fromlist=["Predicate"]).Predicate(
+                                    "c", ">=", 301)],
+                            projection=["k", "c"]))
+    assert sorted(r[0] for r in res.rows) == ["u043", "u044", "u045",
+                                              "u046", "u047", "u048",
+                                              "u049"]
+    # dropped column disappears from scans; its id is retired
+    s2 = new_schema.with_dropped_column("b")
+    eng.alter_schema(s2)
+    res = eng.scan(ScanSpec(read_ht=10_000))
+    assert "b" not in res.columns
+
+
+# -- CQL frontend ------------------------------------------------------------
+
+def test_cql_alter_and_batch():
+    cluster = LocalCluster(num_tablets=2)
+    try:
+        ql = QLProcessor(cluster)
+        ql.execute("CREATE TABLE t (k TEXT, v INT, PRIMARY KEY ((k)))")
+        ql.execute("INSERT INTO t (k, v) VALUES ('x', 1)")
+        ql.execute("ALTER TABLE t ADD w BIGINT")
+        res = ql.execute("SELECT k, v, w FROM t")
+        assert res.rows == [("x", 1, None)]
+        ql.execute("INSERT INTO t (k, v, w) VALUES ('y', 2, 99)")
+        res = ql.execute("SELECT k, w FROM t WHERE w = 99")
+        assert res.rows == [("y", 99)]
+        ql.execute("ALTER TABLE t RENAME v TO vv")
+        res = ql.execute("SELECT vv FROM t WHERE k = 'x'")
+        assert res.rows == [(1,)]
+        ql.execute("ALTER TABLE t DROP vv")
+        with pytest.raises(InvalidArgument):
+            ql.execute("SELECT vv FROM t")
+        # BATCH: multiple DML in one statement
+        ql.execute("BEGIN BATCH "
+                   "INSERT INTO t (k, w) VALUES ('b1', 1); "
+                   "INSERT INTO t (k, w) VALUES ('b2', 2); "
+                   "UPDATE t SET w = 100 WHERE k = 'b1'; "
+                   "DELETE FROM t WHERE k = 'y'; "
+                   "APPLY BATCH")
+        res = ql.execute("SELECT k, w FROM t")
+        got = dict(res.rows)
+        assert got["b1"] == 100 and got["b2"] == 2 and "y" not in got
+        with pytest.raises(InvalidArgument):
+            ql.execute("BEGIN BATCH SELECT k FROM t; APPLY BATCH")
+    finally:
+        cluster.close()
+
+
+# -- SQL frontend ------------------------------------------------------------
+
+def test_pgsql_alter():
+    from yugabyte_db_tpu.yql.pgsql import PgProcessor
+
+    cluster = LocalCluster(num_tablets=2)
+    try:
+        pg = PgProcessor(cluster)
+        pg.execute("CREATE TABLE t (k TEXT PRIMARY KEY, v BIGINT)")
+        pg.execute("INSERT INTO t (k, v) VALUES ('a', 1)")
+        pg.execute("ALTER TABLE t ADD COLUMN w TEXT")
+        pg.execute("INSERT INTO t (k, v, w) VALUES ('b', 2, 'yes')")
+        res = pg.execute("SELECT k, v, w FROM t ORDER BY k")
+        assert res.rows == [("a", 1, None), ("b", 2, "yes")]
+        pg.execute("ALTER TABLE t RENAME COLUMN v TO n")
+        res = pg.execute("SELECT sum(n) FROM t")
+        assert res.rows == [(3,)]
+        pg.execute("ALTER TABLE t DROP COLUMN w")
+        res = pg.execute("SELECT * FROM t ORDER BY k")
+        assert res.columns == ["k", "n"]
+    finally:
+        cluster.close()
+
+
+# -- distributed -------------------------------------------------------------
+
+def test_alter_through_master_and_restart():
+    from yugabyte_db_tpu.client.session import YBSession
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+    from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+    from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+    from yugabyte_db_tpu.yql.cql.processor import QLProcessor as QP
+
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            client = mc.client()
+            ql = QP(ClientCluster(client))
+            ql.execute("CREATE TABLE kv (k TEXT, v BIGINT, "
+                       "PRIMARY KEY ((k)))")
+            s = YBSession(client)
+            table = client.open_table("default.kv")
+            for i in range(20):
+                s.insert(table, {"k": f"r{i:02d}", "v": i})
+            s.flush()
+            ql.execute("ALTER TABLE kv ADD extra TEXT")
+
+            # every replica adopts the replicated change (followers apply
+            # asynchronously behind the leader's commit)
+            def versions():
+                return [peer.tablet.meta.schema.version
+                        for ts in mc.tservers.values()
+                        for peer in ts.tablet_manager.peers()
+                        if peer.tablet.meta.table_name == "default.kv"]
+
+            import time as _time
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline and \
+                    not all(v == 1 for v in versions()):
+                _time.sleep(0.05)
+            assert all(v == 1 for v in versions()), versions()
+            ql.execute("INSERT INTO kv (k, v, extra) "
+                       "VALUES ('zz', 99, 'new')")
+            res = ql.execute("SELECT k, extra FROM kv WHERE k = 'zz'")
+            assert res.rows == [("zz", "new")]
+            res = ql.execute("SELECT k, extra FROM kv WHERE k = 'r05'")
+            assert res.rows == [("r05", None)]
+            # the new schema survives a tserver restart (meta + WAL replay)
+            victim = next(iter(mc.tservers))
+            mc.stop_tserver(victim)
+            mc.restart_tserver(victim)
+            ts = mc.tservers[victim]
+            for peer in ts.tablet_manager.peers():
+                if peer.tablet.meta.table_name == "default.kv":
+                    assert peer.tablet.meta.schema.version == 1
+        finally:
+            mc.shutdown()

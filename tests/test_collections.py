@@ -1,0 +1,125 @@
+"""CQL collections (list/set/map) and JSONB.
+
+Reference analogs: DocDB subdocument collections
+(src/yb/docdb/primitive_value.h collection ValueTypes, per-element
+writes in cql_operation.cc) — here stored as normalized host containers
+with read-modify-write edits — and the jsonb type + operators
+(src/yb/common/jsonb.cc).
+"""
+
+import pytest
+
+from yugabyte_db_tpu.utils.status import InvalidArgument
+from yugabyte_db_tpu.yql.cql.processor import LocalCluster, QLProcessor
+
+
+@pytest.fixture()
+def ql():
+    cluster = LocalCluster(num_tablets=2)
+    yield QLProcessor(cluster)
+    cluster.close()
+
+
+def test_list_operations(ql):
+    ql.execute("CREATE TABLE t (k TEXT, l LIST<INT>, PRIMARY KEY ((k)))")
+    ql.execute("INSERT INTO t (k, l) VALUES ('a', [1, 2, 3])")
+    assert ql.execute("SELECT l FROM t").rows == [([1, 2, 3],)]
+    ql.execute("UPDATE t SET l = l + [4, 5] WHERE k = 'a'")
+    assert ql.execute("SELECT l FROM t").rows == [([1, 2, 3, 4, 5],)]
+    ql.execute("UPDATE t SET l = [0] + l WHERE k = 'a'")
+    assert ql.execute("SELECT l FROM t").rows == [([0, 1, 2, 3, 4, 5],)]
+    ql.execute("UPDATE t SET l = l - [2, 4] WHERE k = 'a'")
+    assert ql.execute("SELECT l FROM t").rows == [([0, 1, 3, 5],)]
+    ql.execute("UPDATE t SET l[1] = 99 WHERE k = 'a'")
+    assert ql.execute("SELECT l FROM t").rows == [([0, 99, 3, 5],)]
+    with pytest.raises(InvalidArgument):
+        ql.execute("UPDATE t SET l[50] = 1 WHERE k = 'a'")
+
+
+def test_set_operations(ql):
+    ql.execute("CREATE TABLE t (k TEXT, s SET<TEXT>, PRIMARY KEY ((k)))")
+    ql.execute("INSERT INTO t (k, s) VALUES ('a', {'x', 'y', 'x'})")
+    assert ql.execute("SELECT s FROM t").rows == [(["x", "y"],)]
+    ql.execute("UPDATE t SET s = s + {'a', 'y'} WHERE k = 'a'")
+    assert ql.execute("SELECT s FROM t").rows == [(["a", "x", "y"],)]
+    ql.execute("UPDATE t SET s = s - {'x'} WHERE k = 'a'")
+    assert ql.execute("SELECT s FROM t").rows == [(["a", "y"],)]
+
+
+def test_map_operations(ql):
+    ql.execute("CREATE TABLE t (k TEXT, m MAP<TEXT, INT>, "
+               "PRIMARY KEY ((k)))")
+    ql.execute("INSERT INTO t (k, m) VALUES ('a', {'b': 2, 'a': 1})")
+    assert ql.execute("SELECT m FROM t").rows == [({"a": 1, "b": 2},)]
+    ql.execute("UPDATE t SET m['c'] = 3 WHERE k = 'a'")
+    ql.execute("UPDATE t SET m = m + {'d': 4, 'a': 10} WHERE k = 'a'")
+    assert ql.execute("SELECT m FROM t").rows == [
+        ({"a": 10, "b": 2, "c": 3, "d": 4},)]
+    ql.execute("UPDATE t SET m = m - {'b', 'd'} WHERE k = 'a'")
+    assert ql.execute("SELECT m FROM t").rows == [({"a": 10, "c": 3},)]
+    # element set on a NULL map creates it
+    ql.execute("INSERT INTO t (k) VALUES ('fresh')")
+    ql.execute("UPDATE t SET m['first'] = 1 WHERE k = 'fresh'")
+    res = ql.execute("SELECT m FROM t WHERE k = 'fresh'")
+    assert res.rows == [({"first": 1},)]
+
+
+def test_collections_survive_flush_both_engines():
+    for engine in ("cpu", "tpu"):
+        cluster = LocalCluster(num_tablets=1, engine=engine,
+                               engine_options={"rows_per_block": 8})
+        try:
+            ql = QLProcessor(cluster)
+            ql.execute("CREATE TABLE t (k TEXT, l LIST<INT>, "
+                       "m MAP<TEXT, INT>, PRIMARY KEY ((k)))")
+            for i in range(20):
+                ql.execute(f"INSERT INTO t (k, l, m) VALUES "
+                           f"('r{i:02d}', [{i}, {i + 1}], "
+                           f"{{'v': {i}}})")
+            for t in cluster.table("default.t").tablets:
+                t.flush()
+            res = ql.execute("SELECT k, l, m FROM t WHERE k = 'r07'")
+            assert res.rows == [("r07", [7, 8], {"v": 7})]
+            res = ql.execute("SELECT count(*) FROM t")
+            assert res.rows[0][0] == 20
+        finally:
+            cluster.close()
+
+
+def test_jsonb_pgsql():
+    from yugabyte_db_tpu.yql.pgsql import PgProcessor
+
+    cluster = LocalCluster(num_tablets=2)
+    try:
+        pg = PgProcessor(cluster)
+        pg.execute("CREATE TABLE docs (id BIGINT PRIMARY KEY, j JSONB)")
+        pg.execute("""INSERT INTO docs (id, j) VALUES
+            (1, '{"name": "ada", "tags": ["x", "y"], "n": {"d": 7}}'),
+            (2, '{"name": "bob", "n": {"d": 9}}')""")
+        res = pg.execute("SELECT j FROM docs WHERE id = 1")
+        assert res.rows[0][0]["name"] == "ada"
+        # -> returns json, ->> returns text; paths chain
+        res = pg.execute("SELECT id, j -> 'name' FROM docs ORDER BY id")
+        assert res.rows == [(1, "ada"), (2, "bob")]
+        res = pg.execute(
+            "SELECT j -> 'n' ->> 'd' FROM docs ORDER BY id")
+        assert res.rows == [("7",), ("9",)]
+        res = pg.execute("SELECT j -> 'tags' -> 0 FROM docs WHERE id = 1")
+        assert res.rows == [("x",)]
+        res = pg.execute("SELECT j ->> 'n' FROM docs WHERE id = 2")
+        assert res.rows == [('{"d":9}',)]
+        # missing keys are NULL
+        res = pg.execute("SELECT j -> 'nope' FROM docs WHERE id = 1")
+        assert res.rows == [(None,)]
+        with pytest.raises(InvalidArgument):
+            pg.execute("INSERT INTO docs (id, j) VALUES (3, 'not json')")
+    finally:
+        cluster.close()
+
+
+def test_jsonb_cql_storage(ql):
+    ql.execute("CREATE TABLE j (k TEXT, doc JSONB, PRIMARY KEY ((k)))")
+    ql.execute('INSERT INTO j (k, doc) VALUES '
+               '(\'a\', \'{"z": 1, "a": [true, null]}\')')
+    res = ql.execute("SELECT doc FROM j")
+    assert res.rows == [({"a": [True, None], "z": 1},)]

@@ -126,6 +126,14 @@ class YBClient:
             raise RuntimeError(f"create_index on {table}.{column}: {resp}")
         return resp["index_table"]
 
+    def alter_table(self, name: str, new_schema_dict: dict) -> None:
+        """Push an evolved schema (version = current + 1) to the master,
+        which replicates it to the catalog and every tablet leader."""
+        resp = self.master_rpc("master.alter_table",
+                               {"name": name, "schema": new_schema_dict})
+        if resp.get("code") not in ("ok", "partial"):
+            raise RuntimeError(f"alter_table {name}: {resp}")
+
     def delete_table(self, name: str) -> None:
         resp = self.master_rpc("master.delete_table", {"name": name})
         if resp.get("code") not in ("ok", "not_found"):

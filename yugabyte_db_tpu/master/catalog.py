@@ -80,6 +80,12 @@ class CatalogState:
                 if t is not None:
                     t.indexes = [i for i in t.indexes
                                  if i["name"] != op["name"]]
+            elif kind == "alter_table":
+                t = self.tables.get(op["table_id"])
+                # versions only move forward (idempotent across replays)
+                if t is not None and op["schema"].get("version", 0) > \
+                        t.schema.get("version", 0):
+                    t.schema = op["schema"]
             else:
                 raise ValueError(f"unknown catalog op {kind!r}")
 

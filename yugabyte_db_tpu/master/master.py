@@ -74,6 +74,9 @@ class Master:
         # restart) are repaired through a config cycle instead
         # (_repair_live_missing_replicas).
         self._failed_creates: set[tuple[str, str]] = set()
+        # (table_id, tablet_id) whose leaders haven't adopted the latest
+        # catalog schema yet; the balancer retries delivery.
+        self._pending_alters: set[tuple[str, str]] = set()
         self.missing_replica_grace_s = missing_replica_grace_s
         # (tablet_id, replica) -> first time a live tserver's heartbeat was
         # seen not reporting a replica the catalog assigns to it.
@@ -246,6 +249,38 @@ class Master:
                     self._failed_creates.add((td["tablet_id"], replica))
                     errors.append(f"{td['tablet_id']}@{replica}: {e}")
         return errors
+
+    def _h_master_alter_table(self, p: dict):
+        """ALTER TABLE: replicate the new schema into the sys catalog,
+        then push it to every tablet leader (reference:
+        CatalogManager::AlterTable + async AlterTable RPCs to tservers).
+        Tablet leaders replicate the change through their own Raft log."""
+        if not self.raft.is_leader():
+            return self._not_leader()
+        t = self.catalog.table_by_name(p["name"])
+        if t is None:
+            return {"code": "not_found"}
+        new_schema = p["schema"]
+        if new_schema.get("version", 0) != t.schema.get("version", 0) + 1:
+            return {"code": "version_mismatch",
+                    "current_version": t.schema.get("version", 0)}
+        try:
+            self.raft.replicate("catalog", {
+                "op": "alter_table", "table_id": t.table_id,
+                "schema": new_schema})
+        except NotLeader:
+            return self._not_leader()
+        errors = []
+        for info in self.catalog.tablets_of(t.table_id):
+            if not self._deliver_schema(info, new_schema):
+                errors.append(info.tablet_id)
+        if errors:
+            # The catalog already holds the new schema: the balancer loop
+            # retries delivery until every tablet leader replicated it.
+            self._pending_alters.update(
+                (t.table_id, tid) for tid in errors)
+            return {"code": "partial", "tablets": errors}
+        return {"code": "ok", "version": new_schema.get("version", 0)}
 
     def _h_master_create_index(self, p: dict):
         """Create a secondary index: an index TABLE (hash = the indexed
@@ -469,6 +504,35 @@ class Master:
                 self._rereplicate_once()
             except Exception:  # noqa: BLE001 — next tick retries
                 pass
+            try:
+                self._retry_pending_alters()
+            except Exception:  # noqa: BLE001 — next tick retries
+                pass
+
+    def _deliver_schema(self, info, schema_dict: dict) -> bool:
+        """Push a schema version to one tablet's leader (whichever
+        replica that is); True once a leader replicated it."""
+        for replica in info.replicas:
+            try:
+                resp = self.transport.send(
+                    replica, "ts.alter_schema",
+                    {"tablet_id": info.tablet_id, "schema": schema_dict},
+                    timeout=5.0)
+                if resp.get("code") == "ok":
+                    return True
+            except Exception:  # noqa: BLE001 — try other replicas
+                continue
+        return False
+
+    def _retry_pending_alters(self) -> None:
+        if not self.raft.leader_ready() or not self._pending_alters:
+            return
+        for table_id, tablet_id in list(self._pending_alters):
+            t = self.catalog.tables.get(table_id)
+            info = self.catalog.tablets.get(tablet_id)
+            if t is None or info is None or \
+                    self._deliver_schema(info, t.schema):
+                self._pending_alters.discard((table_id, tablet_id))
 
     def _rereplicate_once(self) -> None:
         live = sorted(self.ts_manager.live_tservers(),

@@ -28,10 +28,21 @@ class DataType(enum.IntEnum):
     BINARY = 9
     TIMESTAMP = 10  # micros since epoch, int64 semantics
     COUNTER = 11    # int64 with increment semantics (YCQL counter)
+    # Opaque host-resident types: the value lives host-side like a varlen
+    # payload (device planes carry a serialized prefix, used only for
+    # grouping/equality heuristics; predicates on these are host-only).
+    # Collections store normalized python containers (SET as a sorted
+    # list, MAP with sorted keys) so replicas serialize identically.
+    LIST = 12
+    SET = 13
+    MAP = 14
+    JSONB = 15      # parsed JSON value (reference: common/jsonb.cc)
 
     @property
     def is_fixed_width(self) -> bool:
-        return self not in (DataType.STRING, DataType.BINARY)
+        return self not in (DataType.STRING, DataType.BINARY,
+                            DataType.LIST, DataType.SET, DataType.MAP,
+                            DataType.JSONB)
 
     @property
     def is_integer(self) -> bool:
@@ -67,7 +78,7 @@ class DataType(enum.IntEnum):
         cheap 64-bit); varlen types ship as two planes of order-preserving
         8-byte prefix.
         """
-        if self in (DataType.STRING, DataType.BINARY):
+        if not self.is_fixed_width:
             return 2
         if self.np_dtype.itemsize == 8:
             return 2
@@ -97,6 +108,10 @@ class DataType(enum.IntEnum):
             "BINARY": DataType.BINARY,
             "TIMESTAMP": DataType.TIMESTAMP,
             "COUNTER": DataType.COUNTER,
+            "LIST": DataType.LIST,
+            "SET": DataType.SET,
+            "MAP": DataType.MAP,
+            "JSONB": DataType.JSONB,
         }
         key = name.strip().upper()
         if key not in aliases:
@@ -118,4 +133,12 @@ def python_value_matches(dtype: DataType, value) -> bool:
         return isinstance(value, str)
     if dtype == DataType.BINARY:
         return isinstance(value, (bytes, bytearray))
+    if dtype == DataType.LIST:
+        return isinstance(value, list)
+    if dtype == DataType.SET:
+        return isinstance(value, (list, set, frozenset))
+    if dtype == DataType.MAP:
+        return isinstance(value, dict)
+    if dtype == DataType.JSONB:
+        return isinstance(value, (dict, list, str, int, float, bool))
     return False

@@ -46,7 +46,7 @@ class Schema:
     """
 
     def __init__(self, columns: list[ColumnSchema], table_id: str = "",
-                 version: int = 0):
+                 version: int = 0, next_col_id: int | None = None):
         hash_cols = [c for c in columns if c.kind == ColumnKind.HASH]
         range_cols = [c for c in columns if c.kind == ColumnKind.RANGE]
         value_cols = [c for c in columns if not c.is_key]
@@ -65,6 +65,11 @@ class Schema:
             self.columns.append(c)
         self.table_id = table_id
         self.version = version
+        # Monotonic id allocator for ALTER TABLE ADD: never reuses a
+        # DROPPED column's id (old row versions still carry it — a reused
+        # id would resurrect their values under the new column).
+        self.next_col_id = next_col_id if next_col_id is not None else \
+            (max(used) + 1 if used else 10)
         self._by_name = {c.name: i for i, c in enumerate(self.columns)}
         if len(self._by_name) != len(self.columns):
             raise ValueError("duplicate column names")
@@ -106,6 +111,33 @@ class Schema:
         ranges = [(key_values[c.name], c.dtype) for c in self.range_columns]
         return encode_doc_key(hash_code if self.num_hash else None, hashed, ranges)
 
+    # -- evolution (ALTER TABLE; reference: schema evolution keyed by
+    # stable ColumnIds + a schema version, catalog_manager AlterTable) ---
+    def with_added_column(self, name: str, dtype: DataType) -> "Schema":
+        if self.has_column(name):
+            raise ValueError(f"column {name} already exists")
+        new = ColumnSchema(name, dtype, ColumnKind.REGULAR, True,
+                           self.next_col_id)
+        return Schema(self.columns + [new], self.table_id,
+                      self.version + 1, self.next_col_id + 1)
+
+    def with_dropped_column(self, name: str) -> "Schema":
+        col = self.column(name)
+        if col.is_key:
+            raise ValueError(f"cannot drop key column {name}")
+        cols = [c for c in self.columns if c.name != name]
+        return Schema(cols, self.table_id, self.version + 1,
+                      self.next_col_id)
+
+    def with_renamed_column(self, old: str, new: str) -> "Schema":
+        if self.has_column(new):
+            raise ValueError(f"column {new} already exists")
+        col = self.column(old)  # raises if absent
+        cols = [ColumnSchema(new, c.dtype, c.kind, c.nullable, c.col_id)
+                if c.name == old else c for c in self.columns]
+        return Schema(cols, self.table_id, self.version + 1,
+                      self.next_col_id)
+
     def __repr__(self) -> str:
         cols = ", ".join(
             f"{c.name}:{c.dtype.name}:{c.kind.name}" for c in self.columns)
@@ -115,6 +147,7 @@ class Schema:
         return {
             "table_id": self.table_id,
             "version": self.version,
+            "next_col_id": self.next_col_id,
             "columns": [
                 {"name": c.name, "dtype": int(c.dtype), "kind": int(c.kind),
                  "nullable": c.nullable, "col_id": c.col_id}
@@ -129,4 +162,5 @@ class Schema:
                          c["nullable"], c["col_id"])
             for c in d["columns"]
         ]
-        return Schema(cols, d.get("table_id", ""), d.get("version", 0))
+        return Schema(cols, d.get("table_id", ""), d.get("version", 0),
+                      d.get("next_col_id"))

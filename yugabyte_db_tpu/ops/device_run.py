@@ -18,8 +18,8 @@ from yugabyte_db_tpu.storage.columnar import ColumnarRun
 
 
 def dtype_kind(dt: DataType) -> str:
-    if dt in (DataType.STRING, DataType.BINARY):
-        return "str"
+    if not dt.is_fixed_width:
+        return "str"  # varlen/opaque: host payload + 8-byte prefix planes
     if dt == DataType.DOUBLE:
         return "f64"
     if dt == DataType.FLOAT:

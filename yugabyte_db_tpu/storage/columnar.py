@@ -36,6 +36,18 @@ DEFAULT_ROWS_PER_BLOCK = 2048
 KEY_WORDS = 8  # 32-byte key prefix on device
 
 
+def _varlen_raw(v) -> bytes:
+    """Bytes for a varlen value's device prefix planes. Strings/bytes are
+    their contents (order-preserving compares); opaque containers
+    (collections, jsonb) serialize deterministically — their prefix is
+    only an equality heuristic, predicates on them stay host-side."""
+    if isinstance(v, str):
+        return v.encode("utf-8", "surrogateescape")
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    return repr(v).encode("utf-8", "surrogateescape")
+
+
 @dataclass
 class ColumnData:
     """Host planes for one value column across all blocks: [B, R, ...]."""
@@ -210,8 +222,9 @@ class ColumnarRun:
                     exp_idx.append(r)
                     exp_hts.append(v.expire_ht)
                 for cid, val in v.columns.items():
-                    col_rows[cid].append(r)
-                    col_vals[cid].append(val)
+                    if cid in col_rows:  # dropped columns: id retired
+                        col_rows[cid].append(r)
+                        col_vals[cid].append(val)
                 r += 1
         n = r
         self.blocks[b] = BlockMeta(
@@ -280,9 +293,8 @@ class ColumnarRun:
             col.cmp_planes[b, nn_rows, 0] = hi
             col.cmp_planes[b, nn_rows, 1] = lo
             col.arith[b, nn_rows] = arr.astype(np.float32)
-        else:  # STRING / BINARY
-            raws = [v.encode("utf-8", "surrogateescape")
-                    if isinstance(v, str) else bytes(v) for v in nn_vals]
+        else:  # STRING / BINARY / opaque (collections, jsonb)
+            raws = [_varlen_raw(v) for v in nn_vals]
             hi, lo = P.varlen_prefix_planes(raws)
             col.cmp_planes[b, nn_rows, 0] = hi
             col.cmp_planes[b, nn_rows, 1] = lo

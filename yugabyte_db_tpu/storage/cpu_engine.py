@@ -175,6 +175,10 @@ class CpuStorageEngine(StorageEngine):
                     self.flushed_frontier_ht = max(self.flushed_frontier_ht, v.ht)
 
     # -- writes ------------------------------------------------------------
+    def alter_schema(self, new_schema) -> None:
+        super().alter_schema(new_schema)
+        self.mat = RowMaterializer(new_schema)
+
     def apply(self, rows: list[RowVersion]) -> None:
         self.memtable.apply(rows)
         from yugabyte_db_tpu.utils.flags import FLAGS

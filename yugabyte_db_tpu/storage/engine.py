@@ -59,6 +59,13 @@ class StorageEngine(abc.ABC):
     def stats(self) -> dict:
         """Observability counters (runs, rows, bytes, versions)."""
 
+    def alter_schema(self, new_schema: Schema) -> None:
+        """Adopt an evolved schema (ALTER TABLE). Key columns never
+        change; value columns may be added (NULL for existing rows),
+        dropped (values become invisible; ids are never reused), or
+        renamed (ids are stable, so data is untouched)."""
+        self.schema = new_schema
+
     def maybe_compact(self, history_cutoff_ht: int = 0) -> bool:
         """Universal-compaction trigger: compact when run count reaches the
         threshold (reference: universal style with num_levels=1,

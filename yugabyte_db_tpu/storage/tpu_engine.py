@@ -95,6 +95,47 @@ class TpuStorageEngine(StorageEngine):
             self.maybe_compact()
 
     # -- lifecycle ---------------------------------------------------------
+    def alter_schema(self, new_schema: Schema) -> None:
+        """Adopt an evolved schema. Existing columnar runs were built
+        against the old schema, so each gets zero planes for any ADDED
+        column (all rows unset -> NULL) and a fresh device upload;
+        dropped columns keep their (now unreachable) planes. The memtable
+        flushes first so no old-schema rows build runs after the switch."""
+        self.flush()
+        super().alter_schema(new_schema)
+        self.mat = RowMaterializer(new_schema)
+        self._kinds = {c.col_id: dtype_kind(c.dtype)
+                       for c in new_schema.value_columns}
+        self._dtypes = {c.col_id: c.dtype for c in new_schema.value_columns}
+        self._name_to_id = {c.name: c.col_id
+                            for c in new_schema.value_columns}
+        self._key_col_names = {c.name for c in new_schema.key_columns}
+        self._plan_cache.clear()
+        from yugabyte_db_tpu.storage.columnar import ColumnData
+
+        for trun in self.runs:
+            crun = trun.crun
+            changed = False
+            for c in new_schema.value_columns:
+                if c.col_id in crun.cols:
+                    continue
+                B, R = crun.key_planes.shape[0], crun.R
+                planes = 2 if c.dtype.device_planes == 2 else 1
+                crun.cols[c.col_id] = ColumnData(
+                    dtype=c.dtype,
+                    set_=np.zeros((B, R), dtype=bool),
+                    isnull=np.zeros((B, R), dtype=bool),
+                    cmp_planes=np.zeros((B, R, planes), dtype=np.int32),
+                    arith=(np.zeros((B, R), dtype=np.float32)
+                           if c.dtype.is_numeric else None),
+                    varlen=([[None] * R for _ in range(B)]
+                            if not c.dtype.is_fixed_width else None),
+                )
+                changed = True
+            crun.schema = new_schema
+            if changed:
+                trun.dev = DeviceRun(crun, PAD_BLOCKS)
+
     def flush(self) -> None:
         if self.memtable.is_empty:
             return
@@ -357,9 +398,10 @@ class TpuStorageEngine(StorageEngine):
                     continue
                 # ASCII-dominant workloads: len(str) == encoded length; only
                 # re-measure the (rare) non-ASCII cells byte-exactly.
+                from yugabyte_db_tpu.storage.columnar import _varlen_raw
                 lens = [len(v) if (isinstance(v, str) and v.isascii())
-                        else len(v.encode("utf-8", "surrogateescape"))
-                        if isinstance(v, str) else len(v)
+                        else len(v) if isinstance(v, (bytes, bytearray))
+                        else len(_varlen_raw(v))
                         for v in vl[b][:n] if v is not None]
                 if lens:
                     run.varlen_max_len[cid] = max(
@@ -411,7 +453,15 @@ class TpuStorageEngine(StorageEngine):
             if p.column in self._key_col_names or p.op == "IN":
                 host_only.append(p)
                 continue
-            kind = self._kinds[self._name_to_id[p.column]]
+            cid = self._name_to_id[p.column]
+            dt = self._dtypes[cid]
+            if not dt.is_fixed_width and dt not in (DataType.STRING,
+                                                    DataType.BINARY):
+                # opaque payloads (collections, jsonb): the device prefix
+                # is repr-ordered, not value-ordered — host only
+                host_only.append(p)
+                continue
+            kind = self._kinds[cid]
             if kind in ("str", "f32"):
                 superset.append(p)
             else:

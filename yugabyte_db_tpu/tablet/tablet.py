@@ -179,6 +179,14 @@ class Tablet:
         elif entry.op_type == "txn_status" and self.coordinator is not None:
             self.coordinator.apply_status_op(entry.body)
 
+    def alter_schema(self, new_schema) -> None:
+        """Direct schema change (non-consensus tablets; replicated
+        tablets go through TabletPeer.alter_schema)."""
+        if self.consensus_managed:
+            raise RuntimeError("schema changes go through the TabletPeer")
+        with self._write_lock:
+            self._apply_alter_schema({"schema": new_schema.to_dict()})
+
     # -- write path ---------------------------------------------------------
     def write(self, rows: list[RowVersion],
               if_not_exists: bool = False) -> HybridTime:
@@ -234,11 +242,26 @@ class Tablet:
         with self._write_lock:
             if entry.op_type == "write":
                 self._apply_write_body(entry)
+            elif entry.op_type == "alter_schema":
+                self._apply_alter_schema(entry.body)
             else:
                 self._apply_txn_op(entry)
             self._applied_index = max(self._applied_index, entry.op_id.index)
             self._last_index = max(self._last_index, entry.op_id.index)
         self.clock.update(HybridTime(entry.ht))
+
+    def _apply_alter_schema(self, body: dict) -> None:
+        """Adopt a replicated schema change (idempotent across replays:
+        versions only move forward). Reference: the AlterSchema operation
+        (tablet.cc AlterSchema / ChangeMetadataOperation)."""
+        from yugabyte_db_tpu.models.schema import Schema
+
+        new_schema = Schema.from_dict(body["schema"])
+        if new_schema.version <= self.meta.schema.version:
+            return  # stale replay
+        self.meta.schema = new_schema
+        self.meta.save(self.meta_path)
+        self.engine.alter_schema(new_schema)
 
     # -- read path ----------------------------------------------------------
     def read_time(self) -> HybridTime:

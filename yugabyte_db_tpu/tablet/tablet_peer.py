@@ -160,6 +160,13 @@ class TabletPeer:
             return self.replicate_txn_op("intents", body, timeout,
                                          track_mvcc=True)
 
+    def alter_schema(self, new_schema, timeout: float = 10.0) -> None:
+        """Replicate a schema change through this tablet's Raft log so
+        every replica adopts it at the same log position (reference:
+        AlterSchema as a ChangeMetadataOperation through consensus)."""
+        self.replicate_txn_op("alter_schema",
+                              {"schema": new_schema.to_dict()}, timeout)
+
     def replicate_txn_op(self, op_type: str, body: dict,
                          timeout: float = 10.0, ht: int | None = None,
                          track_mvcc: bool = False) -> int:

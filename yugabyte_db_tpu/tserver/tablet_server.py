@@ -279,6 +279,31 @@ class TabletServer:
         payload.update(snap["tail"])
         return {"code": "ok", "payload": payload}
 
+    def _h_ts_alter_schema(self, p: dict):
+        """Adopt a new table schema on one tablet: the LEADER replicates
+        it through the tablet's Raft log so every replica switches at the
+        same log position (reference: the AlterSchema tablet op the
+        master's async AlterTable task invokes)."""
+        from yugabyte_db_tpu.models.schema import Schema
+
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        new_schema = Schema.from_dict(p["schema"])
+        if new_schema.version <= peer.tablet.meta.schema.version:
+            return {"code": "ok"}  # already adopted (idempotent retry)
+        if not peer.raft.is_leader():
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        try:
+            peer.alter_schema(new_schema)
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            return {"code": "timed_out"}
+        return {"code": "ok"}
+
     def _h_ts_set_indexes(self, p: dict):
         """Install the base table's current index set on one tablet (the
         master pushes this after CREATE INDEX)."""
@@ -290,12 +315,18 @@ class TabletServer:
         peer.tablet.meta.save(peer.tablet.meta_path)
         return {"code": "ok"}
 
-    def _maintain_indexes(self, peer, rows) -> dict | None:
+    def _maintain_indexes(self, peer, rows,
+                          insert_only: bool = False) -> dict | None:
         """Leader-side secondary-index maintenance for a base write
         (reference: Tablet::UpdateQLIndexes, tablet.cc:1015). Index
         entries are written FIRST: on a mid-flight failure the index may
         temporarily hold extra entries (lookups verify against the base
-        row) but never misses one. Returns an error dict or None."""
+        row) but never misses one. Returns an error dict or None.
+
+        ``insert_only`` (conditional INSERTs): the row must not exist,
+        so maintenance treats the old state as absent — no tombstones
+        are emitted. A later duplicate_key rejection then leaves at most
+        a stale (base-verified-away) extra entry, never a removed one."""
         from yugabyte_db_tpu.index import index_mutations
         from yugabyte_db_tpu.models.encoding import decode_doc_key
 
@@ -310,7 +341,8 @@ class TabletServer:
                 continue
             _, hashed, ranges = decode_doc_key(row.key)
             base_kv = dict(zip(key_names, hashed + ranges))
-            old = peer.tablet.current_row_values(row.key)
+            old = None if insert_only else \
+                peer.tablet.current_row_values(row.key)
             for itable, _ischema, hc, rv in index_mutations(
                     schema, peer.tablet.meta.indexes, base_kv, old, row):
                 loc = self._locate_by_hash(itable, hc)
@@ -374,7 +406,8 @@ class TabletServer:
         # cannot slip between them (and vice versa: an admitted intent's
         # conflict check sees this write applied).
         if peer.tablet.meta.indexes and peer.raft.is_leader():
-            err = self._maintain_indexes(peer, rows)
+            err = self._maintain_indexes(
+                peer, rows, insert_only=bool(p.get("if_not_exists")))
             if err is not None:
                 return err
         keys = [r.key for r in rows]

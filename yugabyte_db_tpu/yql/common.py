@@ -19,6 +19,13 @@ def coerce_value(col: ColumnSchema, value):
     if value is None:
         return None
     dt = col.dtype
+    if dt == DataType.JSONB and isinstance(value, str):
+        import json
+
+        try:
+            value = json.loads(value)
+        except ValueError as e:
+            raise InvalidArgument(f"invalid json for {col.name}: {e}")
     if dt.is_integer and isinstance(value, bool):
         raise InvalidArgument(f"bad value for {col.name}")
     if dt in (DataType.DOUBLE, DataType.FLOAT) and \
@@ -29,7 +36,47 @@ def coerce_value(col: ColumnSchema, value):
     if not python_value_matches(dt, value):
         raise InvalidArgument(
             f"bad value {value!r} for {col.name} ({dt.name})")
+    # Normalize containers so every replica and every client serializes
+    # them identically (SET: sorted unique list; MAP: sorted key order).
+    if dt == DataType.SET:
+        value = sorted(set(value))
+    elif dt == DataType.MAP:
+        value = dict(sorted(value.items()))
+    elif dt == DataType.JSONB:
+        value = _normalize_json(value)
     return value
+
+
+def _normalize_json(v):
+    """Recursively sort object keys so identical JSON values serialize
+    identically on every replica (reference: jsonb.cc's sorted key
+    layout)."""
+    if isinstance(v, dict):
+        return {k: _normalize_json(v[k]) for k in sorted(v)}
+    if isinstance(v, list):
+        return [_normalize_json(x) for x in v]
+    return v
+
+
+def evolve_schema(handle, action: str, column: str | None,
+                  dtype=None, new_name: str | None = None):
+    """Compute the next schema version for an ALTER TABLE action (shared
+    by both frontends): ADD -> NULL for existing rows, DROP retires the
+    id (never reused) and is refused while the column is indexed,
+    RENAME touches no data."""
+    schema = handle.schema
+    try:
+        if action == "add":
+            return schema.with_added_column(column, dtype)
+        if action == "drop":
+            if any(i["column"] == column
+                   for i in getattr(handle, "indexes", [])):
+                raise InvalidArgument(
+                    f"column {column} is indexed; drop the index first")
+            return schema.with_dropped_column(column)
+        return schema.with_renamed_column(column, new_name)
+    except (ValueError, KeyError) as e:
+        raise InvalidArgument(str(e)) from None
 
 
 def key_and_tablet(cluster, handle, key_values: dict):

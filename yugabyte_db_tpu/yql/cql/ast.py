@@ -57,6 +57,27 @@ class CreateTable:
 
 
 @dataclass
+class Batch:
+    """BEGIN [UNLOGGED] BATCH ... APPLY BATCH: a client-grouped list of
+    DML statements (per-tablet atomicity, reference: exec of PTListNode
+    batches in executor.cc)."""
+
+    statements: list
+    logged: bool = True
+
+
+@dataclass
+class AlterTable:
+    """ALTER TABLE t ADD col type | DROP col | RENAME a TO b."""
+
+    name: str
+    action: str                    # "add" | "drop" | "rename"
+    column: str | None = None
+    dtype: object = None           # DataType for "add"
+    new_name: str | None = None    # for "rename"
+
+
+@dataclass
 class DropTable:
     name: str
     if_exists: bool = False
@@ -123,6 +144,19 @@ class Insert:
     values: list[object]
     ttl_seconds: int | None = None
     if_not_exists: bool = False
+
+
+@dataclass
+class CollectionOp:
+    """UPDATE SET rhs that edits a collection in place:
+    v = v + [...], v = [...] + v (prepend), v = v - {...},
+    v[idx_or_key] = x. Evaluated read-modify-write at the executor
+    (the reference writes per-element subdocuments without a read —
+    the observable end state matches for serialized writers)."""
+
+    op: str            # "append" | "prepend" | "remove" | "setelem"
+    operand: object    # the literal collection / element value
+    index: object = None  # for "setelem": list index or map key
 
 
 @dataclass

@@ -137,6 +137,10 @@ class ClientCluster:
             self._tables[name] = t
         return t
 
+    def alter_table(self, handle: RemoteTable, new_schema: Schema) -> None:
+        self.client.alter_table(handle.name, new_schema.to_dict())
+        handle.schema = new_schema
+
     def create_index(self, base: RemoteTable, name: str,
                      column: str) -> str:
         itable = self.client.create_index(base.name, column, name)

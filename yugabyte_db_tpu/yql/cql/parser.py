@@ -25,7 +25,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<number>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\.\d+|-?\d+[eE][+-]?\d+|-?\d+)
   | (?P<name>[A-Za-z_][A-Za-z_0-9]*|"(?:[^"]|"")*")
   | (?P<op><=|>=|!=|=|<|>)
-  | (?P<sym>[(),.;*?{}:+-])
+  | (?P<sym>[(),.;*?{}:\[\]+-])
 """, re.VERBOSE)
 
 
@@ -116,6 +116,31 @@ class Parser:
         return name
 
     def literal(self):
+        # collection literals: [a, b] list; {a, b} set; {k: v, ...} map
+        if self.take_sym("["):
+            out = []
+            while not self.take_sym("]"):
+                out.append(self.literal())
+                self.take_sym(",")
+            return out
+        if self.at_sym("{"):
+            self.next()
+            if self.take_sym("}"):
+                return {}  # empty braces: map (CQL's untyped empty {})
+            first = self.literal()
+            if self.take_sym(":"):
+                m = {first: self.literal()}
+                while self.take_sym(","):
+                    k = self.literal()
+                    self.expect_sym(":")
+                    m[k] = self.literal()
+                self.expect_sym("}")
+                return dict(sorted(m.items()))  # normalized key order
+            items = [first]
+            while self.take_sym(","):
+                items.append(self.literal())
+            self.expect_sym("}")
+            return sorted(set(items))  # SET: normalized sorted list
         t = self.next()
         if t.kind == "sym" and t.text == "?":
             marker = ast.BindMarker(self.bind_count)
@@ -153,6 +178,7 @@ class Parser:
             "CREATE": self._create, "DROP": self._drop, "USE": self._use,
             "INSERT": self._insert, "SELECT": self._select,
             "UPDATE": self._update, "DELETE": self._delete,
+            "ALTER": self._alter, "BEGIN": self._batch,
         }.get(kw)
         if fn is None:
             raise InvalidArgument(f"unsupported statement {t.text!r}")
@@ -161,6 +187,50 @@ class Parser:
         if self.peek() is not None:
             raise InvalidArgument(f"trailing tokens at {self.peek()}")
         return stmt
+
+    def _alter(self):
+        """ALTER TABLE t ADD col type | DROP col | RENAME a TO b."""
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        name = self.qualified_name()
+        if self.take_kw("ADD"):
+            col = self.ident()
+            dtype = self._type()
+            return ast.AlterTable(name, "add", col, dtype)
+        if self.take_kw("DROP"):
+            return ast.AlterTable(name, "drop", self.ident())
+        if self.take_kw("RENAME"):
+            old = self.ident()
+            self.expect_kw("TO")
+            return ast.AlterTable(name, "rename", old,
+                                  new_name=self.ident())
+        raise InvalidArgument(f"expected ADD/DROP/RENAME, got {self.peek()}")
+
+    def _batch(self):
+        """BEGIN [UNLOGGED|LOGGED|COUNTER] BATCH <dml>; ... APPLY BATCH
+        (reference: PTInsertStmt lists under PTListNode in a batch tree).
+        Batches group client-side; each statement routes to its tablet —
+        per-tablet atomicity, like the reference without transactions."""
+        self.expect_kw("BEGIN")
+        logged = not self.take_kw("UNLOGGED")
+        self.take_kw("LOGGED", "COUNTER")
+        self.expect_kw("BATCH")
+        stmts = []
+        while not self.at_kw("APPLY"):
+            t = self.peek()
+            if t is None:
+                raise InvalidArgument("unterminated BATCH (missing APPLY)")
+            kw = t.text.upper()
+            fn = {"INSERT": self._insert, "UPDATE": self._update,
+                  "DELETE": self._delete}.get(kw)
+            if fn is None:
+                raise InvalidArgument(
+                    f"only INSERT/UPDATE/DELETE allowed in BATCH, got {kw}")
+            stmts.append(fn())
+            self.take_sym(";")
+        self.expect_kw("APPLY")
+        self.expect_kw("BATCH")
+        return ast.Batch(stmts, logged)
 
     def _if_not_exists(self) -> bool:
         if self.take_kw("IF"):
@@ -236,9 +306,18 @@ class Parser:
     def _type(self) -> DataType:
         name = self.ident()
         try:
-            return DataType.parse(name)
+            dt = DataType.parse(name)
         except ValueError as e:
             raise InvalidArgument(str(e))
+        if dt in (DataType.LIST, DataType.SET, DataType.MAP) and \
+                self.take_sym("<"):
+            # element types accepted and discarded: values are stored as
+            # host containers; element validation is container-level
+            self._type()
+            if self.take_sym(","):
+                self._type()
+            self.expect_sym(">")
+        return dt
 
     def _with_properties(self) -> dict:
         props = {}
@@ -453,8 +532,31 @@ class Parser:
 
     def _assignment(self):
         col = self.ident()
+        if self.take_sym("["):
+            idx = self.literal()
+            self.expect_sym("]")
+            self.expect_sym("=")
+            return (col, ast.CollectionOp("setelem", self.literal(),
+                                          index=idx))
         self.expect_sym("=")
-        return (col, self.literal())
+        # collection edits reference the column itself: v = v + [...],
+        # v = [...] + v, v = v - {...}
+        t = self.peek()
+        if t is not None and t.kind == "name" and t.text.lower() == col \
+                and self.i + 1 < len(self.toks) \
+                and self.toks[self.i + 1].text in "+-":
+            self.ident()
+            op = "append" if self.next().text == "+" else "remove"
+            return (col, ast.CollectionOp(op, self.literal()))
+        value = self.literal()
+        if self.at_sym("+"):
+            self.next()
+            name = self.ident()
+            if name != col:
+                raise InvalidArgument(
+                    f"prepend must reference {col}, got {name}")
+            return (col, ast.CollectionOp("prepend", value))
+        return (col, value)
 
     def _delete(self):
         self.expect_kw("DELETE")

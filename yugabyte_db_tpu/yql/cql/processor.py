@@ -136,6 +136,11 @@ class LocalCluster:
         base.indexes.remove(idx)
         self.drop_table(idx["index_table"])
 
+    def alter_table(self, handle: TableHandle, new_schema: Schema) -> None:
+        for t in handle.tablets:
+            t.alter_schema(new_schema)
+        handle.schema = new_schema
+
     def maintain_indexes(self, handle: TableHandle, base_key_values: dict,
                          old_values: dict | None, row) -> None:
         """Apply index mutations for one base write (the LocalCluster
@@ -186,12 +191,14 @@ class QLProcessor:
             ast.UseKeyspace: self._exec_use,
             ast.CreateTable: self._exec_create_table,
             ast.DropTable: self._exec_drop_table,
+            ast.AlterTable: self._exec_alter_table,
             ast.CreateIndex: self._exec_create_index,
             ast.DropIndex: self._exec_drop_index,
             ast.Insert: self._exec_insert,
             ast.Update: self._exec_update,
             ast.Delete: self._exec_delete,
             ast.Select: self._exec_select,
+            ast.Batch: self._exec_batch,
         }[type(stmt)]
         return fn(stmt)
 
@@ -255,6 +262,26 @@ class QLProcessor:
         schema = Schema(cols, table_id=name)
         num_tablets = stmt.properties.get("tablets")
         self.cluster.create_table(name, schema, num_tablets)
+        return None
+
+    def _exec_alter_table(self, stmt: ast.AlterTable):
+        """Schema evolution by stable column ids (reference:
+        catalog_manager AlterTable -> tablet AlterSchema). ADD columns are
+        NULL for existing rows; DROP retires the id (never reused);
+        RENAME touches no data."""
+        from yugabyte_db_tpu.yql.common import evolve_schema
+
+        handle = self.cluster.table(self._qualify(stmt.name))
+        self.cluster.alter_table(handle, evolve_schema(
+            handle, stmt.action, stmt.column, stmt.dtype, stmt.new_name))
+        return None
+
+    def _exec_batch(self, stmt: ast.Batch):
+        """Execute a BATCH's statements in order. Statements grouped per
+        tablet are atomic per tablet; cross-tablet batches are not atomic
+        (the reference's non-transactional batches behave the same)."""
+        for sub in stmt.statements:
+            self.execute(sub, params=self._params)
         return None
 
     def _exec_drop_table(self, stmt: ast.DropTable):
@@ -469,6 +496,19 @@ class QLProcessor:
         handle = self.cluster.table(self._qualify(stmt.table))
         schema = handle.schema
         key_values, _ = self._bound_key_values(schema, stmt.where, True)
+        # Collection edits (v = v + [...], v[k] = x) are read-modify-write
+        # against the current row state.
+        coll_cols = [cname for cname, v in stmt.assignments
+                     if isinstance(v, ast.CollectionOp)]
+        old_row = {}
+        if coll_cols:
+            key0, tablet0 = self._key_and_tablet(handle, key_values)
+            res = tablet0.scan(ScanSpec(
+                lower=key0, upper=key0 + b"\x00",
+                read_ht=tablet0.read_time().value, projection=coll_cols,
+                limit=1))
+            if res.rows:
+                old_row = dict(zip(res.columns, res.rows[0]))
         columns = {}
         for cname, value in stmt.assignments:
             if not schema.has_column(cname):
@@ -476,7 +516,11 @@ class QLProcessor:
             col = schema.column(cname)
             if col.is_key:
                 raise InvalidArgument(f"cannot SET key column {cname}")
-            columns[col.col_id] = self._coerce(col, value)
+            if isinstance(value, ast.CollectionOp):
+                columns[col.col_id] = self._apply_collection_op(
+                    col, old_row.get(cname), value)
+            else:
+                columns[col.col_id] = self._coerce(col, value)
         key, tablet = self._key_and_tablet(handle, key_values)
         # CQL UPDATE is an upsert of the SET columns (no liveness marker:
         # the row exists only while some column is live — reference
@@ -485,6 +529,53 @@ class QLProcessor:
             key, ht=0, columns=columns,
             expire_ht=self._expire_ht(stmt.ttl_seconds)))
         return None
+
+    def _apply_collection_op(self, col: ColumnSchema, old,
+                             op: ast.CollectionOp):
+        """Evaluate one collection edit against the row's current value
+        (reference: per-element subdocument writes in cql_operation.cc;
+        the observable end state is the same for serialized writers)."""
+        dt = col.dtype
+        operand = self._resolve_marker(op.operand)
+        if op.op == "setelem":
+            idx = self._resolve_marker(op.index)
+            if dt == DataType.MAP:
+                m = dict(old or {})
+                m[idx] = operand
+                return dict(sorted(m.items()))
+            if dt == DataType.LIST:
+                if old is None or not isinstance(idx, int) or \
+                        not 0 <= idx < len(old):
+                    raise InvalidArgument(
+                        f"list index {idx!r} out of bounds for {col.name}")
+                out = list(old)
+                out[idx] = operand
+                return out
+            raise InvalidArgument(f"{col.name} is not a list or map")
+        if op.op == "prepend":
+            if dt != DataType.LIST:
+                raise InvalidArgument(f"can only prepend to a list")
+            return list(operand) + list(old or [])
+        if op.op == "append":
+            if dt == DataType.LIST:
+                return list(old or []) + list(operand)
+            if dt == DataType.SET:
+                return sorted(set(old or []) | set(operand))
+            if dt == DataType.MAP:
+                return dict(sorted({**(old or {}), **operand}.items()))
+        if op.op == "remove":
+            if dt == DataType.LIST:
+                drop = set(operand)
+                return [v for v in (old or []) if v not in drop]
+            if dt == DataType.SET:
+                return sorted(set(old or []) - set(operand))
+            if dt == DataType.MAP:
+                keys = set(operand if not isinstance(operand, dict)
+                           else operand.keys())
+                return dict(sorted((k, v) for k, v in (old or {}).items()
+                                   if k not in keys))
+        raise InvalidArgument(
+            f"unsupported collection op on {col.name} ({dt.name})")
 
     def _exec_delete(self, stmt: ast.Delete):
         handle = self.cluster.table(self._qualify(stmt.table))

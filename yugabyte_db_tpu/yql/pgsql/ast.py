@@ -37,6 +37,17 @@ class DropTable:
 
 
 @dataclass
+class AlterTable:
+    """ALTER TABLE t ADD/DROP/RENAME COLUMN."""
+
+    name: str
+    action: str                    # "add" | "drop" | "rename"
+    column: str | None = None
+    dtype: DataType | None = None  # for "add"
+    new_name: str | None = None    # for "rename"
+
+
+@dataclass
 class CreateIndex:
     name: str
     table: str
@@ -84,6 +95,15 @@ class Update:
 class Delete:
     table: str
     where: list[Rel]
+
+
+@dataclass
+class JsonPath:
+    """col -> 'key' -> 0 ->> 'leaf': jsonb extraction, host-evaluated
+    (reference: jsonb operators over common/jsonb.cc)."""
+
+    column: str
+    steps: list                # [(op "->"|"->>", key str|int), ...]
 
 
 @dataclass

@@ -64,6 +64,7 @@ class PgProcessor:
         fn = {
             ast.CreateTable: self._exec_create_table,
             ast.DropTable: self._exec_drop_table,
+            ast.AlterTable: self._exec_alter_table,
             ast.CreateIndex: self._exec_create_index,
             ast.DropIndex: self._exec_drop_index,
             ast.Insert: self._exec_insert,
@@ -125,6 +126,16 @@ class PgProcessor:
             if not stmt.if_exists:
                 raise
         return PgResult(command="DROP TABLE")
+
+    def _exec_alter_table(self, stmt: ast.AlterTable):
+        """Schema evolution by stable column ids (ADD -> NULL for
+        existing rows, DROP retires the id, RENAME touches no data)."""
+        from yugabyte_db_tpu.yql.common import evolve_schema
+
+        handle = self.cluster.table(stmt.name)
+        self.cluster.alter_table(handle, evolve_schema(
+            handle, stmt.action, stmt.column, stmt.dtype, stmt.new_name))
+        return PgResult(command="ALTER TABLE")
 
     def _exec_create_index(self, stmt: ast.CreateIndex):
         handle = self.cluster.table(stmt.table)
@@ -189,7 +200,12 @@ class PgProcessor:
             indexed_cids = {handle.schema.column(i["column"]).col_id
                             for i in handle.indexes}
             if row.tombstone or (indexed_cids & row.columns.keys()):
-                old = tablet.current_row_values(key)
+                # Conditional INSERT: the row must not exist, so the old
+                # state is absent by contract — no tombstones. A later
+                # duplicate rejection then leaves at most a stale extra
+                # entry (base-verified away), never a removed one.
+                old = (None if if_not_exists
+                       else tablet.current_row_values(key))
                 self.cluster.maintain_indexes(handle, key_values, old, row)
         tablet.write([row], if_not_exists=if_not_exists)
 
@@ -313,6 +329,38 @@ class PgProcessor:
             return self._select_aggregate(handle, stmt)
         return self._select_rows(handle, stmt)
 
+    @staticmethod
+    def _eval_item(expr, d: dict):
+        """Evaluate one select-item expression over a row dict (scalar
+        trees via storage.expr; jsonb paths host-side)."""
+        if isinstance(expr, ast.JsonPath):
+            import json
+
+            v = d.get(expr.column)
+            for op, key in expr.steps:
+                if v is None:
+                    return None
+                if isinstance(v, dict):
+                    v = v.get(key)
+                elif isinstance(v, list) and isinstance(key, int) \
+                        and -len(v) <= key < len(v):
+                    v = v[key]
+                else:
+                    return None
+                if op == "->>" and v is not None:
+                    v = (json.dumps(v, separators=(",", ":"))
+                         if isinstance(v, (dict, list)) else
+                         ("true" if v is True else "false"
+                          if v is False else str(v)))
+            return v
+        return X.eval_expr(expr, lambda n: d.get(n))
+
+    @staticmethod
+    def _item_columns(expr) -> set:
+        if isinstance(expr, ast.JsonPath):
+            return {expr.column}
+        return X.columns_of(expr)
+
     def _select_rows(self, handle, stmt: ast.Select):
         schema = handle.schema
         preds = self._predicates(schema, stmt.where)
@@ -330,7 +378,15 @@ class PgProcessor:
             else:
                 names.append(it.alias or "?column?")
             exprs.append(it.expr)
-        needed = sorted({c for e in exprs for c in X.columns_of(e)})
+        # ORDER BY may reference table columns outside the select list
+        # (PG semantics): carry them as hidden trailing columns.
+        hidden = 0
+        for ob in stmt.order_by:
+            if ob.column not in names and schema.has_column(ob.column):
+                names.append(ob.column)
+                exprs.append(X.Col(ob.column))
+                hidden += 1
+        needed = sorted({c for e in exprs for c in self._item_columns(e)})
         limit = self._limit(stmt)
         # Engine-level LIMIT is only a safe pushdown when no later sort
         # reorders rows and a single tablet preserves global key order.
@@ -339,9 +395,11 @@ class PgProcessor:
         rows = []
         for d in self._scan_dicts(handle, stmt.where, preds, needed,
                                   push_limit):
-            rows.append(tuple(
-                X.eval_expr(e, lambda n: d.get(n)) for e in exprs))
+            rows.append(tuple(self._eval_item(e, d) for e in exprs))
         rows = self._order_and_limit(stmt, names, rows, limit)
+        if hidden:
+            rows = [r[:-hidden] for r in rows]
+            names = names[:-hidden]
         return PgResult(columns=names, rows=rows)
 
     def _scan_dicts(self, handle, where, preds, needed, push_limit):
@@ -486,8 +544,8 @@ class PgProcessor:
                 pos[ob.column] = names.index(ob.column)
             for ob in reversed(stmt.order_by):
                 i = pos[ob.column]
-                rows.sort(key=lambda r: ((r[i] is None), r[i])
-                          if not ob.desc else ((r[i] is not None), r[i]),
+                # PG defaults: ASC -> NULLS LAST, DESC -> NULLS FIRST
+                rows.sort(key=lambda r: ((r[i] is None), r[i]),
                           reverse=ob.desc)
         if limit is not None:
             rows = rows[:limit]

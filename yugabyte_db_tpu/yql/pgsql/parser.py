@@ -28,7 +28,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<number>\d+\.\d+(?:[eE][+-]?\d+)?|\.\d+|\d+[eE][+-]?\d+|\d+)
   | (?P<param>\$\d+)
   | (?P<name>[A-Za-z_][A-Za-z_0-9]*|"(?:[^"]|"")*")
-  | (?P<op><=|>=|<>|!=|=|<|>)
+  | (?P<op>->>|->|<=|>=|<>|!=|=|<|>)
   | (?P<sym>[(),.;*+/-])
 """, re.VERBOSE)
 
@@ -45,6 +45,7 @@ _TYPES = {
     "FLOAT8": DataType.DOUBLE,
     "BOOLEAN": DataType.BOOL, "BOOL": DataType.BOOL,
     "BYTEA": DataType.BINARY,
+    "JSONB": DataType.JSONB, "JSON": DataType.JSONB,
 }
 
 
@@ -134,7 +135,11 @@ class Parser:
         if t.kind == "param":
             if neg:
                 raise InvalidArgument("cannot negate a bind marker")
-            return ast.BindMarker(int(t.text[1:]) - 1)
+            idx = int(t.text[1:])
+            if idx < 1:  # $0 would alias params[-1] via negative indexing
+                raise InvalidArgument(
+                    f"bind markers are 1-based: {t.text}")
+            return ast.BindMarker(idx - 1)
         if t.kind == "string":
             if neg:
                 raise InvalidArgument("cannot negate a string")
@@ -173,6 +178,8 @@ class Parser:
             if self.take_kw("INDEX"):
                 return ast.DropIndex(*self._name_if_exists())
             raise InvalidArgument(f"cannot DROP {self.peek()}")
+        if head == "ALTER":
+            return self._alter_table()
         if head == "INSERT":
             return self._insert()
         if head == "UPDATE":
@@ -259,6 +266,31 @@ class Parser:
             raise InvalidArgument("table has no primary key")
         return ast.CreateTable(name, columns, hash_keys, range_keys,
                                if_not_exists, num_tablets)
+
+    def _alter_table(self) -> ast.AlterTable:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        name = self.ident()
+        if self.take_kw("ADD"):
+            self.take_kw("COLUMN")
+            col = self.ident()
+            dtype = self._type()
+            self.take_sym(";")
+            return ast.AlterTable(name, "add", col, dtype)
+        if self.take_kw("DROP"):
+            self.take_kw("COLUMN")
+            col = self.ident()
+            self.take_sym(";")
+            return ast.AlterTable(name, "drop", col)
+        if self.take_kw("RENAME"):
+            self.take_kw("COLUMN")
+            old = self.ident()
+            self.expect_kw("TO")
+            new = self.ident()
+            self.take_sym(";")
+            return ast.AlterTable(name, "rename", old, new_name=new)
+        raise InvalidArgument(
+            f"expected ADD/DROP/RENAME, got {self.peek()}")
 
     def _create_index(self) -> ast.CreateIndex:
         self.take_kw("UNIQUE")  # accepted, enforced as a plain index
@@ -423,7 +455,16 @@ class Parser:
                 raise InvalidArgument(
                     "only integer constants are allowed in expressions")
             return Const(v)
-        return Col(self.ident())
+        name = self.ident()
+        # jsonb path: col -> 'key' -> 0 ->> 'leaf'
+        steps = []
+        while self.peek() is not None and self.peek().kind == "op" \
+                and self.peek().text in ("->", "->>"):
+            op = self.next().text
+            steps.append((op, self.literal()))
+        if steps:
+            return ast.JsonPath(name, steps)
+        return Col(name)
 
     def _scalar_or_literal(self):
         """UPDATE SET rhs: a literal (any type) or a column expression."""

@@ -98,6 +98,10 @@ def _text(v) -> bytes:
         return b"t" if v else b"f"
     if isinstance(v, (bytes, bytearray)):
         return b"\\x" + bytes(v).hex().encode()
+    if isinstance(v, (dict, list)):  # jsonb / collections: json text
+        import json
+
+        return json.dumps(v, separators=(",", ":")).encode()
     return str(v).encode("utf-8", "replace")
 
 
