@@ -74,6 +74,40 @@ class YBClient:
         self._req_lock = threading.Lock()
         self._req_counter = 0
 
+    @classmethod
+    def connect(cls, master_addrs: str) -> "YBClient":
+        """Bootstrap a client over TCP from comma-separated master
+        host:port addresses (the driver connection string); tserver
+        addresses are learned from the master registry (and refreshed
+        whenever a lookup misses)."""
+        from yugabyte_db_tpu.rpc import SocketTransport
+
+        transport = SocketTransport()
+        uuids = []
+        for addr in master_addrs.split(","):
+            addr = addr.strip()
+            if not addr:
+                continue
+            host, port = addr.rsplit(":", 1)
+            uuid = f"master@{addr}"
+            transport.set_address(uuid, host, int(port))
+            uuids.append(uuid)
+        if not uuids:
+            raise ValueError("no master addresses given")
+        c = cls(transport, uuids)
+        c.refresh_tserver_addresses()
+        return c
+
+    def refresh_tserver_addresses(self) -> None:
+        """Learn tserver uuid -> address mappings (socket mode only)."""
+        if not hasattr(self.transport, "set_address"):
+            return
+        for d in self.list_tservers():
+            addr = d.get("addr")
+            if isinstance(addr, (list, tuple)) and len(addr) == 2:
+                self.transport.set_address(d["uuid"], addr[0],
+                                           int(addr[1]))
+
     # -- master path ---------------------------------------------------------
     def master_rpc(self, method: str, payload: dict,
                    timeout_s: float | None = None) -> dict:
@@ -198,8 +232,14 @@ class YBClient:
                     raise err
                 last = resp
             if not tried_refresh:
-                # Replica set may have changed (re-replication): refresh.
+                # Replica set may have changed (re-replication): refresh
+                # locations AND tserver addresses (socket mode: a
+                # restarted tserver binds a new port).
                 tried_refresh = True
+                try:
+                    self.refresh_tserver_addresses()
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
                 try:
                     locs = self.meta_cache.locations(table_name, refresh=True)
                     for t in locs.tablets:

@@ -129,18 +129,42 @@ class Master:
         from yugabyte_db_tpu.server.webserver import Webserver
 
         self.webserver = Webserver(self.metrics, f"master-{self.uuid}")
-        self.webserver.add_json_handler("/tables", lambda: [
-            {"table_id": t.table_id, "name": t.name, "state": t.state,
-             "num_tablets": t.num_tablets,
-             "indexes": [i["name"] for i in t.indexes]}
-            for t in self.catalog.list_tables()])
-        self.webserver.add_json_handler("/tablets", lambda: [
-            {"tablet_id": i.tablet_id, "table_id": i.table_id,
-             "replicas": i.replicas,
-             "leader": self.ts_manager.leader_of(i.tablet_id)}
-            for t in self.catalog.list_tables()
-            for i in self.catalog.tablets_of(t.table_id)])
+
+        # single row builders per entity: JSON API and dashboards agree
+        def _tables_rows():
+            return [{"name": t.name, "table_id": t.table_id,
+                     "state": t.state, "num_tablets": t.num_tablets,
+                     "schema_version": t.schema.get("version", 0),
+                     "indexes": [i["name"] for i in t.indexes]}
+                    for t in self.catalog.list_tables()]
+
+        def _tablets_rows():
+            return [{"tablet_id": i.tablet_id, "table_id": i.table_id,
+                     "leader": self.ts_manager.leader_of(i.tablet_id),
+                     "replicas": i.replicas}
+                    for t in self.catalog.list_tables()
+                    for i in self.catalog.tablets_of(t.table_id)]
+
+        self.webserver.add_json_handler("/tables", _tables_rows)
+        self.webserver.add_json_handler("/tablets", _tablets_rows)
         self.webserver.add_json_handler("/rpcz", self.rpcz.dump)
+
+        def _tservers_rows():
+            import time as _t
+
+            live = {d.uuid for d in self.ts_manager.live_tservers()}
+            return [{"uuid": d.uuid, "live": d.uuid in live,
+                     "tablets": d.num_live_tablets,
+                     "last_heartbeat_age_s": round(
+                         _t.monotonic() - d.last_heartbeat, 1)}
+                    for d in self.ts_manager.all_tservers()]
+
+        self.webserver.add_dashboard("/dashboards/tables", "Tables",
+                                     _tables_rows)
+        self.webserver.add_dashboard("/dashboards/tablets", "Tablets",
+                                     _tablets_rows)
+        self.webserver.add_dashboard("/dashboards/tablet-servers",
+                                     "Tablet servers", _tservers_rows)
         return self.webserver.start(host, port)
 
     def _rpc_entity(self, method: str):
@@ -261,9 +285,14 @@ class Master:
         if t is None:
             return {"code": "not_found"}
         new_schema = p["schema"]
-        if new_schema.get("version", 0) != t.schema.get("version", 0) + 1:
+        cur = t.schema.get("version", 0)
+        if new_schema.get("version", 0) <= cur:
+            # Already applied (a client retry after a slow first attempt
+            # replays the same ALTER): idempotent success.
+            return {"code": "ok", "version": cur}
+        if new_schema.get("version", 0) != cur + 1:
             return {"code": "version_mismatch",
-                    "current_version": t.schema.get("version", 0)}
+                    "current_version": cur}
         try:
             self.raft.replicate("catalog", {
                 "op": "alter_table", "table_id": t.table_id,

@@ -1,13 +1,15 @@
-"""Embedded HTTP server: /metrics (Prometheus), /varz, /healthz, /tablets.
+"""Embedded HTTP server: /metrics (Prometheus), /varz, /healthz, /memz,
+JSON endpoints, and HTML dashboards.
 
 Reference analog: src/yb/server/webserver.cc + the path handlers
-(default-path-handlers.cc, tserver-path-handlers.cc): every daemon
-exposes its metrics registry and flag table over HTTP for scraping and
-debugging.
+(default-path-handlers.cc, master/tserver-path-handlers.cc, assets in
+www/): every daemon exposes its metrics registry, flag table, memory
+stats, and per-daemon dashboards over HTTP.
 """
 
 from __future__ import annotations
 
+import html as _html
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -15,12 +17,38 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from yugabyte_db_tpu.utils.flags import FLAGS
 from yugabyte_db_tpu.utils.metrics import MetricRegistry
 
+_STYLE = """<style>
+body{font-family:system-ui,sans-serif;margin:2em;color:#222}
+table{border-collapse:collapse;margin:1em 0}
+th,td{border:1px solid #ccc;padding:4px 10px;text-align:left;
+      font-size:14px}
+th{background:#f0f3f7}
+h1{font-size:20px} a{color:#2459a8}
+nav a{margin-right:1em}
+</style>"""
+
+
+def _memz() -> dict:
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out = {"max_rss_kb": ru.ru_maxrss, "user_time_s": ru.ru_utime,
+           "system_time_s": ru.ru_stime}
+    try:
+        from yugabyte_db_tpu.utils.memtracker import root_tracker
+
+        out["trackers"] = root_tracker().dump()
+    except ImportError:
+        pass
+    return out
+
 
 class Webserver:
     def __init__(self, registry: MetricRegistry, daemon_name: str = ""):
         self.registry = registry
         self.daemon_name = daemon_name
         self._handlers = {}
+        self._dashboards: list[tuple[str, str]] = []  # (path, title)
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self.add_json_handler("/healthz", lambda: {"status": "ok"})
@@ -28,6 +56,8 @@ class Webserver:
             f.name: {"value": f.value, "default": f.default,
                      "help": f.help, "tags": sorted(f.tags)}
             for f in FLAGS.all()})
+        self.add_json_handler("/memz", _memz)
+        self.add_handler("/", self._home, content_type="text/html")
 
     def add_handler(self, path: str, fn, content_type="text/plain"):
         """fn() -> str served at ``path``."""
@@ -37,6 +67,48 @@ class Webserver:
         self.add_handler(path, lambda: json.dumps(fn(), indent=1,
                                                   default=str),
                          content_type="application/json")
+
+    def add_dashboard(self, path: str, title: str, fn):
+        """Register an HTML table dashboard at ``path`` rendering
+        fn() -> list[dict] (the JSON shape the API endpoints serve);
+        reference: the master/tserver path-handler dashboards."""
+        self._dashboards.append((path, title))
+        self.add_handler(path, lambda: self._render_table(title, fn()),
+                         content_type="text/html")
+
+    def _nav(self) -> str:
+        links = [("/", "home"), ("/metrics", "metrics"),
+                 ("/varz", "varz"), ("/memz", "memz")]
+        links += [(p, t) for p, t in self._dashboards]
+        extra = [(p, p.strip("/")) for p in self._handlers
+                 if p not in {x[0] for x in links} and p != "/"]
+        return "<nav>" + "".join(
+            f'<a href="{p}">{_html.escape(t)}</a>'
+            for p, t in links + sorted(extra)) + "</nav>"
+
+    def _home(self) -> str:
+        return (f"<html><head><title>{_html.escape(self.daemon_name)}"
+                f"</title>{_STYLE}</head><body>"
+                f"<h1>{_html.escape(self.daemon_name)}</h1>"
+                f"{self._nav()}</body></html>")
+
+    def _render_table(self, title: str, rows: list[dict]) -> str:
+        cols: list[str] = []
+        for r in rows:
+            for k in r:
+                if k not in cols:
+                    cols.append(k)
+        body = "".join(
+            "<tr>" + "".join(
+                f"<td>{_html.escape(str(r.get(c, '')))}</td>"
+                for c in cols) + "</tr>"
+            for r in rows)
+        head = "".join(f"<th>{_html.escape(c)}</th>" for c in cols)
+        return (f"<html><head><title>{_html.escape(title)}</title>{_STYLE}"
+                f"</head><body><h1>{_html.escape(title)} — "
+                f"{_html.escape(self.daemon_name)}</h1>{self._nav()}"
+                f"<table><tr>{head}</tr>{body}</table>"
+                f"<p>{len(rows)} row(s)</p></body></html>")
 
     def start(self, host: str = "127.0.0.1",
               port: int = 0) -> tuple[str, int]:

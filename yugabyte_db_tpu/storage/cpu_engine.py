@@ -201,6 +201,15 @@ class CpuStorageEngine(StorageEngine):
         self.runs.append(CpuRun(entries))
         self.memtable = MemTable()
 
+    def restore_entries(self, entries) -> None:
+        self.memtable = MemTable()
+        self.persist.replace_all(entries)
+        self.runs = [CpuRun(entries)] if entries else []
+        for _key, versions in entries:
+            for v in versions:
+                self.flushed_frontier_ht = max(self.flushed_frontier_ht,
+                                               v.ht)
+
     def compact(self, history_cutoff_ht: int = 0) -> None:
         if len(self.runs) <= 1 and history_cutoff_ht == 0:
             return

@@ -59,6 +59,12 @@ class StorageEngine(abc.ABC):
     def stats(self) -> dict:
         """Observability counters (runs, rows, bytes, versions)."""
 
+    def restore_entries(self, entries) -> None:
+        """Replace ALL engine content (memtable + runs + persisted files)
+        with the given (key, versions) entries — the snapshot-restore
+        primitive. Subclasses rebuild their run representations."""
+        raise NotImplementedError
+
     def alter_schema(self, new_schema: Schema) -> None:
         """Adopt an evolved schema (ALTER TABLE). Key columns never
         change; value columns may be added (NULL for existing rows),

@@ -408,6 +408,19 @@ class TpuStorageEngine(StorageEngine):
                         run.varlen_max_len.get(cid, 0), max(lens))
         return run
 
+    def restore_entries(self, entries) -> None:
+        self.memtable = MemTable()
+        self.persist.replace_all(entries)
+        if entries:
+            crun = ColumnarRun.build(self.schema, entries,
+                                     self.rows_per_block)
+            self.runs = [TpuRun(crun)]
+            self.flushed_frontier_ht = max(self.flushed_frontier_ht,
+                                           crun.max_ht)
+        else:
+            self.runs = []
+        self._plan_cache.clear()
+
     def dump_entries(self):
         """All flushed (key, versions ht-desc) pairs, key-merged across
         runs — the storage payload of a remote-bootstrap session."""

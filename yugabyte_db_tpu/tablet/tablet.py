@@ -146,11 +146,9 @@ class Tablet:
             if committed_frontier is not None and \
                     entry.op_id.index > committed_frontier:
                 continue
+            self._apply_entry_body(entry)
             if entry.op_type == "write":
-                self._apply_write_body(entry)
                 replayed += 1
-            else:
-                self._apply_txn_op(entry)
             self._applied_index = max(self._applied_index, entry.op_id.index)
         self._replayed_on_bootstrap = replayed
 
@@ -178,6 +176,120 @@ class Tablet:
             self.participant.apply_remove_op(entry.body)
         elif entry.op_type == "txn_status" and self.coordinator is not None:
             self.coordinator.apply_status_op(entry.body)
+
+    # -- snapshots (reference: Tablet::CreateCheckpoint, tablet.h:348,
+    # via rocksdb hard-link checkpoints, checkpoint.cc:53; cluster RPCs
+    # in backup.proto TabletSnapshotOp CREATE/RESTORE/DELETE) ------------
+    def snapshots_dir(self) -> str:
+        return os.path.join(self.dir, "snapshots")
+
+    def list_snapshots(self) -> list[str]:
+        d = self.snapshots_dir()
+        if not os.path.isdir(d):
+            return []
+        return sorted(n for n in os.listdir(d) if not n.endswith(".tmp"))
+
+    def _apply_snapshot_op(self, op_type: str, body: dict) -> None:
+        """Apply a replicated snapshot op. Runs at a fixed log position on
+        every replica, so each replica's snapshot captures the same
+        logical state; all three ops are idempotent across WAL replays
+        (a re-created snapshot re-captures the same position's state
+        because replay applies entries in order)."""
+        import shutil as _shutil
+
+        sid = body["snapshot_id"]
+        if "/" in sid or sid.startswith("."):
+            raise ValueError(f"bad snapshot id {sid!r}")
+        sdir = os.path.join(self.snapshots_dir(), sid)
+        if op_type == "create_snapshot":
+            if os.path.exists(sdir):
+                return  # replayed: already captured at this position
+            self.engine.flush()  # runs now hold every applied write
+            tmp = sdir + ".tmp"
+            _shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            for path in self.engine.persist.files:
+                dst = os.path.join(tmp, os.path.basename(path))
+                try:
+                    os.link(path, dst)  # hard link: cheap, immutable file
+                except OSError:
+                    _shutil.copy2(path, dst)
+            with open(os.path.join(tmp, "snapshot-meta.json"), "w") as f:
+                import json as _json
+
+                _json.dump({"schema": self.meta.schema.to_dict(),
+                            "ht": self.clock.now().value}, f)
+            os.rename(tmp, sdir)
+        elif op_type == "restore_snapshot":
+            if not os.path.isdir(sdir):
+                # Leaders validate existence before replicating; a miss
+                # here (non-consensus misuse, manual dir removal) must
+                # not wedge the apply stage.
+                if not self.consensus_managed:
+                    raise RuntimeError(f"snapshot {sid} not found")
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "tablet %s: restore of missing snapshot %s skipped",
+                    self.meta.tablet_id, sid)
+                return
+            from yugabyte_db_tpu.storage.merge import merge_entry_streams
+            from yugabyte_db_tpu.storage.run_io import load_run
+
+            runs = [load_run(os.path.join(sdir, n))
+                    for n in sorted(os.listdir(sdir))
+                    if n.startswith("run-")]
+            entries = list(merge_entry_streams(runs)) if runs else []
+            self.engine.restore_entries(entries)
+        else:  # delete_snapshot
+            _shutil.rmtree(sdir, ignore_errors=True)
+
+    def dump_snapshots(self) -> dict:
+        """Every snapshot's logical content (for remote bootstrap: a
+        re-seeded replica must be able to apply later restore_snapshot
+        entries, so the snapshots travel with the storage payload)."""
+        import json as _json
+
+        from yugabyte_db_tpu.storage.merge import merge_entry_streams
+        from yugabyte_db_tpu.storage.run_io import load_run
+
+        out = {}
+        for sid in self.list_snapshots():
+            sdir = os.path.join(self.snapshots_dir(), sid)
+            runs = [load_run(os.path.join(sdir, n))
+                    for n in sorted(os.listdir(sdir))
+                    if n.startswith("run-")]
+            meta = {}
+            mpath = os.path.join(sdir, "snapshot-meta.json")
+            if os.path.exists(mpath):
+                with open(mpath) as f:
+                    meta = _json.load(f)
+            out[sid] = {"entries": list(merge_entry_streams(runs))
+                        if runs else [], "meta": meta}
+        return out
+
+    @staticmethod
+    def install_snapshots(tablet_dir: str, snapshots: dict) -> None:
+        """Materialize dumped snapshots into a (re)built tablet dir."""
+        import json as _json
+
+        from yugabyte_db_tpu.storage.run_io import RunPersistence
+
+        for sid, blob in (snapshots or {}).items():
+            sdir = os.path.join(tablet_dir, "snapshots", sid)
+            os.makedirs(sdir, exist_ok=True)
+            if blob["entries"]:
+                RunPersistence(sdir).save_new(blob["entries"])
+            with open(os.path.join(sdir, "snapshot-meta.json"), "w") as f:
+                _json.dump(blob.get("meta") or {}, f)
+
+    def snapshot_op(self, op_type: str, snapshot_id: str) -> None:
+        """Direct snapshot op (non-consensus tablets; replicated tablets
+        go through TabletPeer.replicate_txn_op)."""
+        if self.consensus_managed:
+            raise RuntimeError("snapshot ops go through the TabletPeer")
+        with self._write_lock:
+            self._apply_snapshot_op(op_type, {"snapshot_id": snapshot_id})
 
     def alter_schema(self, new_schema) -> None:
         """Direct schema change (non-consensus tablets; replicated
@@ -240,15 +352,24 @@ class Tablet:
         the memtable under the same lock — an apply racing that swap would
         vanish while the replay frontier still advances past it."""
         with self._write_lock:
-            if entry.op_type == "write":
-                self._apply_write_body(entry)
-            elif entry.op_type == "alter_schema":
-                self._apply_alter_schema(entry.body)
-            else:
-                self._apply_txn_op(entry)
+            self._apply_entry_body(entry)
             self._applied_index = max(self._applied_index, entry.op_id.index)
             self._last_index = max(self._last_index, entry.op_id.index)
         self.clock.update(HybridTime(entry.ht))
+
+    def _apply_entry_body(self, entry) -> None:
+        """The ONE dispatch for committed entries — the Raft apply stage
+        and WAL-replay bootstrap both route through it, so no op type can
+        apply live but silently vanish on replay."""
+        if entry.op_type == "write":
+            self._apply_write_body(entry)
+        elif entry.op_type == "alter_schema":
+            self._apply_alter_schema(entry.body)
+        elif entry.op_type in ("create_snapshot", "restore_snapshot",
+                               "delete_snapshot"):
+            self._apply_snapshot_op(entry.op_type, entry.body)
+        else:
+            self._apply_txn_op(entry)
 
     def _apply_alter_schema(self, body: dict) -> None:
         """Adopt a replicated schema change (idempotent across replays:

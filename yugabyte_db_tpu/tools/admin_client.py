@@ -197,6 +197,31 @@ class AdminClient:
             n += 1
         return n
 
+    def snapshot_table(self, table: str, snapshot_id: str,
+                       op: str = "create_snapshot") -> int:
+        """Run a snapshot op (create/restore/delete) on every tablet of a
+        table (reference: the snapshot RPCs of backup.proto driven by
+        yb-admin create_snapshot)."""
+        n = 0
+        for t in self.table_locations(table):
+            resp = self._leader_rpc(t["tablet_id"], "ts.snapshot_op",
+                                    {"tablet_id": t["tablet_id"],
+                                     "snapshot_id": snapshot_id, "op": op})
+            if resp.get("code") != "ok":
+                raise AdminError(
+                    f"{op} {snapshot_id} on {t['tablet_id']}: "
+                    f"{resp.get('message', resp.get('code'))}")
+            n += 1
+        return n
+
+    def list_snapshots(self, table: str) -> dict[str, list[str]]:
+        out = {}
+        for t in self.table_locations(table):
+            resp = self._leader_rpc(t["tablet_id"], "ts.list_snapshots",
+                                    {"tablet_id": t["tablet_id"]})
+            out[t["tablet_id"]] = resp.get("snapshots", [])
+        return out
+
     def tserver_status(self, uuid: str) -> dict:
         return self.transport.send(uuid, "ts.status", {}, timeout=3.0)
 

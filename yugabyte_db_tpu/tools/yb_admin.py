@@ -82,6 +82,34 @@ def cmd_delete_table(admin: AdminClient, args) -> int:
     return 0
 
 
+def cmd_create_snapshot(admin: AdminClient, args) -> int:
+    n = admin.snapshot_table(args.table, args.snapshot_id,
+                             "create_snapshot")
+    print(f"created snapshot {args.snapshot_id} on {n} tablet(s)")
+    return 0
+
+
+def cmd_restore_snapshot(admin: AdminClient, args) -> int:
+    n = admin.snapshot_table(args.table, args.snapshot_id,
+                             "restore_snapshot")
+    print(f"restored snapshot {args.snapshot_id} on {n} tablet(s)")
+    return 0
+
+
+def cmd_delete_snapshot(admin: AdminClient, args) -> int:
+    n = admin.snapshot_table(args.table, args.snapshot_id,
+                             "delete_snapshot")
+    print(f"deleted snapshot {args.snapshot_id} on {n} tablet(s)")
+    return 0
+
+
+def cmd_list_snapshots(admin: AdminClient, args) -> int:
+    snaps = admin.list_snapshots(args.table)
+    rows = [[tid, ", ".join(s) or "-"] for tid, s in sorted(snaps.items())]
+    print(_fmt_table(rows, ["TABLET", "SNAPSHOTS"]))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="yb-admin")
     ap.add_argument("--master", required=True, help="host:port of any master")
@@ -118,6 +146,18 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("delete_table")
     p.add_argument("table")
     p.set_defaults(fn=cmd_delete_table)
+
+    for name, fn in (("create_snapshot", cmd_create_snapshot),
+                     ("restore_snapshot", cmd_restore_snapshot),
+                     ("delete_snapshot", cmd_delete_snapshot)):
+        p = sub.add_parser(name)
+        p.add_argument("table")
+        p.add_argument("snapshot_id")
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("list_snapshots")
+    p.add_argument("table")
+    p.set_defaults(fn=cmd_list_snapshots)
     return ap
 
 

@@ -140,6 +140,13 @@ class TSTabletManager:
                        for key, vers in payload["runs"]]
             if entries:
                 RunPersistence(os.path.join(tdir, "runs")).save_new(entries)
+            from yugabyte_db_tpu.tablet.tablet import Tablet as _Tablet
+
+            _Tablet.install_snapshots(tdir, {
+                sid: {"entries": [(k, wire.decode_rows(vers))
+                                  for k, vers in blob["entries"]],
+                      "meta": blob.get("meta") or {}}
+                for sid, blob in (payload.get("snapshots") or {}).items()})
             for name, blob in (("intents.bin", payload.get("intents")),
                                ("retryable.bin", payload.get("retryable"))):
                 if blob is not None:

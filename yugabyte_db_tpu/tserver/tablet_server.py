@@ -103,14 +103,22 @@ class TabletServer:
         from yugabyte_db_tpu.server.webserver import Webserver
 
         self.webserver = Webserver(self.metrics, f"tserver-{self.uuid}")
-        self.webserver.add_json_handler("/tablets", lambda: [
-            {"tablet_id": p.tablet_id,
-             "table": p.tablet.meta.table_name,
-             "leader": p.is_leader(),
-             **{k: v for k, v in p.stats().items()
-                if not isinstance(v, dict)}}
-            for p in self.tablet_manager.peers()])
+
+        def _tablet_rows():
+            # the ONE row builder: JSON API and HTML dashboard agree
+            return [
+                {"tablet_id": p.tablet_id,
+                 "table": p.tablet.meta.table_name,
+                 "role": "leader" if p.is_leader() else "follower",
+                 "schema_version": p.tablet.meta.schema.version,
+                 **{k: v for k, v in p.stats().items()
+                    if not isinstance(v, dict)}}
+                for p in self.tablet_manager.peers()]
+
+        self.webserver.add_json_handler("/tablets", _tablet_rows)
         self.webserver.add_json_handler("/rpcz", self.rpcz.dump)
+        self.webserver.add_dashboard("/dashboards/tablets", "Tablets",
+                                     _tablet_rows)
         return self.webserver.start(host, port)
 
     def _rpc_entity(self, method: str):
@@ -275,9 +283,65 @@ class TabletServer:
             "retryable": t.retryable.dump(),
             "txn_state": (t.coordinator.dump()
                           if t.coordinator is not None else None),
+            "snapshots": {
+                sid: {"entries": [[k, wire.encode_rows(vers)]
+                                  for k, vers in blob["entries"]],
+                      "meta": blob["meta"]}
+                for sid, blob in t.dump_snapshots().items()},
         }
         payload.update(snap["tail"])
         return {"code": "ok", "payload": payload}
+
+    def _h_ts_snapshot_op(self, p: dict):
+        """Replicated tablet snapshot ops (reference: backup.proto
+        TabletSnapshotOp CREATE/RESTORE/DELETE). Each replica captures /
+        restores its own local snapshot at the same log position."""
+        op = p.get("op")
+        if op not in ("create_snapshot", "restore_snapshot",
+                      "delete_snapshot"):
+            return {"code": "error", "message": f"bad snapshot op {op!r}"}
+        sid = p.get("snapshot_id") or ""
+        if not sid or "/" in sid or "\\" in sid or sid.startswith(".") \
+                or sid.endswith(".tmp"):
+            # validated BEFORE replicating: a bad id raising inside the
+            # apply stage would wedge every replica's apply thread
+            return {"code": "error",
+                    "message": f"bad snapshot id {sid!r}"}
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if not peer.raft.is_leader():
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        if op == "restore_snapshot" and \
+                p["snapshot_id"] not in peer.tablet.list_snapshots():
+            # validated BEFORE replicating: the apply stage must never
+            # fail (an apply exception would wedge the tablet)
+            return {"code": "error",
+                    "message": f"snapshot {p['snapshot_id']} not found"}
+        try:
+            peer.replicate_txn_op(op, {"snapshot_id": p["snapshot_id"]})
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            return {"code": "timed_out"}
+        except Exception as e:  # noqa: BLE001 (e.g. snapshot not found)
+            return {"code": "error", "message": str(e)}
+        return {"code": "ok"}
+
+    def _h_ts_list_snapshots(self, p: dict):
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        # Leader-gated: a lagging follower hasn't applied the latest
+        # snapshot ops and would list a stale set.
+        if not peer.raft.is_leader():
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        return {"code": "ok",
+                "snapshots": peer.tablet.list_snapshots()}
 
     def _h_ts_alter_schema(self, p: dict):
         """Adopt a new table schema on one tablet: the LEADER replicates

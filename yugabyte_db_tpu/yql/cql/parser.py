@@ -116,29 +116,39 @@ class Parser:
         return name
 
     def literal(self):
-        # collection literals: [a, b] list; {a, b} set; {k: v, ...} map
+        # collection literals: [a, b] list; {a, b} set; {k: v, ...} map.
+        # Bind markers are supported for WHOLE collections (v = ?) but
+        # not for individual elements — element markers would persist
+        # BindMarker objects as data (and sets can't sort them).
+        def _no_marker(v):
+            if isinstance(v, ast.BindMarker):
+                raise InvalidArgument(
+                    "bind markers are not allowed inside collection "
+                    "literals; bind the whole collection instead")
+            return v
+
         if self.take_sym("["):
             out = []
             while not self.take_sym("]"):
-                out.append(self.literal())
+                out.append(_no_marker(self.literal()))
                 self.take_sym(",")
             return out
         if self.at_sym("{"):
             self.next()
             if self.take_sym("}"):
                 return {}  # empty braces: map (CQL's untyped empty {})
-            first = self.literal()
+            first = _no_marker(self.literal())
             if self.take_sym(":"):
-                m = {first: self.literal()}
+                m = {first: _no_marker(self.literal())}
                 while self.take_sym(","):
-                    k = self.literal()
+                    k = _no_marker(self.literal())
                     self.expect_sym(":")
-                    m[k] = self.literal()
+                    m[k] = _no_marker(self.literal())
                 self.expect_sym("}")
                 return dict(sorted(m.items()))  # normalized key order
             items = [first]
             while self.take_sym(","):
-                items.append(self.literal())
+                items.append(_no_marker(self.literal()))
             self.expect_sym("}")
             return sorted(set(items))  # SET: normalized sorted list
         t = self.next()
