@@ -239,3 +239,61 @@ def test_no_progress_without_majority(group):
                 continue
         return False
     wait_for(can_write, timeout=10.0, msg="post-heal write")
+
+
+def test_message_borne_lease_expires_when_isolated(group):
+    """The leader holds its lease only while a majority's explicit
+    grants (shipped in AppendEntries, echoed in acks) are running;
+    isolating it must drop has_lease within one lease window
+    (reference: leader_lease.h message-borne leases)."""
+    leader = group.leader()
+    assert leader.raft.has_lease()
+    group.transport.isolate(leader.node_uuid)
+    # grants were measured from send time: within effective_lease_s the
+    # isolated leader must stop serving lease reads
+    wait_for(lambda: not leader.raft.has_lease(), timeout=3.0,
+             msg="lease expiry after isolation")
+    # and the remaining majority elects a replacement only AFTER their
+    # promises to the old leader expired — there is never a moment with
+    # two lease-holding leaders
+    new = wait_for(
+        lambda: next((p for p in group.peers.values()
+                      if p.node_uuid != leader.node_uuid
+                      and p.raft.is_leader() and p.raft.has_lease()),
+                     None), timeout=5.0, msg="replacement leader")
+    assert not leader.raft.has_lease()
+    group.transport.heal()
+    wait_for(lambda: not leader.raft.is_leader(), timeout=5.0,
+             msg="old leader steps down")
+    assert new.raft.has_lease()
+
+
+def test_wall_clock_jump_does_not_affect_leases_or_order(group, monkeypatch):
+    """Jump one node's WALL clock far ahead: leases (monotonic-duration
+    arithmetic) must be unaffected, and hybrid-time causality must hold
+    — writes after the jump get larger hybrid times everywhere
+    (reference: SkewedClock tests, clock_synchronization-itest.cc)."""
+    import yugabyte_db_tpu.utils.hybrid_time as HT
+
+    leader = group.leader()
+    ht1 = leader.write([group.row("before-jump", 1)])
+
+    # jump the wall clock +1 hour for every NEW physical reading
+    real_time = HT.time.time
+    monkeypatch.setattr(HT.time, "time", lambda: real_time() + 3600.0)
+
+    assert leader.raft.has_lease()  # monotonic lease unaffected
+    ht2 = leader.write([group.row("after-jump", 2)])
+    assert ht2.value > ht1.value
+    # followers ratchet to the jumped clock through message hybrid times
+    # (causality), so a failover cannot go back in time
+    wait_for(lambda: all(
+        p.tablet.clock.now().value > ht2.value
+        for p in group.peers.values()), timeout=3.0,
+        msg="clock propagation")
+
+    # restore the wall clock: hybrid time must NEVER regress
+    monkeypatch.setattr(HT.time, "time", real_time)
+    ht3 = leader.write([group.row("after-restore", 3)])
+    assert ht3.value > ht2.value
+    assert leader.raft.has_lease()

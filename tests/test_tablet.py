@@ -195,3 +195,41 @@ def test_codec_roundtrip():
     ]
     for v in cases:
         assert codec.decode(codec.encode(v)) == v
+
+
+def test_intra_batch_write_id_ordering(tmp_path):
+    """Two writes to the SAME key in ONE batch share a hybrid time; the
+    write_id sub-ordering (DocHybridTime's write_id component,
+    src/yb/common/doc_hybrid_time.h) makes the LATER one win — on both
+    engines, before and after flush."""
+    import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401
+    from yugabyte_db_tpu.models.partition import compute_hash_code
+
+    for engine in ("cpu", "tpu"):
+        schema = make_schema()
+        cid = {c.name: c.col_id for c in schema.columns}
+        meta = TabletMetadata(f"t-{engine}", "t", schema, 0, 65536,
+                              engine=engine)
+        t = Tablet.create(meta, str(tmp_path / engine), fsync=False)
+        key = schema.encode_primary_key(
+            {"k": "dup", "r": 0},
+            compute_hash_code(schema, {"k": "dup"}))
+        t.write([
+            RowVersion(key, ht=0, liveness=True, columns={cid["v"]: "a"}),
+            RowVersion(key, ht=0, liveness=True, columns={cid["v"]: "b"}),
+            RowVersion(key, ht=0, columns={cid["v"]: "c"}),  # UPDATE-style
+        ])
+        for label in ("memtable", "flushed"):
+            res = t.scan(ScanSpec(read_ht=t.read_time().value,
+                                  projection=["k", "v"]))
+            assert res.rows == [("dup", "c")], (engine, label, res.rows)
+            t.flush()
+        # same-batch DELETE shadows same-ht writes regardless of position
+        # (the device kernel's <= tombstone rule; scan.py:182)
+        t.write([
+            RowVersion(key, ht=0, liveness=True, columns={cid["v"]: "z"}),
+            RowVersion(key, ht=0, tombstone=True),
+        ])
+        res = t.scan(ScanSpec(read_ht=t.read_time().value))
+        assert res.rows == [], (engine, res.rows)
+        t.close()

@@ -14,10 +14,12 @@ commit (the reference's OperationDriver::ApplyTask stage). The WAL is the
 Raft log: every entry is fsynced before it counts toward majority.
 
 Simplifications vs the reference, called out honestly:
-- Leader leases are implemented as majority-ack recency (a leader considers
-  its lease held while a majority acked within ``lease_s``) plus follower
-  vote-withholding while a live leader is heard from — the reference
-  additionally ships lease durations in each message (leader_lease.h).
+- Leader leases are MESSAGE-BORNE (leader_lease.h): every AppendEntries
+  carries a lease duration; the follower promises (vote withholding until
+  a monotonic deadline) and echoes the grant in its ack; the leader holds
+  the lease while a majority's grants — measured from each request's SEND
+  time — are still running. All lease arithmetic is monotonic-clock
+  durations, so wall-clock jumps cannot extend or break a lease.
 - The in-memory entry cache holds the whole log (LogCache with no eviction);
   fine at this framework's log sizes, an eviction policy is a TODO.
 """
@@ -77,6 +79,10 @@ class _PeerState:
         self.next_index = next_index
         self.match_index = 0
         self.last_ack_monotonic = 0.0
+        # monotonic deadline of the lease this peer GRANTED (ack of a
+        # message carrying lease_s): the peer promised not to vote for
+        # anyone else before it (leader_lease.h message-borne leases)
+        self.lease_until = 0.0
         self.needs_remote_bootstrap = False
         self.signal = threading.Event()
         self.thread: threading.Thread | None = None
@@ -105,6 +111,9 @@ class RaftConsensus:
         self._rng = random.Random(hash((self.uuid, tablet_id)) & 0xFFFF)
         self._election_timeout = self._next_timeout()
         self._last_heartbeat_recv = time.monotonic()
+        # monotonic deadline of the vote-withholding promise made to the
+        # current leader (message-borne lease grants)
+        self._vote_withhold_until = 0.0
         self._last_broadcast = 0.0
         self._leader_since = 0.0  # when this node last won an election
         self._own_term_noop = (0, 0)  # (term, index) of our election no_op
@@ -201,15 +210,14 @@ class RaftConsensus:
             if len(cfg.peers) > 1 and \
                     now < self._leader_since + self.opts.effective_lease_s:
                 return False
-            cutoff = now - self.opts.effective_lease_s
             acked = 0
             for uuid in cfg.peers:
                 if uuid == self.uuid:
                     acked += 1  # self counts only while still a member
                     continue
                 p = self._peers.get(uuid)
-                if p is not None and p.last_ack_monotonic >= cutoff:
-                    acked += 1
+                if p is not None and p.lease_until > now:
+                    acked += 1  # explicit grant still running
             return acked >= cfg.majority_size()
 
     def leader_uuid(self) -> str | None:
@@ -321,7 +329,12 @@ class RaftConsensus:
             # prevents a rejoining partitioned node from disrupting the
             # group (reference: leader leases / pre-elections).
             if not req.get("ignore_live_leader"):
-                since = time.monotonic() - self._last_heartbeat_recv
+                now = time.monotonic()
+                # the explicit message-borne promise first, then the
+                # live-leader recency guard
+                if now < self._vote_withhold_until:
+                    return {"term": term, "granted": False}
+                since = now - self._last_heartbeat_recv
                 if self._leader_uuid is not None and \
                         since < self.opts.election_timeout_s:
                     return {"term": term, "granted": False}
@@ -357,6 +370,11 @@ class RaftConsensus:
             self._leader_uuid = req["leader"]
             self._last_heartbeat_recv = time.monotonic()
             self._election_timeout = self._next_timeout()
+            granted = float(req.get("lease_s", 0.0))
+            if granted > 0:
+                self._vote_withhold_until = max(
+                    self._vote_withhold_until,
+                    time.monotonic() + granted)
 
             prev_index, prev_term = req["prev_index"], req["prev_term"]
             if prev_index > 0:
@@ -377,7 +395,11 @@ class RaftConsensus:
                     self._truncate_suffix(e.op_id.index - 1)
                 self._append_local(e, sync=False)
                 appended = True
-            if appended:
+            if appended or self._durable_index < self._last_index:
+                # ALSO when nothing new appended: a retried request whose
+                # first attempt buffered entries but failed its sync must
+                # not ack (and grant a lease) over unsynced entries —
+                # every success response implies everything is durable.
                 self.log.sync()  # one fsync per request (group commit)
                 self._durable_index = self._last_index
             new_commit = min(req["commit_index"], self._last_index)
@@ -385,7 +407,8 @@ class RaftConsensus:
                 self._commit_index = new_commit
                 self._on_commit_advanced_locked()
             return {"term": self.cmeta.current_term, "success": True,
-                    "last_index": self._last_index}
+                    "last_index": self._last_index,
+                    "lease_s_granted": granted}
 
     def _append_local(self, e: LogEntry, sync: bool = True) -> None:
         self.log.append(e)
@@ -464,6 +487,10 @@ class RaftConsensus:
                     "leader": self.uuid, "prev_index": prev_index,
                     "prev_term": prev_term, "entries": batch,
                     "commit_index": self._commit_index,
+                    # message-borne lease: the follower promises not to
+                    # vote for this duration (measured from OUR send
+                    # time; its ack makes the grant effective)
+                    "lease_s": self.opts.effective_lease_s,
                 }
             send_time = time.monotonic()
             try:
@@ -483,6 +510,9 @@ class RaftConsensus:
                     return
                 if resp["success"]:
                     peer.last_ack_monotonic = send_time
+                    peer.lease_until = max(
+                        peer.lease_until,
+                        send_time + float(resp.get("lease_s_granted", 0.0)))
                     if batch:
                         peer.match_index = max(peer.match_index,
                                                batch[-1][1])

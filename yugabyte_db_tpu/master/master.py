@@ -287,9 +287,13 @@ class Master:
         new_schema = p["schema"]
         cur = t.schema.get("version", 0)
         if new_schema.get("version", 0) <= cur:
-            # Already applied (a client retry after a slow first attempt
-            # replays the same ALTER): idempotent success.
-            return {"code": "ok", "version": cur}
+            # A client retry of the SAME ALTER is idempotent success; a
+            # DIFFERENT schema at a consumed version lost a concurrent
+            # DDL race and must re-plan from the current schema.
+            if new_schema.get("version", 0) == cur and \
+                    new_schema.get("columns") == t.schema.get("columns"):
+                return {"code": "ok", "version": cur}
+            return {"code": "version_mismatch", "current_version": cur}
         if new_schema.get("version", 0) != cur + 1:
             return {"code": "version_mismatch",
                     "current_version": cur}

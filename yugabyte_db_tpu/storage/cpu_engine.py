@@ -188,6 +188,7 @@ class CpuStorageEngine(StorageEngine):
         if self.memtable.num_versions >= limit:
             self.flush()
             self.maybe_compact()
+        self._track_memstore()
 
     # -- lifecycle ---------------------------------------------------------
     def flush(self) -> None:
@@ -200,6 +201,7 @@ class CpuStorageEngine(StorageEngine):
         self.persist.save_new(entries)
         self.runs.append(CpuRun(entries))
         self.memtable = MemTable()
+        self._track_memstore()
 
     def restore_entries(self, entries) -> None:
         self.memtable = MemTable()
@@ -236,11 +238,11 @@ class CpuStorageEngine(StorageEngine):
         for key, run in heapq.merge(*iters, key=lambda p: p[0]):
             if key != current_key:
                 if current_key is not None:
-                    yield current_key, sorted(bucket, key=lambda r: -r.ht)
+                    yield current_key, sorted(bucket, key=lambda r: (-r.ht, -r.write_id))
                 current_key, bucket = key, []
             bucket.extend(run.get(key))
         if current_key is not None:
-            yield current_key, sorted(bucket, key=lambda r: -r.ht)
+            yield current_key, sorted(bucket, key=lambda r: (-r.ht, -r.write_id))
 
     @staticmethod
     def _gc_versions(key: bytes, versions: list[RowVersion],

@@ -26,8 +26,43 @@ class StorageEngine(abc.ABC):
     """Per-tablet storage: an LSM of MVCC row versions behind a scan API."""
 
     def __init__(self, schema: Schema, options: dict | None = None):
+        from yugabyte_db_tpu.utils.memtracker import root_tracker
+
         self.schema = schema
         self.options = dict(options or {})
+        # Hierarchical memory accounting: root -> memstore -> this engine
+        # (reference: the MemTracker tree + the shared memstore budget,
+        # mem_tracker.h / docdb_rocksdb_util.cc:437 memory_monitor).
+        self.mem_tracker = root_tracker().child("memstore").child(
+            self.options.get("tracker_name", f"engine-{id(self):x}"))
+        self._tracked_bytes = 0
+
+    def _track_memstore(self) -> None:
+        """Sync this engine's tracker with its memtable size. Crossing
+        the GLOBAL memstore budget flushes this engine only when it is
+        (one of) the LARGEST memstore consumers — flushing whichever
+        writer merely noticed would storm tiny flushes while the real
+        offender stays resident (the reference's memory monitor also
+        picks the largest memstore). An over-budget engine that never
+        writes again keeps its memory until its own next apply/flush."""
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        mem = getattr(self, "memtable", None)
+        current = 0 if mem is None else mem.approx_bytes
+        delta = current - self._tracked_bytes
+        if delta:
+            self.mem_tracker.consume(delta)
+            self._tracked_bytes = current
+        parent = self.mem_tracker.parent
+        if current and parent is not None and \
+                parent.consumption > FLAGS.get("global_memstore_limit_bytes"):
+            with parent._lock:
+                largest = max((c.consumption
+                               for c in parent._children.values()),
+                              default=0)
+            if current >= largest:
+                self.flush()
+                self._track_memstore()  # memtable swapped: release to 0
 
     # -- writes ------------------------------------------------------------
     @abc.abstractmethod
@@ -86,7 +121,7 @@ class StorageEngine(abc.ABC):
         return False
 
     def close(self) -> None:
-        pass
+        self.mem_tracker.detach()
 
 
 _ENGINES: dict[str, type] = {}

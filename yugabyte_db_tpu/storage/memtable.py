@@ -73,10 +73,11 @@ class MemTable:
 
     def drain_sorted(self) -> list[tuple[bytes, list[RowVersion]]]:
         """All (key, versions ht-desc) in key order — the flush input."""
-        from operator import attrgetter
-
-        ht_key = attrgetter("ht")
         data = self._data
+
+        def order(r):
+            return (r.ht, r.write_id)
+
         return [(k, vs if len(vs := data[k]) == 1
-                 else sorted(vs, key=ht_key, reverse=True))
+                 else sorted(vs, key=order, reverse=True))
                 for k in self._index()]

@@ -60,7 +60,7 @@ def merge_versions(key: bytes, versions: list[RowVersion], read_ht: int) -> Merg
             continue
         if v.tombstone and v.ht > out.tomb_ht:
             out.tomb_ht = v.ht
-    for v in sorted(versions, key=lambda r: -r.ht):
+    for v in sorted(versions, key=lambda r: (-r.ht, -r.write_id)):
         if v.ht > read_ht or v.ht <= out.tomb_ht or v.tombstone:
             continue
         expired = v.has_ttl and read_ht >= v.expire_ht
@@ -106,8 +106,8 @@ def merge_entry_streams(streams):
     for key, versions in heapq.merge(*streams, key=lambda p: p[0]):
         if key != current:
             if current is not None:
-                yield current, sorted(bucket, key=lambda r: -r.ht)
+                yield current, sorted(bucket, key=lambda r: (-r.ht, -r.write_id))
             current, bucket = key, []
         bucket.extend(versions)
     if current is not None:
-        yield current, sorted(bucket, key=lambda r: -r.ht)
+        yield current, sorted(bucket, key=lambda r: (-r.ht, -r.write_id))

@@ -38,6 +38,13 @@ class RowVersion:
     # absolute expiry from their wall clock — the reference stores TTLs
     # relative to the value's write time for the same reason).
     ttl_us: int | None = None
+    # Sub-hybrid-time ordering of writes within ONE batch (reference:
+    # DocHybridTime's write_id component, src/yb/common/doc_hybrid_time.h):
+    # every row in a batch shares the batch's hybrid time; write_id is the
+    # row's position, so two writes to the SAME key in one batch order by
+    # (ht, write_id). A row tombstone at ht T still shadows ALL versions
+    # with ht <= T (the same-batch DELETE rule the device kernel applies).
+    write_id: int = 0
 
     def __post_init__(self):
         if self.tombstone and (self.liveness or self.columns):

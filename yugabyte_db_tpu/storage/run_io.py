@@ -23,7 +23,8 @@ _MAGIC = "run1"
 def save_run(path: str, entries: list[tuple[bytes, list[RowVersion]]]) -> None:
     payload = [
         [key, [[v.ht, v.tombstone, v.liveness,
-                {str(c): val for c, val in v.columns.items()}, v.expire_ht]
+                {str(c): val for c, val in v.columns.items()}, v.expire_ht,
+                v.write_id]
                for v in versions]]
         for key, versions in entries
     ]
@@ -93,9 +94,10 @@ def load_run(path: str) -> list[tuple[bytes, list[RowVersion]]]:
     out = []
     for key, versions in payload:
         out.append((key, [
-            RowVersion(key, ht=ht, tombstone=tomb, liveness=live,
-                       columns={int(c): val for c, val in cols.items()},
-                       expire_ht=exp)
-            for ht, tomb, live, cols, exp in versions
+            RowVersion(key, ht=rec[0], tombstone=rec[1], liveness=rec[2],
+                       columns={int(c): val for c, val in rec[3].items()},
+                       expire_ht=rec[4],
+                       write_id=rec[5] if len(rec) > 5 else 0)
+            for rec in versions
         ]))
     return out

@@ -93,6 +93,7 @@ class TpuStorageEngine(StorageEngine):
         if self.memtable.num_versions >= limit:
             self.flush()
             self.maybe_compact()
+        self._track_memstore()
 
     # -- lifecycle ---------------------------------------------------------
     def alter_schema(self, new_schema: Schema) -> None:
@@ -137,6 +138,9 @@ class TpuStorageEngine(StorageEngine):
                 trun.dev = DeviceRun(crun, PAD_BLOCKS)
 
     def flush(self) -> None:
+        from yugabyte_db_tpu.utils.sync_point import sync_point
+
+        sync_point("tpu_engine:flush:start")
         if self.memtable.is_empty:
             return
         if self.memtable.max_ht is not None:
@@ -148,6 +152,8 @@ class TpuStorageEngine(StorageEngine):
         self.runs.append(TpuRun(crun))
         self.memtable = MemTable()
         self._plan_cache.clear()
+        self._track_memstore()
+        sync_point("tpu_engine:flush:done")
 
     def compact(self, history_cutoff_ht: int = 0) -> None:
         """Merge all runs into one, GCing history at the cutoff. The
@@ -731,6 +737,9 @@ class TpuStorageEngine(StorageEngine):
         # The snapshot also covers _AsyncBatch.finish()-time execution of
         # host-path closures: flush() never mutates the old MemTable.
         mem = self.memtable
+        from yugabyte_db_tpu.utils.sync_point import sync_point
+
+        sync_point("tpu_engine:plan:mem_snapshotted")
         runs = self._overlapping_runs(spec)
         mem_live = (not mem.is_empty) and \
             next(mem.scan_keys(spec.lower, spec.upper), None) is not None

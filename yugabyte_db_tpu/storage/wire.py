@@ -18,7 +18,8 @@ from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
 def encode_rows(rows: list[RowVersion]) -> list:
     return [
         [r.key, r.ht, r.tombstone, r.liveness,
-         {str(c): v for c, v in r.columns.items()}, r.expire_ht, r.ttl_us]
+         {str(c): v for c, v in r.columns.items()}, r.expire_ht, r.ttl_us,
+         r.write_id]
         for r in rows
     ]
 
@@ -28,7 +29,8 @@ def decode_rows(body: list) -> list[RowVersion]:
         RowVersion(rec[0], ht=rec[1], tombstone=rec[2], liveness=rec[3],
                    columns={int(c): v for c, v in rec[4].items()},
                    expire_ht=rec[5],
-                   ttl_us=rec[6] if len(rec) > 6 else None)
+                   ttl_us=rec[6] if len(rec) > 6 else None,
+                   write_id=rec[7] if len(rec) > 7 else 0)
         for rec in body
     ]
 

@@ -95,6 +95,9 @@ class Tablet:
         self.mvcc = MvccManager(self.clock)
         opts = dict(engine_options or {})
         opts.setdefault("data_dir", os.path.join(self.dir, "runs"))
+        # unique per live instance: one process may host several replicas
+        # of the same tablet id (MiniCluster)
+        opts.setdefault("tracker_name", f"{meta.tablet_id}:{id(self):x}")
         self.engine = make_engine(meta.engine, meta.schema, opts)
         self.log = Log(os.path.join(self.dir, "wal"), fsync=fsync)
         self._write_lock = threading.Lock()
@@ -328,8 +331,9 @@ class Tablet:
                 stamped = [
                     RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
                                liveness=r.liveness, columns=r.columns,
-                               expire_ht=r.resolve_ttl(ht.value))
-                    for r in rows
+                               expire_ht=r.resolve_ttl(ht.value),
+                               write_id=i)
+                    for i, r in enumerate(rows)
                 ]
                 self._last_index += 1
                 op_id = OpId(self._term, self._last_index)

@@ -97,8 +97,8 @@ class TabletPeer:
         stamped = [
             RowVersion(r.key, ht=ht.value, tombstone=r.tombstone,
                        liveness=r.liveness, columns=r.columns,
-                       expire_ht=r.resolve_ttl(ht.value))
-            for r in rows
+                       expire_ht=r.resolve_ttl(ht.value), write_id=i)
+            for i, r in enumerate(rows)
         ]
         self.tablet.mvcc.add_pending(ht)
         try:

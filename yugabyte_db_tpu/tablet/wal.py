@@ -148,6 +148,11 @@ class Log:
 
     def sync(self) -> None:
         """Group commit: flush buffered records and fsync the segment."""
+        from yugabyte_db_tpu.utils.fault_injection import (FaultInjected,
+                                                           maybe_fault)
+
+        if maybe_fault("fault.wal_sync_failed"):
+            raise FaultInjected("injected WAL sync failure")
         with self._lock:
             if self._file is None and self._buffer:
                 self._open_segment(max(1, self.last_appended.index))

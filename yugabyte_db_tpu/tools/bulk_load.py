@@ -24,7 +24,7 @@ from yugabyte_db_tpu.models.datatypes import DataType
 
 
 def _coerce_csv(dt: DataType, text: str):
-    if text == "":
+    if text is None or text == "":  # short row (restval) or empty cell
         return None
     if dt.is_integer:
         return int(text)
@@ -55,7 +55,11 @@ def load_csv(client: YBClient, table_name: str, csv_path: str,
         missing = [c for c in (reader.fieldnames or []) if c not in cols]
         if missing:
             raise SystemExit(f"unknown columns in CSV header: {missing}")
-        for rec in reader:
+        for lineno, rec in enumerate(reader, start=2):
+            if None in rec:  # more fields than the header declares
+                raise SystemExit(
+                    f"{csv_path}:{lineno}: row has more fields than the "
+                    f"header")
             session.insert(table, {
                 name: _coerce_csv(cols[name].dtype, text)
                 for name, text in rec.items()})

@@ -498,6 +498,13 @@ class TabletServer:
                                 "leader_hint": e.leader_hint}
                     except TimeoutError:
                         return {"code": "timed_out"}
+                    from yugabyte_db_tpu.utils.fault_injection import \
+                        maybe_fault
+                    if maybe_fault("fault.ts_write_respond_failed"):
+                        # the write APPLIED; the client sees a failure
+                        # and retries — exactly-once dedup must absorb it
+                        return {"code": "timed_out",
+                                "injected_fault": True}
                     return {"code": "ok", "ht": ht.value}
             err = self._resolve_write_conflicts(
                 peer, {"priority": 1 << 62}, conflicting)

@@ -1,0 +1,54 @@
+"""Fault injection: flag-driven probabilistic/deterministic failures.
+
+Reference analog: src/yb/util/fault_injection.h:49 (MAYBE_FAULT) and
+the per-service probability flags
+(FLAGS_respond_write_failed_probability, tablet_service.cc:784) —
+production code marks fault points; tests arm them via flags.
+
+    FLAGS.set("fault.ts_write_respond_failed", 1.0)   # always
+    FLAGS.set("fault.ts_write_respond_failed", 0.0)   # never (default)
+    arm_fault_once("fault.wal_sync")                  # exactly one hit
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+_lock = threading.Lock()
+_once: dict[str, int] = {}   # fault name -> remaining forced hits
+_rng = random.Random()
+
+
+def arm_fault_once(name: str, times: int = 1) -> None:
+    """Force the next ``times`` evaluations of ``name`` to fire
+    (deterministic tests; beats probability flags for exactness)."""
+    with _lock:
+        _once[name] = _once.get(name, 0) + times
+
+
+def clear_faults() -> None:
+    with _lock:
+        _once.clear()
+
+
+def maybe_fault(name: str) -> bool:
+    """True when the named fault should fire. Checks armed one-shot
+    hits first, then the flag ``name`` as a probability in [0, 1]
+    (unknown flag = 0: disabled)."""
+    with _lock:
+        n = _once.get(name, 0)
+        if n > 0:
+            _once[name] = n - 1
+            return True
+    from yugabyte_db_tpu.utils.flags import FLAGS
+
+    try:
+        p = float(FLAGS.get(name))
+    except (KeyError, TypeError, ValueError):
+        return False
+    return p > 0 and _rng.random() < p
+
+
+class FaultInjected(Exception):
+    """Raised by fault points that abort the operation."""

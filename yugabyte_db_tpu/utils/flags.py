@@ -82,3 +82,15 @@ FLAGS.define("max_clock_skew_us", 500_000,
              ("stable",))
 FLAGS.define("follower_unavailable_considered_failed_sec", 5.0,
              "tserver liveness timeout", ("stable",))
+FLAGS.define("global_memstore_limit_bytes", 1 << 40,
+             "process-wide memtable budget; crossing it flushes the "
+             "engine that noticed (reference: the shared memory_monitor "
+             "across rocksdb instances)", ("stable", "runtime"))
+FLAGS.define("fault.ts_write_respond_failed", 0.0,
+             "probability a successful tablet write responds failure "
+             "anyway (client-retry / exactly-once testing; reference: "
+             "FLAGS_respond_write_failed_probability)",
+             ("unsafe", "runtime", "hidden"))
+FLAGS.define("fault.wal_sync_failed", 0.0,
+             "probability a WAL group-commit sync raises IOError",
+             ("unsafe", "runtime", "hidden"))
