@@ -123,3 +123,55 @@ def test_jsonb_cql_storage(ql):
                '(\'a\', \'{"z": 1, "a": [true, null]}\')')
     res = ql.execute("SELECT doc FROM j")
     assert res.rows == [({"a": [True, None], "z": 1},)]
+
+
+def test_counter_increments(ql):
+    ql.execute("CREATE TABLE c (k TEXT, hits COUNTER, "
+               "PRIMARY KEY ((k)))")
+    ql.execute("UPDATE c SET hits = hits + 1 WHERE k = 'page'")
+    ql.execute("UPDATE c SET hits = hits + 5 WHERE k = 'page'")
+    ql.execute("UPDATE c SET hits = hits - 2 WHERE k = 'page'")
+    res = ql.execute("SELECT hits FROM c WHERE k = 'page'")
+    assert res.rows == [(4,)]
+
+
+def test_counter_concurrent_increments_distributed():
+    """Counter deltas resolve atomically at the tablet leader: N
+    concurrent incrementing sessions must never lose an increment."""
+    import tempfile
+    import threading
+
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+    from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            setup = QLProcessor(ClientCluster(mc.client("cql-setup")))
+            setup.execute("CREATE TABLE hits (k TEXT, n COUNTER, "
+                          "PRIMARY KEY ((k)))")
+            errs = []
+
+            def worker(w):
+                try:
+                    ql = QLProcessor(ClientCluster(mc.client(f"c{w}")))
+                    for _ in range(25):
+                        ql.execute("UPDATE hits SET n = n + 1 "
+                                   "WHERE k = 'page'")
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker, args=(w,))
+                  for w in range(4)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs, errs[:1]
+            res = setup.execute("SELECT n FROM hits WHERE k = 'page'")
+            assert res.rows == [(100,)]
+            # fused-sign subtraction parses too: 'n = n -10'
+            setup.execute("UPDATE hits SET n = n -10 WHERE k = 'page'")
+            res = setup.execute("SELECT n FROM hits WHERE k = 'page'")
+            assert res.rows == [(90,)]
+        finally:
+            mc.shutdown()

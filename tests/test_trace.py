@@ -109,3 +109,37 @@ def test_rpcz_endpoint_serves_request_traces(tmp_path):
         assert any("row(s)" in m for m in scan_sample["messages"])
     finally:
         c.shutdown()
+
+
+def test_trace_events_and_stacks():
+    from yugabyte_db_tpu.utils.trace import (TRACE_EVENTS, dump_stacks,
+                                             trace_event)
+
+    with trace_event("unit-span", tablet="t1"):
+        pass
+    events = TRACE_EVENTS.dump()["traceEvents"]
+    mine = [e for e in events if e["name"] == "unit-span"]
+    assert mine and mine[-1]["ph"] == "X" and mine[-1]["dur"] >= 0
+    assert mine[-1]["args"] == {"tablet": "t1"}
+    stacks = dump_stacks()
+    assert "MainThread" in stacks and "test_trace_events_and_stacks" in stacks
+
+
+def test_tracing_json_over_http():
+    import json
+    import urllib.request
+
+    from yugabyte_db_tpu.utils.metrics import MetricRegistry
+    from yugabyte_db_tpu.server.webserver import Webserver
+
+    ws = Webserver(MetricRegistry(), "trace-test")
+    host, port = ws.start()
+    try:
+        data = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/tracing.json", timeout=5).read())
+        assert "traceEvents" in data
+        stacks = urllib.request.urlopen(
+            f"http://{host}:{port}/stacks", timeout=5).read().decode()
+        assert "thread" in stacks
+    finally:
+        ws.stop()

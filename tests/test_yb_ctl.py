@@ -110,3 +110,17 @@ def test_stop_start_preserves_data(cluster):
     table = client.open_table("bulk")
     res = YBSession(client).scan(table, ScanSpec(projection=["k"]))
     assert len(res.rows) == 500
+
+
+def test_load_tester_workloads(cluster):
+    from yugabyte_db_tpu.tools.load_test import run_keyvalue, run_scan
+
+    out = run_keyvalue(cluster.master_addresses(), num_ops=600,
+                       threads=3, read_ratio=0.3, batch=32,
+                       value_size=16)
+    assert out["write"]["ops"] > 0 and out["write"]["errors"] == 0
+    assert out["write"]["ops_per_sec"] > 0
+    out = run_scan(cluster.master_addresses(), num_ops=30, threads=3,
+                   limit=50)
+    assert out["scan"]["ops"] == 30 and out["scan"]["errors"] == 0
+    assert out["scan"]["p99_us"] > 0

@@ -57,6 +57,10 @@ class Webserver:
                      "help": f.help, "tags": sorted(f.tags)}
             for f in FLAGS.all()})
         self.add_json_handler("/memz", _memz)
+        from yugabyte_db_tpu.utils.trace import TRACE_EVENTS, dump_stacks
+
+        self.add_json_handler("/tracing.json", TRACE_EVENTS.dump)
+        self.add_handler("/stacks", dump_stacks)
         self.add_handler("/", self._home, content_type="text/html")
 
     def add_handler(self, path: str, fn, content_type="text/plain"):

@@ -45,9 +45,16 @@ class RowVersion:
     # (ht, write_id). A row tombstone at ht T still shadows ALL versions
     # with ht <= T (the same-batch DELETE rule the device kernel applies).
     write_id: int = 0
+    # Pending counter deltas (col_id -> signed int). NEVER stored: the
+    # tablet LEADER resolves them into absolute column values under its
+    # write lock before stamping/appending, so concurrent increments
+    # serialize (reference: counter column read-modify-write inside the
+    # tablet, cql_operation.cc). Only the client->leader RPC carries them.
+    increments: dict = field(default_factory=dict)
 
     def __post_init__(self):
-        if self.tombstone and (self.liveness or self.columns):
+        if self.tombstone and (self.liveness or self.columns
+                               or self.increments):
             raise ValueError("tombstone carries no columns or liveness")
 
     def resolve_ttl(self, ht: int) -> int:

@@ -19,7 +19,7 @@ def encode_rows(rows: list[RowVersion]) -> list:
     return [
         [r.key, r.ht, r.tombstone, r.liveness,
          {str(c): v for c, v in r.columns.items()}, r.expire_ht, r.ttl_us,
-         r.write_id]
+         r.write_id, {str(c): v for c, v in r.increments.items()}]
         for r in rows
     ]
 
@@ -30,7 +30,9 @@ def decode_rows(body: list) -> list[RowVersion]:
                    columns={int(c): v for c, v in rec[4].items()},
                    expire_ht=rec[5],
                    ttl_us=rec[6] if len(rec) > 6 else None,
-                   write_id=rec[7] if len(rec) > 7 else 0)
+                   write_id=rec[7] if len(rec) > 7 else 0,
+                   increments={int(c): v for c, v in rec[8].items()}
+                   if len(rec) > 8 and rec[8] else {})
         for rec in body
     ]
 

@@ -325,6 +325,7 @@ class Tablet:
                         raise AlreadyPresent(
                             "duplicate key value violates unique "
                             "constraint")
+            rows = [self.resolve_increments(r) for r in rows]
             ht = self.clock.now()
             self.mvcc.add_pending(ht)
             try:
@@ -411,6 +412,25 @@ class Tablet:
             self.meta.save(self.meta_path)
             self.log.sync()
             self.log.gc(self.meta.flushed_op_index + 1)
+
+    def resolve_increments(self, row: RowVersion) -> RowVersion:
+        """Turn pending counter deltas into absolute column values by
+        reading the row's current state — callers MUST hold the lock
+        that serializes writes to this tablet (the write lock here, the
+        tserver's intent-admission lock on the replicated path), which
+        is what makes concurrent increments atomic."""
+        if not row.increments:
+            return row
+        by_id = {c.col_id: c.name for c in self.meta.schema.value_columns}
+        cur = self.current_row_values(row.key) or {}
+        columns = dict(row.columns)
+        for cid, delta in row.increments.items():
+            base = cur.get(by_id.get(cid))
+            columns[cid] = (base if isinstance(base, int) else 0) + delta
+        return RowVersion(row.key, ht=row.ht, tombstone=row.tombstone,
+                          liveness=row.liveness, columns=columns,
+                          expire_ht=row.expire_ht, ttl_us=row.ttl_us,
+                          write_id=row.write_id)
 
     def current_row_values(self, key: bytes) -> dict | None:
         """Merged value-column values of one row by name (None if the row

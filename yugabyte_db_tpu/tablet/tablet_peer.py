@@ -86,6 +86,12 @@ class TabletPeer:
         new leader accepts writes."""
         if not (self.raft.is_leader() and self.raft.leader_ready()):
             raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        if any(r.increments for r in rows):
+            # increments resolve under the tserver's intent-admission
+            # lock (the serialization point); reaching here unresolved
+            # would silently drop the delta
+            raise ValueError("unresolved counter increments; route the "
+                             "write through the tserver handler")
         rid = None
         if client_id is not None and request_id is not None:
             prev = self.tablet.retryable.seen(client_id, request_id)

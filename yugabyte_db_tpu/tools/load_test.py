@@ -1,0 +1,191 @@
+"""Load tester: the sample-app workload suite.
+
+Reference analog: java/yb-loadtester's com.yugabyte.sample.apps
+(CassandraKeyValue etc.) and src/yb/benchmarks/yb_load_test_tool.cc —
+the workloads behind the published performance numbers. Drives a real
+cluster through the client with N writer/reader threads and reports
+throughput + latency percentiles.
+
+  python -m yugabyte_db_tpu.tools.load_test --master 127.0.0.1:7100 \
+      --workload keyvalue --num-ops 50000 --threads 8 --read-ratio 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+import time
+
+from yugabyte_db_tpu.client.client import YBClient
+from yugabyte_db_tpu.client.session import YBSession
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
+from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.lat_us: list[int] = []
+        self.errors = 0
+
+    def add(self, us: int):
+        with self.lock:
+            self.lat_us.append(us)
+
+    def error(self):
+        with self.lock:
+            self.errors += 1
+
+    def report(self, elapsed: float, label: str) -> dict:
+        with self.lock:
+            lats = sorted(self.lat_us)
+            n = len(lats)
+        if not n:
+            return {"workload": label, "ops": 0, "errors": self.errors}
+        return {
+            "workload": label,
+            "ops": n,
+            "errors": self.errors,
+            "ops_per_sec": round(n / elapsed, 1),
+            "avg_us": sum(lats) // n,
+            "p50_us": lats[n // 2],
+            "p99_us": lats[min(n - 1, n * 99 // 100)],
+        }
+
+
+def _run_threads(n_threads, per_thread_fn):
+    threads = [threading.Thread(target=per_thread_fn, args=(i,))
+               for i in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0
+
+
+def ensure_table(client: YBClient, table_name: str,
+                 num_tablets: int) -> None:
+    try:
+        client.open_table(table_name)
+    except KeyError:
+        client.create_table(table_name, [
+            ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+            ColumnSchema("v", DataType.STRING),
+        ], num_tablets=num_tablets)
+
+
+def run_keyvalue(master: str, num_ops: int, threads: int,
+                 read_ratio: float, batch: int, value_size: int,
+                 table_name: str = "load_kv",
+                 num_tablets: int = 8) -> dict:
+    """CassandraKeyValue shape: random-key writes and point reads."""
+    boot = YBClient.connect(master)
+    ensure_table(boot, table_name, num_tablets)
+    write_stats, read_stats = Stats(), Stats()
+    per = num_ops // threads
+    value = "v" * value_size
+    written_floor = max(1, per // 10)
+
+    def worker(wid):
+        client = YBClient.connect(master)
+        session = YBSession(client)
+        table = client.open_table(table_name)
+        rng = random.Random(wid)
+        pending = 0
+        written = 0    # inserted (possibly still client-buffered)
+        acked = 0      # flushed: reads must only target these
+        for i in range(per):
+            if rng.random() < read_ratio and acked > written_floor:
+                k = f"w{wid}-{rng.randrange(acked):08d}"
+                t0 = time.perf_counter()
+                try:
+                    session.get(table, {"k": k})
+                    read_stats.add(int((time.perf_counter() - t0) * 1e6))
+                except Exception:  # noqa: BLE001
+                    read_stats.error()
+                continue
+            session.insert(table, {"k": f"w{wid}-{written:08d}",
+                                   "v": value})
+            written += 1
+            pending += 1
+            if pending >= batch:
+                t0 = time.perf_counter()
+                try:
+                    session.flush()
+                    write_stats.add(
+                        int((time.perf_counter() - t0) * 1e6 // pending))
+                    acked = written
+                except Exception:  # noqa: BLE001
+                    write_stats.error()
+                pending = 0
+        if pending:
+            try:
+                session.flush()
+            except Exception:  # noqa: BLE001 — must count, not vanish
+                write_stats.error()
+
+    elapsed = _run_threads(threads, worker)
+    return {"elapsed_s": round(elapsed, 1),
+            "write": write_stats.report(elapsed, "keyvalue-write"),
+            "read": read_stats.report(elapsed, "keyvalue-read")}
+
+
+def run_scan(master: str, num_ops: int, threads: int, limit: int,
+             table_name: str = "load_kv") -> dict:
+    """YCSB-E shape: LIMIT pages from random start keys."""
+    boot = YBClient.connect(master)
+    table = boot.open_table(table_name)
+    stats = Stats()
+    per = num_ops // threads
+
+    def worker(wid):
+        client = YBClient.connect(master)
+        session = YBSession(client)
+        t = client.open_table(table_name)
+        rng = random.Random(1000 + wid)
+        for _ in range(per):
+            lo = t.encode_key({"k": f"w{rng.randrange(threads)}-"
+                                    f"{rng.randrange(1000):08d}"})
+            t0 = time.perf_counter()
+            try:
+                session.scan(t, ScanSpec(lower=lo, limit=limit,
+                                         projection=["k", "v"]))
+                stats.add(int((time.perf_counter() - t0) * 1e6))
+            except Exception:  # noqa: BLE001
+                stats.error()
+
+    elapsed = _run_threads(threads, worker)
+    return {"elapsed_s": round(elapsed, 1),
+            "scan": stats.report(elapsed, "range-scan")}
+
+
+def main(argv=None) -> int:
+    import json
+
+    ap = argparse.ArgumentParser(prog="yb-load-test")
+    ap.add_argument("--master", required=True)
+    ap.add_argument("--workload", choices=("keyvalue", "scan"),
+                    default="keyvalue")
+    ap.add_argument("--num-ops", type=int, default=20_000)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--read-ratio", type=float, default=0.5)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--value-size", type=int, default=64)
+    ap.add_argument("--limit", type=int, default=100)
+    args = ap.parse_args(argv)
+    if args.workload == "keyvalue":
+        out = run_keyvalue(args.master, args.num_ops, args.threads,
+                           args.read_ratio, args.batch, args.value_size)
+    else:
+        out = run_scan(args.master, args.num_ops, args.threads,
+                       args.limit)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -489,6 +489,14 @@ class TabletServer:
                                 peer.tablet.current_row_values(k)
                                 is not None for k in keys):
                             return {"code": "duplicate_key"}
+                    if any(r.increments for r in rows):
+                        # counter deltas -> absolutes, atomic under the
+                        # same lock as the append (see resolve_increments)
+                        if not peer.raft.is_leader():
+                            return {"code": "not_leader",
+                                    "leader_hint": peer.raft.leader_uuid()}
+                        rows = [peer.tablet.resolve_increments(r)
+                                for r in rows]
                     try:
                         ht = peer.write(rows, timeout=p.get("timeout", 10.0),
                                         client_id=p.get("client_id"),

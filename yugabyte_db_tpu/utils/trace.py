@@ -118,6 +118,9 @@ class RpczStore:
             dq.append(trace)
             if trace.duration_us >= self.slow_threshold_us:
                 self._slow.append(trace)
+        # every sampled request is also one /tracing.json slice
+        TRACE_EVENTS.record(trace.method, trace.start_wall,
+                            trace.duration_us)
 
     def dump(self) -> dict:
         with self._lock:
@@ -129,3 +132,73 @@ class RpczStore:
                 "slow": [t.dump() for t in self._slow],
                 "slow_threshold_us": self.slow_threshold_us,
             }
+
+
+# -- chromium trace events (/tracing.json) -----------------------------------
+
+class TraceEventLog:
+    """Process-wide ring of Chromium trace-event records, browsable in
+    Perfetto / chrome://tracing (reference: src/yb/util/debug/
+    trace_event.h + the /tracing.json handler,
+    tracing-path-handlers.cc). Complete events ("ph":"X") only — each
+    traced request or explicitly marked span is one slice."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+
+    def record(self, name: str, start_wall_s: float, duration_us: int,
+               tid: int | None = None, args: dict | None = None) -> None:
+        ev = {"name": name, "ph": "X", "pid": 1,
+              "tid": tid if tid is not None else threading.get_ident(),
+              "ts": int(start_wall_s * 1e6), "dur": int(duration_us)}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {"traceEvents": list(self._events),
+                    "displayTimeUnit": "ms"}
+
+
+TRACE_EVENTS = TraceEventLog()
+
+
+class trace_event:
+    """Span context manager feeding /tracing.json:
+
+        with trace_event("compaction", tablet=tid):
+            ...
+    """
+
+    def __init__(self, name: str, **args):
+        self.name = name
+        self.args = args or None
+
+    def __enter__(self):
+        self._wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        TRACE_EVENTS.record(self.name, self._wall,
+                            (time.perf_counter() - self._t0) * 1e6,
+                            args=self.args)
+        return False
+
+
+def dump_stacks() -> str:
+    """All live threads' Python stacks (the pprof/stacks analog of
+    src/yb/server/pprof-path-handlers.cc, for a Python runtime)."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {tid} ({names.get(tid, '?')}) ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"

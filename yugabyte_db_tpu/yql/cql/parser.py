@@ -549,15 +549,22 @@ class Parser:
             return (col, ast.CollectionOp("setelem", self.literal(),
                                           index=idx))
         self.expect_sym("=")
-        # collection edits reference the column itself: v = v + [...],
-        # v = [...] + v, v = v - {...}
+        # collection/counter edits reference the column itself:
+        # v = v + [...], v = [...] + v, v = v - {...}, c = c + 1
         t = self.peek()
         if t is not None and t.kind == "name" and t.text.lower() == col \
-                and self.i + 1 < len(self.toks) \
-                and self.toks[self.i + 1].text in "+-":
-            self.ident()
-            op = "append" if self.next().text == "+" else "remove"
-            return (col, ast.CollectionOp(op, self.literal()))
+                and self.i + 1 < len(self.toks):
+            nxt = self.toks[self.i + 1]
+            if nxt.text in "+-":
+                self.ident()
+                op = "append" if self.next().text == "+" else "remove"
+                return (col, ast.CollectionOp(op, self.literal()))
+            if nxt.kind == "number" and nxt.text.startswith("-"):
+                # 'c = c -2': the tokenizer fused the sign into the
+                # number; this is still a subtraction
+                self.ident()
+                v = self.literal()
+                return (col, ast.CollectionOp("remove", -v))
         value = self.literal()
         if self.at_sym("+"):
             self.next()

@@ -496,10 +496,20 @@ class QLProcessor:
         handle = self.cluster.table(self._qualify(stmt.table))
         schema = handle.schema
         key_values, _ = self._bound_key_values(schema, stmt.where, True)
+
+        def is_counter_op(col, v):
+            return (isinstance(v, ast.CollectionOp)
+                    and col.dtype.is_integer
+                    and v.op in ("append", "remove")
+                    and isinstance(self._resolve_marker(v.operand), int))
+
         # Collection edits (v = v + [...], v[k] = x) are read-modify-write
-        # against the current row state.
+        # against the current row state; counter increments are NOT — they
+        # ship as deltas the tablet leader resolves atomically under its
+        # write serialization lock (Tablet.resolve_increments).
         coll_cols = [cname for cname, v in stmt.assignments
-                     if isinstance(v, ast.CollectionOp)]
+                     if isinstance(v, ast.CollectionOp)
+                     and not is_counter_op(schema.column(cname), v)]
         old_row = {}
         if coll_cols:
             key0, tablet0 = self._key_and_tablet(handle, key_values)
@@ -510,13 +520,18 @@ class QLProcessor:
             if res.rows:
                 old_row = dict(zip(res.columns, res.rows[0]))
         columns = {}
+        increments = {}
         for cname, value in stmt.assignments:
             if not schema.has_column(cname):
                 raise InvalidArgument(f"unknown column {cname}")
             col = schema.column(cname)
             if col.is_key:
                 raise InvalidArgument(f"cannot SET key column {cname}")
-            if isinstance(value, ast.CollectionOp):
+            if is_counter_op(col, value):
+                delta = self._resolve_marker(value.operand)
+                increments[col.col_id] = (
+                    delta if value.op == "append" else -delta)
+            elif isinstance(value, ast.CollectionOp):
                 columns[col.col_id] = self._apply_collection_op(
                     col, old_row.get(cname), value)
             else:
@@ -526,7 +541,7 @@ class QLProcessor:
         # the row exists only while some column is live — reference
         # semantics of UPDATE vs INSERT in DocDB).
         self._write_row(handle, key_values, key, tablet, RowVersion(
-            key, ht=0, columns=columns,
+            key, ht=0, columns=columns, increments=increments,
             expire_ht=self._expire_ht(stmt.ttl_seconds)))
         return None
 
