@@ -398,3 +398,115 @@ def test_pg_wire_over_mini_cluster():
             if server is not None:
                 server.shutdown()
             mc.shutdown()
+
+
+def test_sql_transactions_end_to_end():
+    """BEGIN/COMMIT/ROLLBACK through the SQL layer over the distributed
+    transaction subsystem: snapshot isolation, read-your-writes point
+    reads, first-committer-wins conflicts as SerializationFailure."""
+    import tempfile
+
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+    from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+    from yugabyte_db_tpu.yql.pgsql.executor import SerializationFailure
+
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            s1 = PgProcessor(ClientCluster(mc.client("s1")))
+            s2 = PgProcessor(ClientCluster(mc.client("s2")))
+            s1.execute("CREATE TABLE acct (id TEXT PRIMARY KEY, "
+                       "bal BIGINT)")
+            s1.execute("INSERT INTO acct (id, bal) VALUES ('a', 100), "
+                       "('b', 50)")
+
+            # atomic transfer, invisible to s2 until commit
+            s1.execute("BEGIN")
+            assert s1.in_txn
+            s1.execute("UPDATE acct SET bal = bal - 30 WHERE id = 'a'")
+            s1.execute("UPDATE acct SET bal = bal + 30 WHERE id = 'b'")
+            # read-your-writes inside the txn
+            r = s1.execute("SELECT bal FROM acct WHERE id = 'a'")
+            assert r.rows == [(70,)]
+            # s2 still sees the pre-txn state
+            r = s2.execute("SELECT bal FROM acct WHERE id = 'a'")
+            assert r.rows == [(100,)]
+            s1.execute("COMMIT")
+            assert not s1.in_txn
+            r = s2.execute("SELECT bal FROM acct WHERE id = 'b'")
+            assert r.rows == [(80,)]
+
+            # rollback discards everything
+            s1.execute("BEGIN")
+            s1.execute("UPDATE acct SET bal = 0 WHERE id = 'a'")
+            s1.execute("ROLLBACK")
+            r = s2.execute("SELECT bal FROM acct WHERE id = 'a'")
+            assert r.rows == [(70,)]
+
+            # INSERT inside a txn + duplicate detection
+            s1.execute("BEGIN")
+            s1.execute("INSERT INTO acct (id, bal) VALUES ('c', 1)")
+            with pytest.raises(AlreadyPresent):
+                s1.execute("INSERT INTO acct (id, bal) VALUES ('c', 2)")
+            s1.execute("ROLLBACK")
+
+            # write-write conflict: first committer wins — exactly one
+            # side fails, with a transaction-conflict error
+            from yugabyte_db_tpu.client.client import TabletOpFailed
+            from yugabyte_db_tpu.txn.client import (TransactionAborted,
+                                                    TransactionConflict)
+
+            conflict_errs = (SerializationFailure, TransactionConflict,
+                             TransactionAborted, TabletOpFailed)
+            s1.execute("BEGIN")
+            s2.execute("BEGIN")
+            s1.execute("UPDATE acct SET bal = 1 WHERE id = 'a'")
+            outcomes = []
+            for s, sql in ((s2, "UPDATE acct SET bal = 2 WHERE id = 'a'"),
+                           (s1, "COMMIT"), (s2, "COMMIT")):
+                try:
+                    s.execute(sql)
+                    outcomes.append("ok")
+                except conflict_errs:
+                    outcomes.append("conflict")
+                except InvalidArgument:
+                    outcomes.append("aborted-block")
+            assert "conflict" in outcomes, outcomes
+            for s in (s1, s2):
+                if s.in_txn:
+                    s.execute("ROLLBACK")
+        finally:
+            mc.shutdown()
+
+
+def test_pg_wire_transactions():
+    """The FE/BE protocol carries transaction state: ReadyForQuery says
+    'T' inside a transaction, 'I' when idle."""
+    import tempfile
+
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        server = None
+        try:
+            mc.wait_tservers_registered()
+            server, (host, port) = mc.start_pg_server()
+            c = MiniPgClient(host, port)
+            c.startup()
+            c.query("CREATE TABLE t (k TEXT PRIMARY KEY, v BIGINT)")
+            msgs = c.query("BEGIN")
+            assert msgs[-1] == (b"Z", b"T")
+            c.query("INSERT INTO t (k, v) VALUES ('x', 1)")
+            msgs = c.query("SELECT v FROM t WHERE k = 'x'")
+            assert MiniPgClient.rows_of(msgs) == [("1",)]
+            msgs = c.query("COMMIT")
+            assert msgs[-1] == (b"Z", b"I")
+            msgs = c.query("SELECT count(*) FROM t")
+            assert MiniPgClient.rows_of(msgs) == [("1",)]
+            c.close()
+        finally:
+            if server is not None:
+                server.shutdown()
+            mc.shutdown()

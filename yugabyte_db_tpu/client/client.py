@@ -73,6 +73,12 @@ class YBClient:
         self.client_id = uuid_mod.uuid4().hex
         self._req_lock = threading.Lock()
         self._req_counter = 0
+        # HLC propagation (the ConsistentReadPoint/session-causality
+        # contract): the largest hybrid time this client has OBSERVED in
+        # any response; piggybacked on tablet RPCs so every touched
+        # server's clock ratchets past it — a read after a write (or
+        # after a transaction commit) can never miss it.
+        self.last_observed_ht = 0
 
     @classmethod
     def connect(cls, master_addrs: str) -> "YBClient":
@@ -195,6 +201,7 @@ class YBClient:
         replica fallback (reference: TabletInvoker::Execute)."""
         deadline = time.monotonic() + (timeout_s or self.default_rpc_timeout_s)
         payload = dict(payload, tablet_id=loc.tablet_id)
+        payload.setdefault("propagated_ht", self.last_observed_ht)
         tried_refresh = False
         last = None
         while time.monotonic() < deadline:
@@ -222,6 +229,11 @@ class YBClient:
                     self.meta_cache.mark_leader(table_name, loc.tablet_id,
                                                 target)
                     loc.leader = target
+                    seen = max(resp.get("ht") or 0,
+                               resp.get("read_ht") or 0,
+                               resp.get("commit_ht") or 0)
+                    if seen > self.last_observed_ht:
+                        self.last_observed_ht = seen
                     return resp
                 if code in TERMINAL_CODES:
                     # Retrying cannot change these outcomes (conflicts,

@@ -425,7 +425,12 @@ class Tablet:
         cur = self.current_row_values(row.key) or {}
         columns = dict(row.columns)
         for cid, delta in row.increments.items():
-            base = cur.get(by_id.get(cid))
+            name = by_id.get(cid)
+            if name is None:
+                # stale client schema (column dropped/recreated): refuse
+                # rather than append a value under a retired column id
+                raise ValueError(f"unknown column id {cid} in increment")
+            base = cur.get(name)
             columns[cid] = (base if isinstance(base, int) else 0) + delta
         return RowVersion(row.key, ht=row.ht, tombstone=row.tombstone,
                           liveness=row.liveness, columns=columns,

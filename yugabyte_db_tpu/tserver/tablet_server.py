@@ -462,6 +462,10 @@ class TabletServer:
         except TabletNotFound:
             return {"code": "not_found"}
         rows = wire.decode_rows(p["rows"])
+        if p.get("propagated_ht"):
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
+
+            peer.tablet.clock.update(_HT(p["propagated_ht"]))
         # Non-transactional writes still resolve against pending intents:
         # they act as a highest-priority writer and wound any pending txn
         # holding intents on these keys (reference: single-row operations
@@ -495,8 +499,11 @@ class TabletServer:
                         if not peer.raft.is_leader():
                             return {"code": "not_leader",
                                     "leader_hint": peer.raft.leader_uuid()}
-                        rows = [peer.tablet.resolve_increments(r)
-                                for r in rows]
+                        try:
+                            rows = [peer.tablet.resolve_increments(r)
+                                    for r in rows]
+                        except ValueError as e:
+                            return {"code": "error", "message": str(e)}
                     try:
                         ht = peer.write(rows, timeout=p.get("timeout", 10.0),
                                         client_id=p.get("client_id"),
@@ -552,6 +559,13 @@ class TabletServer:
             peer = self.tablet_manager.get(p["tablet_id"])
         except TabletNotFound:
             return {"code": "not_found"}
+        if p.get("propagated_ht"):
+            # HLC causality: ratchet past everything the client has
+            # observed (its writes, txn commits) BEFORE choosing the
+            # read time, so a fresh read cannot miss them.
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
+
+            peer.tablet.clock.update(_HT(p["propagated_ht"]))
         spec = wire.decode_spec(p["spec"])
         if spec.read_ht == wire.MAX_HT:
             spec.read_ht = peer.read_time().value

@@ -242,6 +242,13 @@ class YBTransaction:
         res = YBSession(self.client).scan(table, spec)
         return res.rows[0] if res.rows else None
 
+    def own_rows(self, table: YBTable) -> dict:
+        """This txn's buffered/flushed writes to ``table``, merged per
+        key (the _own overlay) — range-reading statements need to see
+        earlier statements' effects."""
+        return {k: row for k, row in self._own.items()
+                if self._own_tables[k].name == table.name}
+
     def snapshot_spec(self, **kwargs):
         """A ScanSpec pinned to the txn read point (range reads see the
         snapshot; own uncommitted writes are NOT merged into range

@@ -166,5 +166,20 @@ class ClientCluster:
         loc = self.client.meta_cache.lookup_by_hash(handle.name, hash_code)
         return RemoteTablet(self.client, handle.name, loc)
 
+    def transaction_manager(self):
+        """The shared TransactionManager over this cluster's client
+        (reference: the TransactionManager the SQL layer's PgTxnManager
+        drives, pg_txn_manager.cc) — distributed seam only."""
+        if getattr(self, "_txn_manager", None) is None:
+            from yugabyte_db_tpu.txn.client import TransactionManager
+
+            self._txn_manager = TransactionManager(self.client)
+            self._txn_manager.ensure_status_table()
+        return self._txn_manager
+
+    def open_yb_table(self, name: str):
+        """A client YBTable handle (the transaction API's table type)."""
+        return self.client.open_table(name)
+
     def close(self) -> None:
         self._tables.clear()

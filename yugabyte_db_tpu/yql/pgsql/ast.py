@@ -62,6 +62,13 @@ class DropIndex:
 
 
 @dataclass
+class TxnControl:
+    """BEGIN / COMMIT / ROLLBACK."""
+
+    kind: str                  # "begin" | "commit" | "rollback"
+
+
+@dataclass
 class BindMarker:
     """$N placeholder (1-based in SQL text, stored 0-based)."""
 

@@ -35,6 +35,10 @@ from yugabyte_db_tpu.yql.pgsql.operations import combine_grouped
 from yugabyte_db_tpu.yql.pgsql.parser import parse_statement
 
 
+class SerializationFailure(Exception):
+    """Transaction conflict/abort (PG error code 40001): retry it."""
+
+
 @dataclass
 class PgResult:
     """Rows returned to the driver (the wire server turns this into
@@ -52,15 +56,44 @@ class PgResult:
 
 
 class PgProcessor:
-    """One SQL session over a Cluster seam."""
+    """One SQL session over a Cluster seam.
+
+    Transactions (BEGIN/COMMIT/ROLLBACK) run on the distributed seam's
+    TransactionManager: DML inside a transaction buffers intents through
+    a YBTransaction (snapshot isolation, first-committer-wins conflicts
+    surfaced as 40001); point SELECTs read-your-writes, range SELECTs
+    read the transaction's snapshot (own uncommitted writes are not
+    merged into range scans — the documented client-txn contract)."""
 
     def __init__(self, cluster):
         self.cluster = cluster
+        self._txn = None
+        self._txn_failed = False  # aborted block awaiting COMMIT/ROLLBACK
+        self._yb_tables: dict = {}
+
+    @property
+    def in_txn(self) -> bool:
+        return self._txn is not None or self._txn_failed
+
+    @property
+    def txn_status(self) -> str:
+        """The ReadyForQuery status byte: I idle, T in txn, E failed."""
+        if self._txn_failed:
+            return "E"
+        return "T" if self._txn is not None else "I"
 
     # -- entry point -------------------------------------------------------
     def execute(self, sql, params: list | None = None) -> PgResult | None:
         stmt = parse_statement(sql) if isinstance(sql, str) else sql
         self._params = params or []
+        if isinstance(stmt, ast.TxnControl):
+            return self._exec_txn_control(stmt)
+        if self._txn_failed:
+            # PG 25P02: the block already failed; only COMMIT/ROLLBACK
+            # (both of which roll back) end it
+            raise InvalidArgument(
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block")
         fn = {
             ast.CreateTable: self._exec_create_table,
             ast.DropTable: self._exec_drop_table,
@@ -72,7 +105,60 @@ class PgProcessor:
             ast.Delete: self._exec_delete,
             ast.Select: self._exec_select,
         }[type(stmt)]
-        return fn(stmt)
+        try:
+            return fn(stmt)
+        except Exception:
+            if self._txn is not None:
+                # a failed statement aborts the whole block (PG
+                # semantics): nothing from it may ever commit
+                self._txn.abort()
+                self._txn = None
+                self._txn_failed = True
+            raise
+
+    # -- transactions ------------------------------------------------------
+    def _exec_txn_control(self, stmt: ast.TxnControl):
+        from yugabyte_db_tpu.txn.client import (TransactionAborted,
+                                                TransactionConflict)
+
+        if stmt.kind == "begin":
+            if self.in_txn:
+                raise InvalidArgument(
+                    "there is already a transaction in progress")
+            mgr_fn = getattr(self.cluster, "transaction_manager", None)
+            if mgr_fn is None:
+                raise InvalidArgument(
+                    "transactions require a distributed cluster")
+            self._txn = mgr_fn().begin()
+            return PgResult(command="BEGIN")
+        if self._txn_failed:
+            # COMMIT of a failed block is a rollback (PG reports it so)
+            self._txn_failed = False
+            return PgResult(command="ROLLBACK")
+        if self._txn is None:
+            raise InvalidArgument("no transaction in progress")
+        txn, self._txn = self._txn, None
+        if stmt.kind == "rollback":
+            txn.abort()
+            return PgResult(command="ROLLBACK")
+        try:
+            txn.commit()
+        except (TransactionConflict, TransactionAborted) as e:
+            raise SerializationFailure(str(e)) from e
+        return PgResult(command="COMMIT")
+
+    def _yb_table(self, name: str):
+        t = self._yb_tables.get(name)
+        if t is None:
+            t = self._yb_tables[name] = self.cluster.open_yb_table(name)
+        return t
+
+    def _read_ht(self, tablet) -> int:
+        """The read point for scans: the txn snapshot inside a
+        transaction, the tablet's safe time otherwise."""
+        if self._txn is not None:
+            return self._txn.read_ht
+        return tablet.read_time().value
 
     # -- binding / coercion ------------------------------------------------
     def _resolve(self, value):
@@ -230,6 +316,21 @@ class PgProcessor:
             for c in schema.value_columns:
                 if c.name in provided:
                     columns[c.col_id] = self._coerce(c, provided[c.name])
+            if self._txn is not None:
+                # Uniqueness inside a txn: read-your-writes existence
+                # check; overlapping inserts from OTHER txns resolve at
+                # the intent level (first-committer-wins).
+                yt = self._yb_table(stmt.table)
+                if self._txn.get(yt, key_values) is not None:
+                    raise AlreadyPresent(
+                        "duplicate key value violates unique constraint")
+                vals = dict(key_values)
+                vals.update({c.name: columns[c.col_id]
+                             for c in schema.value_columns
+                             if c.col_id in columns})
+                self._txn.insert(yt, vals)
+                n += 1
+                continue
             key, tablet = self._key_and_tablet(handle, key_values)
             # PG semantics: duplicate key is an error (23505), not an
             # upsert. The check is ATOMIC with the write — it runs on the
@@ -251,19 +352,76 @@ class PgProcessor:
         if set(key_names) <= set(eq) and len(where) == len(key_names):
             kv = {n: self._coerce(schema.column(n), eq[n])
                   for n in key_names}
+            if self._txn is not None:
+                # point resolution inside a txn: read-your-writes (own
+                # buffered/flushed intents overlay the snapshot)
+                yt = self._yb_table(handle.name)
+                row = self._txn.get(yt, kv)
+                if row is None:
+                    return []
+                names = [c.name for c in schema.columns]
+                return [(kv, dict(zip(names, row)))]
             key, tablet = self._key_and_tablet(handle, kv)
             res = tablet.scan(ScanSpec(
                 lower=key, upper=key + b"\x00",
-                read_ht=tablet.read_time().value, projection=None))
+                read_ht=self._read_ht(tablet), projection=None))
             return [(kv, dict(zip(res.columns, r))) for r in res.rows]
         preds = self._predicates(schema, where)
         out = []
         for tablet in handle.tablets:
             res = tablet.scan(ScanSpec(
-                read_ht=tablet.read_time().value, predicates=preds))
+                read_ht=self._read_ht(tablet), predicates=preds))
             for r in res.rows:
                 d = dict(zip(res.columns, r))
                 out.append(({n: d[n] for n in key_names}, d))
+        if self._txn is not None:
+            out = self._overlay_own_writes(handle, preds, out)
+        return out
+
+    def _overlay_own_writes(self, handle, preds, snapshot_rows):
+        """Statements inside a transaction must see earlier statements'
+        effects: merge the txn's own buffered writes over the snapshot
+        match set (replace matched rows, drop tombstoned ones, add newly
+        inserted ones that match the predicates)."""
+        from yugabyte_db_tpu.models.encoding import decode_doc_key
+        from yugabyte_db_tpu.models.partition import compute_hash_code
+
+        schema = handle.schema
+        key_names = [c.name for c in schema.key_columns]
+        own = self._txn.own_rows(self._yb_table(handle.name))
+        if not own:
+            return snapshot_rows
+        by_id = {c.col_id: c.name for c in schema.value_columns}
+        out = []
+        seen = set()
+        for kv, d in snapshot_rows:
+            key = schema.encode_primary_key(
+                kv, compute_hash_code(schema, kv))
+            row = own.get(key)
+            if row is None:
+                out.append((kv, d))
+                continue
+            seen.add(key)
+            if row.tombstone:
+                continue
+            merged = dict(d)
+            for cid, v in row.columns.items():
+                if cid in by_id:
+                    merged[by_id[cid]] = v
+            if all(p.matches(merged.get(p.column)) for p in preds):
+                out.append((kv, merged))
+        for key, row in own.items():
+            if key in seen or row.tombstone:
+                continue
+            _, hashed, ranges = decode_doc_key(key)
+            kv = dict(zip(key_names, hashed + ranges))
+            d = dict(kv)
+            for c in schema.value_columns:
+                d[c.name] = row.columns.get(c.col_id)
+            if row.liveness or any(v is not None
+                                   for v in row.columns.values()):
+                if all(p.matches(d.get(p.column)) for p in preds):
+                    out.append((kv, d))
         return out
 
     def _predicates(self, schema: Schema, where: list[ast.Rel]):
@@ -294,16 +452,23 @@ class PgProcessor:
             sets.append((col, rhs))
         n = 0
         for kv, old in self._match_rows(handle, stmt.where):
-            columns = {}
+            set_values = {}
             for col, rhs in sets:
                 if isinstance(rhs, (X.Col, X.Const, X.BinOp)):
                     v = X.eval_expr(rhs, lambda name: old.get(name))
                     if col.dtype in (DataType.DOUBLE, DataType.FLOAT) \
                             and isinstance(v, int):
                         v = float(v)
-                    columns[col.col_id] = v
+                    set_values[col.name] = v
                 else:
-                    columns[col.col_id] = self._coerce(col, rhs)
+                    set_values[col.name] = self._coerce(col, rhs)
+            if self._txn is not None:
+                self._txn.update(self._yb_table(stmt.table), kv,
+                                 set_values)
+                n += 1
+                continue
+            columns = {handle.schema.column(nm).col_id: v
+                       for nm, v in set_values.items()}
             key, tablet = self._key_and_tablet(handle, kv)
             self._write_row(handle, kv, key, tablet,
                             RowVersion(key, ht=0, columns=columns))
@@ -314,6 +479,10 @@ class PgProcessor:
         handle = self.cluster.table(stmt.table)
         n = 0
         for kv, _old in self._match_rows(handle, stmt.where):
+            if self._txn is not None:
+                self._txn.delete_row(self._yb_table(stmt.table), kv)
+                n += 1
+                continue
             key, tablet = self._key_and_tablet(handle, kv)
             self._write_row(handle, kv, key, tablet,
                             RowVersion(key, ht=0, tombstone=True))
@@ -408,6 +577,17 @@ class PgProcessor:
         re-verifying predicates against the base row), full predicate-
         pushdown scan otherwise."""
         schema = handle.schema
+        if self._txn is not None:
+            # full-PK point SELECT inside a txn: read-your-writes
+            key_names = [c.name for c in schema.key_columns]
+            eq = {r.column: r.value for r in where if r.op == "="}
+            if set(key_names) <= set(eq) and len(where) == len(key_names):
+                kv = {n: self._coerce(schema.column(n), eq[n])
+                      for n in key_names}
+                row = self._txn.get(self._yb_table(handle.name), kv)
+                if row is not None:
+                    yield dict(zip([c.name for c in schema.columns], row))
+                return
         idx_info = None
         for rel in where:
             if rel.op != "=":
@@ -421,7 +601,7 @@ class PgProcessor:
         if idx_info is None:
             for tablet in handle.tablets:
                 res = tablet.scan(ScanSpec(
-                    read_ht=tablet.read_time().value, predicates=preds,
+                    read_ht=self._read_ht(tablet), predicates=preds,
                     projection=needed, limit=push_limit))
                 for r in res.rows:
                     yield dict(zip(res.columns, r))
@@ -441,13 +621,13 @@ class PgProcessor:
         itablet = self.cluster.tablet_for_hash(ih, hc)
         ires = itablet.scan(ScanSpec(
             lower=prefix, upper=prefix_successor(prefix),
-            read_ht=itablet.read_time().value, projection=key_names))
+            read_ht=self._read_ht(itablet), projection=key_names))
         for irow in ires.rows:
             base_kv = dict(zip(key_names, irow))
             key, btablet = self._key_and_tablet(handle, base_kv)
             res = btablet.scan(ScanSpec(
                 lower=key, upper=key + b"\x00",
-                read_ht=btablet.read_time().value,
+                read_ht=self._read_ht(btablet),
                 predicates=preds, projection=needed, limit=1))
             for r in res.rows:
                 yield dict(zip(res.columns, r))
@@ -495,7 +675,7 @@ class PgProcessor:
         results = []
         for tablet in handle.tablets:
             results.append(tablet.scan(ScanSpec(
-                read_ht=tablet.read_time().value, predicates=preds,
+                read_ht=self._read_ht(tablet), predicates=preds,
                 aggregates=aggs, group_by=group_by or None)))
         combined = combine_grouped(spec, results)
         ngb = len(group_by)

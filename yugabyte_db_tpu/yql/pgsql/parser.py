@@ -178,6 +178,24 @@ class Parser:
             if self.take_kw("INDEX"):
                 return ast.DropIndex(*self._name_if_exists())
             raise InvalidArgument(f"cannot DROP {self.peek()}")
+        if head in ("BEGIN", "START"):
+            self.next()
+            if head == "START":
+                self.expect_kw("TRANSACTION")
+            else:
+                self.take_kw("TRANSACTION", "WORK")
+            self.take_sym(";")
+            return ast.TxnControl("begin")
+        if head == "COMMIT":
+            self.next()
+            self.take_kw("TRANSACTION", "WORK")
+            self.take_sym(";")
+            return ast.TxnControl("commit")
+        if head in ("ROLLBACK", "ABORT"):
+            self.next()
+            self.take_kw("TRANSACTION", "WORK")
+            self.take_sym(";")
+            return ast.TxnControl("rollback")
         if head == "ALTER":
             return self._alter_table()
         if head == "INSERT":

@@ -49,8 +49,9 @@ def parameter_status(key: str, value: str) -> bytes:
     return _msg(b"S", key.encode() + b"\x00" + value.encode() + b"\x00")
 
 
-def ready_for_query() -> bytes:
-    return _msg(b"Z", b"I")
+def ready_for_query(status: bytes = b"I") -> bytes:
+    """'I' idle, 'T' in transaction, 'E' failed transaction."""
+    return _msg(b"Z", status)
 
 
 def command_complete(tag: str) -> bytes:
@@ -176,8 +177,13 @@ class PgConnectionContext(ConnectionContext):
         _tag, status, body = response
         if status == "ok":
             return body
-        # Handler raised outside the per-statement guard: wire-level error.
-        return error_response(str(body)) + ready_for_query()
+        # Handler raised outside the per-statement guard: wire-level
+        # error. Report the session's REAL txn state — claiming 'I'
+        # while a transaction is open desyncs the driver's state machine.
+        st = b"I"
+        if self.session is not None and self.session.in_txn:
+            st = self.session.txn_status.encode()
+        return error_response(str(body)) + ready_for_query(st)
 
 
 class PgServiceImpl:
@@ -202,23 +208,36 @@ class PgServiceImpl:
             return self._query(ctx, payload)
         if kind == "X":
             return b""  # client closes after Terminate
+        st = b"I"
+        if ctx.session is not None and ctx.session.in_txn:
+            st = ctx.session.txn_status.encode()
         return error_response(f"unsupported message {kind!r}",
-                              code="0A000") + ready_for_query()
+                              code="0A000") + ready_for_query(st)
 
     def _query(self, ctx, payload: bytes) -> bytes:
+        from yugabyte_db_tpu.yql.pgsql.executor import SerializationFailure
+
+        session = ctx.session or PgProcessor(self.cluster)
+
+        def txn_status() -> bytes:
+            return session.txn_status.encode()
+
         sql = payload.rstrip(b"\x00").decode("utf-8", "replace")
         out = bytearray()
         try:
             stmts = parse_script(sql)
         except Exception as e:  # noqa: BLE001 - parse error to client
             return bytes(error_response(str(e), "42601")
-                         + ready_for_query())
+                         + ready_for_query(txn_status()))
         if not stmts:
-            return bytes(empty_query_response() + ready_for_query())
+            return bytes(empty_query_response()
+                         + ready_for_query(txn_status()))
         for stmt in stmts:
             try:
-                res = (ctx.session or PgProcessor(self.cluster)).execute(
-                    stmt)
+                res = session.execute(stmt)
+            except SerializationFailure as e:
+                out += error_response(str(e), "40001")
+                break
             except InvalidArgument as e:
                 out += error_response(str(e), "42601")
                 break
@@ -240,7 +259,7 @@ class PgServiceImpl:
                 out += command_complete(f"SELECT {len(res.rows)}")
             else:
                 out += command_complete(res.command)
-        out += ready_for_query()
+        out += ready_for_query(txn_status())
         return bytes(out)
 
 
