@@ -39,6 +39,10 @@ class SerializationFailure(Exception):
     """Transaction conflict/abort (PG error code 40001): retry it."""
 
 
+class FailedTransaction(Exception):
+    """Statement issued inside an aborted block (PG code 25P02)."""
+
+
 @dataclass
 class PgResult:
     """Rows returned to the driver (the wire server turns this into
@@ -91,7 +95,7 @@ class PgProcessor:
         if self._txn_failed:
             # PG 25P02: the block already failed; only COMMIT/ROLLBACK
             # (both of which roll back) end it
-            raise InvalidArgument(
+            raise FailedTransaction(
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block")
         fn = {
@@ -201,6 +205,7 @@ class PgProcessor:
                                      nullable=kind == ColumnKind.REGULAR))
         schema = Schema(cols, table_id=stmt.name)
         self.cluster.create_table(stmt.name, schema, stmt.num_tablets)
+        self._yb_tables.pop(stmt.name, None)
         return PgResult(command="CREATE TABLE")
 
     def _exec_drop_table(self, stmt: ast.DropTable):
@@ -211,6 +216,7 @@ class PgProcessor:
         except NotFound:
             if not stmt.if_exists:
                 raise
+        self._yb_tables.pop(stmt.name, None)
         return PgResult(command="DROP TABLE")
 
     def _exec_alter_table(self, stmt: ast.AlterTable):
@@ -221,6 +227,7 @@ class PgProcessor:
         handle = self.cluster.table(stmt.name)
         self.cluster.alter_table(handle, evolve_schema(
             handle, stmt.action, stmt.column, stmt.dtype, stmt.new_name))
+        self._yb_tables.pop(stmt.name, None)
         return PgResult(command="ALTER TABLE")
 
     def _exec_create_index(self, stmt: ast.CreateIndex):
@@ -353,14 +360,8 @@ class PgProcessor:
             kv = {n: self._coerce(schema.column(n), eq[n])
                   for n in key_names}
             if self._txn is not None:
-                # point resolution inside a txn: read-your-writes (own
-                # buffered/flushed intents overlay the snapshot)
-                yt = self._yb_table(handle.name)
-                row = self._txn.get(yt, kv)
-                if row is None:
-                    return []
-                names = [c.name for c in schema.columns]
-                return [(kv, dict(zip(names, row)))]
+                got = self._txn_point_get(handle, kv)
+                return [] if got is None else [got]
             key, tablet = self._key_and_tablet(handle, kv)
             res = tablet.scan(ScanSpec(
                 lower=key, upper=key + b"\x00",
@@ -377,6 +378,16 @@ class PgProcessor:
         if self._txn is not None:
             out = self._overlay_own_writes(handle, preds, out)
         return out
+
+    def _txn_point_get(self, handle, kv):
+        """Point resolution inside a txn: read-your-writes (own buffered
+        and flushed intents overlay the committed snapshot). Returns
+        (kv, row-dict) or None."""
+        row = self._txn.get(self._yb_table(handle.name), kv)
+        if row is None:
+            return None
+        names = [c.name for c in handle.schema.columns]
+        return (kv, dict(zip(names, row)))
 
     def _overlay_own_writes(self, handle, preds, snapshot_rows):
         """Statements inside a transaction must see earlier statements'
@@ -415,13 +426,16 @@ class PgProcessor:
                 continue
             _, hashed, ranges = decode_doc_key(key)
             kv = dict(zip(key_names, hashed + ranges))
-            d = dict(kv)
-            for c in schema.value_columns:
-                d[c.name] = row.columns.get(c.col_id)
-            if row.liveness or any(v is not None
-                                   for v in row.columns.values()):
-                if all(p.matches(d.get(p.column)) for p in preds):
-                    out.append((kv, d))
+            # full state (committed base + own overlay) via the point
+            # get — the snapshot row may exist but have been excluded by
+            # the pre-overlay predicate values, and building from only
+            # the buffered columns would invent NULLs
+            got = self._txn_point_get(handle, kv)
+            if got is None:
+                continue
+            d = got[1]
+            if all(p.matches(d.get(p.column)) for p in preds):
+                out.append((kv, d))
         return out
 
     def _predicates(self, schema: Schema, where: list[ast.Rel]):
@@ -584,9 +598,9 @@ class PgProcessor:
             if set(key_names) <= set(eq) and len(where) == len(key_names):
                 kv = {n: self._coerce(schema.column(n), eq[n])
                       for n in key_names}
-                row = self._txn.get(self._yb_table(handle.name), kv)
-                if row is not None:
-                    yield dict(zip([c.name for c in schema.columns], row))
+                got = self._txn_point_get(handle, kv)
+                if got is not None:
+                    yield got[1]
                 return
         idx_info = None
         for rel in where:

@@ -215,7 +215,8 @@ class PgServiceImpl:
                               code="0A000") + ready_for_query(st)
 
     def _query(self, ctx, payload: bytes) -> bytes:
-        from yugabyte_db_tpu.yql.pgsql.executor import SerializationFailure
+        from yugabyte_db_tpu.yql.pgsql.executor import (FailedTransaction,
+                                                        SerializationFailure)
 
         session = ctx.session or PgProcessor(self.cluster)
 
@@ -237,6 +238,9 @@ class PgServiceImpl:
                 res = session.execute(stmt)
             except SerializationFailure as e:
                 out += error_response(str(e), "40001")
+                break
+            except FailedTransaction as e:
+                out += error_response(str(e), "25P02")
                 break
             except InvalidArgument as e:
                 out += error_response(str(e), "42601")
