@@ -56,6 +56,17 @@ class TpuRun:
     def __init__(self, crun: ColumnarRun):
         self.crun = crun
         self.dev = DeviceRun(crun, PAD_BLOCKS)
+        self._pallas_tensors = None
+
+    def pallas_tensors(self, col_order: tuple):
+        """Device tensors in the pallas kernel's ref order (bool planes
+        cast to int32, cmp planes sliced), built once per run."""
+        if self._pallas_tensors is None:
+            from yugabyte_db_tpu.ops import pallas_agg
+
+            self._pallas_tensors = pallas_agg.gather_tensors(
+                self.dev.arrays, col_order)
+        return self._pallas_tensors
 
 
 class TpuStorageEngine(StorageEngine):

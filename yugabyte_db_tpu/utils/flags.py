@@ -82,6 +82,10 @@ FLAGS.define("max_clock_skew_us", 500_000,
              ("stable",))
 FLAGS.define("follower_unavailable_considered_failed_sec", 5.0,
              "tserver liveness timeout", ("stable",))
+FLAGS.define("tpu_engine_use_pallas", False,
+             "route eligible flat-run aggregate scans through the "
+             "hand-written Pallas fold kernel (ops.pallas_agg) instead "
+             "of the XLA scan program", ("evolving", "runtime"))
 FLAGS.define("global_memstore_limit_bytes", 1 << 40,
              "process-wide memtable budget; crossing it flushes the "
              "engine that noticed (reference: the shared memory_monitor "

@@ -46,6 +46,7 @@ _TYPES = {
     "BOOLEAN": DataType.BOOL, "BOOL": DataType.BOOL,
     "BYTEA": DataType.BINARY,
     "JSONB": DataType.JSONB, "JSON": DataType.JSONB,
+    "TIMESTAMP": DataType.TIMESTAMP,  # microseconds since epoch (int64)
 }
 
 
