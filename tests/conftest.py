@@ -12,23 +12,20 @@ inside one process.
 """
 
 import os
+import sys
 
 # Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the real TPU
 # tunnel registered by sitecustomize) and its get_backend hook initializes
 # the axon backend even under JAX_PLATFORMS=cpu — which would (a) run every
 # test against the remote chip and (b) hang the whole suite whenever the
-# tunnel is unavailable. Unregister the factory and pin the config instead.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# tunnel is unavailable. The one canonical copy of this order-sensitive
+# recipe lives in __graft_entry__._pin_cpu_platform (the driver gate uses
+# the same one); its module top-level imports only stdlib+numpy, so it is
+# safe to import before jax initializes.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _pin_cpu_platform
 
-import jax
-import jax._src.xla_bridge as _xb
-
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
+_pin_cpu_platform(8)
 
 import numpy as np
 import pytest
