@@ -191,6 +191,7 @@ def bench_ycsb_e(schema, tpu, cpu, max_ht, S, n_pages=256, depth=6,
     cdt = (time.perf_counter() - t0) / 2 * n_batches
 
     lat = _median(lambda: tpu.scan_batch(batches[2][:64]), iters=3)
+    page_lat = _median(lambda: tpu.scan(batches[2][0]), iters=7)
     return {
         "metric": "ycsb_e_scan_ops_per_sec",
         "value": round(ops_s, 1),
@@ -200,6 +201,7 @@ def bench_ycsb_e(schema, tpu, cpu, max_ht, S, n_pages=256, depth=6,
         "vs_cpu_engine": round(cdt / tdt, 2),
         "result_rows_per_sec": round(nrows / tdt, 1),
         "sync_batch64_latency_ms": round(lat * 1000, 1),
+        "single_page_latency_ms": round(page_lat * 1000, 3),
     }
 
 

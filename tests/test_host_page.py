@@ -1,0 +1,230 @@
+"""Host page-cache path (storage.host_page) vs the CPU oracle.
+
+Flat single-run LIMIT scans route through HostPage (no device round
+trip); these tests pin that route's results to the oracle across MVCC
+read points, tombstones, TTL expiry, NULLs, predicates, projections and
+paging — and that non-eligible shapes still fall back to the device /
+host paths with identical results.
+"""
+
+import random
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import (
+    Predicate, RowVersion, ScanSpec, make_engine,
+)
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401  (registers 'tpu')
+
+
+def make_schema():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("a", DataType.INT64),
+        ColumnSchema("s", DataType.STRING),
+        ColumnSchema("c", DataType.DOUBLE),
+        ColumnSchema("d", DataType.INT32),
+        ColumnSchema("bl", DataType.BOOL),
+    ], table_id="hp")
+
+
+def enc(schema, k, r):
+    return schema.encode_primary_key(
+        {"k": k, "r": r}, compute_hash_code(schema, {"k": k}))
+
+
+def load_flat(schema, engines, n=400, seed=3, prefix="u"):
+    """Each key written exactly once -> flat run after one flush."""
+    rnd = random.Random(seed)
+    cids = {c.name: c.col_id for c in schema.value_columns}
+    ht = 0
+    for i in range(n):
+        ht += rnd.randrange(1, 4)
+        key = enc(schema, f"{prefix}{i:05d}", i % 11)
+        roll = rnd.random()
+        if roll < 0.06:
+            rv = RowVersion(key, ht=ht, tombstone=True)
+        else:
+            rv = RowVersion(
+                key, ht=ht, liveness=True,
+                columns={cids["a"]: rnd.randrange(-10**10, 10**10),
+                         cids["s"]: rnd.choice(["ab", "xyz", None, "qq"]),
+                         cids["c"]: rnd.uniform(-100, 100),
+                         cids["d"]: rnd.randrange(-500, 500),
+                         cids["bl"]: rnd.choice([True, False, None])},
+                expire_ht=(ht + rnd.randrange(5, 300)
+                           if rnd.random() < 0.12 else MAX_HT))
+        for e in engines:
+            e.apply([rv])
+    for e in engines:
+        e.flush()
+    return ht
+
+
+def assert_same(cpu, tpu, **kw):
+    a = cpu.scan(ScanSpec(**kw))
+    b = tpu.scan(ScanSpec(**kw))
+    assert a.columns == b.columns
+    assert a.rows == b.rows, kw
+    assert (a.resume_key is None) == (b.resume_key is None)
+    return a, b
+
+
+def setup(n=400, seed=3):
+    schema = make_schema()
+    cpu = make_engine("cpu", schema)
+    tpu = make_engine("tpu", schema, {"rows_per_block": 64})
+    ht = load_flat(schema, [cpu, tpu], n=n, seed=seed)
+    return schema, cpu, tpu, ht
+
+
+def page_plan_taken(tpu, spec):
+    return tpu._plan_scan(spec)[0] == "page"
+
+
+def test_page_route_selected_and_identical():
+    schema, cpu, tpu, ht = setup()
+    spec = ScanSpec(read_ht=ht + 1, limit=50,
+                    projection=["k", "r", "a", "d"])
+    assert page_plan_taken(tpu, spec)
+    assert_same(cpu, tpu, read_ht=ht + 1, limit=50,
+                projection=["k", "r", "a", "d"])
+
+
+def test_page_all_types_projection():
+    schema, cpu, tpu, ht = setup()
+    assert_same(cpu, tpu, read_ht=ht + 1, limit=40,
+                projection=["k", "r", "a", "s", "c", "d", "bl"])
+
+
+def test_page_read_points_time_travel():
+    schema, cpu, tpu, ht = setup()
+    for rp in (1, ht // 3, ht // 2, ht, MAX_HT):
+        assert_same(cpu, tpu, read_ht=rp, limit=30)
+
+
+def test_page_predicates():
+    schema, cpu, tpu, ht = setup()
+    cases = [
+        [Predicate("d", ">=", 0)],
+        [Predicate("d", "<", -100), Predicate("a", ">", 0)],
+        [Predicate("a", "<=", 10**9)],
+        [Predicate("c", ">=", 0.0)],
+        [Predicate("a", "!=", 5)],
+        [Predicate("d", "=", 7)],
+    ]
+    for preds in cases:
+        spec = ScanSpec(read_ht=ht + 1, limit=25, predicates=preds,
+                        projection=["k", "a", "d"])
+        assert page_plan_taken(tpu, spec), preds
+        assert_same(cpu, tpu, read_ht=ht + 1, limit=25, predicates=preds,
+                    projection=["k", "a", "d"])
+
+
+def test_page_string_pred_not_page_routed():
+    """str predicates are superset-only: must NOT take the page route,
+    results still identical via the device+verify path."""
+    schema, cpu, tpu, ht = setup()
+    spec = ScanSpec(read_ht=ht + 1, limit=25,
+                    predicates=[Predicate("s", "=", "ab")])
+    assert not page_plan_taken(tpu, spec)
+    assert_same(cpu, tpu, read_ht=ht + 1, limit=25,
+                predicates=[Predicate("s", "=", "ab")])
+
+
+def test_page_paging_loop_covers_everything():
+    schema, cpu, tpu, ht = setup()
+    spec_a = ScanSpec(read_ht=ht + 1, limit=17)
+    spec_b = ScanSpec(read_ht=ht + 1, limit=17)
+    pages = 0
+    total = 0
+    while True:
+        ra, rb = cpu.scan(spec_a), tpu.scan(spec_b)
+        assert ra.rows == rb.rows
+        assert (ra.resume_key is None) == (rb.resume_key is None)
+        total += len(rb.rows)
+        pages += 1
+        if ra.resume_key is None:
+            break
+        spec_a = ScanSpec(lower=ra.resume_key, read_ht=ht + 1, limit=17)
+        spec_b = ScanSpec(lower=rb.resume_key, read_ht=ht + 1, limit=17)
+    assert pages > 5
+    full = cpu.scan(ScanSpec(read_ht=ht + 1))
+    assert total == len(full.rows)
+
+
+def test_page_range_bounds():
+    schema, cpu, tpu, ht = setup()
+    keys = sorted(enc(schema, f"u{i:05d}", i % 11) for i in range(0, 400, 7))
+    lo, hi = keys[10], keys[40]
+    assert_same(cpu, tpu, lower=lo, upper=hi, read_ht=ht + 1, limit=20)
+    assert_same(cpu, tpu, lower=hi, upper=lo, read_ht=ht + 1, limit=20)
+    assert_same(cpu, tpu, lower=keys[-1], upper=b"", read_ht=ht + 1, limit=20)
+
+
+def test_page_batch_mixed_with_device_work():
+    """scan_batch mixing page scans + aggregates + multi-run fallbacks."""
+    from yugabyte_db_tpu.storage import AggSpec
+
+    schema, cpu, tpu, ht = setup()
+    specs = [
+        ScanSpec(read_ht=ht + 1, limit=10, projection=["k", "a"]),
+        ScanSpec(read_ht=ht + 1,
+                 aggregates=[AggSpec("count", None), AggSpec("sum", "a")]),
+        ScanSpec(read_ht=ht + 1, limit=5, predicates=[Predicate("d", ">", 0)],
+                 projection=["k", "d"]),
+    ]
+    ra = cpu.scan_batch(list(specs))
+    rb = tpu.scan_batch(list(specs))
+    for a, b in zip(ra, rb):
+        assert a.rows == b.rows
+
+
+def test_page_not_taken_multi_run_or_memtable():
+    schema, cpu, tpu, ht = setup()
+    spec = ScanSpec(read_ht=MAX_HT, limit=10)
+    assert page_plan_taken(tpu, spec)
+    # Live memtable overlay: no longer single-source.
+    cids = {c.name: c.col_id for c in schema.value_columns}
+    rv = RowVersion(enc(schema, "u00000", 0), ht=ht + 5, liveness=True,
+                    columns={cids["a"]: 1})
+    cpu.apply([rv])
+    tpu.apply([rv])
+    assert not page_plan_taken(tpu, spec)
+    assert_same(cpu, tpu, read_ht=MAX_HT, limit=10)
+
+
+def test_page_not_taken_multiversion_run():
+    schema = make_schema()
+    cpu = make_engine("cpu", schema)
+    tpu = make_engine("tpu", schema, {"rows_per_block": 64})
+    cids = {c.name: c.col_id for c in schema.value_columns}
+    key = enc(schema, "mv", 0)
+    for e in (cpu, tpu):
+        e.apply([RowVersion(key, ht=10, liveness=True,
+                            columns={cids["a"]: 1}),
+                 RowVersion(key, ht=20, columns={cids["a"]: 2})])
+        e.flush()
+    spec = ScanSpec(read_ht=MAX_HT, limit=10)
+    assert not page_plan_taken(tpu, spec)
+    assert_same(cpu, tpu, read_ht=MAX_HT, limit=10)
+    assert_same(cpu, tpu, read_ht=15, limit=10)
+
+
+def test_page_after_compaction_flat_again():
+    """Two flat runs (disjoint keys) merge into one flat run under
+    compaction: the page route re-engages and stays correct."""
+    schema = make_schema()
+    cpu = make_engine("cpu", schema)
+    tpu = make_engine("tpu", schema, {"rows_per_block": 64})
+    ht = load_flat(schema, [cpu, tpu], n=150, seed=5, prefix="u")
+    ht2 = load_flat(schema, [cpu, tpu], n=150, seed=6, prefix="w")
+    cpu.compact(history_cutoff_ht=max(ht, ht2))
+    tpu.compact(history_cutoff_ht=max(ht, ht2))
+    spec = ScanSpec(read_ht=MAX_HT, limit=20)
+    assert page_plan_taken(tpu, spec)
+    assert_same(cpu, tpu, read_ht=MAX_HT, limit=20,
+                projection=["k", "a", "s", "d"])

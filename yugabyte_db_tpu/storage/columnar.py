@@ -108,6 +108,7 @@ class ColumnarRun:
 
         self._kv_cols: list[np.ndarray] | None = None
         self._kv_blocks_done: set[int] = set()
+        self.kv_ready = False  # True once every block's keys are decoded
         self._kv_lock = threading.Lock()
 
     # -- construction ------------------------------------------------------
@@ -282,7 +283,8 @@ class ColumnarRun:
                 col.cmp_planes[b, nn_rows, 1] = lo
             else:
                 col.cmp_planes[b, nn_rows, 0] = arr
-            col.arith[b, nn_rows] = arr.astype(np.float32)
+            if col.arith is not None:  # BOOL: orderable but not numeric
+                col.arith[b, nn_rows] = arr.astype(np.float32)
         elif dt == DataType.FLOAT:
             arr = np.array(nn_vals, dtype=np.float32)
             col.cmp_planes[b, nn_rows, 0] = arr.view(np.int32)
@@ -338,7 +340,12 @@ class ColumnarRun:
 
         if self.B == 0 or not self.blocks[0].num_valid:
             return 0
-        maxes = [m.max_key for m in self.blocks if m.num_valid]
+        maxes = getattr(self, "_block_maxes", None)
+        if maxes is None:
+            # Runs are immutable once built; cache the per-block max-key
+            # list (page scans bisect this on every request).
+            maxes = self._block_maxes = [m.max_key for m in self.blocks
+                                         if m.num_valid]
         b = _bisect.bisect_left(maxes, key)
         if b >= len(maxes):
             return self.total_rows()
@@ -399,6 +406,8 @@ class ColumnarRun:
         from yugabyte_db_tpu.models.encoding import decode_doc_key
 
         nk = len(self.schema.key_columns)
+        if self.kv_ready:  # lock-free fast path once fully decoded
+            return self._kv_cols
         with self._kv_lock:
             if self._kv_cols is None:
                 self._kv_cols = [np.empty(self.B * self.R, dtype=object)
@@ -422,6 +431,8 @@ class ColumnarRun:
                 # marked done only after the block is fully decoded, so a
                 # concurrent reader can never see half-filled rows
                 self._kv_blocks_done.add(b)
+            if len(self._kv_blocks_done) == self.B:
+                self.kv_ready = True
         return cols
 
     # -- block pruning -----------------------------------------------------

@@ -40,6 +40,7 @@ from yugabyte_db_tpu.ops import agg_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.device_run import DeviceRun, dtype_kind
 from yugabyte_db_tpu.storage.columnar import ColumnarRun
+from yugabyte_db_tpu.storage import host_page
 from yugabyte_db_tpu.storage.cpu_engine import Aggregator, RowMaterializer
 from yugabyte_db_tpu.storage.engine import StorageEngine, register_engine
 from yugabyte_db_tpu.storage.memtable import MemTable
@@ -57,6 +58,7 @@ class TpuRun:
         self.crun = crun
         self.dev = DeviceRun(crun, PAD_BLOCKS)
         self._pallas_tensors = None
+        self.host_index = None  # storage.host_page.HostPageIndex, lazy
 
     def pallas_tensors(self, col_order: tuple):
         """Device tensors in the pallas kernel's ref order (bool planes
@@ -145,6 +147,7 @@ class TpuStorageEngine(StorageEngine):
                 )
                 changed = True
             crun.schema = new_schema
+            trun.host_index = None  # column planes changed shape/set
             if changed:
                 trun.dev = DeviceRun(crun, PAD_BLOCKS)
 
@@ -599,14 +602,22 @@ class TpuStorageEngine(StorageEngine):
         results: list = [None] * len(plans)
         issued_outs = []
         host_plans = []
+        page_items: list[tuple[int, tuple]] = []
         gathers: list[tuple[int, "_GatherScan"]] = []
         for pi, plan in enumerate(plans):
             if plan[0] == "host":
                 host_plans.append((pi, plan[1]))
+            elif plan[0] == "page":
+                page_items.append((pi, plan[1]))
             elif plan[0] == "issued":
                 issued_outs.append((pi, plan[1], plan[2]))
             else:
                 gathers.append((pi, plan[1]))
+        pages = []
+        if page_items:
+            planned = host_page.plan_pages(
+                self, [it for _pi, it in page_items])
+            pages = [(pi, pg) for (pi, _it), pg in zip(page_items, planned)]
 
         states = dict(gathers)
         pending = {pi: st.pending for pi, st in gathers if st.pending}
@@ -615,7 +626,7 @@ class TpuStorageEngine(StorageEngine):
                                      [o for _pi, o, _f in issued_outs]]):
             leaf.copy_to_host_async()
         return _AsyncBatch(self, results, host_plans, issued_outs,
-                           gathers, states, pending, dispatches)
+                           gathers, states, pending, dispatches, pages)
 
     def _issue_round(self, states, pending):
         """Group every active gather's pending param-rows by (signature,
@@ -777,6 +788,18 @@ class TpuStorageEngine(StorageEngine):
             return ("host", lambda: self._row_scan(
                 spec, runs, mem_live, pred_split, aggregate=True, mem=mem))
         if single_source and runs:
+            # Result-bound LIMIT pages on a flat run with host-exact
+            # predicates: serve from the host mirror (block-cache analog,
+            # storage.host_page) — no device round trip for ~100 rows.
+            if (spec.limit is not None
+                    and spec.limit <= host_page.MAX_PAGE_LIMIT
+                    and runs[0].crun.max_group_versions <= 1
+                    and not superset and not host_only):
+                pred_items = host_page.encode_pred_items(self, exact)
+                if pred_items is not None:
+                    # Deferred: scan_batch_async batch-plans all pages
+                    # (one vectorized searchsorted per shared structure).
+                    return ("page", (runs[0], spec, pred_items))
             return ("gather", self._plan_gather(
                 runs[0], spec, pred_split, aggregate=False))
         return ("host", lambda: self._row_scan(
@@ -1381,7 +1404,7 @@ class _AsyncBatch:
     fallback scans, and drives the (rare) continuation rounds."""
 
     def __init__(self, eng, results, host_plans, issued_outs, gathers,
-                 states, pending, dispatches):
+                 states, pending, dispatches, pages=()):
         self.eng = eng
         self.results = results
         self.host_plans = host_plans
@@ -1390,6 +1413,7 @@ class _AsyncBatch:
         self.states = states
         self.pending = pending
         self.dispatches = dispatches
+        self.pages = list(pages)
         self._done = False
 
     def finish(self) -> list[ScanResult]:
@@ -1400,6 +1424,17 @@ class _AsyncBatch:
         # Host-path scans first: device work is already in flight.
         for pi, fin in self.host_plans:
             results[pi] = fin()
+        # Host page-cache scans: group same-structure pages so the whole
+        # batch decodes with one vectorized pass per column.
+        if self.pages:
+            by_struct: dict = {}
+            for pi, pg in self.pages:
+                by_struct.setdefault(pg.struct_key, []).append((pi, pg))
+            for members in by_struct.values():
+                decoded = host_page.decode_pages(
+                    eng, [pg for _pi, pg in members])
+                for (pi, _pg), res in zip(members, decoded):
+                    results[pi] = res
         # One fetch for everything issued in round 1 (device_get reuses
         # buffers the async copies already landed).
         disp_bufs, issued_np = jax.device_get(

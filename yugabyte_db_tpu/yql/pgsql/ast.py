@@ -132,6 +132,34 @@ class OrderBy:
 
 
 @dataclass
+class SubQuery:
+    """A parenthesized SELECT used as a scalar / IN-list value in WHERE
+    (uncorrelated; reference capability: the full PG executor runs
+    subplans above the FDW, src/postgres/src/backend/executor)."""
+
+    select: "Select"
+
+
+@dataclass
+class Join:
+    """One JOIN clause: JOIN table [alias] ON a.x = b.y [AND ...]."""
+
+    table: str
+    alias: str | None
+    kind: str                  # "inner" | "left"
+    on: list[tuple]            # [(left_ref, right_ref)] column refs
+
+
+@dataclass
+class HavingRel:
+    """One HAVING conjunct: <agg-or-scalar expr> op literal."""
+
+    expr: object               # Agg | storage.expr tree
+    op: str
+    value: object
+
+
+@dataclass
 class Select:
     items: list[SelectItem]
     table: str
@@ -139,3 +167,7 @@ class Select:
     group_by: list[str] = field(default_factory=list)
     order_by: list[OrderBy] = field(default_factory=list)
     limit: object | None = None
+    distinct: bool = False
+    alias: str | None = None           # base-table alias
+    joins: list[Join] = field(default_factory=list)
+    having: list[HavingRel] = field(default_factory=list)
