@@ -27,7 +27,7 @@ class Col:
 
 @dataclass(frozen=True)
 class Const:
-    value: int
+    value: int | float  # float constants are host-evaluated only
 
 
 @dataclass(frozen=True)
@@ -71,6 +71,10 @@ def bounds(expr, dtype_of) -> tuple[int, int]:
     """Static [lo, hi] interval of an integer expression from column
     dtype ranges (drives the device small-factor eligibility check)."""
     if isinstance(expr, Const):
+        if not isinstance(expr.value, int) or isinstance(expr.value, bool):
+            # float constants: host-only (the device factor encoding is
+            # exact integer limbs) — reject so lower_product falls back
+            raise ValueError(f"non-integer constant {expr.value!r}")
         return expr.value, expr.value
     if isinstance(expr, Col):
         dt = dtype_of(expr.name)

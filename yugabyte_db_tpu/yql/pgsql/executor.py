@@ -438,9 +438,35 @@ class PgProcessor:
                 out.append((kv, d))
         return out
 
+    def _resolve_subquery(self, rel: ast.Rel) -> ast.Rel:
+        """Execute an uncorrelated subquery used as a WHERE value.
+        Scalar NULL / empty results lower to the never-matching IN ()
+        (PG: comparison with NULL selects no rows, not an error)."""
+        res = self._exec_select(rel.value.select)
+        if len(res.columns) != 1:
+            raise InvalidArgument("subquery must return a single column")
+        if rel.op == "IN":
+            # NULL elements can never satisfy '=' — drop them.
+            vals = tuple(r[0] for r in res.rows if r[0] is not None)
+            return ast.Rel(rel.column, "IN", vals)
+        if len(res.rows) > 1:
+            raise InvalidArgument(
+                "more than one row returned by a subquery used as "
+                "an expression")
+        v = res.rows[0][0] if res.rows else None
+        if v is None:
+            return ast.Rel(rel.column, "IN", ())
+        return ast.Rel(rel.column, rel.op, v)
+
+    def _resolved_where(self, where: list[ast.Rel]) -> list[ast.Rel]:
+        return [self._resolve_subquery(r)
+                if isinstance(r.value, ast.SubQuery) else r for r in where]
+
     def _predicates(self, schema: Schema, where: list[ast.Rel]):
         preds = []
         for rel in where:
+            if isinstance(rel.value, ast.SubQuery):
+                rel = self._resolve_subquery(rel)
             if not schema.has_column(rel.column):
                 raise InvalidArgument(f"unknown column {rel.column}")
             col = schema.column(rel.column)
@@ -505,12 +531,169 @@ class PgProcessor:
 
     # -- SELECT ------------------------------------------------------------
     def _exec_select(self, stmt: ast.Select):
+        if stmt.joins:
+            return self._select_join(stmt)
+        stmt = self._strip_qualifiers(stmt)
         handle = self.cluster.table(stmt.table)
         schema = handle.schema
-        has_agg = any(isinstance(it.expr, ast.Agg) for it in stmt.items)
+        has_agg = (any(isinstance(it.expr, ast.Agg) for it in stmt.items)
+                   or any(isinstance(h.expr, ast.Agg) for h in stmt.having))
         if has_agg or stmt.group_by:
             return self._select_aggregate(handle, stmt)
         return self._select_rows(handle, stmt)
+
+    def _strip_qualifiers(self, stmt: ast.Select) -> ast.Select:
+        """Single-table SELECT: rewrite 'alias.col' refs to bare names
+        (the storage seam knows bare columns only)."""
+        alias = stmt.alias or stmt.table
+        prefix = alias + "."
+
+        def fix(name: str) -> str:
+            if isinstance(name, str) and name.startswith(prefix):
+                return name[len(prefix):]
+            if isinstance(name, str) and "." in name:
+                raise InvalidArgument(
+                    f"unknown table alias in reference {name}")
+            return name
+
+        def fix_expr(e):
+            if isinstance(e, X.Col):
+                return X.Col(fix(e.name)) if "." in e.name else e
+            if isinstance(e, X.BinOp):
+                return X.BinOp(e.op, fix_expr(e.left), fix_expr(e.right))
+            if isinstance(e, ast.JsonPath):
+                return ast.JsonPath(fix(e.column), e.steps)
+            if isinstance(e, ast.Agg):
+                return ast.Agg(e.fn, None if e.arg is None
+                               else fix_expr(e.arg))
+            return e
+
+        needs = (any("." in r.column for r in stmt.where)
+                 or any("." in g for g in stmt.group_by)
+                 or any("." in o.column for o in stmt.order_by))
+        items = [ast.SelectItem(fix_expr(it.expr)
+                                if it.expr != "*" else "*", it.alias)
+                 for it in stmt.items]
+        having = [ast.HavingRel(fix_expr(h.expr), h.op, h.value)
+                  for h in stmt.having]
+        if not needs and items == stmt.items and having == stmt.having:
+            return stmt
+        return ast.Select(
+            items, stmt.table,
+            [ast.Rel(fix(r.column), r.op, r.value) for r in stmt.where],
+            [fix(g) for g in stmt.group_by],
+            [ast.OrderBy(fix(o.column), o.desc) for o in stmt.order_by],
+            stmt.limit, stmt.distinct, stmt.alias, [], having)
+
+    # -- joins (above the storage seam; reference capability: the PG
+    # executor's hash/merge joins over FDW scans, src/postgres executor) --
+    def _select_join(self, stmt: ast.Select):
+        base_alias = stmt.alias or stmt.table
+        tables = [(base_alias, stmt.table)]
+        tables += [(j.alias or j.table, j.table) for j in stmt.joins]
+        if len({a for a, _ in tables}) != len(tables):
+            raise InvalidArgument("duplicate table alias in FROM")
+        handles = {a: self.cluster.table(t) for a, t in tables}
+        owners: dict[str, list[str]] = {}
+        for a, _t in tables:
+            for c in handles[a].schema.columns:
+                owners.setdefault(c.name, []).append(a)
+
+        def qualify(ref: str) -> tuple[str, str]:
+            if "." in ref:
+                a, c = ref.split(".", 1)
+                if a not in handles:
+                    raise InvalidArgument(f"unknown table alias {a}")
+                if not handles[a].schema.has_column(c):
+                    raise InvalidArgument(f"unknown column {ref}")
+                return a, c
+            als = owners.get(ref)
+            if not als:
+                raise InvalidArgument(f"unknown column {ref}")
+            if len(als) > 1:
+                raise InvalidArgument(
+                    f"column reference {ref} is ambiguous")
+            return als[0], ref
+
+        # Resolve subqueries once; split WHERE into per-table pushdowns.
+        where = self._resolved_where(stmt.where)
+        per: dict[str, list[ast.Rel]] = {a: [] for a, _ in tables}
+        for rel in where:
+            a, c = qualify(rel.column)
+            per[a].append(ast.Rel(c, rel.op, rel.value))
+
+        rows_by_alias: dict[str, list[dict]] = {}
+        for a, _tname in tables:
+            h = handles[a]
+            preds = self._predicates(h.schema, per[a])
+            rows_by_alias[a] = [
+                {f"{a}.{k}": v for k, v in d.items()}
+                for d in self._scan_dicts(h, per[a], preds, None, None)]
+
+        joined = rows_by_alias[base_alias]
+        seen_aliases = {base_alias}
+        for j, (a, _tname) in zip(stmt.joins, tables[1:]):
+            lkeys, rkeys = [], []
+            for lref, rref in j.on:
+                la, lc = qualify(lref)
+                ra, rc = qualify(rref)
+                if ra != a:  # written right-to-left: flip
+                    la, lc, ra, rc = ra, rc, la, lc
+                if ra != a or la not in seen_aliases:
+                    raise InvalidArgument(
+                        f"ON must relate {a} to an earlier table")
+                lkeys.append(f"{la}.{lc}")
+                rkeys.append(f"{a}.{rc}")
+            index: dict[tuple, list[dict]] = {}
+            for d in rows_by_alias[a]:
+                kt = tuple(d[k] for k in rkeys)
+                if any(v is None for v in kt):
+                    continue  # SQL: NULL never joins
+                index.setdefault(kt, []).append(d)
+            null_right = {f"{a}.{c.name}": None
+                          for c in handles[a].schema.columns}
+            out = []
+            for ld in joined:
+                kt = tuple(ld[k] for k in lkeys)
+                matches = (index.get(kt)
+                           if not any(v is None for v in kt) else None)
+                if matches:
+                    for rd in matches:
+                        m = dict(ld)
+                        m.update(rd)
+                        out.append(m)
+                elif j.kind == "left":
+                    m = dict(ld)
+                    m.update(null_right)
+                    out.append(m)
+            joined = out
+            seen_aliases.add(a)
+
+        # Bare-name aliases for unambiguous columns (output resolution).
+        bare = [(n, f"{als[0]}.{n}") for n, als in owners.items()
+                if len(als) == 1]
+        for d in joined:
+            for n, qn in bare:
+                d[n] = d[qn]
+
+        # Re-verify WHERE post-join: predicates pushed below a LEFT JOIN's
+        # right side must still filter NULL-extended rows (PG applies
+        # WHERE after the join).
+        if where and any(j.kind == "left" for j in stmt.joins):
+            post = []
+            for rel in where:
+                a, c = qualify(rel.column)
+                col = handles[a].schema.column(c)
+                if rel.op == "IN":
+                    val = tuple(self._coerce(col, v)
+                                for v in self._resolve(rel.value))
+                else:
+                    val = self._coerce(col, rel.value)
+                post.append(Predicate(f"{a}.{c}", rel.op, val))
+            joined = [d for d in joined
+                      if all(p.matches(d.get(p.column)) for p in post)]
+
+        return self._finish_select(stmt, joined, tables, handles, qualify)
 
     @staticmethod
     def _eval_item(expr, d: dict):
@@ -575,10 +758,18 @@ class PgProcessor:
         # reorders rows and a single tablet preserves global key order.
         push_limit = (limit if not stmt.order_by
                       and len(handle.tablets) == 1 else None)
+        if stmt.distinct:
+            if hidden:
+                raise InvalidArgument(
+                    "for SELECT DISTINCT, ORDER BY expressions must "
+                    "appear in the select list")
+            push_limit = None  # dedup may need more input rows
         rows = []
         for d in self._scan_dicts(handle, stmt.where, preds, needed,
                                   push_limit):
             rows.append(tuple(self._eval_item(e, d) for e in exprs))
+        if stmt.distinct:
+            rows = list(dict.fromkeys(rows))
         rows = self._order_and_limit(stmt, names, rows, limit)
         if hidden:
             rows = [r[:-hidden] for r in rows]
@@ -646,6 +837,173 @@ class PgProcessor:
             for r in res.rows:
                 yield dict(zip(res.columns, r))
 
+    @staticmethod
+    def _cmp(op: str, left, right) -> bool:
+        """SQL comparison for HAVING / post-join verification: NULL on
+        either side fails every operator."""
+        if left is None or right is None:
+            return False
+        return {"=": left == right, "!=": left != right,
+                "<": left < right, "<=": left <= right,
+                ">": left > right, ">=": left >= right}[op]
+
+    def _finish_select(self, stmt: ast.Select, dicts: list[dict],
+                       tables, handles, qualify=None) -> PgResult:
+        """Host projection/aggregation over joined row dicts (the work
+        PG's executor does above the FDW scans)."""
+        if qualify is not None:
+            # Validate every column reference (catches ambiguous bare
+            # names, which would otherwise silently read as NULL).
+            def check(e):
+                if isinstance(e, ast.Agg):
+                    if e.arg is not None:
+                        check(e.arg)
+                    return
+                for c in self._item_columns(e):
+                    qualify(c)
+            for it in stmt.items:
+                if it.expr != "*":
+                    check(it.expr)
+            for h in stmt.having:
+                check(h.expr)
+            for g in stmt.group_by:
+                qualify(g)
+        names, exprs = [], []
+        for it in stmt.items:
+            if it.expr == "*":
+                for a, _t in tables:
+                    for c in handles[a].schema.columns:
+                        names.append(c.name)
+                        exprs.append(X.Col(f"{a}.{c.name}"))
+                continue
+            if isinstance(it.expr, ast.Agg):
+                arg = it.expr.arg
+                names.append(it.alias or
+                             f"{it.expr.fn}({'*' if arg is None else '...'})")
+            elif isinstance(it.expr, X.Col):
+                names.append(it.alias or it.expr.name.split(".")[-1])
+            else:
+                names.append(it.alias or "?column?")
+            exprs.append(it.expr)
+        has_agg = (stmt.group_by
+                   or any(isinstance(e, ast.Agg) for e in exprs)
+                   or any(isinstance(h.expr, ast.Agg)
+                          for h in stmt.having))
+        limit = self._limit(stmt)
+        if has_agg:
+            rows = self._host_aggregate(stmt, dicts, exprs)
+            if stmt.distinct:
+                rows = list(dict.fromkeys(rows))
+            rows = self._order_and_limit(stmt, names, rows, limit)
+            return PgResult(columns=names, rows=rows)
+        hidden = 0
+        for ob in stmt.order_by:
+            if ob.column not in names:
+                names.append(ob.column)
+                exprs.append(X.Col(ob.column))
+                hidden += 1
+        rows = [tuple(self._eval_item(e, d) for e in exprs)
+                for d in dicts]
+        if stmt.distinct:
+            if hidden:
+                raise InvalidArgument(
+                    "for SELECT DISTINCT, ORDER BY expressions must "
+                    "appear in the select list")
+            rows = list(dict.fromkeys(rows))
+        rows = self._order_and_limit(stmt, names, rows, limit)
+        if hidden:
+            rows = [r[:-hidden] for r in rows]
+            names = names[:-hidden]
+        return PgResult(columns=names, rows=rows)
+
+    def _host_aggregate(self, stmt: ast.Select, dicts: list[dict],
+                        exprs) -> list[tuple]:
+        """Group + fold on host over row dicts; returns output rows in
+        group-key order (HAVING applied)."""
+        group_by = list(stmt.group_by)
+        agg_items: list[tuple] = []     # (fn, arg)
+        out_plan: list[tuple] = []      # ("agg", slot) | ("expr", e)
+        for e in exprs:
+            if isinstance(e, ast.Agg):
+                out_plan.append(("agg", len(agg_items)))
+                agg_items.append((e.fn, e.arg))
+            else:
+                out_plan.append(("expr", e))
+        having_plan: list[tuple] = []
+        for h in stmt.having:
+            if isinstance(h.expr, ast.Agg):
+                having_plan.append(("agg", len(agg_items), h.op, h.value))
+                agg_items.append((h.expr.fn, h.expr.arg))
+            else:
+                having_plan.append(("expr", h.expr, h.op, h.value))
+
+        def new_accs():
+            return [[0, 0, None, None] for _ in agg_items]  # n,s,mn,mx
+
+        groups: dict[tuple, tuple] = {}
+        order: list[tuple] = []
+        for d in dicts:
+            gk = tuple(self._eval_item(X.Col(g), d) for g in group_by)
+            st = groups.get(gk)
+            if st is None:
+                st = groups[gk] = (d, new_accs())
+                order.append(gk)
+            for acc, (fn, arg) in zip(st[1], agg_items):
+                if fn == "count" and arg is None:
+                    acc[0] += 1
+                    continue
+                v = self._eval_item(arg, d)
+                if v is None:
+                    continue
+                acc[0] += 1
+                if fn in ("sum", "avg"):
+                    acc[1] += v
+                if acc[2] is None or v < acc[2]:
+                    acc[2] = v
+                if acc[3] is None or v > acc[3]:
+                    acc[3] = v
+
+        def finalize(fn, acc):
+            n, s, mn, mx = acc
+            if fn == "count":
+                return n
+            if fn == "sum":
+                return s if n else None
+            if fn == "avg":
+                return s / n if n else None
+            return mn if fn == "min" else mx
+
+        if not group_by and not groups:
+            groups[()] = ({}, new_accs())   # PG: aggregates over zero
+            order.append(())                # rows yield one row
+        order.sort(key=lambda gk: tuple((v is None, v) for v in gk))
+        rows = []
+        for gk in order:
+            rep, accs = groups[gk]
+            keep = True
+            for hp in having_plan:
+                if hp[0] == "agg":
+                    _k, slot, op, lit = hp
+                    fn, _arg = agg_items[slot]
+                    val = finalize(fn, accs[slot])
+                else:
+                    _k, e, op, lit = hp
+                    val = self._eval_item(e, rep)
+                if not self._cmp(op, val, self._resolve(lit)):
+                    keep = False
+                    break
+            if not keep:
+                continue
+            out = []
+            for kind, payload in out_plan:
+                if kind == "agg":
+                    fn, _arg = agg_items[payload]
+                    out.append(finalize(fn, accs[payload]))
+                else:
+                    out.append(self._eval_item(payload, rep))
+            rows.append(tuple(out))
+        return rows
+
     def _select_aggregate(self, handle, stmt: ast.Select):
         schema = handle.schema
         preds = self._predicates(schema, stmt.where)
@@ -684,6 +1042,30 @@ class PgProcessor:
                 raise InvalidArgument(
                     "non-aggregate expressions must be GROUP BY columns")
 
+        # HAVING conjuncts ride as hidden aggregate slots through the
+        # same per-tablet partial combine (avg lowers to sum+count).
+        having_plan = []
+        for h in stmt.having:
+            if isinstance(h.expr, ast.Agg):
+                fn, arg = h.expr.fn, h.expr.arg
+                if fn == "avg":
+                    si = len(aggs)
+                    aggs.append(self._agg_spec("sum", arg, f"_hv_s{si}"))
+                    aggs.append(self._agg_spec("count", arg, f"_hv_c{si}"))
+                    having_plan.append(("avg", si, h.op, h.value))
+                else:
+                    having_plan.append(("agg", len(aggs), h.op, h.value))
+                    aggs.append(self._agg_spec(fn, arg, f"_hv{len(aggs)}"))
+            elif isinstance(h.expr, X.Col):
+                if h.expr.name not in group_by:
+                    raise InvalidArgument(
+                        f"HAVING column {h.expr.name} must appear in "
+                        f"GROUP BY")
+                having_plan.append(
+                    ("group", group_by.index(h.expr.name), h.op, h.value))
+            else:
+                raise InvalidArgument("unsupported HAVING expression")
+
         spec = ScanSpec(read_ht=MAX_HT, predicates=preds,
                         aggregates=aggs, group_by=group_by or None)
         results = []
@@ -693,19 +1075,27 @@ class PgProcessor:
                 aggregates=aggs, group_by=group_by or None)))
         combined = combine_grouped(spec, results)
         ngb = len(group_by)
+
+        def slot(row, kind, payload):
+            if kind == "group":
+                return row[payload]
+            if kind == "agg":
+                # combined columns: group cols, then aggs in order
+                return row[ngb + payload]
+            # avg: sum at payload, count at payload+1
+            s, c = row[ngb + payload], row[ngb + payload + 1]
+            return s / c if c else None
+
         rows = []
         for row in combined.rows:
-            out = []
-            for kind, payload in out_plan:
-                if kind == "group":
-                    out.append(row[payload])
-                elif kind == "agg":
-                    # combined columns: group cols, then aggs in order
-                    out.append(row[ngb + payload])
-                else:  # avg: sum at payload, count at payload+1
-                    s, c = row[ngb + payload], row[ngb + payload + 1]
-                    out.append(s / c if c else None)
-            rows.append(tuple(out))
+            if not all(self._cmp(op, slot(row, kind, payload),
+                                 self._resolve(lit))
+                       for kind, payload, op, lit in having_plan):
+                continue
+            rows.append(tuple(slot(row, kind, payload)
+                              for kind, payload in out_plan))
+        if stmt.distinct:
+            rows = list(dict.fromkeys(rows))
         rows = self._order_and_limit(stmt, names, rows, self._limit(stmt))
         return PgResult(columns=names, rows=rows)
 

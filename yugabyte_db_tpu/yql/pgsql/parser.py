@@ -379,25 +379,64 @@ class Parser:
         return ast.Delete(table, where)
 
     # -- SELECT ------------------------------------------------------------
+    _CLAUSE_KWS = ("FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS",
+                   "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS",
+                   "ON", "HAVING", "AND", "OR", "DESC", "ASC")
+
     def _select(self) -> ast.Select:
         self.expect_kw("SELECT")
+        distinct = bool(self.take_kw("DISTINCT"))
         items = [self._select_item()]
         while self.take_sym(","):
             items.append(self._select_item())
         self.expect_kw("FROM")
         table = self.ident()
+        alias = self._table_alias()
+        joins: list[ast.Join] = []
+        while True:
+            if self.take_kw("JOIN"):
+                kind = "inner"
+            elif self.at_kw("INNER") and self._kw_ahead(1, "JOIN"):
+                self.next(); self.expect_kw("JOIN")
+                kind = "inner"
+            elif self.at_kw("LEFT"):
+                self.next()
+                self.take_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = "left"
+            else:
+                break
+            jtable = self.ident()
+            jalias = self._table_alias()
+            self.expect_kw("ON")
+            on = [self._on_pair()]
+            while self.take_kw("AND"):
+                on.append(self._on_pair())
+            joins.append(ast.Join(jtable, jalias, kind, on))
         where = self._where()
         group_by: list[str] = []
         if self.take_kw("GROUP"):
             self.expect_kw("BY")
-            group_by.append(self.ident())
+            group_by.append(self._colref())
             while self.take_sym(","):
-                group_by.append(self.ident())
+                group_by.append(self._colref())
+        having: list[ast.HavingRel] = []
+        if self.take_kw("HAVING"):
+            while True:
+                expr = self._item_expr()
+                t = self.next()
+                if t.kind != "op":
+                    raise InvalidArgument(
+                        f"expected operator in HAVING, got {t}")
+                op = "!=" if t.text == "<>" else t.text
+                having.append(ast.HavingRel(expr, op, self.literal()))
+                if not self.take_kw("AND"):
+                    break
         order_by: list[ast.OrderBy] = []
         if self.take_kw("ORDER"):
             self.expect_kw("BY")
             while True:
-                col = self.ident()
+                col = self._colref()
                 desc = bool(self.take_kw("DESC"))
                 if not desc:
                     self.take_kw("ASC")
@@ -408,7 +447,34 @@ class Parser:
         if self.take_kw("LIMIT"):
             limit = self.literal()
         self.take_sym(";")
-        return ast.Select(items, table, where, group_by, order_by, limit)
+        return ast.Select(items, table, where, group_by, order_by, limit,
+                          distinct, alias, joins, having)
+
+    def _kw_ahead(self, n: int, kw: str) -> bool:
+        t = self.toks[self.i + n] if self.i + n < len(self.toks) else None
+        return t is not None and t.kind == "name" and t.text.upper() == kw
+
+    def _table_alias(self) -> str | None:
+        if self.take_kw("AS"):
+            return self.ident()
+        t = self.peek()
+        if (t is not None and t.kind == "name"
+                and t.text.upper() not in self._CLAUSE_KWS):
+            return self.ident()
+        return None
+
+    def _colref(self) -> str:
+        """Possibly-qualified column reference: name or alias.name."""
+        name = self.ident()
+        if self.at_sym("."):
+            self.next()
+            return f"{name}.{self.ident()}"
+        return name
+
+    def _on_pair(self) -> tuple:
+        left = self._colref()
+        self.expect_sym("=")
+        return (left, self._colref())
 
     def _select_item(self) -> ast.SelectItem:
         if self.take_sym("*"):
@@ -418,8 +484,7 @@ class Parser:
         if self.take_kw("AS"):
             alias = self.ident()
         elif (self.peek() is not None and self.peek().kind == "name"
-              and self.peek().text.upper() not in
-              ("FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS")):
+              and self.peek().text.upper() not in self._CLAUSE_KWS):
             alias = self.ident()
         return ast.SelectItem(expr, alias)
 
@@ -462,19 +527,9 @@ class Parser:
             self.expect_sym(")")
             return node
         t = self.peek()
-        if t is not None and t.kind == "number":
-            v = self.literal()
-            if not isinstance(v, int):
-                raise InvalidArgument(
-                    "only integer constants are allowed in expressions")
-            return Const(v)
-        if t is not None and self.at_sym("-"):
-            v = self.literal()
-            if not isinstance(v, int):
-                raise InvalidArgument(
-                    "only integer constants are allowed in expressions")
-            return Const(v)
-        name = self.ident()
+        if t is not None and (t.kind == "number" or self.at_sym("-")):
+            return Const(self.literal())
+        name = self._colref()
         # jsonb path: col -> 'key' -> 0 ->> 'leaf'
         steps = []
         while self.peek() is not None and self.peek().kind == "op" \
@@ -499,12 +554,21 @@ class Parser:
         return self._scalar()
 
     # -- WHERE -------------------------------------------------------------
+    def _at_subquery(self) -> bool:
+        return self.at_sym("(") and self._kw_ahead(1, "SELECT")
+
+    def _subquery(self) -> ast.SubQuery:
+        self.expect_sym("(")
+        sel = self._select()
+        self.expect_sym(")")
+        return ast.SubQuery(sel)
+
     def _where(self) -> list[ast.Rel]:
         rels: list[ast.Rel] = []
         if not self.take_kw("WHERE"):
             return rels
         while True:
-            col = self.ident()
+            col = self._colref()
             if self.take_kw("BETWEEN"):
                 lo = self.literal()
                 self.expect_kw("AND")
@@ -512,18 +576,23 @@ class Parser:
                 rels.append(ast.Rel(col, ">=", lo))
                 rels.append(ast.Rel(col, "<=", hi))
             elif self.take_kw("IN"):
-                self.expect_sym("(")
-                vals = [self.literal()]
-                while self.take_sym(","):
-                    vals.append(self.literal())
-                self.expect_sym(")")
-                rels.append(ast.Rel(col, "IN", tuple(vals)))
+                if self._at_subquery():
+                    rels.append(ast.Rel(col, "IN", self._subquery()))
+                else:
+                    self.expect_sym("(")
+                    vals = [self.literal()]
+                    while self.take_sym(","):
+                        vals.append(self.literal())
+                    self.expect_sym(")")
+                    rels.append(ast.Rel(col, "IN", tuple(vals)))
             else:
                 t = self.next()
                 if t.kind != "op":
                     raise InvalidArgument(f"expected operator, got {t}")
                 op = "!=" if t.text == "<>" else t.text
-                rels.append(ast.Rel(col, op, self.literal()))
+                value = (self._subquery() if self._at_subquery()
+                         else self.literal())
+                rels.append(ast.Rel(col, op, value))
             if not self.take_kw("AND"):
                 break
         return rels
