@@ -1,0 +1,240 @@
+"""Redis command families added for reference parity: sorted sets,
+lists, time series, ranges, rename, TTL variants, multi-database,
+AUTH/CONFIG, FLUSHDB/FLUSHALL, and pubsub/MONITOR server-push frames
+(reference registry: redis_commands.cc:69-154).
+"""
+
+import socket
+import time
+
+import pytest
+
+from tests.test_redis import RedisError, RespClient
+from yugabyte_db_tpu.integration import MiniCluster
+from yugabyte_db_tpu.yql.redis import RedisServer
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("redisfam")
+    c = MiniCluster(str(tmp), num_masters=1, num_tservers=3).start()
+    c.wait_tservers_registered()
+    server = RedisServer(c.client("redis-proxy"))
+    host, port = server.listen("127.0.0.1", 0)
+    yield host, port
+    server.shutdown()
+    c.shutdown()
+
+
+@pytest.fixture
+def r(rig):
+    cli = RespClient(*rig)
+    cli.cmd("FLUSHALL")
+    cli.cmd("CONFIG", "SET", "requirepass", "")  # note: "" means unset-ish
+    yield cli
+    cli.close()
+
+
+def test_sorted_sets(r):
+    assert r.cmd("ZADD", "z", "3", "c", "1", "a", "2", "b") == 3
+    assert r.cmd("ZADD", "z", "5", "a") == 0         # update, not add
+    assert r.cmd("ZCARD", "z") == 3
+    assert r.cmd("ZSCORE", "z", "a") == "5"
+    assert r.cmd("ZSCORE", "z", "nope") is None
+    assert r.cmd("ZRANGE", "z", "0", "-1") == ["b", "c", "a"]
+    assert r.cmd("ZRANGE", "z", "0", "1", "WITHSCORES") == \
+        ["b", "2", "c", "3"]
+    assert r.cmd("ZREVRANGE", "z", "0", "0") == ["a"]
+    assert r.cmd("ZRANGEBYSCORE", "z", "2", "3") == ["b", "c"]
+    assert r.cmd("ZRANGEBYSCORE", "z", "(2", "+inf") == ["c", "a"]
+    assert r.cmd("ZRANGEBYSCORE", "z", "-inf", "+inf") == ["b", "c", "a"]
+    assert r.cmd("ZREM", "z", "b", "nope") == 1
+    assert r.cmd("ZCARD", "z") == 2
+
+
+def test_lists(r):
+    assert r.cmd("RPUSH", "l", "b", "c") == 2
+    assert r.cmd("LPUSH", "l", "a") == 3
+    assert r.cmd("LLEN", "l") == 3
+    assert r.cmd("LPOP", "l") == "a"
+    assert r.cmd("RPOP", "l") == "c"
+    assert r.cmd("LPOP", "l") == "b"
+    assert r.cmd("LPOP", "l") is None
+    assert r.cmd("LLEN", "l") == 0
+
+
+def test_time_series(r):
+    assert r.cmd("TSADD", "ts", "100", "v100", "50", "v50",
+                 "-20", "vneg") == "OK"
+    assert r.cmd("TSGET", "ts", "50") == "v50"
+    assert r.cmd("TSGET", "ts", "51") is None
+    assert r.cmd("TSCARD", "ts") == 3
+    assert r.cmd("TSRANGEBYTIME", "ts", "-inf", "+inf") == \
+        ["-20", "vneg", "50", "v50", "100", "v100"]
+    assert r.cmd("TSRANGEBYTIME", "ts", "0", "99") == ["50", "v50"]
+    assert r.cmd("TSREVRANGEBYTIME", "ts", "-inf", "+inf") == \
+        ["100", "v100", "50", "v50", "-20", "vneg"]
+    assert r.cmd("TSLASTN", "ts", "2") == ["50", "v50", "100", "v100"]
+    assert r.cmd("TSREM", "ts", "50") == 1
+    assert r.cmd("TSCARD", "ts") == 2
+
+
+def test_string_ranges(r):
+    r.cmd("SET", "s", "Hello World")
+    assert r.cmd("GETRANGE", "s", "0", "4") == "Hello"
+    assert r.cmd("GETRANGE", "s", "-5", "-1") == "World"
+    assert r.cmd("SETRANGE", "s", "6", "Redis") == 11
+    assert r.cmd("GET", "s") == "Hello Redis"
+    assert r.cmd("SETRANGE", "empty", "3", "x") == 4
+    assert r.cmd("GET", "empty") == "\x00\x00\x00x"
+
+
+def test_hash_extensions(r):
+    r.cmd("HSET", "h", "f", "10")
+    assert r.cmd("HINCRBY", "h", "f", "5") == 15
+    assert r.cmd("HINCRBY", "h", "new", "-3") == -3
+    assert r.cmd("HSTRLEN", "h", "f") == 2
+    assert r.cmd("HSTRLEN", "h", "missing") == 0
+
+
+def test_rename(r):
+    r.cmd("HSET", "src", "a", "1", "b", "2")
+    r.cmd("SET", "dst", "old")
+    assert r.cmd("RENAME", "src", "dst") == "OK"
+    assert r.cmd("HGET", "dst", "a") == "1"
+    assert r.cmd("GET", "dst") is None          # old dst content replaced
+    assert r.cmd("EXISTS", "src") == 0
+    with pytest.raises(RedisError):
+        r.cmd("RENAME", "nope", "x")
+
+
+def test_ttl_variants(r):
+    r.cmd("SET", "t1", "v")
+    assert r.cmd("PEXPIRE", "t1", "600000") == 1
+    assert r.cmd("PERSIST", "t1") == 1
+    assert r.cmd("TTL", "t1") == -1
+    assert r.cmd("PTTL", "missing") == -2
+    assert r.cmd("EXPIREAT", "t1", str(int(time.time()) + 600)) == 1
+    assert r.cmd("GET", "t1") == "v"
+    # expireat in the past deletes
+    assert r.cmd("EXPIREAT", "t1", "1") == 1
+    assert r.cmd("GET", "t1") is None
+    assert r.cmd("PSETEX", "t2", "600000", "v2") == "OK"
+    assert r.cmd("GET", "t2") == "v2"
+
+
+def test_databases(r):
+    r.cmd("SET", "k", "db0")
+    assert r.cmd("CREATEDB", "two") == "OK"
+    assert "two" in r.cmd("LISTDB")
+    assert r.cmd("SELECT", "two") == "OK"
+    assert r.cmd("GET", "k") is None            # isolated namespace
+    r.cmd("SET", "k", "db2")
+    assert r.cmd("GET", "k") == "db2"
+    assert r.cmd("KEYS", "*") == ["k"]
+    assert r.cmd("SELECT", "0") == "OK"
+    assert r.cmd("GET", "k") == "db0"
+    with pytest.raises(RedisError):
+        r.cmd("SELECT", "nonexistent")
+    assert r.cmd("DELETEDB", "two") == "OK"
+    with pytest.raises(RedisError):
+        r.cmd("SELECT", "two")
+
+
+def test_flushdb_scoped(r):
+    r.cmd("SET", "a", "1")
+    r.cmd("CREATEDB", "other")
+    r.cmd("SELECT", "other")
+    r.cmd("SET", "b", "2")
+    assert r.cmd("FLUSHDB") == "OK"
+    assert r.cmd("KEYS", "*") == []
+    r.cmd("SELECT", "0")
+    assert r.cmd("GET", "a") == "1"             # other db untouched
+    assert r.cmd("FLUSHALL") == "OK"
+    assert r.cmd("KEYS", "*") == []
+    r.cmd("DELETEDB", "other")
+
+
+def test_pubsub_push(rig):
+    sub = RespClient(*rig)
+    pub = RespClient(*rig)
+    try:
+        assert sub.cmd("SUBSCRIBE", "news") == ["subscribe", "news", 1]
+        # Let the subscription register before publishing.
+        assert pub.cmd("PUBSUB", "CHANNELS") == ["news"]
+        assert pub.cmd("PUBLISH", "news", "hello") == 1
+        assert sub._read_reply() == ["message", "news", "hello"]
+        assert pub.cmd("PUBLISH", "nosubs", "x") == 0
+        assert sub.cmd("UNSUBSCRIBE", "news") == ["unsubscribe", "news", 0]
+        assert pub.cmd("PUBSUB", "NUMPAT") == 0
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_pattern_subscribe(rig):
+    sub = RespClient(*rig)
+    pub = RespClient(*rig)
+    try:
+        assert sub.cmd("PSUBSCRIBE", "news.*") == \
+            ["psubscribe", "news.*", 1]
+        assert pub.cmd("PUBLISH", "news.tech", "t") == 1
+        assert sub._read_reply() == ["pmessage", "news.*", "news.tech", "t"]
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_monitor_push(rig):
+    mon = RespClient(*rig)
+    cli = RespClient(*rig)
+    try:
+        assert mon.cmd("MONITOR") == "OK"
+        cli.cmd("SET", "mk", "v")
+        line = mon._read_reply()
+        assert '"SET"' in line and '"mk"' in line
+    finally:
+        mon.close()
+        cli.close()
+
+
+def test_auth(rig):
+    admin = RespClient(*rig)
+    other = RespClient(*rig)
+    try:
+        assert admin.cmd("CONFIG", "SET", "requirepass", "s3cret") == "OK"
+        with pytest.raises(RedisError, match="NOAUTH"):
+            other.cmd("GET", "k")
+        with pytest.raises(RedisError, match="invalid password"):
+            other.cmd("AUTH", "wrong")
+        assert other.cmd("AUTH", "s3cret") == "OK"
+        other.cmd("SET", "k", "v")            # authorized now
+        assert other.cmd("GET", "k") == "v"
+        # admin set the password but never authed: locked out too.
+        with pytest.raises(RedisError, match="NOAUTH"):
+            admin.cmd("CONFIG", "GET", "requirepass")
+        assert other.cmd("CONFIG", "GET", "requirepass") == \
+            ["requirepass", "s3cret"]
+    finally:
+        # Unset so later tests in this module aren't locked out.
+        try:
+            other.cmd("CONFIG", "SET", "requirepass", "")
+        finally:
+            admin.close()
+            other.close()
+
+
+def test_misc_server_commands(r):
+    assert r.cmd("ROLE") == ["master"]
+    assert r.cmd("QUIT") == "OK"
+    assert "cluster_enabled:0" in r.cmd("CLUSTER", "INFO")
+    assert r.cmd("PUBSUB", "NUMSUB", "nochannel") == ["nochannel", 0]
+
+
+def test_command_count_target():
+    """The reference registers ~85 commands (redis_commands.cc:69-154);
+    parity requires >= 70 here."""
+    from yugabyte_db_tpu.yql.redis.server import RedisServiceImpl
+
+    cmds = [m for m in dir(RedisServiceImpl) if m.startswith("cmd_")]
+    assert len(cmds) >= 70, len(cmds)

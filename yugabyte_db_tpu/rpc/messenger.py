@@ -212,10 +212,17 @@ class Messenger:
             self._dispatch(conn, call)
 
     def _dispatch(self, conn: _Connection, call) -> None:
-        """Worker-side: run the handler, enqueue the response."""
+        """Worker-side: run the handler, enqueue the response.
+
+        A handler with ``takes_conn = True`` receives the connection as
+        its first argument — foreign protocols with server-push frames
+        (Redis pubsub/monitor) address pushes via send_on(conn, ...)."""
         call_id, method, body = call
         try:
-            result = conn.handler(method, body)
+            if getattr(conn.handler, "takes_conn", False):
+                result = conn.handler(conn, method, body)
+            else:
+                result = conn.handler(method, body)
             response = (call_id, "ok", result)
         except Exception as e:  # propagate as remote error
             response = (call_id, "error", f"{type(e).__name__}: {e}")
