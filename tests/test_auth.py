@@ -1,0 +1,279 @@
+"""Roles / permissions / authentication across frontends.
+
+Reference analogs: master CreateRole/GrantRevokeRole/
+GrantRevokePermission RPCs (master.proto:1383-1388), CQL enforcement +
+auth vtables (yql_auth_roles_vtable.cc), PG password auth. Every
+unauthorized-op test asserts fail-closed behavior.
+"""
+
+import pytest
+
+from yugabyte_db_tpu.auth import RoleStore, hash_password
+from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.status import (AlreadyPresent, InvalidArgument,
+                                          NotFound)
+from yugabyte_db_tpu.yql.cql.processor import (LocalCluster, QLProcessor,
+                                               Unauthorized)
+
+
+@pytest.fixture
+def auth_on():
+    FLAGS.set("use_cassandra_authentication", True)
+    yield
+    FLAGS.set("use_cassandra_authentication", False)
+
+
+# -- RoleStore unit ----------------------------------------------------------
+
+def test_role_store_basics():
+    st = RoleStore()
+    st.apply({"op": "auth_create_role", "name": "admin",
+              "superuser": True, "can_login": True,
+              "salted_hash": hash_password("pw")})
+    st.apply({"op": "auth_create_role", "name": "reader",
+              "can_login": True, "salted_hash": hash_password("r")})
+    with pytest.raises(AlreadyPresent):
+        st.apply({"op": "auth_create_role", "name": "admin"})
+    assert st.check_login("admin", "pw")
+    assert not st.check_login("admin", "wrong")
+    assert not st.check_login("ghost", "pw")
+    # superuser passes everything; reader nothing yet
+    assert st.authorize("admin", "MODIFY", "data/ks/t")
+    assert not st.authorize("reader", "SELECT", "data/ks/t")
+    st.apply({"op": "auth_grant_perm", "role": "reader",
+              "resource": "data/ks", "perm": "SELECT"})
+    # keyspace grant covers tables beneath it
+    assert st.authorize("reader", "SELECT", "data/ks/t")
+    assert not st.authorize("reader", "MODIFY", "data/ks/t")
+    st.apply({"op": "auth_revoke_perm", "role": "reader",
+              "resource": "data/ks", "perm": "SELECT"})
+    assert not st.authorize("reader", "SELECT", "data/ks/t")
+
+
+def test_role_store_membership_transitive():
+    st = RoleStore()
+    for n in ("a", "b", "c"):
+        st.apply({"op": "auth_create_role", "name": n})
+    st.apply({"op": "auth_grant_perm", "role": "a",
+              "resource": "data", "perm": "SELECT"})
+    st.apply({"op": "auth_grant_role", "role": "a", "member": "b"})
+    st.apply({"op": "auth_grant_role", "role": "b", "member": "c"})
+    assert st.authorize("c", "SELECT", "data/x/y")   # c -> b -> a
+    with pytest.raises(InvalidArgument):             # circular grant
+        st.apply({"op": "auth_grant_role", "role": "c", "member": "a"})
+    st.apply({"op": "auth_revoke_role", "role": "a", "member": "b"})
+    assert not st.authorize("c", "SELECT", "data/x/y")
+
+
+def test_role_store_drop_cleans_up():
+    st = RoleStore()
+    st.apply({"op": "auth_create_role", "name": "a"})
+    st.apply({"op": "auth_create_role", "name": "b"})
+    st.apply({"op": "auth_grant_role", "role": "a", "member": "b"})
+    st.apply({"op": "auth_grant_perm", "role": "a",
+              "resource": "data", "perm": "ALL"})
+    st.apply({"op": "auth_drop_role", "name": "a"})
+    assert "a" not in st.roles
+    assert not st.roles["b"].member_of
+    assert not st.perms
+    with pytest.raises(NotFound):
+        st.apply({"op": "auth_drop_role", "name": "a"})
+
+
+def test_role_store_serialization_round_trip():
+    st = RoleStore()
+    st.apply({"op": "auth_create_role", "name": "r", "can_login": True,
+              "salted_hash": hash_password("x")})
+    st.apply({"op": "auth_grant_perm", "role": "r",
+              "resource": "data/ks", "perm": "MODIFY"})
+    st2 = RoleStore.from_dict(st.to_dict())
+    assert st2.check_login("r", "x")
+    assert st2.authorize("r", "MODIFY", "data/ks/t")
+
+
+# -- CQL statements + enforcement (in-process cluster) -----------------------
+
+def test_cql_role_ddl_and_lists():
+    p = QLProcessor(LocalCluster(num_tablets=2))
+    p.execute("CREATE ROLE admin WITH PASSWORD = 'pw' AND LOGIN = true "
+              "AND SUPERUSER = true")
+    p.execute("CREATE ROLE reader WITH PASSWORD = 'r' AND LOGIN = true")
+    p.execute("GRANT SELECT ON ALL KEYSPACES TO reader")
+    roles = p.execute("LIST ROLES")
+    assert [r[0] for r in roles.rows] == ["admin", "reader"]
+    perms = p.execute("LIST ALL PERMISSIONS")
+    assert ("reader", "data", "SELECT") in perms.rows
+    p.execute("REVOKE SELECT ON ALL KEYSPACES FROM reader")
+    assert not p.execute("LIST ALL PERMISSIONS").rows
+    p.execute("ALTER ROLE reader WITH SUPERUSER = true")
+    roles = p.execute("LIST ROLES").dicts()
+    assert roles[1]["is_superuser"] is True
+    p.execute("DROP ROLE reader")
+    assert len(p.execute("LIST ROLES").rows) == 1
+    # idempotent forms
+    p.execute("CREATE ROLE IF NOT EXISTS admin")
+    p.execute("DROP ROLE IF EXISTS ghost")
+
+
+def test_cql_enforcement_fails_closed(auth_on):
+    cluster = LocalCluster(num_tablets=2)
+    root = QLProcessor(cluster, login_role="root")
+    # Bootstrap superuser applied directly to the store (the reference
+    # seeds the cassandra superuser at initdb time).
+    cluster.auth_op({"op": "auth_create_role", "name": "root",
+                     "superuser": True, "can_login": True,
+                     "salted_hash": hash_password("rootpw")})
+    root.execute("CREATE TABLE t (k INT PRIMARY KEY, v TEXT)")
+    root.execute("INSERT INTO t (k, v) VALUES (1, 'a')")
+    root.execute("CREATE ROLE reader WITH PASSWORD = 'r' AND LOGIN = true")
+
+    unauth = QLProcessor(cluster)       # no login at all
+    with pytest.raises(Unauthorized):
+        unauth.execute("SELECT * FROM t")
+
+    reader = QLProcessor(cluster, login_role="reader")
+    for stmt in ("SELECT * FROM t",
+                 "INSERT INTO t (k, v) VALUES (2, 'b')",
+                 "CREATE TABLE t2 (k INT PRIMARY KEY)",
+                 "DROP TABLE t",
+                 "ALTER TABLE t ADD x INT",
+                 "CREATE ROLE sneaky",
+                 "GRANT SELECT ON ALL KEYSPACES TO reader"):
+        with pytest.raises(Unauthorized):
+            reader.execute(stmt)
+
+    root.execute("GRANT SELECT ON TABLE t TO reader")
+    assert reader.execute("SELECT * FROM t").rows == [(1, "a")]
+    with pytest.raises(Unauthorized):   # SELECT != MODIFY
+        reader.execute("INSERT INTO t (k, v) VALUES (3, 'c')")
+    root.execute("GRANT MODIFY ON KEYSPACE default TO reader")
+    reader.execute("INSERT INTO t (k, v) VALUES (3, 'c')")
+    root.execute("REVOKE SELECT ON TABLE t FROM reader")
+    with pytest.raises(Unauthorized):
+        reader.execute("SELECT * FROM t")
+
+
+def test_cql_wire_auth_handshake(tmp_path, auth_on):
+    from tests.test_cql_wire import WireClient
+    from yugabyte_db_tpu.yql.cql import wire_protocol as W
+    from yugabyte_db_tpu.yql.cql.server import CQLServer
+
+    cluster = LocalCluster(num_tablets=2)
+    cluster.auth_op({"op": "auth_create_role", "name": "cassandra",
+                     "superuser": True, "can_login": True,
+                     "salted_hash": hash_password("cassandra")})
+    server = CQLServer(cluster)
+    host, port = server.listen("127.0.0.1", 0)
+    try:
+        cli = WireClient(host, port)
+        w = W.Writer()
+        w.short(1)
+        w.string("CQL_VERSION").string("3.4.4")
+        cli._send(W.OP_STARTUP, w.getvalue())
+        _s, opcode, body = cli._recv_frame()
+        assert opcode == W.OP_AUTHENTICATE
+        assert b"PasswordAuthenticator" in body
+        # wrong password -> credentials error
+        bad = W.Writer().bytes_(b"\x00cassandra\x00wrong").getvalue()
+        cli._send(W.OP_AUTH_RESPONSE, bad)
+        _s, opcode, body = cli._recv_frame()
+        assert opcode == W.OP_ERROR
+        # right password -> AUTH_SUCCESS, then statements flow
+        good = W.Writer().bytes_(b"\x00cassandra\x00cassandra").getvalue()
+        cli._send(W.OP_AUTH_RESPONSE, good)
+        _s, opcode, _b = cli._recv_frame()
+        assert opcode == W.OP_AUTH_SUCCESS
+        kind, _, _ = cli.query(
+            "CREATE TABLE ta (k INT, PRIMARY KEY (k))")
+        assert kind == W.RESULT_SCHEMA_CHANGE
+        cli.close()
+        # a fresh connection that skips auth is rejected on QUERY
+        cli2 = WireClient(host, port)
+        cli2._send(W.OP_STARTUP, w.getvalue())
+        _s, opcode, _b = cli2._recv_frame()
+        assert opcode == W.OP_AUTHENTICATE
+        with pytest.raises(Exception):
+            cli2.query("SELECT * FROM ta")
+        cli2.close()
+    finally:
+        server.shutdown()
+
+
+def test_pg_wire_password_auth(tmp_path):
+    import socket
+    import struct
+
+    from yugabyte_db_tpu.yql.pgsql.wire import PgServer
+
+    FLAGS.set("ysql_require_auth", True)
+    cluster = LocalCluster(num_tablets=2)
+    cluster.auth_op({"op": "auth_create_role", "name": "postgres",
+                     "can_login": True,
+                     "salted_hash": hash_password("pg")})
+    server = PgServer(cluster)
+    host, port = server.listen("127.0.0.1", 0)
+
+    def startup(sock, user):
+        body = struct.pack(">I", 196608) + \
+            b"user\x00" + user.encode() + b"\x00\x00"
+        sock.sendall(struct.pack(">I", len(body) + 4) + body)
+
+    def read_msg(sock, buf):
+        while len(buf) < 5:
+            buf += sock.recv(65536)
+        tag = buf[:1]
+        (ln,) = struct.unpack_from(">I", buf, 1)
+        while len(buf) < 1 + ln:
+            buf += sock.recv(65536)
+        return tag, bytes(buf[5:1 + ln]), buf[1 + ln:]
+
+    try:
+        # wrong password fails closed
+        s = socket.create_connection((host, port), timeout=10)
+        startup(s, "postgres")
+        tag, payload, rest = read_msg(s, b"")
+        assert tag == b"R" and struct.unpack(">I", payload)[0] == 3
+        pw = b"wrong\x00"
+        s.sendall(b"p" + struct.pack(">I", len(pw) + 4) + pw)
+        tag, payload, rest = read_msg(s, rest)
+        assert tag == b"E" and b"authentication failed" in payload
+        s.close()
+        # right password authenticates and serves queries
+        s = socket.create_connection((host, port), timeout=10)
+        startup(s, "postgres")
+        tag, payload, rest = read_msg(s, b"")
+        assert tag == b"R"
+        pw = b"pg\x00"
+        s.sendall(b"p" + struct.pack(">I", len(pw) + 4) + pw)
+        tag, payload, rest = read_msg(s, rest)
+        assert tag == b"R" and struct.unpack(">I", payload)[0] == 0
+        s.close()
+    finally:
+        FLAGS.set("ysql_require_auth", False)
+        server.shutdown()
+
+
+# -- distributed: role ops replicate through the master catalog --------------
+
+def test_roles_replicate_through_master(tmp_path):
+    from yugabyte_db_tpu.integration import MiniCluster
+    from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+
+    mc = MiniCluster(str(tmp_path), num_masters=3, num_tservers=3).start()
+    try:
+        mc.wait_tservers_registered()
+        cc = ClientCluster(mc.client())
+        p = QLProcessor(cc)
+        p.execute("CREATE ROLE dadmin WITH PASSWORD = 'd' AND "
+                  "LOGIN = true AND SUPERUSER = true")
+        p.execute("GRANT SELECT ON ALL KEYSPACES TO dadmin")
+        with pytest.raises(Exception):
+            p.execute("CREATE ROLE dadmin")  # duplicate rejected
+        # a second client session observes the replicated store
+        cc2 = ClientCluster(mc.client("c2"))
+        st = cc2.auth_store()
+        assert st.check_login("dadmin", "d")
+        assert st.authorize("dadmin", "SELECT", "data/ks/t")
+        assert ("dadmin", "data", "SELECT") in st.list_perms()
+    finally:
+        mc.shutdown()

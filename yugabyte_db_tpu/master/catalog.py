@@ -39,13 +39,27 @@ class CatalogState:
     """Deterministic state machine over replicated catalog ops."""
 
     def __init__(self):
+        from yugabyte_db_tpu.auth import RoleStore
+
         self._lock = threading.RLock()
         self.tables: dict[str, TableInfo] = {}
         self.tables_by_name: dict[str, str] = {}
         self.tablets: dict[str, TabletInfo] = {}
+        # Roles/permissions ride the same replicated catalog pipeline
+        # (reference: role records in the sys catalog, master.proto:1383).
+        self.auth = RoleStore()
 
     def apply(self, op: dict) -> None:
         kind = op["op"]
+        if kind.startswith("auth_"):
+            # Replicas hold identical state at each log index, so a
+            # validation failure here is the SAME no-op on every replica
+            # (the leader pre-validates; this guards races + replays).
+            try:
+                self.auth.apply(op)
+            except Exception:  # noqa: BLE001
+                pass
+            return
         with self._lock:
             if kind == "create_table":
                 t = TableInfo(op["table_id"], op["name"], op["schema"],

@@ -465,6 +465,37 @@ class Master:
             for t in self.catalog.list_tables()
         ]}
 
+    # -- auth/roles (reference: CreateRole/GrantRevokeRole/
+    # GrantRevokePermission, master.proto:1383-1388) ------------------------
+    def _h_master_auth_op(self, p: dict):
+        """Replicate one role/permission mutation through the catalog.
+        The op is validated against current state first so obvious
+        errors (duplicate role, unknown role) fail without a Raft round;
+        apply-time errors surface as error responses."""
+        if not self.raft.is_leader():
+            return self._not_leader()
+        op = dict(p["auth"])
+        try:
+            # Dry-run validation against a copy keeps apply() (the
+            # replicated path) deterministic and non-throwing.
+            from yugabyte_db_tpu.auth import RoleStore
+
+            RoleStore.from_dict(self.catalog.auth.to_dict()).apply(op)
+        except Exception as e:  # noqa: BLE001
+            return {"code": "error", "message": str(e)}
+        try:
+            self.raft.replicate("catalog", op)
+        except NotLeader:
+            return self._not_leader()
+        return {"code": "ok"}
+
+    def _h_master_get_auth(self, p: dict):
+        # Leader-only: a follower may lag the latest role DDL and a
+        # stale mirror would let a just-revoked permission keep working.
+        if not self.raft.is_leader():
+            return self._not_leader()
+        return {"code": "ok", "auth": self.catalog.auth.to_dict()}
+
     def _h_master_list_tservers(self, p: dict):
         now_dead = {d.uuid for d in self.ts_manager.dead_tservers()}
         return {"code": "ok", "tservers": [

@@ -90,6 +90,14 @@ FLAGS.define("global_memstore_limit_bytes", 1 << 40,
              "process-wide memtable budget; crossing it flushes the "
              "engine that noticed (reference: the shared memory_monitor "
              "across rocksdb instances)", ("stable", "runtime"))
+FLAGS.define("use_cassandra_authentication", False,
+             "require CQL authentication + per-statement role "
+             "permission checks (reference: the flag of the same name "
+             "gating auth in the CQL proxy)", ("stable", "runtime"))
+FLAGS.define("ysql_require_auth", False,
+             "require cleartext-password authentication on the PG wire "
+             "(reference: pg_hba password auth via initdb defaults)",
+             ("stable", "runtime"))
 FLAGS.define("fault.ts_write_respond_failed", 0.0,
              "probability a successful tablet write responds failure "
              "anyway (client-retry / exactly-once testing; reference: "

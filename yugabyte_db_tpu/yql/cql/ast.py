@@ -47,6 +47,65 @@ class UseKeyspace:
 
 
 @dataclass
+class CreateRole:
+    """CREATE ROLE r [WITH PASSWORD = '..' [AND LOGIN = b] [AND
+    SUPERUSER = b]] (reference: PTCreateRole / master CreateRole RPC,
+    src/yb/master/master.proto:1383)."""
+
+    name: str
+    password: str | None = None
+    can_login: bool = False
+    superuser: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class AlterRole:
+    name: str
+    password: str | None = None
+    can_login: bool | None = None
+    superuser: bool | None = None
+
+
+@dataclass
+class DropRole:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class GrantRevokeRole:
+    """GRANT r TO m / REVOKE r FROM m (master.proto:1386)."""
+
+    grant: bool
+    role: str
+    member: str
+
+
+@dataclass
+class GrantRevokePermission:
+    """GRANT/REVOKE <perm> ON <resource> TO/FROM role
+    (master.proto:1388). resource uses the hierarchical form of
+    yugabyte_db_tpu.auth ("data", "data/ks", "data/ks/t", "roles",
+    "roles/r")."""
+
+    grant: bool
+    permission: str            # ALL or one of auth.PERMISSIONS
+    resource: str
+    role: str
+
+
+@dataclass
+class ListRoles:
+    pass
+
+
+@dataclass
+class ListPermissions:
+    pass
+
+
+@dataclass
 class CreateTable:
     name: str                      # possibly keyspace-qualified "ks.t"
     columns: list[ColumnDef]

@@ -92,6 +92,30 @@ class ClientCluster:
         # (same shape as LocalCluster's shared clock).
         self.clock = HybridClock()
         self._tables: dict[str, RemoteTable] = {}
+        self._auth_cache = None
+        self._auth_cache_at = 0.0
+
+    def auth_store(self):
+        """Short-TTL mirror of the master's role store (the client-side
+        caching the reference's CQL auth does against system_auth)."""
+        import time as _t
+
+        from yugabyte_db_tpu.auth import RoleStore
+
+        now = _t.monotonic()
+        if self._auth_cache is None or now - self._auth_cache_at > 1.0:
+            resp = self.client.master_rpc("master.get_auth", {})
+            self._auth_cache = RoleStore.from_dict(resp["auth"])
+            self._auth_cache_at = now
+        return self._auth_cache
+
+    def auth_op(self, op: dict) -> None:
+        resp = self.client.master_rpc("master.auth_op", {"auth": op})
+        if resp.get("code") != "ok":
+            from yugabyte_db_tpu.utils.status import InvalidArgument
+
+            raise InvalidArgument(resp.get("message", "auth op failed"))
+        self._auth_cache = None
 
     @property
     def tables(self) -> dict:

@@ -189,6 +189,8 @@ class Parser:
             "INSERT": self._insert, "SELECT": self._select,
             "UPDATE": self._update, "DELETE": self._delete,
             "ALTER": self._alter, "BEGIN": self._batch,
+            "GRANT": self._grant_revoke, "REVOKE": self._grant_revoke,
+            "LIST": self._list,
         }.get(kw)
         if fn is None:
             raise InvalidArgument(f"unsupported statement {t.text!r}")
@@ -198,9 +200,86 @@ class Parser:
             raise InvalidArgument(f"trailing tokens at {self.peek()}")
         return stmt
 
+    # -- roles / permissions (reference grammar: PTCreateRole,
+    # PTGrantRevokePermission in parser_gram.y) -----------------------------
+    _PERMS = ("ALL", "ALTER", "AUTHORIZE", "CREATE", "DESCRIBE", "DROP",
+              "MODIFY", "SELECT")
+
+    def _role_options(self):
+        password, can_login, superuser = None, None, None
+        if self.take_kw("WITH"):
+            while True:
+                opt = self.ident().upper()
+                self.expect_sym("=")
+                v = self.literal()
+                if opt == "PASSWORD":
+                    password = str(v)
+                elif opt == "LOGIN":
+                    can_login = bool(v)
+                elif opt == "SUPERUSER":
+                    superuser = bool(v)
+                else:
+                    raise InvalidArgument(f"unknown role option {opt}")
+                if not self.take_kw("AND"):
+                    break
+        return password, can_login, superuser
+
+    def _grant_revoke(self):
+        grant = self.take_kw("GRANT")
+        if not grant:
+            self.expect_kw("REVOKE")
+        t = self.peek()
+        word = t.text.upper() if t is not None and t.kind == "name" else ""
+        if word in self._PERMS and (
+                self._peek_ahead_kw(1, "ON", "PERMISSION", "PERMISSIONS")):
+            perm = self.ident().upper()
+            self.take_kw("PERMISSION") or self.take_kw("PERMISSIONS")
+            self.expect_kw("ON")
+            resource = self._auth_resource()
+            self.expect_kw("TO" if grant else "FROM")
+            return ast.GrantRevokePermission(grant, perm, resource,
+                                             self.ident())
+        role = self.ident()
+        self.expect_kw("TO" if grant else "FROM")
+        return ast.GrantRevokeRole(grant, role, self.ident())
+
+    def _peek_ahead_kw(self, n: int, *kws) -> bool:
+        t = self.toks[self.i + n] if self.i + n < len(self.toks) else None
+        return (t is not None and t.kind == "name"
+                and t.text.upper() in kws)
+
+    def _auth_resource(self) -> str:
+        if self.take_kw("ALL"):
+            if self.take_kw("KEYSPACES"):
+                return "data"
+            self.expect_kw("ROLES")
+            return "roles"
+        if self.take_kw("KEYSPACE"):
+            return f"data/{self.ident()}"
+        if self.take_kw("ROLE"):
+            return f"roles/{self.ident()}"
+        self.take_kw("TABLE")
+        name = self.qualified_name()
+        if "." in name:
+            ks, table = name.split(".", 1)
+            return f"data/{ks}/{table}"
+        return f"data//{name}"   # keyspace resolved by the processor
+
+    def _list(self):
+        self.expect_kw("LIST")
+        if self.take_kw("ROLES"):
+            return ast.ListRoles()
+        self.take_kw("ALL")
+        self.expect_kw("PERMISSIONS")
+        return ast.ListPermissions()
+
     def _alter(self):
-        """ALTER TABLE t ADD col type | DROP col | RENAME a TO b."""
+        """ALTER TABLE t ... | ALTER ROLE r WITH ..."""
         self.expect_kw("ALTER")
+        if self.take_kw("ROLE"):
+            name = self.ident()
+            password, can_login, superuser = self._role_options()
+            return ast.AlterRole(name, password, can_login, superuser)
         self.expect_kw("TABLE")
         name = self.qualified_name()
         if self.take_kw("ADD"):
@@ -257,6 +336,12 @@ class Parser:
 
     def _create(self):
         self.expect_kw("CREATE")
+        if self.take_kw("ROLE"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            password, can_login, superuser = self._role_options()
+            return ast.CreateRole(name, password,
+                                  bool(can_login), bool(superuser), ine)
         if self.take_kw("KEYSPACE", "SCHEMA"):
             ine = self._if_not_exists()
             name = self.ident()
@@ -348,6 +433,9 @@ class Parser:
 
     def _drop(self):
         self.expect_kw("DROP")
+        if self.take_kw("ROLE"):
+            ie = self._if_exists()
+            return ast.DropRole(self.ident(), ie)
         if self.take_kw("KEYSPACE", "SCHEMA"):
             ie = self._if_exists()
             return ast.DropKeyspace(self.ident(), ie)

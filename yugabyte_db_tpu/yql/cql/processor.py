@@ -81,8 +81,17 @@ class LocalCluster:
         self.fsync = fsync
         self.clock = HybridClock()
         self.tables: dict[str, TableHandle] = {}
+        from yugabyte_db_tpu.auth import RoleStore
+
+        self._auth = RoleStore()
         if engine == "tpu":
             import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401
+
+    def auth_store(self):
+        return self._auth
+
+    def auth_op(self, op: dict) -> None:
+        self._auth.apply(op)
 
     def create_table(self, name: str, schema: Schema,
                      num_tablets: int | None = None) -> TableHandle:
@@ -164,13 +173,26 @@ class LocalCluster:
 
 # -- the processor -----------------------------------------------------------
 
-class QLProcessor:
-    """One CQL session: keyspace state + statement execution."""
+class Unauthorized(Exception):
+    """Role lacks the permission a statement requires (fails closed;
+    reference: UnauthorizedException from the CQL analyzer)."""
 
-    def __init__(self, cluster: LocalCluster):
+
+class QLProcessor:
+    """One CQL session: keyspace state + statement execution.
+
+    ``login_role`` is the authenticated role (set by the wire server's
+    auth handshake). Enforcement is active when the
+    ``use_cassandra_authentication`` flag is on: every statement then
+    requires the matching permission on its resource, checked against
+    the cluster's replicated role store (fails closed; reference:
+    enforcement in the CQL analyzer against the auth vtables)."""
+
+    def __init__(self, cluster: LocalCluster, login_role: str | None = None):
         self.cluster = cluster
         self.keyspace = "default"
         self.keyspaces = {"default", "system"}
+        self.login_role = login_role
 
     # -- entry points ------------------------------------------------------
     def execute(self, sql, params: list | None = None,
@@ -185,6 +207,7 @@ class QLProcessor:
         self._params = params or []
         self._page_size = page_size
         self._paging_state = paging_state
+        self._enforce(stmt)
         fn = {
             ast.CreateKeyspace: self._exec_create_keyspace,
             ast.DropKeyspace: self._exec_drop_keyspace,
@@ -199,8 +222,134 @@ class QLProcessor:
             ast.Delete: self._exec_delete,
             ast.Select: self._exec_select,
             ast.Batch: self._exec_batch,
+            ast.CreateRole: self._exec_create_role,
+            ast.AlterRole: self._exec_alter_role,
+            ast.DropRole: self._exec_drop_role,
+            ast.GrantRevokeRole: self._exec_grant_revoke_role,
+            ast.GrantRevokePermission: self._exec_grant_revoke_perm,
+            ast.ListRoles: self._exec_list_roles,
+            ast.ListPermissions: self._exec_list_permissions,
         }[type(stmt)]
         return fn(stmt)
+
+    # -- authorization -----------------------------------------------------
+    def _table_resource(self, name: str) -> str:
+        ks, table = self._qualify(name).split(".", 1)
+        return f"data/{ks}/{table}"
+
+    def _stmt_permission(self, stmt):
+        """(permission, resource) a statement requires, or None."""
+        if isinstance(stmt, ast.Select):
+            return ("SELECT", self._table_resource(stmt.table))
+        if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
+            return ("MODIFY", self._table_resource(stmt.table))
+        if isinstance(stmt, ast.Batch):
+            for s in stmt.statements:
+                self._check_perm(*self._stmt_permission(s))
+            return None
+        if isinstance(stmt, ast.CreateTable):
+            ks = self._qualify(stmt.name).split(".", 1)[0]
+            return ("CREATE", f"data/{ks}")
+        if isinstance(stmt, ast.DropTable):
+            return ("DROP", self._table_resource(stmt.name))
+        if isinstance(stmt, ast.AlterTable):
+            return ("ALTER", self._table_resource(stmt.name))
+        if isinstance(stmt, ast.CreateIndex):
+            return ("ALTER", self._table_resource(stmt.table))
+        if isinstance(stmt, ast.DropIndex):
+            return ("ALTER", "data")
+        if isinstance(stmt, ast.CreateKeyspace):
+            return ("CREATE", "data")
+        if isinstance(stmt, ast.DropKeyspace):
+            return ("DROP", f"data/{stmt.name}")
+        if isinstance(stmt, (ast.CreateRole, ast.AlterRole, ast.DropRole,
+                             ast.GrantRevokeRole,
+                             ast.GrantRevokePermission)):
+            return ("AUTHORIZE", "roles")
+        return None  # USE, LIST: any authenticated role
+
+    def _enforce(self, stmt) -> None:
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        if not FLAGS.get("use_cassandra_authentication"):
+            return
+        if self.login_role is None:
+            raise Unauthorized("not authenticated")
+        need = self._stmt_permission(stmt)
+        if need is not None:
+            self._check_perm(*need)
+
+    def _check_perm(self, perm: str, resource: str) -> None:
+        if not self.cluster.auth_store().authorize(
+                self.login_role, perm, resource):
+            raise Unauthorized(
+                f"role {self.login_role} has no {perm} permission on "
+                f"{resource}")
+
+    # -- role DDL ----------------------------------------------------------
+    def _exec_create_role(self, stmt: ast.CreateRole):
+        from yugabyte_db_tpu import auth as A
+
+        op = {"op": "auth_create_role", "name": stmt.name,
+              "can_login": stmt.can_login, "superuser": stmt.superuser,
+              "salted_hash": (A.hash_password(stmt.password)
+                              if stmt.password is not None else "")}
+        try:
+            self.cluster.auth_op(op)
+        except (AlreadyPresent, InvalidArgument):
+            if not stmt.if_not_exists:
+                raise
+        return None
+
+    def _exec_alter_role(self, stmt: ast.AlterRole):
+        from yugabyte_db_tpu import auth as A
+
+        op = {"op": "auth_alter_role", "name": stmt.name}
+        if stmt.password is not None:
+            op["salted_hash"] = A.hash_password(stmt.password)
+        if stmt.can_login is not None:
+            op["can_login"] = stmt.can_login
+        if stmt.superuser is not None:
+            op["superuser"] = stmt.superuser
+        self.cluster.auth_op(op)
+        return None
+
+    def _exec_drop_role(self, stmt: ast.DropRole):
+        try:
+            self.cluster.auth_op({"op": "auth_drop_role",
+                                  "name": stmt.name})
+        except (NotFound, InvalidArgument):
+            if not stmt.if_exists:
+                raise
+        return None
+
+    def _exec_grant_revoke_role(self, stmt: ast.GrantRevokeRole):
+        self.cluster.auth_op({
+            "op": "auth_grant_role" if stmt.grant else "auth_revoke_role",
+            "role": stmt.role, "member": stmt.member})
+        return None
+
+    def _exec_grant_revoke_perm(self, stmt: ast.GrantRevokePermission):
+        resource = stmt.resource
+        if resource.startswith("data//"):
+            # unqualified table: resolve against the session keyspace
+            resource = f"data/{self.keyspace}/{resource[len('data//'):]}"
+        self.cluster.auth_op({
+            "op": "auth_grant_perm" if stmt.grant else "auth_revoke_perm",
+            "role": stmt.role, "resource": resource,
+            "perm": stmt.permission})
+        return None
+
+    def _exec_list_roles(self, _stmt):
+        rows = [(r.name, r.can_login, r.superuser,
+                 sorted(r.member_of))
+                for r in self.cluster.auth_store().list_roles()]
+        return ResultSet(["role", "can_login", "is_superuser",
+                          "member_of"], rows)
+
+    def _exec_list_permissions(self, _stmt):
+        return ResultSet(["role", "resource", "permission"],
+                         self.cluster.auth_store().list_perms())
 
     # -- name resolution ---------------------------------------------------
     def _qualify(self, name: str) -> str:

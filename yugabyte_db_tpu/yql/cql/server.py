@@ -24,7 +24,8 @@ from yugabyte_db_tpu.utils.status import (AlreadyPresent, InvalidArgument,
 from yugabyte_db_tpu.yql.cql import ast
 from yugabyte_db_tpu.yql.cql import wire_protocol as W
 from yugabyte_db_tpu.yql.cql.parser import Parser
-from yugabyte_db_tpu.yql.cql.processor import QLProcessor, ResultSet
+from yugabyte_db_tpu.yql.cql.processor import (QLProcessor, ResultSet,
+                                               Unauthorized)
 
 
 class CQLConnectionContext(ConnectionContext):
@@ -88,9 +89,34 @@ class CQLServiceImpl:
     # -- frame dispatch ------------------------------------------------------
     def handle_call(self, processor: QLProcessor, stream: int, opcode: int,
                     body: bytes) -> bytes:
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
         try:
             if opcode == W.OP_STARTUP:
+                if FLAGS.get("use_cassandra_authentication"):
+                    w = W.Writer()
+                    w.string("org.apache.cassandra.auth."
+                             "PasswordAuthenticator")
+                    return W.frame(W.OP_AUTHENTICATE, stream, w.getvalue())
                 return W.frame(W.OP_READY, stream, b"")
+            if opcode == W.OP_AUTH_RESPONSE:
+                # SASL PLAIN token: \x00<user>\x00<password>.
+                token = W.Reader(body).bytes_() or b""
+                parts = token.split(b"\x00")
+                if len(parts) != 3:
+                    return W.error_frame(stream, W.ERR_PROTOCOL,
+                                         "malformed auth token")
+                user = parts[1].decode("utf-8", "surrogateescape")
+                password = parts[2].decode("utf-8", "surrogateescape")
+                if not processor.cluster.auth_store().check_login(
+                        user, password):
+                    return W.error_frame(
+                        stream, W.ERR_BAD_CREDENTIALS,
+                        "Provided username or password is incorrect")
+                processor.login_role = user
+                w = W.Writer()
+                w.bytes_(None)
+                return W.frame(W.OP_AUTH_SUCCESS, stream, w.getvalue())
             if opcode == W.OP_OPTIONS:
                 w = W.Writer()
                 w.short(2)
@@ -109,6 +135,8 @@ class CQLServiceImpl:
                                  f"unsupported opcode {opcode:#x}")
         except InvalidArgument as e:
             return W.error_frame(stream, W.ERR_INVALID, str(e))
+        except Unauthorized as e:
+            return W.error_frame(stream, W.ERR_UNAUTHORIZED, str(e))
         except AlreadyPresent as e:
             return W.error_frame(stream, W.ERR_ALREADY_EXISTS, str(e))
         except NotFound as e:

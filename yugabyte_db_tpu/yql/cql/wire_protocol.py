@@ -31,6 +31,9 @@ OP_PREPARE = 0x09
 OP_EXECUTE = 0x0A
 OP_REGISTER = 0x0B
 OP_EVENT = 0x0C
+OP_AUTH_CHALLENGE = 0x0E
+OP_AUTH_RESPONSE = 0x0F
+OP_AUTH_SUCCESS = 0x10
 
 # RESULT kinds (§4.2.5)
 RESULT_VOID = 0x0001
@@ -42,6 +45,8 @@ RESULT_SCHEMA_CHANGE = 0x0005
 # Error codes (§9)
 ERR_SERVER = 0x0000
 ERR_PROTOCOL = 0x000A
+ERR_BAD_CREDENTIALS = 0x0100
+ERR_UNAUTHORIZED = 0x2100
 ERR_INVALID = 0x2200
 ERR_ALREADY_EXISTS = 0x2400
 ERR_UNPREPARED = 0x2500

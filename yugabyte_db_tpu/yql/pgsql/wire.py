@@ -45,6 +45,11 @@ def auth_ok() -> bytes:
     return _msg(b"R", _U32.pack(0))
 
 
+def auth_cleartext_password() -> bytes:
+    """AuthenticationCleartextPassword (R, code 3)."""
+    return _msg(b"R", _U32.pack(3))
+
+
 def parameter_status(key: str, value: str) -> bytes:
     return _msg(b"S", key.encode() + b"\x00" + value.encode() + b"\x00")
 
@@ -193,17 +198,46 @@ class PgServiceImpl:
     def __init__(self, cluster):
         self.cluster = cluster
 
+    @staticmethod
+    def _session_ready() -> bytes:
+        return (parameter_status("server_version", "11.2-yb-tpu")
+                + parameter_status("client_encoding", "UTF8")
+                + parameter_status("integer_datetimes", "on")
+                + ready_for_query())
+
     def handle(self, _method: str, call) -> bytes:
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
         ctx, kind, payload = call
         if kind == "ssl":
             return b"N"  # SSL refused; client retries in cleartext
         if kind == "startup":
+            if FLAGS.get("ysql_require_auth"):
+                # Cleartext-password handshake (reference: pg_hba
+                # password auth); the role must exist with LOGIN and a
+                # matching password in the replicated role store.
+                ctx.pending_user = payload.get("user", "")
+                return auth_cleartext_password()
             ctx.session = PgProcessor(self.cluster)
-            return (auth_ok()
-                    + parameter_status("server_version", "11.2-yb-tpu")
-                    + parameter_status("client_encoding", "UTF8")
-                    + parameter_status("integer_datetimes", "on")
-                    + ready_for_query())
+            return auth_ok() + self._session_ready()
+        if kind == "p":  # PasswordMessage
+            user = getattr(ctx, "pending_user", None)
+            if user is None or ctx.session is not None:
+                return error_response("unexpected password message",
+                                      "08P01")
+            password = payload.rstrip(b"\x00").decode(
+                "utf-8", "surrogateescape")
+            store = getattr(self.cluster, "auth_store", None)
+            if store is None or not store().check_login(user, password):
+                return error_response(
+                    f'password authentication failed for user "{user}"',
+                    "28P01")
+            ctx.session = PgProcessor(self.cluster)
+            ctx.session.login_role = user
+            return auth_ok() + self._session_ready()
+        if ctx.session is None and kind == "Q":
+            return error_response("not authenticated", "28000") \
+                + ready_for_query()
         if kind == "Q":
             return self._query(ctx, payload)
         if kind == "X":
