@@ -1,0 +1,129 @@
+"""CQL system vtables: the stock-driver handshake sequence.
+
+Reference analog: the master's YQLVirtualTable family
+(yql_local_vtable.cc, yql_peers_vtable.cc, yql_keyspaces_vtable.cc,
+yql_tables_vtable.cc, yql_columns_vtable.cc). A Cassandra driver's
+connect sequence is: query system.local, system.peers, then the
+system_schema tables to build its metadata — these tests replay that
+exact sequence over the real wire protocol.
+"""
+
+import pytest
+
+from tests.test_cql_wire import WireClient
+from yugabyte_db_tpu.integration import MiniCluster
+from yugabyte_db_tpu.yql.cql import wire_protocol as W
+from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+from yugabyte_db_tpu.yql.cql.processor import LocalCluster, QLProcessor
+from yugabyte_db_tpu.yql.cql.server import CQLServer
+
+
+@pytest.fixture
+def wire(tmp_path):
+    cluster = LocalCluster(num_tablets=2)
+    server = CQLServer(cluster)
+    host, port = server.listen("127.0.0.1", 0)
+    cli = WireClient(host, port)
+    cli.startup()
+    yield cli
+    cli.close()
+    server.shutdown()
+
+
+def _text_cell(b):
+    return None if b is None else b.decode()
+
+
+def test_driver_handshake_sequence(wire):
+    cli = wire
+    # Schema the driver will discover.
+    cli.query("CREATE TABLE users (id INT, r BIGINT, name TEXT, "
+              "score DOUBLE, PRIMARY KEY ((id), r))")
+
+    # 1. system.local — one row, the handshake's first read.
+    cols, rows, _p = cli.query("SELECT * FROM system.local")
+    names = [c[0] for c in cols]
+    assert len(rows) == 1
+    local = dict(zip(names, rows[0]))
+    assert _text_cell(local["key"]) == "local"
+    assert _text_cell(local["cql_version"]) == "3.4.4"
+    assert _text_cell(local["partitioner"]).endswith("Murmur3Partitioner")
+    for required in ("cluster_name", "data_center", "rack", "host_id",
+                     "release_version", "rpc_address", "tokens",
+                     "native_protocol_version", "schema_version"):
+        assert required in names, required
+
+    # 2. system.peers — valid result (empty for a single node) with the
+    #    column set the driver reads.
+    cols, rows, _p = cli.query("SELECT * FROM system.peers")
+    names = [c[0] for c in cols]
+    for required in ("peer", "rpc_address", "data_center", "rack",
+                     "host_id", "tokens"):
+        assert required in names, required
+
+    # 3. schema metadata.
+    cols, rows, _p = cli.query("SELECT keyspace_name FROM "
+                               "system_schema.keyspaces")
+    keyspaces = {_text_cell(r[0]) for r in rows}
+    assert {"default", "system", "system_schema"} <= keyspaces
+
+    cols, rows, _p = cli.query(
+        "SELECT keyspace_name, table_name FROM system_schema.tables "
+        "WHERE keyspace_name = 'default'")
+    tables = {(_text_cell(r[0]), _text_cell(r[1])) for r in rows}
+    assert ("default", "users") in tables
+
+    cols, rows, _p = cli.query(
+        "SELECT column_name, kind, position, type FROM "
+        "system_schema.columns WHERE keyspace_name = 'default' AND "
+        "table_name = 'users'")
+    got = {_text_cell(r[0]): (_text_cell(r[1]), _text_cell(r[3]))
+           for r in rows}
+    assert got["id"] == ("partition_key", "int")
+    assert got["r"] == ("clustering", "bigint")
+    assert got["name"] == ("regular", "text")
+    assert got["score"] == ("regular", "double")
+
+
+def test_vtable_count_and_limit(wire):
+    cli = wire
+    cols, rows, _p = cli.query("SELECT count(*) FROM system.peers")
+    assert [c[0] for c in cols] == ["count"]
+    cli.query("CREATE TABLE t1 (k INT, PRIMARY KEY (k))")
+    cli.query("CREATE TABLE t2 (k INT, PRIMARY KEY (k))")
+    _c, rows, _p = cli.query(
+        "SELECT table_name FROM system_schema.tables LIMIT 1")
+    assert len(rows) == 1
+
+
+def test_peers_reflect_distributed_tservers(tmp_path):
+    mc = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3).start()
+    try:
+        mc.wait_tservers_registered()
+        p = QLProcessor(ClientCluster(mc.client()))
+        res = p.execute("SELECT peer, rpc_address FROM system.peers")
+        # 3 tservers -> this node + 2 peers.
+        assert len(res.rows) == 2
+    finally:
+        mc.shutdown()
+
+
+def test_vtables_readable_without_table_permission():
+    """Handshake must work for ANY authenticated role (no grants)."""
+    from yugabyte_db_tpu.auth import hash_password
+    from yugabyte_db_tpu.utils.flags import FLAGS
+
+    FLAGS.set("use_cassandra_authentication", True)
+    try:
+        cluster = LocalCluster(num_tablets=2)
+        cluster.auth_op({"op": "auth_create_role", "name": "app",
+                         "can_login": True,
+                         "salted_hash": hash_password("x")})
+        p = QLProcessor(cluster, login_role="app")
+        assert p.execute("SELECT key FROM system.local").rows
+        from yugabyte_db_tpu.yql.cql.processor import Unauthorized
+
+        with pytest.raises(Unauthorized):
+            p.execute("CREATE TABLE t (k INT, PRIMARY KEY (k))")
+    finally:
+        FLAGS.set("use_cassandra_authentication", False)

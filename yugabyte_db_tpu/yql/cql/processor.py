@@ -240,6 +240,12 @@ class QLProcessor:
     def _stmt_permission(self, stmt):
         """(permission, resource) a statement requires, or None."""
         if isinstance(stmt, ast.Select):
+            from yugabyte_db_tpu.yql.cql import vtables
+
+            # Any authenticated role may read the system vtables (the
+            # driver handshake path; Cassandra behaves the same).
+            if vtables.is_virtual(self._qualify(stmt.table)):
+                return None
             return ("SELECT", self._table_resource(stmt.table))
         if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)):
             return ("MODIFY", self._table_resource(stmt.table))
@@ -780,6 +786,10 @@ class QLProcessor:
 
     # -- reads -------------------------------------------------------------
     def _exec_select(self, stmt: ast.Select):
+        from yugabyte_db_tpu.yql.cql import vtables
+
+        if vtables.is_virtual(self._qualify(stmt.table)):
+            return vtables.virtual_select(self, stmt)
         handle = self.cluster.table(self._qualify(stmt.table))
         schema = handle.schema
         plan = self._plan_select(handle, stmt)
