@@ -192,6 +192,20 @@ def decode_doc_key(buf: bytes) -> tuple[int | None, list, list]:
     return hash_code, hashed, ranges
 
 
+def hashed_prefix(buf: bytes) -> bytes:
+    """The hashed-components section of an encoded key, INCLUDING its
+    terminating GROUP_END — the unit the run bloom filters key on
+    (reference: DocDbAwareFilterPolicy's hash-prefix extraction,
+    src/yb/docdb/doc_key.h:551-575). b'' for range-partitioned keys
+    (no hash section -> filter does not apply)."""
+    if not buf or buf[0] != TAG_HASH:
+        return b""
+    pos = 3
+    while pos < len(buf) and buf[pos] != GROUP_END:
+        _v, pos = decode_key_component(buf, pos)
+    return bytes(buf[:pos + 1])
+
+
 def prefix_successor(prefix: bytes) -> bytes:
     """Smallest byte string greater than every string with this prefix.
 

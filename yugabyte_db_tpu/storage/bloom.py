@@ -1,0 +1,48 @@
+"""Hashed-prefix bloom filter for sorted-run pruning.
+
+Reference analog: DocDbAwareFilterPolicy — the RocksDB fork blooms on
+the DocKey *hashed-components prefix* only (src/yb/docdb/doc_key.h:
+551-575, boundary extraction in doc_boundary_values_extractor.cc), so a
+point get (or any scan bounded within one primary key's hash section)
+can skip SSTables that cannot contain the key. Here the filter is a
+plain numpy bit array per ColumnarRun, rebuilt from host-resident keys
+on load (no persistence needed — construction is one hash per distinct
+key group).
+
+Double hashing (Kirsch–Mitzenmacher): two 64-bit halves of one
+blake2b digest generate all k probe positions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+BITS_PER_KEY = 10   # ~1% false-positive rate at k=7
+NUM_PROBES = 7
+
+
+class BloomFilter:
+    __slots__ = ("m", "bits")
+
+    def __init__(self, n_items: int):
+        self.m = max(64, n_items * BITS_PER_KEY)
+        self.bits = np.zeros((self.m + 63) // 64, dtype=np.uint64)
+
+    def _probes(self, data: bytes):
+        d = hashlib.blake2b(data, digest_size=16).digest()
+        h1 = int.from_bytes(d[:8], "little")
+        h2 = int.from_bytes(d[8:], "little") | 1
+        m = self.m
+        return [((h1 + i * h2) % m) for i in range(NUM_PROBES)]
+
+    def add(self, data: bytes) -> None:
+        for p in self._probes(data):
+            self.bits[p >> 6] |= np.uint64(1 << (p & 63))
+
+    def may_contain(self, data: bytes) -> bool:
+        for p in self._probes(data):
+            if not (int(self.bits[p >> 6]) >> (p & 63)) & 1:
+                return False
+        return True

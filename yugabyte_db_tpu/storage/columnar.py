@@ -109,6 +109,9 @@ class ColumnarRun:
         self._kv_cols: list[np.ndarray] | None = None
         self._kv_blocks_done: set[int] = set()
         self.kv_ready = False  # True once every block's keys are decoded
+        # Hashed-prefix bloom (storage.bloom): None = not built yet,
+        # True = inapplicable (range-partitioned keys present).
+        self._hash_bloom = None
         self._kv_lock = threading.Lock()
 
     # -- construction ------------------------------------------------------
@@ -434,6 +437,43 @@ class ColumnarRun:
             if len(self._kv_blocks_done) == self.B:
                 self.kv_ready = True
         return cols
+
+    # -- run pruning (hashed-prefix bloom) ----------------------------------
+    def may_contain_hashed(self, prefix: bytes) -> bool:
+        """Can this run contain any key with the given hashed-components
+        prefix? False lets point gets / single-key scans skip the run
+        entirely (reference: DocDbAwareFilterPolicy,
+        src/yb/docdb/doc_key.h:551-575). Never a false negative."""
+        bl = self._hash_bloom
+        if bl is None:
+            bl = self._build_hash_bloom()
+        if bl is True:
+            return True
+        return bl.may_contain(prefix)
+
+    def _build_hash_bloom(self):
+        from yugabyte_db_tpu.models.encoding import hashed_prefix
+        from yugabyte_db_tpu.storage.bloom import BloomFilter
+
+        with self._kv_lock:
+            if self._hash_bloom is not None:
+                return self._hash_bloom
+            bl = BloomFilter(self.num_versions or 1)
+            last = None
+            for b in range(self.B):
+                n = self.blocks[b].num_valid
+                rk = self.row_keys[b]
+                for r in range(n):
+                    key = rk[r]
+                    hp = hashed_prefix(key)
+                    if not hp:
+                        self._hash_bloom = True  # filter inapplicable
+                        return True
+                    if hp != last:
+                        bl.add(hp)
+                        last = hp
+            self._hash_bloom = bl
+            return bl
 
     # -- block pruning -----------------------------------------------------
     def block_range(self, lower: bytes, upper: bytes) -> tuple[int, int]:

@@ -458,14 +458,35 @@ class TpuStorageEngine(StorageEngine):
         }
 
     # -- scan plumbing ------------------------------------------------------
+    @staticmethod
+    def _prune_prefix(spec: ScanSpec) -> bytes | None:
+        """The hashed-components prefix shared by EVERY key in the scan
+        range, or None. Present for point gets and single-primary-key
+        range scans — the shapes the per-run bloom prunes."""
+        if not spec.lower or not spec.upper:
+            return None
+        from yugabyte_db_tpu.models.encoding import (hashed_prefix,
+                                                     prefix_successor)
+
+        hp = hashed_prefix(spec.lower)
+        if not hp:
+            return None
+        ps = prefix_successor(hp)
+        if ps and spec.upper > ps:
+            return None  # range crosses out of the hash section
+        return hp
+
     def _overlapping_runs(self, spec: ScanSpec) -> list[TpuRun]:
         out = []
+        hp = self._prune_prefix(spec)
         for t in self.runs:
             if t.crun.num_versions == 0:
                 continue
             if spec.upper and t.crun.min_key >= spec.upper:
                 continue
             if t.crun.max_key < spec.lower:
+                continue
+            if hp is not None and not t.crun.may_contain_hashed(hp):
                 continue
             out.append(t)
         return out
