@@ -1385,6 +1385,8 @@ class TpuStorageEngine(StorageEngine):
         window and returns two packed vectors (ops.agg_fold) — one dispatch
         plus two small transfers per scan, because the host link pays
         per-transfer latency (see ops/agg_fold.py docstring)."""
+        from yugabyte_db_tpu.ops import flat_fold
+
         crun = trun.crun
         row_lo = crun.lower_row(spec.lower)
         row_hi = crun.upper_row(spec.upper)
@@ -1397,13 +1399,22 @@ class TpuStorageEngine(StorageEngine):
         sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
                             preds=pred_sigs, aggs=dev_aggs, apply_preds=True,
                             flat=crun.max_group_versions <= 1)
-        W = trun.dev.B // K
-        w_first, w_last = agg_fold.window_bounds(row_lo, row_hi, R, K, W)
-        fn = agg_fold.compiled_full_aggregate(sig)
         r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
-        ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo), jnp.int32(row_hi),
-                        jnp.int32(w_first), jnp.int32(w_last),
-                        r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
+        if flat_fold.supports(sig):
+            # Flat run: one fused full-array program (bandwidth-roofline;
+            # ops.flat_fold) instead of the serialized window fold.
+            fn = flat_fold.compiled_flat_aggregate(sig)
+            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
+                            jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
+                            pred_lits)
+        else:
+            W = trun.dev.B // K
+            w_first, w_last = agg_fold.window_bounds(row_lo, row_hi, R, K, W)
+            fn = agg_fold.compiled_full_aggregate(sig)
+            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
+                            jnp.int32(row_hi),
+                            jnp.int32(w_first), jnp.int32(w_last),
+                            r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
 
         def finish(f):
             iv, fv = f
