@@ -245,21 +245,28 @@ class RaftConsensus:
         self.wait_applied(entry.op_id, timeout)
         return entry
 
-    def append_leader(self, op_type: str, body, ht: int | None = None) -> LogEntry:
+    def append_leader(self, op_type: str, body, ht: int | None = None,
+                      decoded_rows=None) -> LogEntry:
         """Leader append + durability, without waiting for commit. Callers
-        that need the outcome follow with wait_applied()."""
+        that need the outcome follow with wait_applied().
+        ``decoded_rows`` rides on the in-memory entry so the leader's own
+        apply skips re-decoding the body (followers decode from wire)."""
         with self._lock:
-            entry = self._leader_append_locked(op_type, body, ht)
+            entry = self._leader_append_locked(op_type, body, ht,
+                                               decoded_rows)
         self._ensure_durable(entry.op_id.index)
         return entry
 
-    def _leader_append_locked(self, op_type: str, body, ht: int | None) -> LogEntry:
+    def _leader_append_locked(self, op_type: str, body, ht: int | None,
+                              decoded_rows=None) -> LogEntry:
         if self._role != Role.LEADER:
             raise NotLeader(self.uuid, self._leader_uuid)
         if ht is None:
             ht = self.clock.now().value
         entry = LogEntry(OpId(self.cmeta.current_term, self._last_index + 1),
                          ht, op_type, body, self._commit_index)
+        if decoded_rows is not None:
+            entry.decoded_rows = decoded_rows
         # No fsync under the lock: durability is established by
         # _ensure_durable OUTSIDE it, and the entry only counts toward the
         # majority (self's match = _durable_index) once synced. Concurrent

@@ -98,6 +98,11 @@ class Messenger:
         self._sel = selectors.DefaultSelector()
         self._pool = ThreadPoolExecutor(max_workers=num_workers,
                                         thread_name_prefix=f"{name}-svc")
+        # Dedicated per-service pools (reference: one ServicePool per
+        # service, service_pool.cc). Without them a worker pool full of
+        # user writes BLOCKED on majority replication starves the very
+        # consensus RPCs that would unblock them.
+        self._service_pools: list[tuple[str, ThreadPoolExecutor]] = []
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._sel.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
@@ -198,7 +203,8 @@ class Messenger:
                         self._pool.submit(self._drain_ordered, conn)
                 else:
                     for call in calls:
-                        self._pool.submit(self._dispatch, conn, call)
+                        self._pool_for(call[1]).submit(
+                            self._dispatch, conn, call)
         if mask & selectors.EVENT_WRITE:
             self._try_write(conn)
 
@@ -233,6 +239,20 @@ class Messenger:
             return
         if out:
             self.send_on(conn, out)
+
+    def add_service_pool(self, prefix: str, num_workers: int) -> None:
+        """Route native-protocol methods starting with ``prefix`` onto a
+        dedicated worker pool."""
+        self._service_pools.append((prefix, ThreadPoolExecutor(
+            max_workers=num_workers,
+            thread_name_prefix=f"{self.name}-{prefix.rstrip('.')}")))
+
+    def _pool_for(self, method) -> ThreadPoolExecutor:
+        if self._service_pools and isinstance(method, str):
+            for prefix, pool in self._service_pools:
+                if method.startswith(prefix):
+                    return pool
+        return self._pool
 
     def send_on(self, conn: _Connection, data: bytes) -> None:
         """Queue bytes on a connection (thread-safe; used by workers and by
@@ -294,6 +314,8 @@ class Messenger:
         self._wake()
         self._thread.join(timeout=5.0)
         self._pool.shutdown(wait=False, cancel_futures=True)
+        for _prefix, pool in self._service_pools:
+            pool.shutdown(wait=False, cancel_futures=True)
         self._sel.close()
         self._wake_r.close()
         self._wake_w.close()

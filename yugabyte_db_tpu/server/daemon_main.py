@@ -69,7 +69,12 @@ def main(argv=None) -> int:
         daemon = TabletServer(args.uuid, args.data_dir, transport,
                               master_uuids, fsync=not args.no_fsync,
                               engine_options=None)
-    messenger = Messenger(args.uuid)
+    messenger = Messenger(args.uuid, num_workers=16)
+    # Consensus traffic rides a dedicated pool: user writes block their
+    # workers on majority replication, and the raft RPCs that complete
+    # that majority must never queue behind them (reference: separate
+    # ServicePools per service, src/yb/rpc/service_pool.cc).
+    messenger.add_service_pool("raft.", 8)
     bound = messenger.listen(host, port, daemon.handle)
     daemon.advertised_addr = bound
     daemon.start()

@@ -16,22 +16,34 @@ from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
 # -- rows -------------------------------------------------------------------
 
 def encode_rows(rows: list[RowVersion]) -> list:
+    # Column ids ride as INT map keys (the codec supports any scalar
+    # key); decode_rows accepts the legacy str-keyed form from older WAL
+    # segments.
     return [
-        [r.key, r.ht, r.tombstone, r.liveness,
-         {str(c): v for c, v in r.columns.items()}, r.expire_ht, r.ttl_us,
-         r.write_id, {str(c): v for c, v in r.increments.items()}]
+        [r.key, r.ht, r.tombstone, r.liveness, r.columns, r.expire_ht,
+         r.ttl_us, r.write_id, r.increments or None]
         for r in rows
     ]
+
+
+def _int_keys(d: dict) -> dict:
+    if not d:
+        return {}
+    for k in d:  # all-int fast path: no per-entry rebuild
+        if not isinstance(k, int):
+            return {int(c): v for c, v in d.items()}
+        break
+    return d
 
 
 def decode_rows(body: list) -> list[RowVersion]:
     return [
         RowVersion(rec[0], ht=rec[1], tombstone=rec[2], liveness=rec[3],
-                   columns={int(c): v for c, v in rec[4].items()},
+                   columns=_int_keys(rec[4]),
                    expire_ht=rec[5],
                    ttl_us=rec[6] if len(rec) > 6 else None,
                    write_id=rec[7] if len(rec) > 7 else 0,
-                   increments={int(c): v for c, v in rec[8].items()}
+                   increments=_int_keys(rec[8])
                    if len(rec) > 8 and rec[8] else {})
         for rec in body
     ]

@@ -159,14 +159,21 @@ class Tablet:
         """Apply a "write" entry; bodies are either the legacy raw row
         list or {"rows":..., "rid":[client_id, request_id]} — the rid is
         recorded for exactly-once retry dedup (retryable.py)."""
+        # Leader fast path: the writer attached its already-stamped
+        # RowVersions to the in-memory entry (tablet_peer.write), so the
+        # leader's apply skips the wire round trip; followers and WAL
+        # replay decode from the body.
+        decoded = getattr(entry, "decoded_rows", None)
         body = entry.body
         if isinstance(body, dict):
-            self.engine.apply(_decode_rows(body["rows"]))
+            self.engine.apply(decoded if decoded is not None
+                              else _decode_rows(body["rows"]))
             rid = body.get("rid")
             if rid:
                 self.retryable.record(rid[0], rid[1], entry.ht)
         else:
-            self.engine.apply(_decode_rows(body))
+            self.engine.apply(decoded if decoded is not None
+                              else _decode_rows(body))
 
     def _apply_txn_op(self, entry) -> None:
         """Apply transaction ops (intents / commit-apply / abort-remove /

@@ -52,6 +52,11 @@ class TabletPeer:
         # plant intents (the reference holds its SharedLockManager batch
         # across the whole doc-write, shared_lock_manager.h).
         self._intent_lock = threading.Lock()
+        # (client_id, request_id) -> (op_id, ht) of an APPENDED but not
+        # yet applied write: a racing retry waits on the original entry
+        # instead of appending a duplicate (the admission lock no longer
+        # spans the majority wait).
+        self._inflight_rids: dict = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -77,13 +82,25 @@ class TabletPeer:
 
         A (client_id, request_id) pair makes the write EXACTLY-ONCE under
         client retries: a replayed id returns the original write's hybrid
-        time without re-applying (retryable_requests.h:34). Callers must
-        serialize writes sharing an id (the tserver's write handler holds
-        the intent-admission lock across the check + append). Writes also
-        require leader_ready() — an own-term entry applied — which
-        guarantees every prior-term entry (including any original of a
-        retried id) has already applied into the dedup registry before a
-        new leader accepts writes."""
+        time without re-applying (retryable_requests.h:34). Admission
+        (dedup check + stamp + append) and completion (majority wait)
+        are split so the tserver's intent-admission lock covers ONLY
+        admission — concurrent writes to one tablet pipeline through one
+        replication round instead of serializing on full commit latency
+        (reference: leader-side batching, src/yb/tablet/preparer.cc).
+        Writes also require leader_ready() — an own-term entry applied —
+        which guarantees every prior-term entry (including any original
+        of a retried id) has already applied into the dedup registry
+        before a new leader accepts writes."""
+        admitted = self.write_admit(rows, client_id, request_id)
+        return self.write_finish(admitted, timeout)
+
+    def write_admit(self, rows: list[RowVersion],
+                    client_id: str | None = None,
+                    request_id: int | None = None):
+        """Admission phase. The CALLER serializes admissions for one
+        tablet (the tserver holds the intent-admission lock across this
+        call). Returns an opaque token for write_finish."""
         if not (self.raft.is_leader() and self.raft.leader_ready()):
             raise NotLeader(self.node_uuid, self.raft.leader_uuid())
         if any(r.increments for r in rows):
@@ -93,10 +110,17 @@ class TabletPeer:
             raise ValueError("unresolved counter increments; route the "
                              "write through the tserver handler")
         rid = None
+        rid_key = None
         if client_id is not None and request_id is not None:
             prev = self.tablet.retryable.seen(client_id, request_id)
             if prev is not None:
-                return HybridTime(prev)  # duplicate retry: original result
+                return ("dup", HybridTime(prev))  # replay: original result
+            rid_key = (client_id, request_id)
+            inflight = self._inflight_rids.get(rid_key)
+            if inflight is not None:
+                # A retry raced its in-flight original (timeout + resend):
+                # wait on the ORIGINAL entry, never append a second copy.
+                return ("inflight",) + inflight
             rid = [client_id, request_id]
         ht = self.tablet.clock.now()
         TRACE("write: %d row(s) stamped at ht=%d", len(rows), ht.value)
@@ -110,26 +134,48 @@ class TabletPeer:
         try:
             body = ({"rows": _encode_rows(stamped), "rid": rid}
                     if rid else _encode_rows(stamped))
-            entry = self.raft.append_leader("write", body, ht=ht.value)
+            entry = self.raft.append_leader("write", body, ht=ht.value,
+                                            decoded_rows=stamped)
             TRACE("write: appended %d.%d", entry.op_id.term,
                   entry.op_id.index)
         except BaseException:
             self.tablet.mvcc.aborted(ht)  # never entered the log
             raise
+        if rid_key is not None:
+            self._inflight_rids[rid_key] = (entry.op_id, ht)
+        return ("appended", entry.op_id, ht, rid_key)
+
+    def write_finish(self, admitted, timeout: float = 10.0) -> HybridTime:
+        """Completion phase: wait for commit+apply. Safe to run OUTSIDE
+        the admission lock."""
+        kind = admitted[0]
+        if kind == "dup":
+            return admitted[1]
+        if kind == "inflight":
+            _k, op_id, ht = admitted
+            self.raft.wait_applied(op_id, timeout)
+            return ht
+        _k, op_id, ht, rid_key = admitted
         try:
-            self.raft.wait_applied(entry.op_id, timeout)
+            self.raft.wait_applied(op_id, timeout)
         except NotLeader:
             self.tablet.mvcc.aborted(ht)  # entry truncated: definite abort
+            if rid_key is not None:
+                self._inflight_rids.pop(rid_key, None)
             raise
         except TimeoutError:
             # Outcome UNKNOWN: the entry is in the log and may still commit.
             # The pending HT must stay pinned (a premature abort would let
             # safe_time advance past a write that later commits — a
-            # non-repeatable read). Resolve it in the background.
+            # non-repeatable read). Resolve it in the background. The
+            # in-flight rid entry stays until resolution: a retry must
+            # keep waiting on the original, not append a duplicate.
             threading.Thread(target=self._resolve_unknown_write,
-                             args=(entry.op_id, ht), daemon=True).start()
+                             args=(op_id, ht, rid_key), daemon=True).start()
             raise
         self.tablet.mvcc.replicated(ht)
+        if rid_key is not None:
+            self._inflight_rids.pop(rid_key, None)
         return ht
 
     # -- transaction write path ---------------------------------------------
@@ -208,20 +254,25 @@ class TabletPeer:
             self.tablet.mvcc.replicated(hto)
         return ht
 
-    def _resolve_unknown_write(self, op_id, ht: HybridTime) -> None:
+    def _resolve_unknown_write(self, op_id, ht: HybridTime,
+                               rid_key=None) -> None:
         """Keep a timed-out write's HT pinned until Raft resolves it."""
-        while True:
-            try:
-                self.raft.wait_applied(op_id, timeout=10.0)
-                self.tablet.mvcc.replicated(ht)
-                return
-            except NotLeader:
-                self.tablet.mvcc.aborted(ht)
-                return
-            except TimeoutError:
-                if not self.raft._running:
-                    return  # shutting down; pin dies with the process
-                continue
+        try:
+            while True:
+                try:
+                    self.raft.wait_applied(op_id, timeout=10.0)
+                    self.tablet.mvcc.replicated(ht)
+                    return
+                except NotLeader:
+                    self.tablet.mvcc.aborted(ht)
+                    return
+                except TimeoutError:
+                    if not self.raft._running:
+                        return  # shutting down; pin dies with the process
+                    continue
+        finally:
+            if rid_key is not None:
+                self._inflight_rids.pop(rid_key, None)
 
     def _apply(self, entry) -> None:
         self.tablet.apply_replicated(entry)

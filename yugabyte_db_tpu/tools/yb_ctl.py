@@ -98,7 +98,11 @@ class ClusterCtl:
         log_path = os.path.join(self.data_dir, f"{d['uuid']}.log")
         log = open(log_path, "ab")
         env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # Daemons run the cpu engine: FORCE the cpu backend (override,
+        # not setdefault — the ambient env may pin the real-TPU tunnel,
+        # and N daemons grabbing the single-chip lease would deadlock
+        # the machine's actual TPU user).
+        env["JAX_PLATFORMS"] = "cpu"
         cmd = [sys.executable, "-m",
                "yugabyte_db_tpu.server.daemon_main",
                "--role", d["role"], "--uuid", d["uuid"],

@@ -479,53 +479,80 @@ class TabletServer:
             if err is not None:
                 return err
         keys = [r.key for r in rows]
+        needs_full_lock = bool(p.get("if_not_exists")) or \
+            any(r.increments for r in rows)
         for _attempt in range(3):
+            admitted = None
             with peer._intent_lock:
                 conflicting = peer.tablet.participant.pending_on_keys(keys)
                 if not conflicting:
-                    if p.get("if_not_exists"):
-                        # Atomic uniqueness: the intent-admission lock is
-                        # held across this check AND peer.write's
-                        # append+wait, so a concurrent duplicate insert
-                        # observes the first one applied (SQL INSERT
-                        # semantics; errcode 23505 at the frontend).
-                        if peer.raft.is_leader() and any(
-                                peer.tablet.current_row_values(k)
-                                is not None for k in keys):
-                            return {"code": "duplicate_key"}
-                    if any(r.increments for r in rows):
-                        # counter deltas -> absolutes, atomic under the
-                        # same lock as the append (see resolve_increments)
-                        if not peer.raft.is_leader():
-                            return {"code": "not_leader",
-                                    "leader_hint": peer.raft.leader_uuid()}
+                    if needs_full_lock:
+                        # Read-modify admission (conditional insert /
+                        # counter resolve): the lock must span the check
+                        # AND the append+wait so a concurrent duplicate /
+                        # increment observes the first one applied.
+                        if p.get("if_not_exists"):
+                            if peer.raft.is_leader() and any(
+                                    peer.tablet.current_row_values(k)
+                                    is not None for k in keys):
+                                return {"code": "duplicate_key"}
+                        if any(r.increments for r in rows):
+                            if not peer.raft.is_leader():
+                                return {"code": "not_leader", "leader_hint":
+                                        peer.raft.leader_uuid()}
+                            try:
+                                rows = [peer.tablet.resolve_increments(r)
+                                        for r in rows]
+                            except ValueError as e:
+                                return {"code": "error", "message": str(e)}
                         try:
-                            rows = [peer.tablet.resolve_increments(r)
-                                    for r in rows]
-                        except ValueError as e:
-                            return {"code": "error", "message": str(e)}
+                            ht = peer.write(
+                                rows, timeout=p.get("timeout", 10.0),
+                                client_id=p.get("client_id"),
+                                request_id=p.get("request_id"))
+                        except NotLeader as e:
+                            return {"code": "not_leader",
+                                    "leader_hint": e.leader_hint}
+                        except TimeoutError:
+                            return {"code": "timed_out"}
+                        return self._write_ok(ht)
+                    # Blind-write fast path: admission (dedup + stamp +
+                    # append) under the lock, the majority wait OUTSIDE
+                    # it — concurrent writers pipeline through one
+                    # replication round instead of serializing on full
+                    # commit latency (reference: preparer.cc batching).
                     try:
-                        ht = peer.write(rows, timeout=p.get("timeout", 10.0),
-                                        client_id=p.get("client_id"),
-                                        request_id=p.get("request_id"))
+                        admitted = peer.write_admit(
+                            rows, client_id=p.get("client_id"),
+                            request_id=p.get("request_id"))
                     except NotLeader as e:
                         return {"code": "not_leader",
                                 "leader_hint": e.leader_hint}
-                    except TimeoutError:
-                        return {"code": "timed_out"}
-                    from yugabyte_db_tpu.utils.fault_injection import \
-                        maybe_fault
-                    if maybe_fault("fault.ts_write_respond_failed"):
-                        # the write APPLIED; the client sees a failure
-                        # and retries — exactly-once dedup must absorb it
-                        return {"code": "timed_out",
-                                "injected_fault": True}
-                    return {"code": "ok", "ht": ht.value}
+            if admitted is not None:
+                try:
+                    ht = peer.write_finish(admitted,
+                                           timeout=p.get("timeout", 10.0))
+                except NotLeader as e:
+                    return {"code": "not_leader",
+                            "leader_hint": e.leader_hint}
+                except TimeoutError:
+                    return {"code": "timed_out"}
+                return self._write_ok(ht)
             err = self._resolve_write_conflicts(
                 peer, {"priority": 1 << 62}, conflicting)
             if err is not None:
                 return err
         return {"code": "conflict", "message": "intents kept reappearing"}
+
+    @staticmethod
+    def _write_ok(ht) -> dict:
+        from yugabyte_db_tpu.utils.fault_injection import maybe_fault
+
+        if maybe_fault("fault.ts_write_respond_failed"):
+            # the write APPLIED; the client sees a failure and retries —
+            # exactly-once dedup must absorb it
+            return {"code": "timed_out", "injected_fault": True}
+        return {"code": "ok", "ht": ht.value}
 
     @staticmethod
     def _pin_read_point(peer, read_ht: int, timeout: float) -> dict | None:
