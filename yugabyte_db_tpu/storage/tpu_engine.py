@@ -180,6 +180,23 @@ class TpuStorageEngine(StorageEngine):
         src/yb/rocksdb/db/compaction_job.cc:622)."""
         if len(self.runs) <= 1 and history_cutoff_ht == 0:
             return
+        # Bulk object churn (hundreds of thousands of row objects moving
+        # between containers) makes the cyclic GC fire on allocation and
+        # rescan the whole heap repeatedly — measured 27x slowdown on
+        # plain object-array fills. Nothing here creates cycles; pause
+        # collection for the duration (the reference's arena-allocated
+        # compaction has no analogous cost).
+        import gc
+
+        gc_was = gc.isenabled()
+        gc.disable()
+        try:
+            self._compact_locked(history_cutoff_ht)
+        finally:
+            if gc_was:
+                gc.enable()
+
+    def _compact_locked(self, history_cutoff_ht: int) -> None:
         result = None
         if self.runs and all(t.crun.max_key_len <= 32 for t in self.runs) \
                 and sum(t.crun.num_versions for t in self.runs) > 0:
@@ -198,10 +215,15 @@ class TpuStorageEngine(StorageEngine):
             crun = (ColumnarRun.build(self.schema, merged,
                                       self.rows_per_block)
                     if merged else None)
+            self.persist.replace_all(merged)
         else:
-            merged, crun = result
-        self.persist.replace_all(merged)
-        self.runs = [TpuRun(crun)] if merged else []
+            make_entries, crun = result
+            # The (key, versions) entry list exists only for durability;
+            # materialize it lazily — an in-memory engine (data_dir=None)
+            # skips the 1-tuple-per-group Python walk entirely.
+            self.persist.replace_all(make_entries()
+                                     if self.persist.enabled else [])
+        self.runs = [TpuRun(crun)] if crun is not None else []
         self._plan_cache.clear()
 
     def _device_compact_entries(self, cutoff: int):
@@ -222,9 +244,19 @@ class TpuStorageEngine(StorageEngine):
         cmp_parts = {cid: [] for cid in col_ids}
         arith_parts = {cid: [] for cid in col_ids}
         varlen_all = {cid: [] for cid in col_ids}
-        all_keys: list[bytes] = []
-        all_vers: list = []
-        all_kvs: list = []
+        # Row-level Python payloads collect as OBJECT ndarrays: the
+        # per-row extend loop was the compaction hot spot (200K appends);
+        # np.array over a list slice copies pointers at C speed and the
+        # survivor selection later is one fancy index.
+        key_parts: list = []
+        ver_parts: list = []
+        kv_parts: list = []
+
+        def _obj(lst, nv):
+            a = np.empty(nv, dtype=object)
+            a[:] = lst[:nv]
+            return a
+
         for cr in crs:
             for b in range(cr.B):
                 nv = cr.blocks[b].num_valid
@@ -246,11 +278,14 @@ class TpuStorageEngine(StorageEngine):
                         arith_parts[cid].append(col.arith[b, :nv])
                     if col.varlen is not None:
                         varlen_all[cid].extend(col.varlen[b][:nv])
-                all_keys.extend(cr.row_keys[b][:nv])
-                all_vers.extend(cr.row_versions[b][:nv])
-                all_kvs.extend(cr.row_key_vals[b][:nv])
+                key_parts.append(_obj(cr.row_keys[b], nv))
+                ver_parts.append(_obj(cr.row_versions[b], nv))
+                kv_parts.append(_obj(cr.row_key_vals[b], nv))
         if not parts_kw:
             return None
+        all_keys = np.concatenate(key_parts)
+        all_vers = np.concatenate(ver_parts)
+        all_kvs = np.concatenate(kv_parts)
         N = len(all_keys)
         # Pad to a size bucket so the compiled program is reused; pad rows
         # carry max key planes (sort last) and the plane encoding of
@@ -310,7 +345,7 @@ class TpuStorageEngine(StorageEngine):
         kept_pos = np.nonzero(keep[:].astype(bool) & (perm < N))[0]
         kept_src = perm[kept_pos]
         if kept_src.size == 0:
-            return [], None
+            return (lambda: []), None
         # Group boundaries among KEPT rows (still key-sorted).
         gid_sorted = np.cumsum(new_group.astype(np.int64)) - 1
         kept_gids = gid_sorted[kept_pos]
@@ -318,13 +353,18 @@ class TpuStorageEngine(StorageEngine):
         kept_new_group[0] = True
         kept_new_group[1:] = kept_gids[1:] != kept_gids[:-1]
 
-        entries: list[tuple[bytes, list]] = []
-        srcs = kept_src.tolist()
-        starts = kept_new_group.tolist()
-        for oi, is_new in zip(srcs, starts):
-            if is_new:
-                entries.append((all_keys[oi], []))
-            entries[-1][1].append(all_vers[oi])
+        # Survivor (key, versions) groups via one fancy index + per-group
+        # slices (C-speed object-array copies; the per-row append loop
+        # was the second compaction hot spot). Deferred: only the
+        # durability path needs the entry-list form.
+        kept_keys = all_keys[kept_src]
+        kept_vers = all_vers[kept_src]
+
+        def make_entries() -> list[tuple[bytes, list]]:
+            group_starts = np.nonzero(kept_new_group)[0].tolist()
+            group_ends = group_starts[1:] + [kept_src.size]
+            return [(kept_keys[g0], kept_vers[g0:g1].tolist())
+                    for g0, g1 in zip(group_starts, group_ends)]
 
         planes = {
             "ht_hi": ht_hi, "ht_lo": ht_lo, "exp_hi": exp_hi,
@@ -335,7 +375,7 @@ class TpuStorageEngine(StorageEngine):
                                 all_vers, all_kvs, kw, planes, col_ids,
                                 null_parts, cmp_parts, arith_parts,
                                 varlen_all)
-        return entries, crun
+        return make_entries, crun
 
     def _gather_run(self, kept_src, kept_new_group, all_keys, all_vers,
                     all_kvs, kw, planes, col_ids, null_parts, cmp_parts,
@@ -394,10 +434,9 @@ class TpuStorageEngine(StorageEngine):
                 if col.varlen is not None:
                     vl = varlen_all[cid]
                     col.varlen[b][:n] = [vl[i] for i in sel.tolist()]
-            idxs = sel.tolist()
-            run.row_keys[b][:n] = [all_keys[i] for i in idxs]
-            run.row_versions[b][:n] = [all_vers[i] for i in idxs]
-            run.row_key_vals[b][:n] = [all_kvs[i] for i in idxs]
+            run.row_keys[b][:n] = all_keys[sel].tolist()
+            run.row_versions[b][:n] = all_vers[sel].tolist()
+            run.row_key_vals[b][:n] = all_kvs[sel].tolist()
             run.blocks[b] = BlockMeta(run.row_keys[b][0],
                                       run.row_keys[b][n - 1], n)
         run.min_key = run.row_keys[0][0]
