@@ -356,6 +356,12 @@ def bench_kernel_scan(n_rows=16 * 1024 * 1024, R=2048, iters=12):
                 arrays["cols"][2]["set"], arrays["cols"][2]["isnull"],
                 arrays["cols"][2]["cmp"]))
         if not flat:
+            # Free the ~600MB of staged planes before later benches: the
+            # residue skews their upload-bound phases (measured on the
+            # engine write bench).
+            for leaf in jax.tree.leaves(arrays):
+                leaf.delete()
+        if not flat:
             bytes_per_pass += arrays["group_start"].nbytes
         out.append({
             "metric": f"kernel_{label}_scan_rows_per_sec",

@@ -1,0 +1,109 @@
+"""Task-11 gap closers: data-dir identity (FsManager), block-level run
+dump (sst_dump analog), and the docker deploy orchestrator's command
+construction.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from yugabyte_db_tpu import fs as yfs
+from yugabyte_db_tpu.tools import yb_docker_ctl as dctl
+
+
+def test_instance_metadata_format_and_reopen(tmp_path):
+    d = str(tmp_path / "ts-data")
+    meta = yfs.format_or_open(d, "ts-1")
+    assert meta["server_uuid"] == "ts-1" and meta["instance_uuid"]
+    again = yfs.format_or_open(d, "ts-1")
+    assert again["instance_uuid"] == meta["instance_uuid"]
+
+
+def test_instance_metadata_rejects_swapped_dir(tmp_path):
+    d = str(tmp_path / "ts-data")
+    yfs.format_or_open(d, "ts-1")
+    with pytest.raises(yfs.FsMismatch):
+        yfs.format_or_open(d, "ts-2")
+
+
+def test_daemons_refuse_foreign_data_dir(tmp_path):
+    from yugabyte_db_tpu.consensus.transport import LocalTransport
+    from yugabyte_db_tpu.tserver.tablet_server import TabletServer
+
+    root = str(tmp_path / "node")
+    t = LocalTransport()
+    ts = TabletServer("ts-a", root, t, ["m-0"], fsync=False)
+    with pytest.raises(yfs.FsMismatch):
+        TabletServer("ts-b", root, LocalTransport(), ["m-0"], fsync=False)
+    assert ts.instance["server_uuid"] == "ts-a"
+
+
+def test_fs_tool_blocks_and_instance(tmp_path):
+    from yugabyte_db_tpu.storage.row_version import RowVersion
+    from yugabyte_db_tpu.storage.run_io import save_run
+
+    entries = []
+    for i in range(10):
+        key = b"\x01" + bytes([i]) + b"\x02k%d" % i
+        entries.append((key, [RowVersion(key, ht=100 + i, liveness=True,
+                                         columns={3: i * 7})]))
+    run_path = str(tmp_path / "run-0000000000.dat")
+    save_run(run_path, entries)
+    out = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.tools.fs_tool",
+         "blocks", run_path, "--rows-per-block", "4"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    assert "10 keys, 10 versions, 3 block(s)" in out.stdout
+    assert "block 0:" in out.stdout and "keycrc=" in out.stdout
+
+    yfs.format_or_open(str(tmp_path), "node-X")
+    out = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.tools.fs_tool",
+         "instance", str(tmp_path)],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0 and '"server_uuid": "node-X"' in out.stdout
+
+
+def test_docker_ctl_command_construction():
+    cmds = dctl.create_commands(1, 3, "yugabyte-tpu:latest")
+    assert cmds[0] == ["docker", "network", "create", "yb-tpu-net"]
+    run_cmds = cmds[1:]
+    assert len(run_cmds) == 4  # 1 master + 3 tservers
+    master = run_cmds[0]
+    assert "--role" in master and master[master.index("--role") + 1] == \
+        "master"
+    # every daemon shares the master topology string
+    topo = master[master.index("--topology") + 1]
+    assert topo == "yb-master-0=yb-master-0:7100"
+    for c in run_cmds[1:]:
+        assert c[c.index("--topology") + 1] == topo
+        assert c[c.index("--role") + 1] == "tserver"
+    # dry run prints, never invokes docker
+    assert dctl._run(cmds, dry_run=True) == 0
+
+
+def test_docker_ctl_cli_dry_run(capsys):
+    rc = dctl.main(["create", "--masters", "1", "--tservers", "2",
+                    "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("docker run") == 3
+    assert "yb-tserver-1" in out
+    rc = dctl.main(["destroy", "--dry-run"])
+    assert rc == 0
+
+
+def test_k8s_manifest_parses_and_binds_roles():
+    """The shipped manifest must stay structurally valid (no yaml module
+    dependency: structural checks on the text)."""
+    text = open("/root/repo/deploy/kubernetes/"
+                "yugabyte-tpu-statefulset.yaml").read()
+    assert text.count("kind: StatefulSet") == 2
+    assert text.count("kind: Service") == 2
+    assert "--role=master" in text and "--role=tserver" in text
+    assert "google.com/tpu" in text            # tserver pins the TPU
+    assert "JAX_PLATFORMS" in text             # master stays on cpu
+    assert "volumeClaimTemplates" in text

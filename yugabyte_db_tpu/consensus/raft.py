@@ -20,8 +20,14 @@ Simplifications vs the reference, called out honestly:
   the lease while a majority's grants — measured from each request's SEND
   time — are still running. All lease arithmetic is monotonic-clock
   durations, so wall-clock jumps cannot extend or break a lease.
-- The in-memory entry cache holds the whole log (LogCache with no eviction);
-  fine at this framework's log sizes, an eviction policy is a TODO.
+- The in-memory entry cache (LogCache analog) is bounded by the engine's
+  flushed frontier: every flush evicts entries below it (evict_cache,
+  keeping two anchor entries for peer consistency probes), and a peer
+  lagging past the eviction floor is re-seeded via remote bootstrap
+  instead of log catchup — the same handoff consensus_queue.cc makes.
+  Unlike the reference's LogCache there is no disk read-back path for
+  peer catchup (log_cache.cc falls back to LogReader); the cache floor
+  therefore never exceeds the flushed frontier.
 """
 
 from __future__ import annotations

@@ -50,6 +50,9 @@ class Master:
         self.uuid = uuid
         self.transport = transport
         self.advertised_addr = advertised_addr
+        from yugabyte_db_tpu import fs as _fs
+
+        self.instance = _fs.format_or_open(fs_root, uuid)
         self.catalog = CatalogState()
         self.ts_manager = TSManager(ts_unresponsive_timeout_s)
         self.balance_interval_s = balance_interval_s

@@ -102,6 +102,56 @@ def wal_segment_paths(path: str) -> list[str]:
     return [path]
 
 
+# -- block-level dump (sst_dump analog) --------------------------------------
+
+def _cmd_blocks(path: str, rows_per_block: int) -> int:
+    """Rebuild the columnar block layout of one run file and print
+    per-block metadata + plane statistics — the role of the reference's
+    sst_dump over SSTable blocks (src/yb/rocksdb/tools/sst_dump_tool.cc),
+    for the columnar format: block boundaries, key ranges, validity,
+    per-column set/null density, plane checksums."""
+    from yugabyte_db_tpu.models.schema import Schema  # noqa: F401 (doc)
+    from yugabyte_db_tpu.storage.row_version import RowVersion
+
+    entries = []
+    for key, versions in iter_run_entries(path):
+        entries.append((key, [
+            RowVersion(key, ht=rec[0], tombstone=rec[1], liveness=rec[2],
+                       columns={int(c): val for c, val in rec[3].items()},
+                       expire_ht=rec[4],
+                       write_id=rec[5] if len(rec) > 5 else 0)
+            for rec in versions]))
+    if not entries:
+        print("empty run")
+        return 0
+    # A schema-free structural build: block packing + key/ht planes only
+    # need the keys and version lists, so derive column ids from the data.
+    col_ids = sorted({c for _k, vs in entries for v in vs
+                      for c in v.columns})
+    from yugabyte_db_tpu.storage.columnar import ColumnarRun
+
+    ranges = ColumnarRun.pack_group_ranges(
+        [len(v) for _, v in entries], rows_per_block)
+    total_rows = sum(len(v) for _, v in entries)
+    print(f"run: {len(entries)} keys, {total_rows} versions, "
+          f"{len(ranges)} block(s) at R={rows_per_block}, "
+          f"columns={col_ids}")
+    for b, (g0, gn, rows) in enumerate(ranges):
+        group = entries[g0:g0 + gn]
+        min_key = group[0][0]
+        max_key = group[-1][0]
+        max_ht = max(v.ht for _k, vs in group for v in vs)
+        tombs = sum(1 for _k, vs in group for v in vs if v.tombstone)
+        per_col = {c: sum(1 for _k, vs in group for v in vs
+                          if c in v.columns) for c in col_ids}
+        crc = zlib.crc32(b"".join(k for k, _ in group)) & 0xFFFFFFFF
+        print(f"  block {b}: rows={rows} groups={gn} "
+              f"min={min_key.hex()[:24]} max={max_key.hex()[:24]} "
+              f"max_ht={max_ht} tombstones={tombs} "
+              f"set_counts={per_col} keycrc={crc:08x}")
+    return 0
+
+
 # -- CLI ---------------------------------------------------------------------
 
 def _preview(v, limit=80) -> str:
@@ -122,6 +172,13 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("dump_wal")
     p.add_argument("path")
     p.add_argument("-n", type=int, default=50, help="max records")
+    p = sub.add_parser("blocks", help="block-level columnar layout of a "
+                       "run (sst_dump analog)")
+    p.add_argument("path")
+    p.add_argument("--rows-per-block", type=int, default=2048)
+    p = sub.add_parser("instance", help="data-dir identity record "
+                       "(fs_manager instance metadata)")
+    p.add_argument("data_dir")
     args = ap.parse_args(argv)
 
     if args.cmd == "list":
@@ -151,6 +208,20 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as e:  # noqa: BLE001 — corrupt file is the use case
             print(f"!! corrupt run file: {type(e).__name__}: {e}")
             return 1
+        return 0
+
+    if args.cmd == "blocks":
+        return _cmd_blocks(args.path, args.rows_per_block)
+
+    if args.cmd == "instance":
+        path = os.path.join(args.data_dir, "instance")
+        try:
+            rec = codec.decode(open(path, "rb").read())
+        except FileNotFoundError:
+            print(f"{args.data_dir}: no instance metadata (unformatted)")
+            return 1
+        print(json.dumps({"server_uuid": rec[1], "instance_uuid": rec[2],
+                          "format_time_us": rec[3]}))
         return 0
 
     # dump_wal

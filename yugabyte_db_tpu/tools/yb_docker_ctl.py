@@ -1,0 +1,122 @@
+"""yb-docker-ctl: local containerized cluster orchestrator.
+
+Reference analog: bin/yb-docker-ctl — create/start/stop/destroy a local
+cluster where every daemon is a docker container on one bridge network.
+The command construction is pure (testable without a docker engine);
+``--dry-run`` prints the exact docker invocations instead of executing.
+
+Usage:
+  python -m yugabyte_db_tpu.tools.yb_docker_ctl create \
+      [--masters N] [--tservers N] [--image yugabyte-tpu:latest] [--dry-run]
+  python -m yugabyte_db_tpu.tools.yb_docker_ctl destroy [--dry-run]
+  python -m yugabyte_db_tpu.tools.yb_docker_ctl status
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+NETWORK = "yb-tpu-net"
+MASTER_RPC, MASTER_WEB = 7100, 7000
+TS_RPC, TS_WEB = 9100, 9000
+
+
+def master_names(n: int) -> list[str]:
+    return [f"yb-master-{i}" for i in range(n)]
+
+
+def tserver_names(n: int) -> list[str]:
+    return [f"yb-tserver-{i}" for i in range(n)]
+
+
+def topology(masters: list[str]) -> str:
+    return ",".join(f"{m}={m}:{MASTER_RPC}" for m in masters)
+
+
+def create_commands(num_masters: int, num_tservers: int,
+                    image: str) -> list[list[str]]:
+    """The full docker command sequence bringing a cluster up."""
+    masters = master_names(num_masters)
+    cmds = [["docker", "network", "create", NETWORK]]
+    for i, name in enumerate(masters):
+        cmds.append([
+            "docker", "run", "-d", "--name", name, "--hostname", name,
+            "--network", NETWORK,
+            "-p", f"{MASTER_WEB + i}:{MASTER_WEB}",
+            "-v", f"{name}-data:/mnt/data",
+            "-e", "JAX_PLATFORMS=cpu",
+            image,
+            "--role", "master", "--uuid", name,
+            "--data-dir", "/mnt/data",
+            "--masters", ",".join(masters),
+            "--topology", topology(masters),
+            "--web-port", str(MASTER_WEB),
+        ])
+    for i, name in enumerate(tserver_names(num_tservers)):
+        cmds.append([
+            "docker", "run", "-d", "--name", name, "--hostname", name,
+            "--network", NETWORK,
+            "-p", f"{TS_WEB + 100 + i}:{TS_WEB}",
+            "-v", f"{name}-data:/mnt/data",
+            image,
+            "--role", "tserver", "--uuid", name,
+            "--data-dir", "/mnt/data",
+            "--masters", ",".join(masters),
+            "--topology", topology(masters),
+            "--web-port", str(TS_WEB),
+        ])
+    return cmds
+
+
+def destroy_commands(num_masters: int = 8,
+                     num_tservers: int = 16) -> list[list[str]]:
+    """Remove any cluster containers/volumes up to the given bounds
+    (idempotent: docker rm -f of an absent container is tolerated)."""
+    names = master_names(num_masters) + tserver_names(num_tservers)
+    cmds = [["docker", "rm", "-f"] + names]
+    cmds.append(["docker", "volume", "rm", "-f"]
+                + [f"{n}-data" for n in names])
+    cmds.append(["docker", "network", "rm", NETWORK])
+    return cmds
+
+
+def _run(cmds: list[list[str]], dry_run: bool, tolerate=False) -> int:
+    for cmd in cmds:
+        if dry_run:
+            print(" ".join(cmd))
+            continue
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0 and not tolerate:
+            print(proc.stderr.strip())
+            return proc.returncode
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="yb-docker-ctl")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("create")
+    p.add_argument("--masters", type=int, default=1)
+    p.add_argument("--tservers", type=int, default=3)
+    p.add_argument("--image", default="yugabyte-tpu:latest")
+    p.add_argument("--dry-run", action="store_true")
+    p = sub.add_parser("destroy")
+    p.add_argument("--dry-run", action="store_true")
+    sub.add_parser("status")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "create":
+        return _run(create_commands(args.masters, args.tservers,
+                                    args.image), args.dry_run)
+    if args.cmd == "destroy":
+        return _run(destroy_commands(), args.dry_run, tolerate=True)
+    # status
+    return _run([["docker", "ps", "--filter", f"network={NETWORK}",
+                  "--format", "{{.Names}}\t{{.Status}}"]], False,
+                tolerate=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,6 +42,12 @@ class TabletServer:
         self.uuid = uuid
         self.transport = transport
         self.advertised_addr = advertised_addr  # (host, port) when on TCP
+        # Data-dir identity: formats on first open, refuses a directory
+        # owned by another server (reference: FsManager::Open,
+        # src/yb/fs/fs_manager.cc).
+        from yugabyte_db_tpu import fs as _fs
+
+        self.instance = _fs.format_or_open(fs_root, uuid)
         self.tablet_manager = TSTabletManager(
             uuid, fs_root, transport, raft_opts=raft_opts,
             engine_options=engine_options, fsync=fsync)

@@ -89,6 +89,11 @@ class AlreadyPresent(StatusError):
         super().__init__(Status(Code.ALREADY_PRESENT, message))
 
 
+class IllegalState(StatusError):
+    def __init__(self, message: str):
+        super().__init__(Status(Code.ILLEGAL_STATE, message))
+
+
 OK = Status()
 
 
