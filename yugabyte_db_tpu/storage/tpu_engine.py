@@ -1439,10 +1439,20 @@ class TpuStorageEngine(StorageEngine):
                             preds=pred_sigs, aggs=dev_aggs, apply_preds=True,
                             flat=crun.max_group_versions <= 1)
         r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
+        from yugabyte_db_tpu.ops import seg_fold
+
         if flat_fold.supports(sig):
             # Flat run: one fused full-array program (bandwidth-roofline;
             # ops.flat_fold) instead of the serialized window fold.
             fn = flat_fold.compiled_flat_aggregate(sig)
+            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
+                            jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
+                            pred_lits)
+        elif seg_fold.supports(sig):
+            # Multi-version run: fused segmented-scan resolve
+            # (ops.seg_fold) — same results as the windowed fold without
+            # the serialized window walk.
+            fn = seg_fold.compiled_seg_aggregate(sig)
             ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
                             jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
                             pred_lits)
