@@ -1,0 +1,184 @@
+"""PG extended query protocol (Parse/Bind/Describe/Execute/Sync) over a
+raw socket — the exact message flow libpq's PQexecParams/psycopg2 uses.
+"""
+
+import socket
+import struct
+
+import pytest
+
+from yugabyte_db_tpu.yql.cql.processor import LocalCluster
+from yugabyte_db_tpu.yql.pgsql.wire import PgServer
+
+_U32 = struct.Struct(">I")
+
+
+class ExtClient:
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.buf = b""
+        body = _U32.pack(196608) + b"user\x00pg\x00\x00"
+        self.sock.sendall(_U32.pack(len(body) + 4) + body)
+        # consume until ReadyForQuery
+        while True:
+            tag, _payload = self.read_msg()
+            if tag == b"Z":
+                break
+
+    def close(self):
+        self.sock.close()
+
+    def send(self, tag: bytes, payload: bytes = b""):
+        self.sock.sendall(tag + _U32.pack(len(payload) + 4) + payload)
+
+    def read_msg(self):
+        while len(self.buf) < 5:
+            chunk = self.sock.recv(65536)
+            assert chunk, "closed"
+            self.buf += chunk
+        tag = self.buf[:1]
+        (ln,) = _U32.unpack_from(self.buf, 1)
+        while len(self.buf) < 1 + ln:
+            chunk = self.sock.recv(65536)
+            assert chunk, "closed"
+            self.buf += chunk
+        payload = self.buf[5:1 + ln]
+        self.buf = self.buf[1 + ln:]
+        return tag, payload
+
+    # -- extended-protocol helpers ------------------------------------------
+    def parse(self, name: str, query: str):
+        self.send(b"P", name.encode() + b"\x00" + query.encode()
+                  + b"\x00" + struct.pack(">H", 0))
+
+    def bind(self, portal: str, stmt: str, params: list):
+        out = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+        out += struct.pack(">H", 0)        # all-text param formats
+        out += struct.pack(">H", len(params))
+        for p in params:
+            if p is None:
+                out += struct.pack(">i", -1)
+            else:
+                b = str(p).encode()
+                out += struct.pack(">i", len(b)) + b
+        out += struct.pack(">H", 0)        # result formats: default text
+        self.send(b"B", out)
+
+    def describe_portal(self, portal: str):
+        self.send(b"D", b"P" + portal.encode() + b"\x00")
+
+    def execute(self, portal: str, max_rows: int = 0):
+        self.send(b"E", portal.encode() + b"\x00"
+                  + struct.pack(">i", max_rows))
+
+    def sync(self):
+        self.send(b"S")
+
+    def drain_until_ready(self):
+        msgs = []
+        while True:
+            tag, payload = self.read_msg()
+            msgs.append((tag, payload))
+            if tag == b"Z":
+                return msgs
+
+    def run(self, query: str, params: list = ()):  # full PQexecParams flow
+        self.parse("", query)
+        self.bind("", "", list(params))
+        self.describe_portal("")
+        self.execute("")
+        self.sync()
+        return self.drain_until_ready()
+
+
+def _rows(msgs):
+    out = []
+    for tag, payload in msgs:
+        if tag != b"D":
+            continue
+        (n,) = struct.unpack_from(">H", payload, 0)
+        pos, row = 2, []
+        for _ in range(n):
+            (ln,) = struct.unpack_from(">i", payload, pos)
+            pos += 4
+            if ln < 0:
+                row.append(None)
+            else:
+                row.append(payload[pos:pos + ln].decode())
+                pos += ln
+        out.append(tuple(row))
+    return out
+
+
+def _tags(msgs):
+    return [t for t, _p in msgs]
+
+
+@pytest.fixture
+def cli():
+    server = PgServer(LocalCluster(num_tablets=2))
+    host, port = server.listen("127.0.0.1", 0)
+    c = ExtClient(host, port)
+    yield c
+    c.close()
+    server.shutdown()
+
+
+def test_extended_ddl_dml_select(cli):
+    msgs = cli.run("CREATE TABLE t (k INT PRIMARY KEY, v TEXT, d FLOAT8)")
+    assert b"1" in _tags(msgs) and b"2" in _tags(msgs)
+    assert b"C" in _tags(msgs) and b"Z" in _tags(msgs)
+
+    # parameterized inserts: text params coerced to column types
+    for i in range(5):
+        msgs = cli.run("INSERT INTO t (k, v, d) VALUES ($1, $2, $3)",
+                       [i, f"row{i}", i * 1.5])
+        assert b"E" not in _tags(msgs), msgs
+    msgs = cli.run("SELECT k, v, d FROM t WHERE k >= $1 ORDER BY k", [3])
+    tags = _tags(msgs)
+    # Describe produced a RowDescription before the data rows.
+    assert tags.index(b"T") < tags.index(b"D")
+    assert _rows(msgs) == [("3", "row3", "4.5"), ("4", "row4", "6.0")]
+
+
+def test_extended_named_statement_reuse(cli):
+    cli.run("CREATE TABLE n (k INT PRIMARY KEY, v BIGINT)")
+    cli.parse("ins", "INSERT INTO n (k, v) VALUES ($1, $2)")
+    for i in range(3):
+        cli.bind("", "ins", [i, i * 100])
+        cli.execute("")
+    cli.sync()
+    msgs = cli.drain_until_ready()
+    assert _tags(msgs).count(b"C") == 3   # three CommandCompletes
+    msgs = cli.run("SELECT count(*) FROM n")
+    assert _rows(msgs) == [("3",)]
+
+
+def test_extended_error_skips_until_sync(cli):
+    cli.run("CREATE TABLE e (k INT PRIMARY KEY)")
+    cli.parse("", "INSERT INTO e (k) VALUES ($1)")
+    cli.bind("", "", ["notanint"])
+    cli.describe_portal("")
+    cli.execute("")      # must be skipped after the bind error surfaces
+    cli.sync()
+    msgs = cli.drain_until_ready()
+    tags = _tags(msgs)
+    assert b"E" in tags                  # one ErrorResponse
+    assert tags[-1] == b"Z"              # and recovery at Sync
+    # the connection works again afterwards
+    msgs = cli.run("INSERT INTO e (k) VALUES ($1)", [7])
+    assert b"E" not in _tags(msgs)
+    assert _rows(cli.run("SELECT k FROM e")) == [("7",)]
+
+
+def test_extended_unknown_statement_errors(cli):
+    cli.bind("", "missing", [])
+    cli.sync()
+    msgs = cli.drain_until_ready()
+    assert _tags(msgs)[0] == b"E"
+
+
+def test_extended_null_param(cli):
+    cli.run("CREATE TABLE np (k INT PRIMARY KEY, v TEXT)")
+    cli.run("INSERT INTO np (k, v) VALUES ($1, $2)", [1, None])
+    assert _rows(cli.run("SELECT v FROM np WHERE k = $1", [1])) == [(None,)]

@@ -177,7 +177,30 @@ class PgProcessor:
     def _coerce(self, col: ColumnSchema, value):
         from yugabyte_db_tpu.yql.common import coerce_value
 
-        return coerce_value(col, self._resolve(value))
+        value = self._resolve(value)
+        # PG-style input conversion: extended-protocol parameters arrive
+        # as TEXT ('123'), and PG coerces string literals to the target
+        # type; mirror that here (CQL stays strict in its own coercer).
+        if isinstance(value, str):
+            dt = col.dtype
+            try:
+                if dt.is_integer:
+                    value = int(value)
+                elif dt in (DataType.DOUBLE, DataType.FLOAT):
+                    value = float(value)
+                elif dt == DataType.BOOL:
+                    low = value.lower()
+                    if low in ("t", "true", "1", "on", "yes"):
+                        value = True
+                    elif low in ("f", "false", "0", "off", "no"):
+                        value = False
+                    else:
+                        raise ValueError(value)
+            except ValueError:
+                raise InvalidArgument(
+                    f"invalid input syntax for {dt.name}: {value!r}") \
+                    from None
+        return coerce_value(col, value)
 
     # -- DDL ---------------------------------------------------------------
     def _exec_create_table(self, stmt: ast.CreateTable):

@@ -9,10 +9,14 @@ RowDescription/DataRow/CommandComplete, ErrorResponse) directly on the
 shared rpc Messenger via a pluggable ConnectionContext — the exact seam
 the CQL and Redis frontends ride (src/yb/rpc/connection_context.h).
 
-Covered: SSLRequest (refused with 'N'), StartupMessage, simple Query
-('Q', multi-statement), Terminate ('X'). Not covered (extended
-protocol): Parse/Bind/Execute — the in-process pggate API serves
-prepared statements instead.
+Covered: SSLRequest (refused with 'N'), StartupMessage (incl. the
+cleartext-password handshake behind ysql_require_auth), simple Query
+('Q', multi-statement), Terminate ('X'), and the extended query
+protocol drivers actually use — Parse ('P'), Bind ('B'), Describe
+('D'), Execute ('E'), Close ('C'), Flush ('H'), Sync ('S') with
+error-skip-until-Sync semantics. Describe-portal executes the portal
+eagerly (results cached for Execute) so RowDescription can be answered
+without a separate planner output-schema pass.
 """
 
 from __future__ import annotations
@@ -136,6 +140,10 @@ class PgConnectionContext(ConnectionContext):
         self._buf = bytearray()
         self._started = False
         self.session = None  # attached by the service on startup
+        # Extended-protocol state.
+        self.prepared: dict = {}       # name -> parsed statement AST
+        self.portals: dict = {}        # name -> {"stmt","params","result"}
+        self.skip_until_sync = False
 
     def feed(self, data: bytes) -> list:
         self._buf.extend(data)
@@ -235,11 +243,30 @@ class PgServiceImpl:
             ctx.session = PgProcessor(self.cluster)
             ctx.session.login_role = user
             return auth_ok() + self._session_ready()
-        if ctx.session is None and kind == "Q":
+        if ctx.session is None and kind in "QPBDECHS":
             return error_response("not authenticated", "28000") \
                 + ready_for_query()
         if kind == "Q":
             return self._query(ctx, payload)
+        if kind in "PBDECH":
+            if ctx.skip_until_sync:
+                return b""  # discard until Sync after an error
+            try:
+                return self._extended(ctx, kind, payload)
+            except Exception as e:  # noqa: BLE001 — protocol error reply
+                ctx.skip_until_sync = True
+                code = {  # same mapping as the simple-query path
+                    "InvalidArgument": "42601", "AlreadyPresent": "23505",
+                    "NotFound": "42P01", "SerializationFailure": "40001",
+                    "FailedTransaction": "25P02",
+                }.get(type(e).__name__, "XX000")
+                return error_response(str(e), code)
+        if kind == "S":  # Sync
+            ctx.skip_until_sync = False
+            st = b"I"
+            if ctx.session is not None and ctx.session.in_txn:
+                st = ctx.session.txn_status.encode()
+            return ready_for_query(st)
         if kind == "X":
             return b""  # client closes after Terminate
         st = b"I"
@@ -247,6 +274,110 @@ class PgServiceImpl:
             st = ctx.session.txn_status.encode()
         return error_response(f"unsupported message {kind!r}",
                               code="0A000") + ready_for_query(st)
+
+    # -- extended query protocol --------------------------------------------
+    @staticmethod
+    def _cstr(payload: bytes, pos: int) -> tuple[str, int]:
+        end = payload.index(b"\x00", pos)
+        return payload[pos:end].decode("utf-8", "surrogateescape"), end + 1
+
+    def _extended(self, ctx, kind: str, payload: bytes) -> bytes:
+        from yugabyte_db_tpu.yql.pgsql.parser import parse_script
+
+        if kind == "P":  # Parse: name, query, n param-type oids
+            name, pos = self._cstr(payload, 0)
+            query, pos = self._cstr(payload, pos)
+            stmts = parse_script(query)
+            if len(stmts) > 1:
+                raise ValueError(
+                    "cannot insert multiple commands into a prepared "
+                    "statement")
+            ctx.prepared[name] = stmts[0] if stmts else None
+            return _msg(b"1", b"")  # ParseComplete
+        if kind == "B":  # Bind: portal, stmt, formats, params, result fmts
+            portal, pos = self._cstr(payload, 0)
+            sname, pos = self._cstr(payload, pos)
+            if sname not in ctx.prepared:
+                raise ValueError(f"prepared statement {sname!r} "
+                                 "does not exist")
+            (nfmt,) = struct.unpack_from(">H", payload, pos)
+            pos += 2
+            fmts = struct.unpack_from(f">{nfmt}H", payload, pos)
+            pos += 2 * nfmt
+            (nparams,) = struct.unpack_from(">H", payload, pos)
+            pos += 2
+            params = []
+            for i in range(nparams):
+                (ln,) = struct.unpack_from(">i", payload, pos)
+                pos += 4
+                if ln < 0:
+                    params.append(None)
+                    continue
+                raw = payload[pos:pos + ln]
+                pos += ln
+                fmt = fmts[i] if i < nfmt else (fmts[0] if nfmt else 0)
+                if fmt != 0:
+                    raise ValueError(
+                        "binary parameter format is not supported")
+                params.append(raw.decode("utf-8", "surrogateescape"))
+            ctx.portals[portal] = {"stmt": ctx.prepared[sname],
+                                   "params": params, "result": None,
+                                   "done": False}
+            return _msg(b"2", b"")  # BindComplete
+        if kind == "D":  # Describe
+            target = chr(payload[0])
+            name, _pos = self._cstr(payload, 1)
+            if target == "S":
+                if name not in ctx.prepared:
+                    raise ValueError(f"prepared statement {name!r} "
+                                     "does not exist")
+                # Unspecified param types (text); result shape resolves
+                # at portal describe/execute time.
+                return _msg(b"t", struct.pack(">H", 0)) + _msg(b"n", b"")
+            p = ctx.portals.get(name)
+            if p is None:
+                raise ValueError(f"portal {name!r} does not exist")
+            self._run_portal(ctx, p)
+            res = p["result"]
+            if res is None or not res.columns:
+                return _msg(b"n", b"")  # NoData
+            return row_description(res)
+        if kind == "E":  # Execute: portal, max rows (0 = all)
+            name, pos = self._cstr(payload, 0)
+            p = ctx.portals.get(name)
+            if p is None:
+                raise ValueError(f"portal {name!r} does not exist")
+            self._run_portal(ctx, p)
+            res = p["result"]
+            out = bytearray()
+            if res is None:
+                out += command_complete("OK")
+            else:
+                for r in res.rows:
+                    out += data_row(r)
+                if res.command.startswith(("SELECT", "select")) \
+                        or res.columns:
+                    out += command_complete(f"SELECT {len(res.rows)}")
+                else:
+                    out += command_complete(res.command)
+            return bytes(out)
+        if kind == "C":  # Close statement/portal
+            target = chr(payload[0])
+            name, _pos = self._cstr(payload, 1)
+            (ctx.prepared if target == "S" else ctx.portals).pop(name, None)
+            return _msg(b"3", b"")  # CloseComplete
+        # 'H' Flush: responses are written immediately; nothing buffered.
+        return b""
+
+    def _run_portal(self, ctx, p: dict) -> None:
+        """Execute a bound portal once (Describe-portal triggers it so
+        RowDescription reflects the real result shape; Execute reuses
+        the cached result)."""
+        if p["done"]:
+            return
+        p["result"] = (None if p["stmt"] is None
+                       else ctx.session.execute(p["stmt"], p["params"]))
+        p["done"] = True
 
     def _query(self, ctx, payload: bytes) -> bytes:
         from yugabyte_db_tpu.yql.pgsql.executor import (FailedTransaction,
