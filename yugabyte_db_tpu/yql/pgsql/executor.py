@@ -554,6 +554,11 @@ class PgProcessor:
 
     # -- SELECT ------------------------------------------------------------
     def _exec_select(self, stmt: ast.Select):
+        if not stmt.joins:
+            from yugabyte_db_tpu.yql.pgsql import vtables as PV
+
+            if PV.is_virtual(stmt.table):
+                return PV.virtual_select(self, stmt)
         if stmt.joins:
             return self._select_join(stmt)
         stmt = self._strip_qualifiers(stmt)

@@ -390,7 +390,7 @@ class Parser:
         while self.take_sym(","):
             items.append(self._select_item())
         self.expect_kw("FROM")
-        table = self.ident()
+        table = self._table_name()
         alias = self._table_alias()
         joins: list[ast.Join] = []
         while True:
@@ -406,7 +406,7 @@ class Parser:
                 kind = "left"
             else:
                 break
-            jtable = self.ident()
+            jtable = self._table_name()
             jalias = self._table_alias()
             self.expect_kw("ON")
             on = [self._on_pair()]
@@ -453,6 +453,15 @@ class Parser:
     def _kw_ahead(self, n: int, kw: str) -> bool:
         t = self.toks[self.i + n] if self.i + n < len(self.toks) else None
         return t is not None and t.kind == "name" and t.text.upper() == kw
+
+    def _table_name(self) -> str:
+        """Possibly schema-qualified table: name or schema.name (the
+        pg_catalog / information_schema surface)."""
+        name = self.ident()
+        if self.at_sym("."):
+            self.next()
+            return f"{name}.{self.ident()}"
+        return name
 
     def _table_alias(self) -> str | None:
         if self.take_kw("AS"):
