@@ -8,297 +8,22 @@
 // code, with the Python implementation as the compatibility fallback
 // for arbitrary-precision integers (OverflowError here -> Python path).
 //
+// The codec core lives in tagcodec.h, shared with writeplane.cc.
+//
 // Exposed as the CPython extension module `yb_codec`:
 //   yb_codec.encode(obj) -> bytes
 //   yb_codec.decode(bytes_like) -> obj
 
-#define PY_SSIZE_T_CLEAN
-#include <Python.h>
-
-#include <cstdint>
-#include <cstring>
+#include "tagcodec.h"
 
 namespace {
 
-enum Tag : unsigned char {
-  T_NONE = 0, T_TRUE, T_FALSE, T_INT, T_F64, T_STR, T_BYTES, T_LIST, T_MAP
-};
-
-constexpr int kMaxDepth = 200;
-
-// -- growable output buffer --------------------------------------------------
-
-struct Buf {
-  char* data = nullptr;
-  size_t len = 0, cap = 0;
-  ~Buf() { PyMem_Free(data); }
-};
-
-bool buf_reserve(Buf* b, size_t extra) {
-  if (b->len + extra <= b->cap) return true;
-  size_t cap = b->cap ? b->cap : 256;
-  while (cap < b->len + extra) cap *= 2;
-  char* p = static_cast<char*>(PyMem_Realloc(b->data, cap));
-  if (p == nullptr) { PyErr_NoMemory(); return false; }
-  b->data = p;
-  b->cap = cap;
-  return true;
-}
-
-bool buf_put(Buf* b, const void* p, size_t n) {
-  if (!buf_reserve(b, n)) return false;
-  memcpy(b->data + b->len, p, n);
-  b->len += n;
-  return true;
-}
-
-bool buf_putc(Buf* b, unsigned char c) { return buf_put(b, &c, 1); }
-
-bool write_varint(Buf* b, uint64_t v) {
-  unsigned char tmp[10];
-  int n = 0;
-  for (;;) {
-    unsigned char byte = v & 0x7F;
-    v >>= 7;
-    if (v) {
-      tmp[n++] = byte | 0x80;
-    } else {
-      tmp[n++] = byte;
-      return buf_put(b, tmp, n);
-    }
-  }
-}
-
-// -- encode ------------------------------------------------------------------
-
-bool encode_obj(Buf* b, PyObject* v, int depth) {
-  if (depth > kMaxDepth) {
-    PyErr_SetString(PyExc_ValueError, "codec: nesting too deep");
-    return false;
-  }
-  if (v == Py_None) return buf_putc(b, T_NONE);
-  if (PyBool_Check(v)) return buf_putc(b, v == Py_True ? T_TRUE : T_FALSE);
-  if (PyLong_Check(v)) {
-    int overflow = 0;
-    long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
-    if (overflow != 0) {
-      // > 64-bit int: the Python implementation handles it (wrapper
-      // catches OverflowError and falls back).
-      PyErr_SetString(PyExc_OverflowError, "int beyond int64");
-      return false;
-    }
-    if (x == -1 && PyErr_Occurred()) return false;
-    uint64_t z = (x >= 0)
-        ? (static_cast<uint64_t>(x) << 1)
-        : ((static_cast<uint64_t>(-(x + 1)) << 1) | 1);
-    return buf_putc(b, T_INT) && write_varint(b, z);
-  }
-  if (PyFloat_Check(v)) {
-    double d = PyFloat_AS_DOUBLE(v);
-    // wire format is little-endian f64; all supported targets are LE
-    return buf_putc(b, T_F64) && buf_put(b, &d, 8);
-  }
-  if (PyUnicode_Check(v)) {
-    PyObject* raw = PyUnicode_AsEncodedString(v, "utf-8", "surrogateescape");
-    if (raw == nullptr) return false;
-    char* p;
-    Py_ssize_t n;
-    if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
-      Py_DECREF(raw);
-      return false;
-    }
-    bool ok = buf_putc(b, T_STR) && write_varint(b, (uint64_t)n) &&
-              buf_put(b, p, (size_t)n);
-    Py_DECREF(raw);
-    return ok;
-  }
-  if (PyBytes_Check(v)) {
-    char* p;
-    Py_ssize_t n;
-    if (PyBytes_AsStringAndSize(v, &p, &n) < 0) return false;
-    return buf_putc(b, T_BYTES) && write_varint(b, (uint64_t)n) &&
-           buf_put(b, p, (size_t)n);
-  }
-  if (PyByteArray_Check(v) || PyMemoryView_Check(v)) {
-    PyObject* raw = PyBytes_FromObject(v);
-    if (raw == nullptr) return false;
-    bool ok = encode_obj(b, raw, depth);
-    Py_DECREF(raw);
-    return ok;
-  }
-  if (PyList_Check(v) || PyTuple_Check(v)) {
-    PyObject* fast = PySequence_Fast(v, "codec: sequence");
-    if (fast == nullptr) return false;
-    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
-    bool ok = buf_putc(b, T_LIST) && write_varint(b, (uint64_t)n);
-    for (Py_ssize_t i = 0; ok && i < n; i++) {
-      ok = encode_obj(b, PySequence_Fast_GET_ITEM(fast, i), depth + 1);
-    }
-    Py_DECREF(fast);
-    return ok;
-  }
-  if (PyDict_Check(v)) {
-    if (!buf_putc(b, T_MAP) ||
-        !write_varint(b, (uint64_t)PyDict_Size(v))) {
-      return false;
-    }
-    PyObject *key, *val;
-    Py_ssize_t pos = 0;
-    while (PyDict_Next(v, &pos, &key, &val)) {
-      if (!encode_obj(b, key, depth + 1) ||
-          !encode_obj(b, val, depth + 1)) {
-        return false;
-      }
-    }
-    return true;
-  }
-  PyErr_Format(PyExc_TypeError, "codec cannot encode %s",
-               Py_TYPE(v)->tp_name);
-  return false;
-}
-
-// -- decode ------------------------------------------------------------------
-
-struct Reader {
-  const unsigned char* data;
-  size_t len, pos = 0;
-};
-
-bool read_varint(Reader* r, uint64_t* out) {
-  uint64_t result = 0;
-  int shift = 0;
-  for (;;) {
-    if (r->pos >= r->len) {
-      PyErr_SetString(PyExc_ValueError, "codec: truncated varint");
-      return false;
-    }
-    unsigned char byte = r->data[r->pos++];
-    if (shift >= 64 || (shift == 63 && (byte & 0x7E))) {
-      // arbitrary-precision int: fall back to the Python decoder
-      PyErr_SetString(PyExc_OverflowError, "varint beyond uint64");
-      return false;
-    }
-    result |= (uint64_t)(byte & 0x7F) << shift;
-    if (!(byte & 0x80)) {
-      *out = result;
-      return true;
-    }
-    shift += 7;
-  }
-}
-
-bool need(Reader* r, size_t n) {
-  if (r->len - r->pos < n) {
-    PyErr_SetString(PyExc_ValueError, "codec: truncated payload");
-    return false;
-  }
-  return true;
-}
-
-PyObject* decode_obj(Reader* r, int depth) {
-  if (depth > kMaxDepth) {
-    PyErr_SetString(PyExc_ValueError, "codec: nesting too deep");
-    return nullptr;
-  }
-  if (!need(r, 1)) return nullptr;
-  unsigned char tag = r->data[r->pos++];
-  switch (tag) {
-    case T_NONE: Py_RETURN_NONE;
-    case T_TRUE: Py_RETURN_TRUE;
-    case T_FALSE: Py_RETURN_FALSE;
-    case T_INT: {
-      uint64_t z;
-      if (!read_varint(r, &z)) return nullptr;
-      long long x = (z & 1)
-          ? -(long long)(z >> 1) - 1
-          : (long long)(z >> 1);
-      return PyLong_FromLongLong(x);
-    }
-    case T_F64: {
-      if (!need(r, 8)) return nullptr;
-      double d;
-      memcpy(&d, r->data + r->pos, 8);
-      r->pos += 8;
-      return PyFloat_FromDouble(d);
-    }
-    case T_STR: {
-      uint64_t n;
-      if (!read_varint(r, &n) || !need(r, n)) return nullptr;
-      PyObject* s = PyUnicode_DecodeUTF8(
-          (const char*)(r->data + r->pos), (Py_ssize_t)n, "surrogateescape");
-      r->pos += n;
-      return s;
-    }
-    case T_BYTES: {
-      uint64_t n;
-      if (!read_varint(r, &n) || !need(r, n)) return nullptr;
-      PyObject* s = PyBytes_FromStringAndSize(
-          (const char*)(r->data + r->pos), (Py_ssize_t)n);
-      r->pos += n;
-      return s;
-    }
-    case T_LIST: {
-      uint64_t n;
-      if (!read_varint(r, &n)) return nullptr;
-      if (n > r->len - r->pos) {  // each item needs >= 1 byte
-        PyErr_SetString(PyExc_ValueError, "codec: bad list length");
-        return nullptr;
-      }
-      PyObject* list = PyList_New((Py_ssize_t)n);
-      if (list == nullptr) return nullptr;
-      for (uint64_t i = 0; i < n; i++) {
-        PyObject* item = decode_obj(r, depth + 1);
-        if (item == nullptr) {
-          Py_DECREF(list);
-          return nullptr;
-        }
-        PyList_SET_ITEM(list, (Py_ssize_t)i, item);
-      }
-      return list;
-    }
-    case T_MAP: {
-      uint64_t n;
-      if (!read_varint(r, &n)) return nullptr;
-      if (n > r->len - r->pos) {
-        PyErr_SetString(PyExc_ValueError, "codec: bad map length");
-        return nullptr;
-      }
-      PyObject* d = PyDict_New();
-      if (d == nullptr) return nullptr;
-      for (uint64_t i = 0; i < n; i++) {
-        PyObject* key = decode_obj(r, depth + 1);
-        if (key == nullptr) {
-          Py_DECREF(d);
-          return nullptr;
-        }
-        PyObject* val = decode_obj(r, depth + 1);
-        if (val == nullptr) {
-          Py_DECREF(key);
-          Py_DECREF(d);
-          return nullptr;
-        }
-        int rc = PyDict_SetItem(d, key, val);
-        Py_DECREF(key);
-        Py_DECREF(val);
-        if (rc < 0) {
-          Py_DECREF(d);
-          return nullptr;
-        }
-      }
-      return d;
-    }
-    default:
-      PyErr_Format(PyExc_ValueError, "codec: bad tag 0x%02x at %zu",
-                   tag, r->pos - 1);
-      return nullptr;
-  }
-}
-
-// -- module ------------------------------------------------------------------
+using ybtag::Buf;
+using ybtag::Reader;
 
 PyObject* py_encode(PyObject*, PyObject* arg) {
   Buf b;
-  if (!encode_obj(&b, arg, 0)) return nullptr;
+  if (!ybtag::encode_obj(&b, arg, 0)) return nullptr;
   return PyBytes_FromStringAndSize(b.data, (Py_ssize_t)b.len);
 }
 
@@ -307,7 +32,7 @@ PyObject* py_decode(PyObject*, PyObject* arg) {
   if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
   Reader r{static_cast<const unsigned char*>(view.buf),
            (size_t)view.len};
-  PyObject* v = decode_obj(&r, 0);
+  PyObject* v = ybtag::decode_obj(&r, 0);
   if (v != nullptr && r.pos != r.len) {
     PyErr_Format(PyExc_ValueError, "codec: %zu trailing bytes",
                  r.len - r.pos);

@@ -1,0 +1,1091 @@
+// Native write plane: row-block codec, client-side batch encoding,
+// leader-side hybrid-time stamping, and the C++ memtable.
+//
+// The reference's entire write pipeline is C++ — RPC framing
+// (src/yb/rpc/reactor.cc), WAL group-commit append (src/yb/consensus/
+// log.cc Log::Appender/TaskStream), leader-side batch assembly
+// (src/yb/tablet/preparer.cc), and the rocksdb memtable
+// (src/yb/rocksdb/memtable). This module is the equivalent hot path for
+// the TPU-native framework: a write batch is encoded ONCE on the client
+// into a contiguous "row block" (doc-key encoding + partition hashing +
+// per-tablet split all native), flows opaque through RPC, the WAL body,
+// and Raft replication, is stamped with the commit hybrid time by a
+// single native pass on the leader, and lands in a C++ memtable on every
+// replica — no per-row Python objects anywhere on the path.
+//
+// Row block layout (little-endian):
+//   u32 nrows, then per row:
+//     u16 key_len, key bytes        (byte-comparable DocKey)
+//     u64 ht                        (commit hybrid time; 0 until stamped)
+//     u64 expire_ht                 (TTL expiry; MAX_HT = none)
+//     i64 ttl_us                    (-1 = none; resolved at stamping)
+//     u32 write_id                  (intra-batch MVCC order)
+//     u8  flags                     (1 = tombstone, 2 = liveness)
+//     u16 ncols, then per column: u32 col_id, tagged value (tagcodec.h)
+//
+// The pure-Python spec lives in yugabyte_db_tpu/storage/rowblock.py;
+// yugabyte_db_tpu/storage/memtable.py documents the memtable interface.
+//
+// Exposed as the CPython extension module `yb_wp`.
+
+#include "tagcodec.h"
+
+#include <algorithm>
+#include <map>
+#include <unordered_map>
+#include <string>
+#include <vector>
+
+namespace {
+
+using ybtag::Buf;
+using ybtag::Reader;
+
+constexpr uint64_t kMaxHT = (1ULL << 63) - 1;
+
+// Key-encoding tags (yugabyte_db_tpu/models/encoding.py).
+enum KeyTag : unsigned char {
+  K_GROUP_END = 0x01,
+  K_NULL = 0x04,
+  K_HASH = 0x08,
+  K_FALSE = 0x10,
+  K_TRUE = 0x11,
+  K_INT = 0x20,
+  K_DOUBLE = 0x28,
+  K_STRING = 0x30,
+  K_BINARY = 0x32,
+};
+
+// dtype codes passed from Python (models/datatypes.py key kinds).
+enum DtypeCode { DT_BOOL = 0, DT_INT = 1, DT_DOUBLE = 2, DT_STR = 3,
+                 DT_BIN = 4 };
+
+// -- crc32 (zlib-compatible) -------------------------------------------------
+
+const uint32_t* crc_table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) {
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+uint32_t crc32(const unsigned char* p, size_t n) {
+  const uint32_t* t = crc_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) {
+    c = t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  }
+  return c ^ 0xFFFFFFFFu;
+}
+
+// -- little-endian scalar writes --------------------------------------------
+
+bool put_u16(Buf* b, uint16_t v) { return ybtag::buf_put(b, &v, 2); }
+bool put_u32(Buf* b, uint32_t v) { return ybtag::buf_put(b, &v, 4); }
+bool put_u64(Buf* b, uint64_t v) { return ybtag::buf_put(b, &v, 8); }
+bool put_i64(Buf* b, int64_t v) { return ybtag::buf_put(b, &v, 8); }
+
+uint16_t get_u16(const unsigned char* p) { uint16_t v; memcpy(&v, p, 2); return v; }
+uint32_t get_u32(const unsigned char* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+uint64_t get_u64(const unsigned char* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+int64_t get_i64(const unsigned char* p) { int64_t v; memcpy(&v, p, 8); return v; }
+
+// -- key-component encoding (parity with models/encoding.py) -----------------
+
+bool key_put_int(Buf* b, long long x) {
+  // Sign-flip maps signed order onto unsigned byte order; big-endian.
+  uint64_t biased = static_cast<uint64_t>(x) + (1ULL << 63);
+  unsigned char be[8];
+  for (int i = 7; i >= 0; i--) { be[i] = biased & 0xFF; biased >>= 8; }
+  return ybtag::buf_putc(b, K_INT) && ybtag::buf_put(b, be, 8);
+}
+
+bool key_put_double(Buf* b, double d) {
+  if (d == 0.0) d = 0.0;  // canonicalize -0.0
+  uint64_t bits;
+  memcpy(&bits, &d, 8);
+  if (bits & (1ULL << 63)) {
+    bits = ~bits;                 // negative: flip all bits
+  } else {
+    bits |= 1ULL << 63;           // positive: flip sign bit
+  }
+  unsigned char be[8];
+  for (int i = 7; i >= 0; i--) { be[i] = bits & 0xFF; bits >>= 8; }
+  return ybtag::buf_putc(b, K_DOUBLE) && ybtag::buf_put(b, be, 8);
+}
+
+bool key_put_escaped(Buf* b, const unsigned char* p, size_t n) {
+  // 0x00 -> 0x00 0x01, terminated 0x00 0x00 (ZeroEncodeAndAppendStrToKey).
+  for (size_t i = 0; i < n; i++) {
+    if (!ybtag::buf_putc(b, p[i])) return false;
+    if (p[i] == 0 && !ybtag::buf_putc(b, 0x01)) return false;
+  }
+  return ybtag::buf_putc(b, 0x00) && ybtag::buf_putc(b, 0x00);
+}
+
+// Encode one key column value as [tag][payload]. Returns false with a
+// Python error set on unsupported value.
+bool encode_key_component(Buf* b, PyObject* v, int dtype) {
+  if (v == Py_None) return ybtag::buf_putc(b, K_NULL);
+  switch (dtype) {
+    case DT_BOOL: {
+      int truth = PyObject_IsTrue(v);
+      if (truth < 0) return false;
+      return ybtag::buf_putc(b, truth ? K_TRUE : K_FALSE);
+    }
+    case DT_INT: {
+      long long x;
+      if (PyLong_Check(v)) {
+        int overflow = 0;
+        x = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (overflow != 0) {
+          PyErr_SetString(PyExc_ValueError,
+                          "integer key value out of int64 range");
+          return false;
+        }
+        if (x == -1 && PyErr_Occurred()) return false;
+      } else {
+        PyObject* as_int = PyNumber_Long(v);
+        if (as_int == nullptr) return false;
+        x = PyLong_AsLongLong(as_int);
+        Py_DECREF(as_int);
+        if (x == -1 && PyErr_Occurred()) return false;
+      }
+      return key_put_int(b, x);
+    }
+    case DT_DOUBLE: {
+      double d = PyFloat_AsDouble(v);
+      if (d == -1.0 && PyErr_Occurred()) return false;
+      return key_put_double(b, d);
+    }
+    case DT_STR: {
+      if (!PyUnicode_Check(v)) {
+        PyErr_Format(PyExc_TypeError, "string key value must be str, not %s",
+                     Py_TYPE(v)->tp_name);
+        return false;
+      }
+      PyObject* raw = PyUnicode_AsEncodedString(v, "utf-8", "surrogateescape");
+      if (raw == nullptr) return false;
+      char* p;
+      Py_ssize_t n;
+      if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
+        Py_DECREF(raw);
+        return false;
+      }
+      bool ok = ybtag::buf_putc(b, K_STRING) &&
+                key_put_escaped(b, (const unsigned char*)p, (size_t)n);
+      Py_DECREF(raw);
+      return ok;
+    }
+    case DT_BIN: {
+      PyObject* raw = PyBytes_FromObject(v);
+      if (raw == nullptr) return false;
+      char* p;
+      Py_ssize_t n;
+      if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
+        Py_DECREF(raw);
+        return false;
+      }
+      bool ok = ybtag::buf_putc(b, K_BINARY) &&
+                key_put_escaped(b, (const unsigned char*)p, (size_t)n);
+      Py_DECREF(raw);
+      return ok;
+    }
+    default:
+      PyErr_Format(PyExc_ValueError, "bad key dtype code %d", dtype);
+      return false;
+  }
+}
+
+// -- record writer -----------------------------------------------------------
+
+struct RecHeader {
+  uint64_t ht;
+  uint64_t expire_ht;
+  int64_t ttl_us;      // -1 = none
+  uint32_t write_id;
+  uint8_t flags;       // 1 = tombstone, 2 = liveness
+};
+
+// After key: ht(8) expire(8) ttl(8) write_id(4) flags(1) ncols(2)
+constexpr size_t kFixedAfterKey = 8 + 8 + 8 + 4 + 1 + 2;
+
+bool write_rec_fixed(Buf* b, const RecHeader& h, uint16_t ncols) {
+  return put_u64(b, h.ht) && put_u64(b, h.expire_ht) &&
+         put_i64(b, h.ttl_us) && put_u32(b, h.write_id) &&
+         ybtag::buf_putc(b, h.flags) && put_u16(b, ncols);
+}
+
+// Parse one record starting at r->pos. On success leaves r->pos at the
+// next record and fills out the component offsets/lengths.
+struct RecView {
+  const unsigned char* key;
+  size_t key_len;
+  size_t fixed_off;     // offset of ht field within the block
+  RecHeader h;
+  uint16_t ncols;
+  const unsigned char* cols;
+  size_t cols_len;
+};
+
+bool parse_rec(Reader* r, RecView* out) {
+  if (!ybtag::need(r, 2)) return false;
+  uint16_t klen = get_u16(r->data + r->pos);
+  r->pos += 2;
+  if (!ybtag::need(r, klen + kFixedAfterKey)) return false;
+  out->key = r->data + r->pos;
+  out->key_len = klen;
+  r->pos += klen;
+  out->fixed_off = r->pos;
+  const unsigned char* p = r->data + r->pos;
+  out->h.ht = get_u64(p);
+  out->h.expire_ht = get_u64(p + 8);
+  out->h.ttl_us = get_i64(p + 16);
+  out->h.write_id = get_u32(p + 24);
+  out->h.flags = p[28];
+  out->ncols = get_u16(p + 29);
+  r->pos += kFixedAfterKey;
+  size_t cols_start = r->pos;
+  out->cols = r->data + cols_start;
+  for (uint16_t i = 0; i < out->ncols; i++) {
+    if (!ybtag::need(r, 4)) return false;
+    r->pos += 4;
+    if (!ybtag::skip_obj(r, 0)) return false;
+  }
+  out->cols_len = r->pos - cols_start;
+  return true;
+}
+
+bool read_nrows(Reader* r, uint32_t* nrows) {
+  if (!ybtag::need(r, 4)) return false;
+  *nrows = get_u32(r->data + r->pos);
+  r->pos += 4;
+  return true;
+}
+
+// Decode a record's column section into a fresh dict {col_id: value}.
+PyObject* cols_to_dict(const unsigned char* cols, size_t cols_len,
+                       uint16_t ncols) {
+  PyObject* d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  Reader r{cols, cols_len};
+  for (uint16_t i = 0; i < ncols; i++) {
+    if (!ybtag::need(&r, 4)) { Py_DECREF(d); return nullptr; }
+    uint32_t col_id = get_u32(r.data + r.pos);
+    r.pos += 4;
+    PyObject* key = PyLong_FromUnsignedLong(col_id);
+    if (key == nullptr) { Py_DECREF(d); return nullptr; }
+    PyObject* val = ybtag::decode_obj(&r, 0);
+    if (val == nullptr) { Py_DECREF(key); Py_DECREF(d); return nullptr; }
+    int rc = PyDict_SetItem(d, key, val);
+    Py_DECREF(key);
+    Py_DECREF(val);
+    if (rc < 0) { Py_DECREF(d); return nullptr; }
+  }
+  return d;
+}
+
+// Build the Python row tuple (key, ht, tombstone, liveness, columns,
+// expire_ht, ttl_us, write_id) — RowVersion's positional field order.
+PyObject* rec_to_tuple(const RecView& v) {
+  PyObject* cols = cols_to_dict(v.cols, v.cols_len, v.ncols);
+  if (cols == nullptr) return nullptr;
+  PyObject* ttl = (v.h.ttl_us < 0) ? Py_NewRef(Py_None)
+                                   : PyLong_FromLongLong(v.h.ttl_us);
+  if (ttl == nullptr) { Py_DECREF(cols); return nullptr; }
+  PyObject* tup = Py_BuildValue(
+      "(y#LOONLNk)",
+      (const char*)v.key, (Py_ssize_t)v.key_len,
+      (long long)v.h.ht,
+      (v.h.flags & 1) ? Py_True : Py_False,
+      (v.h.flags & 2) ? Py_True : Py_False,
+      cols,
+      (long long)v.h.expire_ht,
+      ttl,
+      (unsigned long)v.h.write_id);
+  // Py_BuildValue 'N' steals cols/ttl refs on success; on failure it
+  // decrefs already-converted items itself.
+  return tup;
+}
+
+// -- encode_ops: the client-side batch encoder -------------------------------
+
+struct ColSpec {
+  PyObject* name;   // borrowed from the desc tuple (held by caller)
+  int dtype;
+};
+
+bool parse_colspecs(PyObject* seq, std::vector<ColSpec>* out) {
+  PyObject* fast = PySequence_Fast(seq, "encode_ops: column spec list");
+  if (fast == nullptr) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(fast, i);
+    PyObject* name;
+    int dtype;
+    if (!PyArg_ParseTuple(item, "Oi", &name, &dtype)) {
+      Py_DECREF(fast);
+      return false;
+    }
+    out->push_back({name, dtype});
+  }
+  Py_DECREF(fast);
+  return true;
+}
+
+// encode_ops(desc, ops, starts) -> list of (nrows, bytes) | None per
+// partition.
+//   desc = (hash_cols, range_cols, value_cols, valmap)
+//     hash_cols / range_cols: sequence of (name, dtype_code)
+//     value_cols: sequence of (name, col_id) in schema order
+//     valmap: dict name -> col_id (update-set lookups)
+//   ops: sequence of (kind, key_src, cols_src, expire_ht, ttl_us)
+//     kind 0 = insert (columns taken from key_src by value_cols order),
+//     kind 1 = update (columns from cols_src via valmap),
+//     kind 2 = delete (tombstone)
+//   starts: sequence of partition start hash codes (sorted, first == 0)
+PyObject* py_encode_ops(PyObject*, PyObject* args) {
+  PyObject *desc, *ops, *starts_obj;
+  if (!PyArg_ParseTuple(args, "OOO", &desc, &ops, &starts_obj)) return nullptr;
+
+  PyObject *hash_cols_obj, *range_cols_obj, *value_cols_obj, *valmap;
+  if (!PyArg_ParseTuple(desc, "OOOO", &hash_cols_obj, &range_cols_obj,
+                        &value_cols_obj, &valmap)) {
+    return nullptr;
+  }
+  std::vector<ColSpec> hash_cols, range_cols;
+  if (!parse_colspecs(hash_cols_obj, &hash_cols) ||
+      !parse_colspecs(range_cols_obj, &range_cols)) {
+    return nullptr;
+  }
+  // value columns: (name, col_id)
+  std::vector<std::pair<PyObject*, uint32_t>> value_cols;
+  {
+    PyObject* fast = PySequence_Fast(value_cols_obj,
+                                     "encode_ops: value column list");
+    if (fast == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* item = PySequence_Fast_GET_ITEM(fast, i);
+      PyObject* name;
+      unsigned long col_id;
+      if (!PyArg_ParseTuple(item, "Ok", &name, &col_id)) {
+        Py_DECREF(fast);
+        return nullptr;
+      }
+      value_cols.push_back({name, (uint32_t)col_id});
+    }
+    Py_DECREF(fast);
+  }
+  std::vector<uint32_t> starts;
+  {
+    PyObject* fast = PySequence_Fast(starts_obj, "encode_ops: starts");
+    if (fast == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(fast, i));
+      if (v == -1 && PyErr_Occurred()) { Py_DECREF(fast); return nullptr; }
+      starts.push_back((uint32_t)v);
+    }
+    Py_DECREF(fast);
+  }
+  if (starts.empty() || starts[0] != 0) {
+    // starts[0] == 0 guarantees the upper_bound partition lookup below
+    // can never underflow (every hash has a covering partition).
+    PyErr_SetString(PyExc_ValueError,
+                    "encode_ops: partition starts must begin at 0");
+    return nullptr;
+  }
+
+  size_t nparts = starts.size();
+  std::vector<Buf> bufs(nparts);
+  std::vector<uint32_t> counts(nparts, 0);
+
+  PyObject* ops_fast = PySequence_Fast(ops, "encode_ops: ops");
+  if (ops_fast == nullptr) return nullptr;
+  Py_ssize_t nops = PySequence_Fast_GET_SIZE(ops_fast);
+  Buf key;      // reused per row
+  Buf hashbuf;  // reused per row (hash-column bytes for crc)
+  for (Py_ssize_t i = 0; i < nops; i++) {
+    PyObject* op = PySequence_Fast_GET_ITEM(ops_fast, i);
+    int kind;
+    PyObject *key_src, *cols_src, *ttl_obj;
+    long long expire_ht;
+    if (!PyArg_ParseTuple(op, "iOOLO", &kind, &key_src, &cols_src,
+                          &expire_ht, &ttl_obj)) {
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    // -- doc key + partition hash
+    key.len = 0;
+    size_t part = 0;
+    if (!hash_cols.empty()) {
+      hashbuf.len = 0;
+      for (const ColSpec& c : hash_cols) {
+        PyObject* v = PyDict_GetItemWithError(key_src, c.name);
+        if (v == nullptr) {
+          if (!PyErr_Occurred()) PyErr_SetObject(PyExc_KeyError, c.name);
+          Py_DECREF(ops_fast);
+          return nullptr;
+        }
+        if (!encode_key_component(&hashbuf, v, c.dtype)) {
+          Py_DECREF(ops_fast);
+          return nullptr;
+        }
+      }
+      uint32_t crc = crc32((const unsigned char*)hashbuf.data, hashbuf.len);
+      uint16_t h = (uint16_t)(((crc >> 16) ^ (crc & 0xFFFF)) & 0xFFFF);
+      // partition index: last start <= h
+      part = std::upper_bound(starts.begin(), starts.end(), (uint32_t)h) -
+             starts.begin() - 1;
+      bool ok = ybtag::buf_putc(&key, K_HASH) &&
+                ybtag::buf_putc(&key, (unsigned char)(h >> 8)) &&
+                ybtag::buf_putc(&key, (unsigned char)(h & 0xFF)) &&
+                ybtag::buf_put(&key, hashbuf.data, hashbuf.len) &&
+                ybtag::buf_putc(&key, K_GROUP_END);
+      if (!ok) { Py_DECREF(ops_fast); return nullptr; }
+    }
+    for (const ColSpec& c : range_cols) {
+      PyObject* v = PyDict_GetItemWithError(key_src, c.name);
+      if (v == nullptr) {
+        if (!PyErr_Occurred()) PyErr_SetObject(PyExc_KeyError, c.name);
+        Py_DECREF(ops_fast);
+        return nullptr;
+      }
+      if (!encode_key_component(&key, v, c.dtype)) {
+        Py_DECREF(ops_fast);
+        return nullptr;
+      }
+    }
+    if (!ybtag::buf_putc(&key, K_GROUP_END)) {
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    // -- record
+    if (key.len > 0xFFFF) {
+      PyErr_SetString(PyExc_ValueError, "encoded key exceeds 64KiB");
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    Buf* out = &bufs[part];
+    if (counts[part] == 0 && !put_u32(out, 0)) {  // nrows placeholder
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    RecHeader h{};
+    h.ht = 0;
+    h.expire_ht = (uint64_t)expire_ht;
+    h.ttl_us = (ttl_obj == Py_None) ? -1 : PyLong_AsLongLong(ttl_obj);
+    if (h.ttl_us == -1 && ttl_obj != Py_None && PyErr_Occurred()) {
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    h.write_id = 0;
+    h.flags = (kind == 2) ? 1 : (kind == 0 ? 2 : 0);
+    if (!put_u16(out, (uint16_t)key.len) ||
+        !ybtag::buf_put(out, key.data, key.len)) {
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    size_t fixed_at = out->len;
+    if (!write_rec_fixed(out, h, 0)) {
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    uint16_t ncols = 0;
+    bool ok = true;
+    if (kind == 0) {
+      for (const auto& vc : value_cols) {
+        PyObject* v = PyDict_GetItemWithError(key_src, vc.first);
+        if (v == nullptr) {
+          if (PyErr_Occurred()) { ok = false; break; }
+          continue;  // column not provided
+        }
+        ok = put_u32(out, vc.second) && ybtag::encode_obj(out, v, 0);
+        if (!ok) break;
+        ncols++;
+      }
+    } else if (kind == 1) {
+      PyObject *name, *v;
+      Py_ssize_t pos = 0;
+      while (ok && PyDict_Next(cols_src, &pos, &name, &v)) {
+        PyObject* cid = PyDict_GetItemWithError(valmap, name);
+        if (cid == nullptr) {
+          if (!PyErr_Occurred()) PyErr_SetObject(PyExc_KeyError, name);
+          ok = false;
+          break;
+        }
+        unsigned long col_id = PyLong_AsUnsignedLong(cid);
+        if (col_id == (unsigned long)-1 && PyErr_Occurred()) {
+          ok = false;
+          break;
+        }
+        ok = put_u32(out, (uint32_t)col_id) && ybtag::encode_obj(out, v, 0);
+        if (ok) ncols++;
+      }
+    }
+    if (!ok) {
+      Py_DECREF(ops_fast);
+      return nullptr;
+    }
+    // patch ncols
+    uint16_t nc = ncols;
+    memcpy(out->data + fixed_at + 29, &nc, 2);
+    counts[part]++;
+  }
+  Py_DECREF(ops_fast);
+
+  PyObject* result = PyList_New((Py_ssize_t)nparts);
+  if (result == nullptr) return nullptr;
+  for (size_t p = 0; p < nparts; p++) {
+    if (counts[p] == 0) {
+      PyList_SET_ITEM(result, (Py_ssize_t)p, Py_NewRef(Py_None));
+      continue;
+    }
+    memcpy(bufs[p].data, &counts[p], 4);  // patch nrows
+    PyObject* block = PyBytes_FromStringAndSize(bufs[p].data,
+                                               (Py_ssize_t)bufs[p].len);
+    if (block == nullptr) { Py_DECREF(result); return nullptr; }
+    PyObject* pair = Py_BuildValue("(kN)", (unsigned long)counts[p], block);
+    if (pair == nullptr) { Py_DECREF(result); return nullptr; }
+    PyList_SET_ITEM(result, (Py_ssize_t)p, pair);
+  }
+  return result;
+}
+
+// -- encode_rows: RowVersion list -> block (legacy-path bridge) --------------
+
+PyObject* py_encode_rows(PyObject*, PyObject* arg) {
+  PyObject* fast = PySequence_Fast(arg, "encode_rows: row list");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  Buf out;
+  if (!put_u32(&out, (uint32_t)n)) { Py_DECREF(fast); return nullptr; }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* row = PySequence_Fast_GET_ITEM(fast, i);
+    PyObject* key = PyObject_GetAttrString(row, "key");
+    PyObject* ht = key ? PyObject_GetAttrString(row, "ht") : nullptr;
+    PyObject* tomb = ht ? PyObject_GetAttrString(row, "tombstone") : nullptr;
+    PyObject* live = tomb ? PyObject_GetAttrString(row, "liveness") : nullptr;
+    PyObject* cols = live ? PyObject_GetAttrString(row, "columns") : nullptr;
+    PyObject* exp = cols ? PyObject_GetAttrString(row, "expire_ht") : nullptr;
+    PyObject* ttl = exp ? PyObject_GetAttrString(row, "ttl_us") : nullptr;
+    PyObject* wid = ttl ? PyObject_GetAttrString(row, "write_id") : nullptr;
+    PyObject* incs = wid ? PyObject_GetAttrString(row, "increments") : nullptr;
+    bool ok = incs != nullptr;
+    if (ok && PyObject_IsTrue(incs)) {
+      PyErr_SetString(PyExc_ValueError,
+                      "encode_rows: unresolved counter increments");
+      ok = false;
+    }
+    char* kp = nullptr;
+    Py_ssize_t klen = 0;
+    ok = ok && PyBytes_AsStringAndSize(key, &kp, &klen) == 0;
+    RecHeader h{};
+    if (ok) {
+      h.ht = (uint64_t)PyLong_AsLongLong(ht);
+      h.expire_ht = (uint64_t)PyLong_AsLongLong(exp);
+      h.ttl_us = (ttl == Py_None) ? -1 : PyLong_AsLongLong(ttl);
+      h.write_id = (uint32_t)PyLong_AsLong(wid);
+      int t = PyObject_IsTrue(tomb);
+      int l = PyObject_IsTrue(live);
+      if (t < 0 || l < 0 || PyErr_Occurred()) ok = false;
+      h.flags = (uint8_t)((t ? 1 : 0) | (l ? 2 : 0));
+    }
+    if (ok && !PyDict_Check(cols)) {
+      PyErr_SetString(PyExc_TypeError, "encode_rows: columns must be a dict");
+      ok = false;
+    }
+    if (ok && klen > 0xFFFF) {
+      PyErr_SetString(PyExc_ValueError, "encoded key exceeds 64KiB");
+      ok = false;
+    }
+    if (ok) {
+      Py_ssize_t ncols = PyDict_Size(cols);
+      ok = ncols <= 0xFFFF &&
+           put_u16(&out, (uint16_t)klen) &&
+           ybtag::buf_put(&out, kp, (size_t)klen) &&
+           write_rec_fixed(&out, h, (uint16_t)ncols);
+      PyObject *ck, *cv;
+      Py_ssize_t pos = 0;
+      while (ok && PyDict_Next(cols, &pos, &ck, &cv)) {
+        unsigned long col_id = PyLong_AsUnsignedLong(ck);
+        if (col_id == (unsigned long)-1 && PyErr_Occurred()) {
+          ok = false;
+          break;
+        }
+        ok = put_u32(&out, (uint32_t)col_id) && ybtag::encode_obj(&out, cv, 0);
+      }
+    }
+    Py_XDECREF(key); Py_XDECREF(ht); Py_XDECREF(tomb); Py_XDECREF(live);
+    Py_XDECREF(cols); Py_XDECREF(exp); Py_XDECREF(ttl); Py_XDECREF(wid);
+    Py_XDECREF(incs);
+    if (!ok) {
+      Py_DECREF(fast);
+      if (!PyErr_Occurred()) {
+        PyErr_SetString(PyExc_ValueError, "encode_rows: bad row");
+      }
+      return nullptr;
+    }
+  }
+  Py_DECREF(fast);
+  return PyBytes_FromStringAndSize(out.data, (Py_ssize_t)out.len);
+}
+
+// -- stamp_block -------------------------------------------------------------
+
+// stamp_block(block, ht, logical_shift) -> bytes
+// Leader-side commit stamping in one native pass: every row gets the
+// batch hybrid time, its position as write_id, and TTLs resolved to
+// absolute expiry (expire_ht = ht + (ttl_us << logical_shift)).
+PyObject* py_stamp_block(PyObject*, PyObject* args) {
+  Py_buffer view;
+  long long ht;
+  int shift;
+  if (!PyArg_ParseTuple(args, "y*Li", &view, &ht, &shift)) return nullptr;
+  PyObject* out = PyBytes_FromStringAndSize((const char*)view.buf, view.len);
+  PyBuffer_Release(&view);
+  if (out == nullptr) return nullptr;
+  unsigned char* data = (unsigned char*)PyBytes_AS_STRING(out);
+  size_t len = (size_t)PyBytes_GET_SIZE(out);
+  Reader r{data, len};
+  uint32_t nrows;
+  if (!read_nrows(&r, &nrows)) { Py_DECREF(out); return nullptr; }
+  for (uint32_t i = 0; i < nrows; i++) {
+    RecView v;
+    if (!parse_rec(&r, &v)) { Py_DECREF(out); return nullptr; }
+    unsigned char* p = data + v.fixed_off;
+    uint64_t hts = (uint64_t)ht;
+    memcpy(p, &hts, 8);
+    if (v.h.ttl_us >= 0) {
+      uint64_t exp = (uint64_t)ht + ((uint64_t)v.h.ttl_us << shift);
+      memcpy(p + 8, &exp, 8);
+      int64_t none = -1;
+      memcpy(p + 16, &none, 8);  // ttl resolved; stamped rows carry none
+    }
+    memcpy(p + 24, &i, 4);
+  }
+  if (r.pos != len) {
+    PyErr_SetString(PyExc_ValueError, "stamp_block: trailing bytes");
+    Py_DECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+// -- block accessors ---------------------------------------------------------
+
+PyObject* py_block_count(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  Reader r{(const unsigned char*)view.buf, (size_t)view.len};
+  uint32_t nrows;
+  bool ok = read_nrows(&r, &nrows);
+  PyBuffer_Release(&view);
+  if (!ok) return nullptr;
+  return PyLong_FromUnsignedLong(nrows);
+}
+
+PyObject* py_block_keys(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  Reader r{(const unsigned char*)view.buf, (size_t)view.len};
+  uint32_t nrows;
+  if (!read_nrows(&r, &nrows)) { PyBuffer_Release(&view); return nullptr; }
+  PyObject* out = PyList_New((Py_ssize_t)nrows);
+  if (out == nullptr) { PyBuffer_Release(&view); return nullptr; }
+  for (uint32_t i = 0; i < nrows; i++) {
+    RecView v;
+    if (!parse_rec(&r, &v)) {
+      Py_DECREF(out);
+      PyBuffer_Release(&view);
+      return nullptr;
+    }
+    PyObject* key = PyBytes_FromStringAndSize((const char*)v.key,
+                                              (Py_ssize_t)v.key_len);
+    if (key == nullptr) {
+      Py_DECREF(out);
+      PyBuffer_Release(&view);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, key);
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+PyObject* py_block_rows(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  Reader r{(const unsigned char*)view.buf, (size_t)view.len};
+  uint32_t nrows;
+  if (!read_nrows(&r, &nrows)) { PyBuffer_Release(&view); return nullptr; }
+  PyObject* out = PyList_New((Py_ssize_t)nrows);
+  if (out == nullptr) { PyBuffer_Release(&view); return nullptr; }
+  for (uint32_t i = 0; i < nrows; i++) {
+    RecView v;
+    PyObject* tup = parse_rec(&r, &v) ? rec_to_tuple(v) : nullptr;
+    if (tup == nullptr) {
+      Py_DECREF(out);
+      PyBuffer_Release(&view);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, tup);
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+// block_ht_range(block) -> (min_ht, max_ht) or None for an empty block.
+PyObject* py_block_ht_range(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  Reader r{(const unsigned char*)view.buf, (size_t)view.len};
+  uint32_t nrows;
+  if (!read_nrows(&r, &nrows)) { PyBuffer_Release(&view); return nullptr; }
+  uint64_t lo = ~0ULL, hi = 0;
+  for (uint32_t i = 0; i < nrows; i++) {
+    RecView v;
+    if (!parse_rec(&r, &v)) { PyBuffer_Release(&view); return nullptr; }
+    lo = std::min(lo, v.h.ht);
+    hi = std::max(hi, v.h.ht);
+  }
+  PyBuffer_Release(&view);
+  if (nrows == 0) Py_RETURN_NONE;
+  return Py_BuildValue("(LL)", (long long)lo, (long long)hi);
+}
+
+// -- Memtable ----------------------------------------------------------------
+
+struct Ver {
+  uint64_t ht;
+  uint64_t expire_ht;
+  int64_t ttl_us;
+  uint32_t write_id;
+  uint8_t flags;
+  uint16_t ncols;
+  std::string cols;
+};
+
+// Hash-map store + lazily-sorted key index: writes are O(1) (the hot
+// path), the sort is amortized across scans/flushes — the same shape as
+// the rocksdb memtable's skiplist trade-off, tuned for write-heavy
+// batches. Key-string pointers are stable across inserts (node-based
+// unordered_map), so the index holds pointers.
+struct MtData {
+  std::unordered_map<std::string, std::vector<Ver>> map;
+  std::vector<const std::string*> index;  // sorted when index_valid
+  bool index_valid = false;
+
+  void ensure_index() {
+    if (index_valid) return;
+    index.clear();
+    index.reserve(map.size());
+    for (const auto& kv : map) index.push_back(&kv.first);
+    std::sort(index.begin(), index.end(),
+              [](const std::string* a, const std::string* b) {
+                return *a < *b;
+              });
+    index_valid = true;
+  }
+};
+
+struct MemtableObject {
+  PyObject_HEAD
+  MtData* data;
+  size_t num_versions;
+  size_t approx_bytes;
+  uint64_t min_ht, max_ht;
+  bool has_ht;
+};
+
+PyObject* mt_new(PyTypeObject* type, PyObject*, PyObject*) {
+  MemtableObject* self = (MemtableObject*)type->tp_alloc(type, 0);
+  if (self == nullptr) return nullptr;
+  self->data = new (std::nothrow) MtData();
+  if (self->data == nullptr) {
+    Py_DECREF(self);
+    return PyErr_NoMemory();
+  }
+  self->num_versions = 0;
+  self->approx_bytes = 0;
+  self->min_ht = 0;
+  self->max_ht = 0;
+  self->has_ht = false;
+  return (PyObject*)self;
+}
+
+void mt_dealloc(MemtableObject* self) {
+  delete self->data;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+PyObject* mt_apply_block(MemtableObject* self, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  Reader r{(const unsigned char*)view.buf, (size_t)view.len};
+  uint32_t nrows;
+  if (!read_nrows(&r, &nrows)) { PyBuffer_Release(&view); return nullptr; }
+  for (uint32_t i = 0; i < nrows; i++) {
+    RecView v;
+    if (!parse_rec(&r, &v)) { PyBuffer_Release(&view); return nullptr; }
+    std::string key((const char*)v.key, v.key_len);
+    Ver ver{v.h.ht, v.h.expire_ht, v.h.ttl_us, v.h.write_id, v.h.flags,
+            v.ncols, std::string((const char*)v.cols, v.cols_len)};
+    auto emplaced = self->data->map.try_emplace(std::move(key));
+    if (emplaced.second) self->data->index_valid = false;
+    emplaced.first->second.push_back(std::move(ver));
+    self->num_versions++;
+    self->approx_bytes += v.key_len + 64 + 16 * (size_t)v.ncols;
+    if (!self->has_ht) {
+      self->min_ht = self->max_ht = v.h.ht;
+      self->has_ht = true;
+    } else {
+      self->min_ht = std::min(self->min_ht, v.h.ht);
+      self->max_ht = std::max(self->max_ht, v.h.ht);
+    }
+  }
+  PyBuffer_Release(&view);
+  if (r.pos != r.len) {
+    PyErr_SetString(PyExc_ValueError, "apply_block: trailing bytes");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* ver_to_tuple(const std::string& key, const Ver& v) {
+  RecView rv;
+  rv.key = (const unsigned char*)key.data();
+  rv.key_len = key.size();
+  rv.h = RecHeader{v.ht, v.expire_ht, v.ttl_us, v.write_id, v.flags};
+  rv.ncols = v.ncols;
+  rv.cols = (const unsigned char*)v.cols.data();
+  rv.cols_len = v.cols.size();
+  return rec_to_tuple(rv);
+}
+
+PyObject* mt_versions(MemtableObject* self, PyObject* arg) {
+  char* kp;
+  Py_ssize_t klen;
+  if (PyBytes_AsStringAndSize(arg, &kp, &klen) < 0) return nullptr;
+  std::string key(kp, (size_t)klen);
+  auto it = self->data->map.find(key);
+  if (it == self->data->map.end()) return PyList_New(0);
+  PyObject* out = PyList_New((Py_ssize_t)it->second.size());
+  if (out == nullptr) return nullptr;
+  for (size_t i = 0; i < it->second.size(); i++) {
+    PyObject* tup = ver_to_tuple(it->first, it->second[i]);
+    if (tup == nullptr) { Py_DECREF(out); return nullptr; }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, tup);
+  }
+  return out;
+}
+
+PyObject* mt_scan_keys(MemtableObject* self, PyObject* args) {
+  Py_buffer lo, hi;
+  if (!PyArg_ParseTuple(args, "y*y*", &lo, &hi)) return nullptr;
+  std::string lower((const char*)lo.buf, (size_t)lo.len);
+  std::string upper((const char*)hi.buf, (size_t)hi.len);
+  PyBuffer_Release(&lo);
+  PyBuffer_Release(&hi);
+  PyObject* out = PyList_New(0);
+  if (out == nullptr) return nullptr;
+  self->data->ensure_index();
+  auto& idx = self->data->index;
+  auto it = std::lower_bound(idx.begin(), idx.end(), lower,
+                             [](const std::string* a, const std::string& b) {
+                               return *a < b;
+                             });
+  for (; it != idx.end(); ++it) {
+    if (!upper.empty() && **it >= upper) break;
+    PyObject* key = PyBytes_FromStringAndSize((*it)->data(),
+                                              (Py_ssize_t)(*it)->size());
+    if (key == nullptr || PyList_Append(out, key) < 0) {
+      Py_XDECREF(key);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(key);
+  }
+  return out;
+}
+
+// has_keys(lower, upper) -> bool: emptiness probe without materializing.
+PyObject* mt_has_keys(MemtableObject* self, PyObject* args) {
+  Py_buffer lo, hi;
+  if (!PyArg_ParseTuple(args, "y*y*", &lo, &hi)) return nullptr;
+  std::string lower((const char*)lo.buf, (size_t)lo.len);
+  std::string upper((const char*)hi.buf, (size_t)hi.len);
+  PyBuffer_Release(&lo);
+  PyBuffer_Release(&hi);
+  self->data->ensure_index();
+  auto& idx = self->data->index;
+  auto it = std::lower_bound(idx.begin(), idx.end(), lower,
+                             [](const std::string* a, const std::string& b) {
+                               return *a < b;
+                             });
+  bool hit = it != idx.end() && (upper.empty() || **it < upper);
+  return PyBool_FromLong(hit);
+}
+
+// drain_sorted() -> [(key, [row tuples ht-desc])] in key order.
+PyObject* mt_drain_sorted(MemtableObject* self, PyObject*) {
+  PyObject* out = PyList_New((Py_ssize_t)self->data->map.size());
+  if (out == nullptr) return nullptr;
+  self->data->ensure_index();
+  Py_ssize_t idx = 0;
+  for (const std::string* kp : self->data->index) {
+    const std::string& key = *kp;
+    std::vector<Ver>& vers = self->data->map[key];
+    if (vers.size() > 1) {
+      std::stable_sort(vers.begin(), vers.end(),
+                       [](const Ver& a, const Ver& b) {
+                         if (a.ht != b.ht) return a.ht > b.ht;
+                         return a.write_id > b.write_id;
+                       });
+    }
+    PyObject* vlist = PyList_New((Py_ssize_t)vers.size());
+    if (vlist == nullptr) { Py_DECREF(out); return nullptr; }
+    for (size_t i = 0; i < vers.size(); i++) {
+      PyObject* tup = ver_to_tuple(key, vers[i]);
+      if (tup == nullptr) {
+        Py_DECREF(vlist);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      PyList_SET_ITEM(vlist, (Py_ssize_t)i, tup);
+    }
+    PyObject* kb = PyBytes_FromStringAndSize(key.data(),
+                                             (Py_ssize_t)key.size());
+    if (kb == nullptr) { Py_DECREF(vlist); Py_DECREF(out); return nullptr; }
+    PyObject* pair = PyTuple_New(2);
+    if (pair == nullptr) {
+      Py_DECREF(kb);
+      Py_DECREF(vlist);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(pair, 0, kb);
+    PyTuple_SET_ITEM(pair, 1, vlist);
+    PyList_SET_ITEM(out, idx++, pair);
+  }
+  return out;
+}
+
+PyObject* mt_stats(MemtableObject* self, PyObject*) {
+  return Py_BuildValue(
+      "{s:n,s:n,s:N,s:N}",
+      "num_versions", (Py_ssize_t)self->num_versions,
+      "approx_bytes", (Py_ssize_t)self->approx_bytes,
+      "min_ht", self->has_ht
+          ? PyLong_FromUnsignedLongLong(self->min_ht) : Py_NewRef(Py_None),
+      "max_ht", self->has_ht
+          ? PyLong_FromUnsignedLongLong(self->max_ht) : Py_NewRef(Py_None));
+}
+
+PyObject* mt_num_versions(MemtableObject* self, void*) {
+  return PyLong_FromSize_t(self->num_versions);
+}
+PyObject* mt_approx_bytes(MemtableObject* self, void*) {
+  return PyLong_FromSize_t(self->approx_bytes);
+}
+PyObject* mt_min_ht(MemtableObject* self, void*) {
+  if (!self->has_ht) Py_RETURN_NONE;
+  return PyLong_FromUnsignedLongLong(self->min_ht);
+}
+PyObject* mt_max_ht(MemtableObject* self, void*) {
+  if (!self->has_ht) Py_RETURN_NONE;
+  return PyLong_FromUnsignedLongLong(self->max_ht);
+}
+
+Py_ssize_t mt_len(PyObject* self) {
+  return (Py_ssize_t)((MemtableObject*)self)->num_versions;
+}
+
+PyMethodDef kMemtableMethods[] = {
+    {"apply_block", (PyCFunction)mt_apply_block, METH_O,
+     "apply_block(block): insert every row of an encoded row block"},
+    {"versions", (PyCFunction)mt_versions, METH_O,
+     "versions(key) -> list of row tuples (insertion order)"},
+    {"scan_keys", (PyCFunction)mt_scan_keys, METH_VARARGS,
+     "scan_keys(lower, upper) -> ordered keys in [lower, upper)"},
+    {"has_keys", (PyCFunction)mt_has_keys, METH_VARARGS,
+     "has_keys(lower, upper) -> any key in [lower, upper)"},
+    {"drain_sorted", (PyCFunction)mt_drain_sorted, METH_NOARGS,
+     "drain_sorted() -> [(key, [row tuples ht-desc])] in key order"},
+    {"stats", (PyCFunction)mt_stats, METH_NOARGS, "summary dict"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyGetSetDef kMemtableGetSet[] = {
+    {"num_versions", (getter)mt_num_versions, nullptr, nullptr, nullptr},
+    {"approx_bytes", (getter)mt_approx_bytes, nullptr, nullptr, nullptr},
+    {"min_ht", (getter)mt_min_ht, nullptr, nullptr, nullptr},
+    {"max_ht", (getter)mt_max_ht, nullptr, nullptr, nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+PySequenceMethods kMemtableSeq = {
+    mt_len,  // sq_length
+};
+
+PyTypeObject MemtableType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "yb_wp.Memtable",              // tp_name
+    sizeof(MemtableObject),        // tp_basicsize
+};
+
+// -- module ------------------------------------------------------------------
+
+PyMethodDef kMethods[] = {
+    {"encode_ops", py_encode_ops, METH_VARARGS,
+     "encode_ops(desc, ops, starts) -> per-partition (nrows, block)"},
+    {"encode_rows", py_encode_rows, METH_O,
+     "encode_rows(row_versions) -> block bytes"},
+    {"stamp_block", py_stamp_block, METH_VARARGS,
+     "stamp_block(block, ht, logical_shift) -> stamped block"},
+    {"block_count", py_block_count, METH_O, "row count of a block"},
+    {"block_keys", py_block_keys, METH_O, "keys of a block"},
+    {"block_rows", py_block_rows, METH_O,
+     "block -> list of RowVersion field tuples"},
+    {"block_ht_range", py_block_ht_range, METH_O,
+     "block -> (min_ht, max_ht) | None"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "yb_wp",
+    "native write plane: row blocks, batch encode, stamping, memtable",
+    -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_yb_wp() {
+  MemtableType.tp_flags = Py_TPFLAGS_DEFAULT;
+  MemtableType.tp_doc = "C++ memtable: encoded-key -> MVCC version list";
+  MemtableType.tp_new = mt_new;
+  MemtableType.tp_dealloc = (destructor)mt_dealloc;
+  MemtableType.tp_methods = kMemtableMethods;
+  MemtableType.tp_getset = kMemtableGetSet;
+  MemtableType.tp_as_sequence = &kMemtableSeq;
+  if (PyType_Ready(&MemtableType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&kModule);
+  if (m == nullptr) return nullptr;
+  Py_INCREF(&MemtableType);
+  if (PyModule_AddObject(m, "Memtable", (PyObject*)&MemtableType) < 0) {
+    Py_DECREF(&MemtableType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

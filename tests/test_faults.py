@@ -148,7 +148,7 @@ def test_write_respond_failed_is_exactly_once():
                         versions += len(vers)
                     versions += sum(
                         len(eng.memtable.versions(k))
-                        for k in list(eng.memtable._data))
+                        for k in eng.memtable.scan_keys(b"", b""))
             assert versions == 2  # 'a' and 'b', one version each
         finally:
             clear_faults()

@@ -100,9 +100,11 @@ def test_rpcz_endpoint_serves_request_traces(tmp_path):
         with urllib.request.urlopen(
                 f"http://{host}:{port}/rpcz", timeout=5) as r:
             d = json.load(r)
-        assert "ts.write" in d["methods"]
+        # The session's write pipeline admits via ts.write_admit
+        # (two-phase); ts.write remains the one-shot form.
+        assert "ts.write_admit" in d["methods"]
         assert "ts.scan" in d["methods"]
-        write_sample = d["methods"]["ts.write"][-1]
+        write_sample = d["methods"]["ts.write_admit"][-1]
         assert write_sample["duration_us"] >= 0
         assert any("stamped" in m for m in write_sample["messages"])
         scan_sample = d["methods"]["ts.scan"][-1]

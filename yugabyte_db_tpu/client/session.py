@@ -13,10 +13,41 @@ and recombined here — the cross-shard combine (CP analog) of SURVEY §2.4.
 from __future__ import annotations
 
 from yugabyte_db_tpu.client.client import YBClient, YBTable
-from yugabyte_db_tpu.storage import wire
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.storage import rowblock, wire
 from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
                                                ScanSpec)
+
+# Key-column dtype codes for the native batch encoder (writeplane.cc).
+_KEY_DTYPE_CODE = {DataType.BOOL: 0, DataType.FLOAT: 2, DataType.DOUBLE: 2,
+                   DataType.STRING: 3, DataType.BINARY: 4}
+
+
+def _table_block_desc(table: YBTable):
+    """The (hash_cols, range_cols, value_cols, valmap) descriptor the
+    native encoder takes, cached on the table handle; None when any key
+    column's type is not key-encodable natively."""
+    desc = getattr(table, "_block_desc", False)
+    if desc is not False:
+        return desc
+
+    def code(dtype: DataType):
+        if dtype.is_integer:
+            return 1
+        return _KEY_DTYPE_CODE.get(dtype)
+
+    schema = table.schema
+    hash_cols = tuple((c.name, code(c.dtype)) for c in schema.hash_columns)
+    range_cols = tuple((c.name, code(c.dtype)) for c in schema.range_columns)
+    if any(c[1] is None for c in hash_cols + range_cols):
+        desc = None
+    else:
+        desc = (hash_cols, range_cols,
+                tuple((c.name, c.col_id) for c in schema.value_columns),
+                {c.name: c.col_id for c in schema.value_columns})
+    table._block_desc = desc
+    return desc
 
 
 class YBSession:
@@ -29,37 +60,104 @@ class YBSession:
 
     def __init__(self, client: YBClient):
         self.client = client
-        self._ops: list[tuple[YBTable, int, RowVersion]] = []
+        # Unified write buffer, in op order. Entries are either
+        #   ("b", table, kind, key_src, cols_src, expire_ht, ttl_us)
+        # (block-eligible: encoded natively at flush, zero per-row
+        # Python work — the native write plane) or
+        #   ("r", table, hash_code, row)
+        # (a materialized RowVersion: counters, processor-built rows).
+        # A table whose flush contains ANY "r" op takes the row path for
+        # ALL its ops, preserving same-key ordering within the flush.
+        self._ops: list[tuple] = []
 
     # -- write ops -----------------------------------------------------------
     def insert(self, table: YBTable, values: dict,
                ttl_expire_ht: int = MAX_HT,
                ttl_us: int | None = None) -> None:
-        key_values = {c.name: values[c.name] for c in table.schema.key_columns}
-        cols = {table.col_id[c.name]: values[c.name]
-                for c in table.schema.value_columns if c.name in values}
-        row = RowVersion(table.encode_key(key_values), ht=0, liveness=True,
-                         columns=cols, expire_ht=ttl_expire_ht,
-                         ttl_us=ttl_us)
-        self._ops.append((table, table.hash_code(key_values), row))
+        names = getattr(table, "_key_names", None)
+        if names is None:
+            names = table._key_names = tuple(
+                c.name for c in table.schema.key_columns)
+        for n in names:
+            if n not in values:
+                raise KeyError(n)
+        # Copy: the op encodes at flush time, and callers may legally
+        # reuse/mutate their dict between ops (the old eager-encoding
+        # API allowed it).
+        self._ops.append(("b", table, 0, dict(values), None,
+                          ttl_expire_ht, ttl_us))
 
     def update(self, table: YBTable, key_values: dict, set_values: dict,
                ttl_expire_ht: int = MAX_HT) -> None:
-        cols = {table.col_id[name]: v for name, v in set_values.items()}
+        value_ids = getattr(table, "_value_ids", None)
+        if value_ids is None:
+            value_ids = table._value_ids = {
+                c.name for c in table.schema.value_columns}
+        for name in set_values:
+            if name not in table.col_id:
+                raise KeyError(name)
+        self._check_key_values(table, key_values)
+        if all(n in value_ids for n in set_values):
+            self._ops.append(("b", table, 1, dict(key_values),
+                              dict(set_values), ttl_expire_ht, None))
+            return
+        # SET of a key column: historical behavior stores it under the
+        # key column's id (a no-op for reads); the native encoder's
+        # valmap has value columns only, so take the row path.
+        cols = {table.col_id[n]: v for n, v in set_values.items()}
         row = RowVersion(table.encode_key(key_values), ht=0, liveness=False,
                          columns=cols, expire_ht=ttl_expire_ht)
-        self._ops.append((table, table.hash_code(key_values), row))
+        self._ops.append(("r", table, table.hash_code(key_values), row))
 
     def delete(self, table: YBTable, key_values: dict) -> None:
-        row = RowVersion(table.encode_key(key_values), ht=0, tombstone=True)
-        self._ops.append((table, table.hash_code(key_values), row))
+        self._check_key_values(table, key_values)
+        self._ops.append(("b", table, 2, dict(key_values), None,
+                          MAX_HT, None))
+
+    @staticmethod
+    def _check_key_values(table: YBTable, key_values: dict) -> None:
+        """Eager missing-key validation — errors must surface at the op
+        call (the old eager-encoding behavior), never mid-flush where
+        the buffer is already popped."""
+        names = getattr(table, "_key_names", None)
+        if names is None:
+            names = table._key_names = tuple(
+                c.name for c in table.schema.key_columns)
+        for n in names:
+            if n not in key_values:
+                raise KeyError(n)
 
     def apply_row(self, table: YBTable, hash_code: int, row: RowVersion) -> None:
-        self._ops.append((table, hash_code, row))
+        self._ops.append(("r", table, hash_code, row))
 
     @property
     def pending_ops(self) -> int:
         return len(self._ops)
+
+    def _op_to_row(self, op) -> tuple[YBTable, int, RowVersion]:
+        """Materialize one buffered op as (table, hash_code, RowVersion)
+        — the row-path fallback."""
+        if op[0] == "r":
+            return op[1], op[2], op[3]
+        _tag, table, kind, key_src, cols_src, expire_ht, ttl_us = op
+        key_values = {c.name: key_src[c.name]
+                      for c in table.schema.key_columns}
+        if kind == 0:
+            cols = {table.col_id[c.name]: key_src[c.name]
+                    for c in table.schema.value_columns
+                    if c.name in key_src}
+            row = RowVersion(table.encode_key(key_values), ht=0,
+                             liveness=True, columns=cols,
+                             expire_ht=expire_ht, ttl_us=ttl_us)
+        elif kind == 1:
+            cols = {table.col_id[n]: v for n, v in cols_src.items()}
+            row = RowVersion(table.encode_key(key_values), ht=0,
+                             liveness=False, columns=cols,
+                             expire_ht=expire_ht)
+        else:
+            row = RowVersion(table.encode_key(key_values), ht=0,
+                             tombstone=True)
+        return table, table.hash_code(key_values), row
 
     def flush(self, timeout_s: float = 15.0) -> int:
         """Group buffered ops per tablet and issue the per-tablet write
@@ -69,17 +167,68 @@ class YBSession:
         concurrently, src/yb/client/batcher.h:80). Returns the number of
         rows written. Raises on any tablet failure (ops for OTHER tablets
         may have applied — same per-tablet atomicity as the reference
-        without transactions)."""
-        ops, self._ops = self._ops, []
-        by_tablet: dict[str, tuple[YBTable, object, list]] = {}
-        for table, hash_code, row in ops:
-            loc = self.client.meta_cache.lookup_by_hash(table.name, hash_code)
-            key = loc.tablet_id
-            if key not in by_tablet:
-                by_tablet[key] = (table, loc, [])
-            by_tablet[key][2].append(row)
+        without transactions).
 
-        def send(table, loc, rows):
+        Block-eligible tables encode through the native write plane: ONE
+        native call builds every tablet's row block (doc keys, partition
+        hashes, per-tablet split), and the RPC payload is the block —
+        rowblock.py / native/writeplane.cc."""
+        ops, self._ops = self._ops, []
+        # Partition ops per table; decide block vs row path per table.
+        per_table: dict[str, list] = {}
+        tables: dict[str, YBTable] = {}
+        for op in ops:
+            t = op[1]
+            per_table.setdefault(t.name, []).append(op)
+            tables[t.name] = t
+
+        # (table, loc, rows) row groups / (table, loc, block, n) blocks
+        row_groups: dict[str, tuple[YBTable, object, list]] = {}
+        block_groups: list[tuple[YBTable, object, bytes, int]] = []
+
+        def row_path(table, table_ops):
+            for op in table_ops:
+                _t, hash_code, row = self._op_to_row(op)
+                loc = self.client.meta_cache.lookup_by_hash(table.name,
+                                                            hash_code)
+                g = row_groups.get(loc.tablet_id)
+                if g is None:
+                    g = row_groups[loc.tablet_id] = (table, loc, [])
+                g[2].append(row)
+
+        errors = []
+        for name, table_ops in per_table.items():
+            table = tables[name]
+            # One table's bad op must not drop OTHER tables' buffered
+            # writes (the buffer is already popped): isolate per table,
+            # surface the first error after everything else sent.
+            try:
+                desc = (_table_block_desc(table)
+                        if rowblock.HAVE_NATIVE and
+                        all(op[0] == "b" for op in table_ops) else None)
+                if desc is None:
+                    row_path(table, table_ops)
+                    continue
+                locs = self.client.meta_cache.locations(table.name)
+                tablets = sorted(locs.tablets,
+                                 key=lambda t: t.partition_start)
+                try:
+                    from yugabyte_db_tpu.native import yb_wp
+
+                    parts = yb_wp.encode_ops(
+                        desc, [op[2:] for op in table_ops],
+                        [t.partition_start for t in tablets])
+                except Exception:  # noqa: BLE001 — value shape the
+                    row_path(table, table_ops)  # native encoder rejects:
+                    continue                    # row path (canonical error)
+                for t_loc, part in zip(tablets, parts):
+                    if part is not None:
+                        block_groups.append((table, t_loc, part[1],
+                                             part[0]))
+            except Exception as e:  # noqa: BLE001 — surfaced after sends
+                errors.append(e)
+
+        def send_rows(table, loc, rows):
             self.client.tablet_rpc(
                 table.name, loc, "ts.write",
                 {"rows": wire.encode_rows(rows),
@@ -90,12 +239,49 @@ class YBSession:
                 timeout_s=timeout_s)
             return len(rows)
 
-        groups = list(by_tablet.values())
-        if len(groups) == 1:
-            return send(*groups[0])
-        futs = [self._pool().submit(send, *g) for g in groups]
         written = 0
-        errors = []
+        # Row groups replicate in parallel on the batcher pool while the
+        # caller's own thread pipelines the block groups.
+        futs = [self._pool().submit(send_rows, *g)
+                for g in row_groups.values()]
+        # Block groups: two-phase pipeline from THIS thread — admit every
+        # tablet's block (returns at append, before commit), then collect
+        # the outcomes. One thread drives N tablets' replication rounds
+        # concurrently with zero pool hops (reference: the async client
+        # write pipeline, src/yb/client/async_rpc.cc).
+        cid = self.client.client_id
+        pending = []
+        for table, loc, block, n in block_groups:
+            rid = self.client.next_request_id()
+            try:
+                resp = self.client.tablet_rpc(
+                    table.name, loc, "ts.write_admit",
+                    {"rows": block, "client_id": cid, "request_id": rid},
+                    timeout_s=timeout_s)
+            except Exception as e:  # noqa: BLE001 — surfaced after joins
+                errors.append(e)
+                continue
+            if resp.get("admitted"):
+                pending.append((table, loc, block, n, rid))
+            else:
+                written += n  # completed synchronously (dup / slow path)
+        for table, loc, block, n, rid in pending:
+            try:
+                resp = self.client.tablet_rpc(
+                    table.name, loc, "ts.write_sync",
+                    {"client_id": cid, "request_id": rid},
+                    timeout_s=timeout_s)
+                if resp.get("retry_write"):
+                    # The admitted entry was lost to a leader change
+                    # before commit: re-send the full write under the
+                    # SAME id (dedup keeps it exactly-once).
+                    self.client.tablet_rpc(
+                        table.name, loc, "ts.write",
+                        {"rows": block, "client_id": cid,
+                         "request_id": rid}, timeout_s=timeout_s)
+                written += n
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
         for f in futs:
             try:
                 written += f.result()

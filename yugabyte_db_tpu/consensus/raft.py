@@ -151,10 +151,14 @@ class RaftConsensus:
         self._durable_index = self._last_index  # on-disk log is durable
 
         self._peers: dict[str, _PeerState] = {}
+        self._applying = False  # single-applier guard (inline + thread)
         self._threads: list[threading.Thread] = []
         # Invoked (tablet_id, peer_uuid) when a peer needs entries evicted
         # from the cache — wired by the tserver to kick remote bootstrap.
         self.on_needs_bootstrap = None
+        # Invoked (entries) when a log suffix is truncated (definite
+        # aborts) — wired by the TabletPeer to resolve MVCC pendings.
+        self.on_entries_truncated = None
 
     # ------------------------------------------------------------------ api
     def start(self) -> None:
@@ -252,15 +256,32 @@ class RaftConsensus:
         return entry
 
     def append_leader(self, op_type: str, body, ht: int | None = None,
-                      decoded_rows=None) -> LogEntry:
+                      decoded_rows=None, on_append=None) -> LogEntry:
         """Leader append + durability, without waiting for commit. Callers
         that need the outcome follow with wait_applied().
         ``decoded_rows`` rides on the in-memory entry so the leader's own
-        apply skips re-decoding the body (followers decode from wire)."""
+        apply skips re-decoding the body (followers decode from wire).
+
+        Multi-peer groups DEFER the leader's own fsync off the admission
+        path: the entry only counts toward the majority once synced, but
+        two follower disks already form a majority (standard Raft — a
+        leader may lose its unsynced tail), and each replication thread
+        syncs the log right after its send (amortized group commit), so
+        a majority that needs the leader's disk (one follower down) is
+        never more than one replication round away. Single-peer groups
+        sync inline — there is nobody else to carry durability."""
         with self._lock:
             entry = self._leader_append_locked(op_type, body, ht,
                                                decoded_rows)
-        self._ensure_durable(entry.op_id.index)
+            if on_append is not None:
+                # Runs under the raft lock: applies/truncations of this
+                # entry are ordered strictly after it, so per-entry
+                # bookkeeping (the peer's MVCC-resolution registry) can
+                # never miss its own entry.
+                on_append(entry)
+            defer = len(self.cmeta.active_config.peers) > 1
+        if not defer:
+            self._ensure_durable(entry.op_id.index)
         return entry
 
     def _leader_append_locked(self, op_type: str, body, ht: int | None,
@@ -442,14 +463,21 @@ class RaftConsensus:
         """Erase a conflicting log suffix (follower divergence)."""
         self.log.truncate_after(last_kept)
         self._durable_index = min(self._durable_index, last_kept)
+        dropped = []
         for idx in range(last_kept + 1, self._last_index + 1):
             e = self._entries.pop(idx, None)
-            if e is not None and e.op_type == "change_config" and \
+            if e is None:
+                continue
+            dropped.append(e)
+            if e.op_type == "change_config" and \
                     self.cmeta.pending_config is not None and \
                     self.cmeta.pending_config.opid_index == idx:
                 self.cmeta.pending_config = None
                 self.cmeta.flush()
         self._last_index = last_kept
+        if dropped and self.on_entries_truncated is not None:
+            # Definite aborts: these entries will never apply here.
+            self.on_entries_truncated(dropped)
 
     # -- leader-side peer loop ----------------------------------------------
     def _peer_loop(self, peer: _PeerState) -> None:
@@ -514,6 +542,18 @@ class RaftConsensus:
                 # remote handler error surfacing as RpcCallError) must leave
                 # this replication thread alive; retry on the next tick.
                 continue
+            if batch and self._durable_index < batch[-1][1]:
+                # Deferred leader durability (append_leader): sync once
+                # per replication round, off the admission path. Shared
+                # across both peer threads via the group-commit sync
+                # lock. A sync failure must not kill the replication
+                # thread — self simply keeps not counting toward the
+                # majority (the two followers carry it).
+                try:
+                    self._ensure_durable(batch[-1][1])
+                except Exception:  # noqa: BLE001
+                    pass
+            need_apply = False
             with self._lock:
                 if not self._running or self._role != Role.LEADER or \
                         self.cmeta.current_term != term:
@@ -532,12 +572,19 @@ class RaftConsensus:
                         peer.next_index = peer.match_index + 1
                         peer.needs_remote_bootstrap = False
                     self._advance_commit_locked()
+                    need_apply = self._applied_index < self._commit_index
                     if peer.next_index <= self._last_index:
                         peer.signal.set()  # keep streaming the backlog
                 else:
                     peer.next_index = max(1, min(resp["last_index"] + 1,
                                                  peer.next_index - 1))
                     peer.signal.set()
+            if need_apply:
+                # Apply inline: the ack that advanced the commit point
+                # finishes the write without an apply-thread hop. Bounded
+                # so this replication thread keeps heartbeating its
+                # follower; any remainder falls to the apply thread.
+                self._drain_applies(max_entries=4 * self.opts.max_batch_entries)
 
     def _advance_commit_locked(self) -> None:
         """Advance the majority-replicated watermark (current-term entries
@@ -620,27 +667,66 @@ class RaftConsensus:
         while True:
             with self._lock:
                 while self._running and \
-                        self._applied_index >= self._commit_index:
+                        (self._applying or
+                         self._applied_index >= self._commit_index):
                     self._apply_cond.wait(timeout=0.5)
                 if not self._running:
                     return
-                # Strictly contiguous batch: a hole (possible transiently
-                # after an interrupted truncation) must stall the apply, not
-                # be skipped over — and must not busy-spin.
-                batch = []
-                i = self._applied_index + 1
-                while i <= self._commit_index and i in self._entries:
-                    batch.append(self._entries[i])
-                    i += 1
-                if not batch:
+            self._drain_applies()
+            with self._lock:
+                # A hole (possible transiently after an interrupted
+                # truncation) must stall the apply, not busy-spin.
+                if not self._applying and \
+                        self._applied_index < self._commit_index:
                     self._apply_cond.wait(timeout=0.2)
-                    continue
-            for e in batch:
-                if e.op_type not in ("no_op", "change_config"):
-                    self.apply_cb(e)
+
+    def _drain_applies(self, max_entries: int | None = None) -> None:
+        """Apply committed entries in strict log order, from WHATEVER
+        thread reached the commit point first (single applier at a
+        time). Leader-side, the replication thread that advanced the
+        commit watermark applies inline — the writer waiting in
+        wait_applied wakes exactly once, with the result ready, instead
+        of paying an extra thread hop through the apply loop (the same
+        motive as the reference running ApplyTask on the prepare
+        thread's token when it can, operation_driver.cc). The apply
+        thread remains for entries nobody is waiting on (followers).
+
+        ``max_entries`` bounds an inline drain: a replication thread
+        must not disappear into a huge committed backlog (its follower
+        would miss heartbeats long enough to start an election) — it
+        applies a bounded slice and hands the rest to the apply thread."""
+        with self._lock:
+            if self._applying:
+                return
+            self._applying = True
+        applied = 0
+        try:
+            while True:
                 with self._lock:
-                    self._applied_index = e.op_id.index
-                    self._commit_cond.notify_all()
+                    # Strictly contiguous: stop at any hole.
+                    batch = []
+                    i = self._applied_index + 1
+                    while i <= self._commit_index and i in self._entries:
+                        if max_entries is not None and \
+                                applied + len(batch) >= max_entries:
+                            break
+                        batch.append(self._entries[i])
+                        i += 1
+                    if not batch:
+                        return
+                for e in batch:
+                    if e.op_type not in ("no_op", "change_config"):
+                        self.apply_cb(e)
+                    with self._lock:
+                        self._applied_index = e.op_id.index
+                        self._commit_cond.notify_all()
+                applied += len(batch)
+                if max_entries is not None and applied >= max_entries:
+                    return
+        finally:
+            with self._lock:
+                self._applying = False
+                self._apply_cond.notify_all()
 
     def wait_applied(self, op_id: OpId, timeout: float) -> None:
         """Block until the entry is applied. Raises NotLeader if it was
@@ -668,25 +754,40 @@ class RaftConsensus:
         return self.opts.election_timeout_s * (1.0 + self._rng.random())
 
     def _timer_loop(self) -> None:
-        tick = min(self.opts.heartbeat_interval_s / 2,
-                   self.opts.election_timeout_s / 6)
+        # Deadline-based, not fixed-tick: sleep until the next event
+        # (heartbeat due / election timeout) and recompute on wake. A
+        # node hosts one Raft instance PER TABLET, so idle tick storms
+        # scale with tablet count — the reference amortizes this with a
+        # shared timer wheel (rpc/scheduler.cc); sleeping to the exact
+        # deadline gets the same effect per-instance.
+        min_sleep = min(0.02, self.opts.heartbeat_interval_s / 2)
         while True:
-            time.sleep(tick)
             start_election = False
             with self._lock:
                 if not self._running:
                     return
                 now = time.monotonic()
                 if self._role == Role.LEADER:
-                    if now - self._last_broadcast >= \
-                            self.opts.heartbeat_interval_s:
+                    due = self._last_broadcast + \
+                        self.opts.heartbeat_interval_s
+                    if now >= due:
                         self._last_broadcast = now
                         self._signal_peers_locked()
+                        due = now + self.opts.heartbeat_interval_s
+                    sleep_s = due - now
                 elif self.cmeta.active_config.has_peer(self.uuid):
-                    if now - self._last_heartbeat_recv > self._election_timeout:
+                    deadline = self._last_heartbeat_recv + \
+                        self._election_timeout
+                    if now > deadline:
                         start_election = True
+                        sleep_s = min_sleep
+                    else:
+                        sleep_s = deadline - now
+                else:
+                    sleep_s = self.opts.election_timeout_s
             if start_election:
                 self._start_election()
+            time.sleep(max(min_sleep, min(sleep_s, 0.5)))
 
     def _start_election(self, ignore_live_leader: bool = False) -> None:
         with self._lock:

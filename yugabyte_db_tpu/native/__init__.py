@@ -1,11 +1,18 @@
 """Native (C++) runtime components, with build-on-first-import.
 
 The reference's runtime serialization/framing is C++ (protobuf +
-src/yb/rpc); here the codec hot path lives in native/codec.cc, compiled
-into the extension module ``yb_codec`` next to this package. If the
-extension is missing, we try ONE quiet `make -C native` (the toolchain
-is a build requirement, not a runtime one — pure-Python fallbacks exist
-for every native component), gated by YB_NO_NATIVE=1.
+src/yb/rpc); here the hot paths live in native/*.cc, compiled into
+extension modules next to this package:
+
+- ``yb_codec`` (native/codec.cc) — the tagged binary codec framing every
+  RPC payload and WAL record.
+- ``yb_wp``   (native/writeplane.cc) — the write plane: row-block batch
+  encoding (doc keys + partition hash + per-tablet split), leader-side
+  hybrid-time stamping, and the C++ memtable.
+
+If an extension is missing, we try ONE quiet `make -C native` (the
+toolchain is a build requirement, not a runtime one — pure-Python
+fallbacks exist for every native component), gated by YB_NO_NATIVE=1.
 """
 
 from __future__ import annotations
@@ -15,42 +22,64 @@ import os
 import subprocess
 import sys
 
-_MOD = "yugabyte_db_tpu.native.yb_codec"
+_MODS = ("yb_codec", "yb_wp")
+
+
+def _import_each():
+    """Best-effort per-module import: one extension failing to build or
+    import must not disable the others (each has its own pure-Python
+    fallback)."""
+    mods = {}
+    for name in _MODS:
+        try:
+            mods[name] = importlib.import_module(f"{__name__}.{name}")
+        except ImportError:
+            mods[name] = None
+    return mods
 
 
 def _load():
     if os.environ.get("YB_NO_NATIVE") == "1":
-        return None
-    try:
-        return importlib.import_module(_MOD)
-    except ImportError:
-        pass
+        return {name: None for name in _MODS}
+    mods = _import_each()
+    if all(m is not None for m in mods.values()):
+        return mods
     src = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))), "native")
     if not os.path.isdir(src):
-        return None
+        return mods
     # Negative cache: one failed build attempt per source version, not one
     # per process (a doomed `make` at import time would tax every CLI run).
     stamp = os.path.join(src, ".build_failed")
-    codec_src = os.path.join(src, "codec.cc")
+    sources = [os.path.join(src, n)
+               for n in ("codec.cc", "writeplane.cc", "tagcodec.h")]
     try:
-        if os.path.exists(stamp) and \
-                os.path.getmtime(stamp) >= os.path.getmtime(codec_src):
-            return None
+        if os.path.exists(stamp) and all(
+                os.path.getmtime(stamp) >= os.path.getmtime(s)
+                for s in sources if os.path.exists(s)):
+            return mods
     except OSError:
-        return None
+        return mods
     try:
-        subprocess.run(["make", "-C", src, f"PY={sys.executable}"],
-                       capture_output=True, timeout=120, check=True)
-        return importlib.import_module(_MOD)
+        # -k: build every target it can — a partial toolchain failure
+        # still yields the extensions that do compile.
+        proc = subprocess.run(["make", "-C", src, "-k",
+                               f"PY={sys.executable}"],
+                              capture_output=True, timeout=120)
+        mods = _import_each()
+        if proc.returncode != 0:
+            raise RuntimeError("partial native build")
+        return mods
     except Exception:  # noqa: BLE001 — fall back to pure Python
         try:
             with open(stamp, "w") as f:
                 f.write("native build failed; delete to retry\n")
         except OSError:
             pass
-        return None
+        return mods
 
 
-yb_codec = _load()
+_loaded = _load()
+yb_codec = _loaded.get("yb_codec")
+yb_wp = _loaded.get("yb_wp")

@@ -17,7 +17,8 @@ import heapq
 from yugabyte_db_tpu.models.encoding import decode_doc_key
 from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.storage.engine import StorageEngine, register_engine
-from yugabyte_db_tpu.storage.memtable import MemTable
+from yugabyte_db_tpu.storage.memtable import (MemTable, NativeMemTable,
+                                              make_memtable)
 from yugabyte_db_tpu.storage.merge import MergedRow, merge_versions
 from yugabyte_db_tpu.storage.row_version import RowVersion
 from yugabyte_db_tpu.storage.scan_spec import AggSpec, ScanResult, ScanSpec
@@ -162,7 +163,7 @@ class CpuStorageEngine(StorageEngine):
         super().__init__(schema, options)
         from yugabyte_db_tpu.storage.run_io import RunPersistence
 
-        self.memtable = MemTable()
+        self.memtable = make_memtable()
         self.runs: list[CpuRun] = []
         self.mat = RowMaterializer(schema)
         self.flushed_frontier_ht = 0  # max ht persisted into runs
@@ -181,6 +182,13 @@ class CpuStorageEngine(StorageEngine):
 
     def apply(self, rows: list[RowVersion]) -> None:
         self.memtable.apply(rows)
+        self._after_apply()
+
+    def apply_block(self, block: bytes) -> None:
+        self.memtable.apply_block(block)
+        self._after_apply()
+
+    def _after_apply(self) -> None:
         from yugabyte_db_tpu.utils.flags import FLAGS
 
         limit = self.options.get("memtable_flush_versions",
@@ -200,11 +208,11 @@ class CpuStorageEngine(StorageEngine):
         entries = self.memtable.drain_sorted()
         self.persist.save_new(entries)
         self.runs.append(CpuRun(entries))
-        self.memtable = MemTable()
+        self.memtable = make_memtable()
         self._track_memstore()
 
     def restore_entries(self, entries) -> None:
-        self.memtable = MemTable()
+        self.memtable = make_memtable()
         self.persist.replace_all(entries)
         self.runs = [CpuRun(entries)] if entries else []
         for _key, versions in entries:
@@ -294,7 +302,7 @@ class CpuStorageEngine(StorageEngine):
             last = key
             versions: list[RowVersion] = []
             for src in sources:
-                if isinstance(src, MemTable):
+                if isinstance(src, (MemTable, NativeMemTable)):
                     versions.extend(src.versions(key))
                 else:
                     versions.extend(src.get(key))

@@ -69,6 +69,15 @@ class StorageEngine(abc.ABC):
     def apply(self, rows: list[RowVersion]) -> None:
         """Apply committed row versions (the Raft-apply stage calls this)."""
 
+    def apply_block(self, block: bytes) -> None:
+        """Apply an encoded row block (storage.rowblock layout) — the
+        native write path's zero-materialization ingest. The default
+        decodes and delegates; engines with a block-aware memtable
+        override it."""
+        from yugabyte_db_tpu.storage import rowblock
+
+        self.apply(rowblock.rows_from_block(block))
+
     # -- reads -------------------------------------------------------------
     @abc.abstractmethod
     def scan(self, spec: ScanSpec) -> ScanResult:

@@ -1,16 +1,23 @@
 """In-memory write buffer: the memtable.
 
-Reference analog: src/yb/rocksdb/memtable (skiplist memtable). Host-side
-Python structure: a dict keyed by encoded key with per-key version lists,
-plus a lazily-sorted key index for ordered scans. Writes are O(1); the sort
-is amortized across scans/flushes. (A C++ skiplist replaces this on the
-native path; the interface is what matters here.)
+Reference analog: src/yb/rocksdb/memtable (skiplist memtable). Two
+implementations behind one interface:
+
+- ``MemTable`` — pure Python: a dict keyed by encoded key with per-key
+  version lists, plus a lazily-sorted key index for ordered scans.
+- ``NativeMemTable`` — the C++ ordered map of native/writeplane.cc
+  (module yb_wp), applied to directly from encoded row blocks so the hot
+  write path never builds per-row Python objects; reads materialize
+  RowVersions on demand.
+
+``make_memtable()`` picks the native one when the extension is present.
 """
 
 from __future__ import annotations
 
 import bisect
 
+from yugabyte_db_tpu.storage import rowblock
 from yugabyte_db_tpu.storage.merge import MergedRow, merge_versions
 from yugabyte_db_tpu.storage.row_version import RowVersion
 
@@ -62,6 +69,10 @@ class MemTable:
             yield k
             i += 1
 
+    def has_keys(self, lower: bytes, upper: bytes) -> bool:
+        """Any key in [lower, upper)? (the scan-planning emptiness probe)."""
+        return next(self.scan_keys(lower, upper), None) is not None
+
     def versions(self, key: bytes) -> list[RowVersion]:
         return self._data.get(key, [])
 
@@ -70,6 +81,10 @@ class MemTable:
         if not versions:
             return None
         return merge_versions(key, versions, read_ht)
+
+    def apply_block(self, block: bytes) -> None:
+        """Apply an encoded row block (storage.rowblock layout)."""
+        self.apply(rowblock.rows_from_block(block))
 
     def drain_sorted(self) -> list[tuple[bytes, list[RowVersion]]]:
         """All (key, versions ht-desc) in key order — the flush input."""
@@ -81,3 +96,127 @@ class MemTable:
         return [(k, vs if len(vs := data[k]) == 1
                  else sorted(vs, key=order, reverse=True))
                 for k in self._index()]
+
+
+class NativeMemTable:
+    """The C++ memtable (yb_wp.Memtable) behind the MemTable interface.
+
+    apply_block() is the hot path: one native call per replicated batch,
+    no per-row Python objects. Reads (versions/merged/drain) materialize
+    RowVersions from native tuples — amortized over scans and flushes.
+
+    Rows the native codec cannot represent (integers beyond int64 — the
+    tagged-varint grammar's documented Python-fallback case, e.g. inside
+    JSONB values) SPILL to a pure-Python MemTable merged on every read:
+    an un-encodable value must degrade that row to the slow path, never
+    crash the Raft apply stage.
+    """
+
+    def __init__(self):
+        from yugabyte_db_tpu.native import yb_wp
+
+        self._mt = yb_wp.Memtable()
+        self._spill: MemTable | None = None
+
+    def __len__(self) -> int:
+        return self.num_versions
+
+    @property
+    def num_versions(self) -> int:
+        n = self._mt.num_versions
+        return n + self._spill.num_versions if self._spill else n
+
+    @property
+    def approx_bytes(self) -> int:
+        n = self._mt.approx_bytes
+        return n + self._spill.approx_bytes if self._spill else n
+
+    @property
+    def min_ht(self):
+        a = self._mt.min_ht
+        b = self._spill.min_ht if self._spill else None
+        if a is None:
+            return b
+        return a if b is None else min(a, b)
+
+    @property
+    def max_ht(self):
+        a = self._mt.max_ht
+        b = self._spill.max_ht if self._spill else None
+        if a is None:
+            return b
+        return a if b is None else max(a, b)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.num_versions == 0
+
+    def apply_block(self, block: bytes) -> None:
+        self._mt.apply_block(block)
+
+    def apply(self, rows: list[RowVersion]) -> None:
+        try:
+            self._mt.apply_block(rowblock.encode_rows(rows))
+        except (OverflowError, ValueError, TypeError):
+            for r in rows:  # isolate the un-encodable row(s)
+                try:
+                    self._mt.apply_block(rowblock.encode_rows([r]))
+                except (OverflowError, ValueError, TypeError):
+                    if self._spill is None:
+                        self._spill = MemTable()
+                    self._spill.apply([r])
+
+    def scan_keys(self, lower: bytes, upper: bytes):
+        native = self._mt.scan_keys(lower, upper)
+        if not self._spill:
+            return iter(native)
+        import heapq
+
+        merged = heapq.merge(native, self._spill.scan_keys(lower, upper))
+        last = [None]
+
+        def dedup():
+            for k in merged:
+                if k != last[0]:
+                    last[0] = k
+                    yield k
+        return dedup()
+
+    def has_keys(self, lower: bytes, upper: bytes) -> bool:
+        if self._mt.has_keys(lower, upper):
+            return True
+        return bool(self._spill) and self._spill.has_keys(lower, upper)
+
+    def versions(self, key: bytes) -> list[RowVersion]:
+        out = [RowVersion(*t) for t in self._mt.versions(key)]
+        if self._spill:
+            out.extend(self._spill.versions(key))
+        return out
+
+    def merged(self, key: bytes, read_ht: int) -> MergedRow | None:
+        versions = self.versions(key)
+        if not versions:
+            return None
+        return merge_versions(key, versions, read_ht)
+
+    def drain_sorted(self) -> list[tuple[bytes, list[RowVersion]]]:
+        native = [(k, [RowVersion(*t) for t in vers])
+                  for k, vers in self._mt.drain_sorted()]
+        if not self._spill:
+            return native
+        by_key = dict(native)
+        for k, vers in self._spill.drain_sorted():
+            if k in by_key:
+                both = by_key[k] + vers
+                both.sort(key=lambda r: (r.ht, r.write_id), reverse=True)
+                by_key[k] = both
+            else:
+                by_key[k] = vers
+        return [(k, by_key[k]) for k in sorted(by_key)]
+
+
+def make_memtable():
+    """The fastest available memtable implementation."""
+    if rowblock.HAVE_NATIVE:
+        return NativeMemTable()
+    return MemTable()

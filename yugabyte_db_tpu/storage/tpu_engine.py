@@ -43,7 +43,7 @@ from yugabyte_db_tpu.storage.columnar import ColumnarRun
 from yugabyte_db_tpu.storage import host_page
 from yugabyte_db_tpu.storage.cpu_engine import Aggregator, RowMaterializer
 from yugabyte_db_tpu.storage.engine import StorageEngine, register_engine
-from yugabyte_db_tpu.storage.memtable import MemTable
+from yugabyte_db_tpu.storage.memtable import MemTable, make_memtable
 from yugabyte_db_tpu.storage.merge import merge_versions
 from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
@@ -74,7 +74,7 @@ class TpuRun:
 class TpuStorageEngine(StorageEngine):
     def __init__(self, schema: Schema, options: dict | None = None):
         super().__init__(schema, options)
-        self.memtable = MemTable()
+        self.memtable = make_memtable()
         self.runs: list[TpuRun] = []
         self.mat = RowMaterializer(schema)
         self.flushed_frontier_ht = 0
@@ -99,6 +99,13 @@ class TpuStorageEngine(StorageEngine):
     # -- writes ------------------------------------------------------------
     def apply(self, rows: list[RowVersion]) -> None:
         self.memtable.apply(rows)
+        self._after_apply()
+
+    def apply_block(self, block: bytes) -> None:
+        self.memtable.apply_block(block)
+        self._after_apply()
+
+    def _after_apply(self) -> None:
         from yugabyte_db_tpu.utils.flags import FLAGS
 
         limit = self.options.get("memtable_flush_versions",
@@ -164,7 +171,7 @@ class TpuStorageEngine(StorageEngine):
         self.persist.save_new(entries)
         crun = ColumnarRun.build(self.schema, entries, self.rows_per_block)
         self.runs.append(TpuRun(crun))
-        self.memtable = MemTable()
+        self.memtable = make_memtable()
         self._plan_cache.clear()
         self._track_memstore()
         sync_point("tpu_engine:flush:done")
@@ -468,7 +475,7 @@ class TpuStorageEngine(StorageEngine):
         return run
 
     def restore_entries(self, entries) -> None:
-        self.memtable = MemTable()
+        self.memtable = make_memtable()
         self.persist.replace_all(entries)
         if entries:
             crun = ColumnarRun.build(self.schema, entries,
@@ -533,7 +540,7 @@ class TpuStorageEngine(StorageEngine):
     def _memtable_in_range(self, spec: ScanSpec) -> bool:
         if self.memtable.is_empty:
             return False
-        return next(self.memtable.scan_keys(spec.lower, spec.upper), None) is not None
+        return self.memtable.has_keys(spec.lower, spec.upper)
 
     def _split_predicates(self, spec: ScanSpec):
         """(device-exact preds, device-superset preds, host-only preds).
@@ -824,7 +831,7 @@ class TpuStorageEngine(StorageEngine):
         sync_point("tpu_engine:plan:mem_snapshotted")
         runs = self._overlapping_runs(spec)
         mem_live = (not mem.is_empty) and \
-            next(mem.scan_keys(spec.lower, spec.upper), None) is not None
+            mem.has_keys(spec.lower, spec.upper)
         exact, superset, host_only = self._split_predicates(spec)
         pred_split = (exact, superset, host_only)
         single_source = len(runs) == 1 and not mem_live

@@ -156,24 +156,28 @@ class Tablet:
         self._replayed_on_bootstrap = replayed
 
     def _apply_write_body(self, entry) -> None:
-        """Apply a "write" entry; bodies are either the legacy raw row
-        list or {"rows":..., "rid":[client_id, request_id]} — the rid is
-        recorded for exactly-once retry dedup (retryable.py)."""
+        """Apply a "write" entry. Bodies are one of: an encoded row BLOCK
+        (bytes, storage.rowblock — the native write plane's zero-copy
+        form), the legacy raw row list, or {"rows": <either>, "rid":
+        [client_id, request_id]} — the rid is recorded for exactly-once
+        retry dedup (retryable.py)."""
         # Leader fast path: the writer attached its already-stamped
         # RowVersions to the in-memory entry (tablet_peer.write), so the
         # leader's apply skips the wire round trip; followers and WAL
-        # replay decode from the body.
+        # replay decode from the body. (Block bodies need no such
+        # attachment: every replica ingests the block natively.)
         decoded = getattr(entry, "decoded_rows", None)
         body = entry.body
-        if isinstance(body, dict):
+        rows = body["rows"] if isinstance(body, dict) else body
+        if isinstance(rows, (bytes, bytearray)):
+            self.engine.apply_block(rows)
+        else:
             self.engine.apply(decoded if decoded is not None
-                              else _decode_rows(body["rows"]))
+                              else _decode_rows(rows))
+        if isinstance(body, dict):
             rid = body.get("rid")
             if rid:
                 self.retryable.record(rid[0], rid[1], entry.ht)
-        else:
-            self.engine.apply(decoded if decoded is not None
-                              else _decode_rows(body))
 
     def _apply_txn_op(self, entry) -> None:
         """Apply transaction ops (intents / commit-apply / abort-remove /

@@ -55,8 +55,17 @@ class TabletPeer:
         # (client_id, request_id) -> (op_id, ht) of an APPENDED but not
         # yet applied write: a racing retry waits on the original entry
         # instead of appending a duplicate (the admission lock no longer
-        # spans the majority wait).
+        # spans the majority wait). Two-phase writes (ts.write_admit /
+        # ts.write_sync) leave entries registered past apply; admissions
+        # purge applied ones lazily (_purge_inflight_rids).
         self._inflight_rids: dict = {}
+        # op_id -> pending HybridTime of writes THIS replica admitted
+        # into MVCC. Resolution rides the Raft outcome itself: the apply
+        # stage calls mvcc.replicated, a log-suffix truncation calls
+        # mvcc.aborted — so a pending HT can never leak (no waiter
+        # required; clients may disappear after admission).
+        self._mvcc_unresolved: dict = {}
+        self.raft.on_entries_truncated = self._on_entries_truncated
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -103,6 +112,7 @@ class TabletPeer:
         call). Returns an opaque token for write_finish."""
         if not (self.raft.is_leader() and self.raft.leader_ready()):
             raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        self._purge_inflight_rids()
         if any(r.increments for r in rows):
             # increments resolve under the tserver's intent-admission
             # lock (the serialization point); reaching here unresolved
@@ -134,8 +144,10 @@ class TabletPeer:
         try:
             body = ({"rows": _encode_rows(stamped), "rid": rid}
                     if rid else _encode_rows(stamped))
-            entry = self.raft.append_leader("write", body, ht=ht.value,
-                                            decoded_rows=stamped)
+            entry = self.raft.append_leader(
+                "write", body, ht=ht.value, decoded_rows=stamped,
+                on_append=lambda e: self._mvcc_unresolved.__setitem__(
+                    e.op_id, ht))
             TRACE("write: appended %d.%d", entry.op_id.term,
                   entry.op_id.index)
         except BaseException:
@@ -145,9 +157,66 @@ class TabletPeer:
             self._inflight_rids[rid_key] = (entry.op_id, ht)
         return ("appended", entry.op_id, ht, rid_key)
 
+    def write_admit_block(self, block: bytes,
+                          client_id: str | None = None,
+                          request_id: int | None = None):
+        """Admission phase of the native write plane: same contract as
+        write_admit, but the batch arrives as an encoded row block
+        (storage.rowblock) and is commit-stamped by ONE native pass —
+        no per-row Python objects anywhere (reference: the C++
+        leader-side batch assembly of src/yb/tablet/preparer.cc). The
+        block then rides the WAL body and Raft replication verbatim."""
+        from yugabyte_db_tpu.storage import rowblock
+
+        if not (self.raft.is_leader() and self.raft.leader_ready()):
+            raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        self._purge_inflight_rids()
+        rid = None
+        rid_key = None
+        if client_id is not None and request_id is not None:
+            prev = self.tablet.retryable.seen(client_id, request_id)
+            if prev is not None:
+                return ("dup", HybridTime(prev))  # replay: original result
+            rid_key = (client_id, request_id)
+            inflight = self._inflight_rids.get(rid_key)
+            if inflight is not None:
+                return ("inflight",) + inflight
+            rid = [client_id, request_id]
+        ht = self.tablet.clock.now()
+        TRACE("write: block stamped at ht=%d", ht.value)
+        stamped = rowblock.stamp_block(block, ht.value)
+        self.tablet.mvcc.add_pending(ht)
+        try:
+            body = {"rows": stamped, "rid": rid} if rid else stamped
+            entry = self.raft.append_leader(
+                "write", body, ht=ht.value,
+                on_append=lambda e: self._mvcc_unresolved.__setitem__(
+                    e.op_id, ht))
+        except BaseException:
+            self.tablet.mvcc.aborted(ht)  # never entered the log
+            raise
+        if rid_key is not None:
+            self._inflight_rids[rid_key] = (entry.op_id, ht)
+        return ("appended", entry.op_id, ht, rid_key)
+
+    def _purge_inflight_rids(self) -> None:
+        """Drop in-flight rid entries whose entry has applied (their
+        outcome now lives in the durable dedup registry) — two-phase
+        writes never pop their own entry. Amortized: only sweeps once
+        the registry has accumulated a few entries."""
+        if len(self._inflight_rids) <= 8:
+            return
+        applied = self.raft._applied_index
+        for k, (op_id, _ht) in list(self._inflight_rids.items()):
+            if op_id.index <= applied:
+                self._inflight_rids.pop(k, None)
+
     def write_finish(self, admitted, timeout: float = 10.0) -> HybridTime:
         """Completion phase: wait for commit+apply. Safe to run OUTSIDE
-        the admission lock."""
+        the admission lock. MVCC resolution is NOT the waiter's job —
+        the apply stage / truncation hooks resolve the pending HT
+        whether or not anyone is waiting (clients may vanish after
+        admission; a timed-out waiter needs no background babysitter)."""
         kind = admitted[0]
         if kind == "dup":
             return admitted[1]
@@ -159,21 +228,9 @@ class TabletPeer:
         try:
             self.raft.wait_applied(op_id, timeout)
         except NotLeader:
-            self.tablet.mvcc.aborted(ht)  # entry truncated: definite abort
             if rid_key is not None:
                 self._inflight_rids.pop(rid_key, None)
             raise
-        except TimeoutError:
-            # Outcome UNKNOWN: the entry is in the log and may still commit.
-            # The pending HT must stay pinned (a premature abort would let
-            # safe_time advance past a write that later commits — a
-            # non-repeatable read). Resolve it in the background. The
-            # in-flight rid entry stays until resolution: a retry must
-            # keep waiting on the original, not append a duplicate.
-            threading.Thread(target=self._resolve_unknown_write,
-                             args=(op_id, ht, rid_key), daemon=True).start()
-            raise
-        self.tablet.mvcc.replicated(ht)
         if rid_key is not None:
             self._inflight_rids.pop(rid_key, None)
         return ht
@@ -231,51 +288,43 @@ class TabletPeer:
         hto = HybridTime(ht)
         if track_mvcc:
             self.tablet.mvcc.add_pending(hto)
+            on_append = lambda e: self._mvcc_unresolved.__setitem__(  # noqa: E731
+                e.op_id, hto)
+        else:
+            on_append = None
         try:
-            entry = self.raft.append_leader(op_type, body, ht=ht)
+            entry = self.raft.append_leader(op_type, body, ht=ht,
+                                            on_append=on_append)
         except BaseException:
             if track_mvcc:
                 self.tablet.mvcc.aborted(hto)
             raise
-        try:
-            self.raft.wait_applied(entry.op_id, timeout)
-        except NotLeader:
-            if track_mvcc:
-                self.tablet.mvcc.aborted(hto)  # truncated: definite abort
-            raise
-        except TimeoutError:
-            if track_mvcc:
-                # Outcome unknown: keep the HT pinned until Raft resolves
-                # it (same contract as write()).
-                threading.Thread(target=self._resolve_unknown_write,
-                                 args=(entry.op_id, hto), daemon=True).start()
-            raise
-        if track_mvcc:
-            self.tablet.mvcc.replicated(hto)
+        self.raft.wait_applied(entry.op_id, timeout)
         return ht
-
-    def _resolve_unknown_write(self, op_id, ht: HybridTime,
-                               rid_key=None) -> None:
-        """Keep a timed-out write's HT pinned until Raft resolves it."""
-        try:
-            while True:
-                try:
-                    self.raft.wait_applied(op_id, timeout=10.0)
-                    self.tablet.mvcc.replicated(ht)
-                    return
-                except NotLeader:
-                    self.tablet.mvcc.aborted(ht)
-                    return
-                except TimeoutError:
-                    if not self.raft._running:
-                        return  # shutting down; pin dies with the process
-                    continue
-        finally:
-            if rid_key is not None:
-                self._inflight_rids.pop(rid_key, None)
 
     def _apply(self, entry) -> None:
         self.tablet.apply_replicated(entry)
+        # Resolve the MVCC pending of a write this replica admitted —
+        # AFTER the apply, so a reader released by the advancing safe
+        # time always sees the applied rows.
+        ht = self._mvcc_unresolved.pop(entry.op_id, None)
+        if ht is not None:
+            self.tablet.mvcc.replicated(ht)
+
+    def _on_entries_truncated(self, entries) -> None:
+        """A truncated suffix is a definite abort for every entry this
+        replica admitted: release their MVCC pendings and drop their
+        in-flight rid registrations (a retry must re-append)."""
+        dropped_ids = set()
+        for e in entries:
+            dropped_ids.add(e.op_id)
+            ht = self._mvcc_unresolved.pop(e.op_id, None)
+            if ht is not None:
+                self.tablet.mvcc.aborted(ht)
+        if self._inflight_rids:
+            for k, (op_id, _ht) in list(self._inflight_rids.items()):
+                if op_id in dropped_ids:
+                    self._inflight_rids.pop(k, None)
 
     # -- read path ----------------------------------------------------------
     def read_time(self) -> HybridTime:

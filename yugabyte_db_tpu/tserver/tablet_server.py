@@ -467,11 +467,48 @@ class TabletServer:
             peer = self.tablet_manager.get(p["tablet_id"])
         except TabletNotFound:
             return {"code": "not_found"}
-        rows = wire.decode_rows(p["rows"])
         if p.get("propagated_ht"):
             from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
 
             peer.tablet.clock.update(_HT(p["propagated_ht"]))
+        payload = p["rows"]
+        if isinstance(payload, (bytes, bytearray)):
+            # Native write plane: the batch is an encoded row block
+            # (storage.rowblock) — admit it without materializing rows.
+            # The session only packs plain blind writes into blocks, so
+            # the slow machinery (conditionals, counters) can't be
+            # needed; tablets with secondary indexes or any pending
+            # transaction intents drop to the row path below (the
+            # intent lock spans the emptiness check + admission, so an
+            # intent admitted concurrently can't be missed).
+            from yugabyte_db_tpu.storage import rowblock
+
+            fast = (not peer.tablet.meta.indexes
+                    and not p.get("if_not_exists"))
+            admitted = None
+            if fast:
+                with peer._intent_lock:
+                    if not peer.tablet.participant.txns:
+                        try:
+                            admitted = peer.write_admit_block(
+                                payload, client_id=p.get("client_id"),
+                                request_id=p.get("request_id"))
+                        except NotLeader as e:
+                            return {"code": "not_leader",
+                                    "leader_hint": e.leader_hint}
+            if admitted is not None:
+                try:
+                    ht = peer.write_finish(admitted,
+                                           timeout=p.get("timeout", 10.0))
+                except NotLeader as e:
+                    return {"code": "not_leader",
+                            "leader_hint": e.leader_hint}
+                except TimeoutError:
+                    return {"code": "timed_out"}
+                return self._write_ok(ht)
+            rows = rowblock.rows_from_block(payload)
+        else:
+            rows = wire.decode_rows(payload)
         # Non-transactional writes still resolve against pending intents:
         # they act as a highest-priority writer and wound any pending txn
         # holding intents on these keys (reference: single-row operations
@@ -549,6 +586,83 @@ class TabletServer:
             if err is not None:
                 return err
         return {"code": "conflict", "message": "intents kept reappearing"}
+
+    def _h_ts_write_admit(self, p: dict):
+        """Admission half of the two-phase write: append + start
+        replication, return WITHOUT waiting for commit. The client
+        pipelines admissions across all its tablets from one thread,
+        then collects outcomes with ts.write_sync — the (client_id,
+        request_id) pair is the resume token, durable across leader
+        changes because it is replicated inside the entry body
+        (reference: the fully-async client write pipeline of
+        src/yb/client/async_rpc.cc over Preparer batching)."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        payload = p.get("rows")
+        cid, rid = p.get("client_id"), p.get("request_id")
+        if not isinstance(payload, (bytes, bytearray)) or cid is None or \
+                rid is None or p.get("if_not_exists") or \
+                peer.tablet.meta.indexes:
+            return self._h_ts_write(p)  # full synchronous write
+        if p.get("propagated_ht"):
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
+
+            peer.tablet.clock.update(_HT(p["propagated_ht"]))
+        admitted = None
+        with peer._intent_lock:
+            if not peer.tablet.participant.txns:
+                try:
+                    admitted = peer.write_admit_block(payload, cid, rid)
+                except NotLeader as e:
+                    return {"code": "not_leader",
+                            "leader_hint": e.leader_hint}
+        if admitted is None:
+            return self._h_ts_write(p)  # pending intents: slow path
+        if admitted[0] == "dup":
+            return self._write_ok(admitted[1])
+        return {"code": "ok", "admitted": True}
+
+    def _h_ts_write_sync(self, p: dict):
+        """Completion half of the two-phase write: resolve the outcome
+        of an admitted (client_id, request_id). Any replica that already
+        APPLIED the write answers from its dedup registry; the leader
+        waits for an in-flight one; an id nobody knows means the entry
+        was lost to a leader change before commit — the client must
+        re-send the full write (same id, so dedup keeps it exactly
+        once)."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        cid, rid = p["client_id"], p["request_id"]
+        from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
+
+        prev = peer.tablet.retryable.seen(cid, rid)
+        if prev is not None:
+            return self._write_ok(_HT(prev))
+        inflight = peer._inflight_rids.get((cid, rid))
+        if inflight is None:
+            if peer.raft.is_leader():
+                if not peer.raft.leader_ready():
+                    # A fresh leader may still hold the admitted entry
+                    # uncommitted from the prior term; only once its own
+                    # no_op has applied (and with it every surviving
+                    # prior-term entry, into the dedup registry) is
+                    # "unknown id" proof the entry was lost.
+                    return {"code": "timed_out"}
+                return {"code": "ok", "retry_write": True}
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        try:
+            ht = peer.write_finish(("inflight",) + inflight,
+                                   timeout=p.get("timeout", 10.0))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            return {"code": "timed_out"}
+        return self._write_ok(ht)
 
     @staticmethod
     def _write_ok(ht) -> dict:
