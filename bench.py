@@ -307,17 +307,19 @@ def bench_kernel_scan(n_rows=16 * 1024 * 1024, R=2048, iters=12):
     flat_mask = d_vals >= -500_000
     mvcc_mask = flat_mask & ((idx % 2) == 0)  # newest version per group
 
-    from yugabyte_db_tpu.ops import flat_fold, seg_fold
+    from yugabyte_db_tpu.ops import flat_fold, lookback_fold
 
     out = []
     for label, flat, mask in (("flat", True, flat_mask),
                               ("mvcc", False, mvcc_mask)):
         sig = dscan.ScanSig(B=B, R=R, K=K, cols=cols, preds=preds,
-                            aggs=aggs, apply_preds=True, flat=flat)
+                            aggs=aggs, apply_preds=True, flat=flat,
+                            lookback=0 if flat else 2)
         # The engine's fused full-array programs (flat_fold for flat
-        # runs, segmented-scan seg_fold for multi-version runs).
+        # runs; bounded-lookback resolve for multi-version runs — the
+        # route _plan_device_aggregate takes for this run shape).
         fn = (flat_fold.compiled_flat_aggregate(sig) if flat
-              else seg_fold.compiled_seg_aggregate(sig))
+              else lookback_fold.compiled_lookback_aggregate(sig))
         args = (arrays, jnp.int32(0), jnp.int32(n_rows),
                 jnp.int32(r_hi), jnp.int32(r_lo),
                 jnp.int32(e_hi), jnp.int32(e_lo), pred_lits)

@@ -1,0 +1,108 @@
+"""ops.lookback_fold (bounded shifted-mask MVCC aggregate) vs the CPU
+oracle and the segmented-scan fold on randomized multi-version data:
+overwrites, tombstones (incl. same-ht DELETE+write ties), TTL, NULLs,
+predicates, range bounds, many read points.
+"""
+
+import pytest
+
+from yugabyte_db_tpu.storage import AggSpec, Predicate, ScanSpec
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+
+from tests.test_seg_fold import AGGS, assert_same_agg, enc, setup
+
+
+def test_lookback_route_taken(monkeypatch):
+    """The ENGINE's aggregate planner must actually dispatch through
+    lookback_fold for a bounded-version run (not fall to seg_fold)."""
+    from yugabyte_db_tpu.ops import lookback_fold
+
+    schema, cpu, tpu, ht = setup()
+    mgv = tpu.runs[0].crun.max_group_versions
+    assert 1 < mgv <= lookback_fold.MAX_LOOKBACK
+    seen = []
+    orig = lookback_fold.compiled_lookback_aggregate
+
+    def spy(sig):
+        seen.append(sig)
+        return orig(sig)
+
+    monkeypatch.setattr(lookback_fold, "compiled_lookback_aggregate", spy)
+    assert_same_agg(cpu, tpu, read_ht=MAX_HT, aggregates=list(AGGS))
+    assert seen, "engine did not route through lookback_fold"
+    assert seen[0].lookback >= mgv  # rounded-up power of two
+
+
+def test_lookback_matches_oracle_many_read_points():
+    schema, cpu, tpu, ht = setup(seed=41)
+    for rp in (1, ht // 4, ht // 2, 3 * ht // 4, ht, MAX_HT):
+        assert_same_agg(cpu, tpu, read_ht=rp, aggregates=list(AGGS))
+
+
+def test_lookback_predicates_and_bounds():
+    schema, cpu, tpu, ht = setup(seed=43)
+    lo = enc(schema, "k0020", 0)
+    hi = enc(schema, "k0090", 0)
+    cases = [
+        dict(read_ht=MAX_HT, aggregates=list(AGGS),
+             predicates=[Predicate("d", ">=", 0)]),
+        dict(read_ht=ht, aggregates=list(AGGS),
+             predicates=[Predicate("a", "<", 0),
+                         Predicate("d", "!=", 3)]),
+        dict(read_ht=ht // 2, aggregates=list(AGGS), lower=lo, upper=hi),
+        dict(read_ht=MAX_HT, aggregates=[AggSpec("count", None)],
+             predicates=[Predicate("c", ">=", 0.0)]),
+    ]
+    for kw in cases:
+        assert_same_agg(cpu, tpu, **kw)
+
+
+def test_lookback_matches_seg_fold_exactly():
+    """Finalized-value equivalence of the shifted-mask resolve and the
+    segmented-scan resolve on the same uploaded run."""
+    import jax.numpy as jnp
+
+    from yugabyte_db_tpu.ops import agg_fold, lookback_fold, seg_fold
+    from yugabyte_db_tpu.ops import scan as dscan
+    from yugabyte_db_tpu.utils import planes as P
+
+    schema, _cpu, tpu, ht = setup(seed=57)
+    trun = tpu.runs[0]
+    crun = trun.crun
+    name_to_id = {c.name: c.col_id for c in schema.value_columns}
+    dev_aggs, _low = agg_fold.lower_aggs(AGGS, name_to_id, tpu._kinds)
+    cols = tpu._col_sigs()
+    preds = (dscan.PredSig(name_to_id["d"], "i32", ">="),)
+    K = agg_fold.safe_window_blocks(crun.R, agg_fold.FULL_WINDOW_BLOCKS)
+    base = dict(B=trun.dev.B, R=crun.R, K=K, cols=cols, preds=preds,
+                aggs=dev_aggs, apply_preds=True, flat=False)
+    sig_seg = dscan.ScanSig(**base)
+    sig_lb = dscan.ScanSig(**base, lookback=crun.max_group_versions)
+    assert lookback_fold.supports(sig_lb)
+
+    for rp in (ht // 3, ht, MAX_HT - 1):
+        r_hi, r_lo = P.scalar_ht_planes(rp)
+        args = (trun.dev.arrays, jnp.int32(0), jnp.int32(crun.total_rows()),
+                jnp.int32(r_hi), jnp.int32(r_lo), jnp.int32(r_hi),
+                jnp.int32(r_lo), (jnp.int32(-500),))
+        iv_s, fv_s = seg_fold.compiled_seg_aggregate(sig_seg)(*args)
+        iv_l, fv_l = lookback_fold.compiled_lookback_aggregate(sig_lb)(*args)
+        acc_s, scanned_s = agg_fold.unpack(dev_aggs, iv_s, fv_s)
+        acc_l, scanned_l = agg_fold.unpack(dev_aggs, iv_l, fv_l)
+        assert scanned_s == scanned_l, rp
+        for ag, a_s, a_l in zip(dev_aggs, acc_s, acc_l):
+            vs = agg_fold.finalize(ag, a_s, ag.fn)
+            vl = agg_fold.finalize(ag, a_l, ag.fn)
+            if isinstance(vs, float):
+                assert vl == pytest.approx(vs, rel=1e-5, abs=1e-3), rp
+            else:
+                assert vs == vl, (rp, ag)
+
+
+def test_lookback_randomized_blocks_sizes():
+    for seed, rpb in ((61, 32), (62, 128), (63, 257)):
+        schema, cpu, tpu, ht = setup(n=400, seed=seed,
+                                     rows_per_block=rpb)
+        assert_same_agg(cpu, tpu, read_ht=MAX_HT, aggregates=list(AGGS))
+        assert_same_agg(cpu, tpu, read_ht=ht // 2,
+                        aggregates=list(AGGS))

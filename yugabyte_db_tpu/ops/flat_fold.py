@@ -90,6 +90,78 @@ def _eval_pred_flat(ps: dscan.PredSig, cmp, arith, lit):
             ">": ~(lt | eq), ">=": ~lt}[ps.op]
 
 
+def finish_groups(sig: dscan.ScanSig, gs, live_any, col_notnull, col_val,
+                  row_lo, row_hi, pred_lits):
+    """Shared group-representative accumulation tail of the multi-version
+    folds (seg_fold / lookback_fold): exists fold, range/predicate result
+    mask at each group's first row, and exact count/sum/min/max packing.
+    ``col_val`` holds each column's latest-visible payload {null, exp,
+    cmp[, arith]} evaluated at the representative row."""
+    from jax import lax
+
+    exists = live_any
+    for cs in sig.cols:
+        exists = exists | col_notnull[cs.col_id]
+
+    B, R = gs.shape
+    gidx = (lax.broadcasted_iota(jnp.int32, (B, R), 0) * R
+            + lax.broadcasted_iota(jnp.int32, (B, R), 1))
+    result = gs & exists & (gidx >= row_lo) & (gidx < row_hi)
+    for i, ps in enumerate(sig.preds):
+        latest = col_val[ps.col_id]
+        result = result & col_notnull[ps.col_id] & \
+            _eval_pred_flat(ps, latest["cmp"], latest.get("arith"),
+                            pred_lits[i])
+
+    scanned = jnp.sum(result, dtype=jnp.int32)
+    acc = []
+    for ag in sig.aggs:
+        if ag.fn == "count":
+            m = (result if ag.col_id is None
+                 else result & col_notnull[ag.col_id])
+            acc.append({"count": jnp.sum(m, dtype=jnp.int32)})
+            continue
+        latest = col_val[ag.col_id]
+        m = result & col_notnull[ag.col_id]
+        n = jnp.sum(m, dtype=jnp.int32)
+        if ag.fn == "sum":
+            if ag.kind in ("f32", "f64"):
+                s1 = jnp.sum(jnp.where(m, latest["arith"], 0.0), axis=1)
+                acc.append({"fsum": jnp.sum(s1),
+                            "fcomp": jnp.float32(0), "n": n})
+            else:
+                m_i32 = m.astype(jnp.int32)
+                digits = [jnp.int32(0)] * agg_fold.DIGITS
+                if ag.kind == "i32":
+                    digits = _masked_plane_limbs(
+                        latest["cmp"][..., 0], m_i32, digits, 0)
+                else:
+                    digits = _masked_plane_limbs(
+                        latest["cmp"][..., 1], m_i32, digits, 0)
+                    digits = _masked_plane_limbs(
+                        latest["cmp"][..., 0], m_i32, digits, 2)
+                acc.append({"digits": jnp.stack(digits), "n": n})
+        else:
+            is_max = ag.fn == "max"
+            red = jnp.max if is_max else jnp.min
+            if ag.kind == "f32":
+                fill = jnp.float32(-jnp.inf if is_max else jnp.inf)
+                acc.append({"fext": red(
+                    jnp.where(m, latest["arith"], fill)), "n": n})
+            elif ag.kind == "i32":
+                fill = I32_MIN if is_max else I32_MAX
+                acc.append({"ext": red(jnp.where(
+                    m, latest["cmp"][..., 0], fill)), "n": n})
+            else:
+                fill = I32_MIN if is_max else I32_MAX
+                hi = latest["cmp"][..., 0]
+                lo = latest["cmp"][..., 1]
+                ext_hi = red(jnp.where(m, hi, fill))
+                ext_lo = red(jnp.where(m & (hi == ext_hi), lo, fill))
+                acc.append({"ext_hi": ext_hi, "ext_lo": ext_lo, "n": n})
+    return agg_fold.pack(sig.aggs, acc, scanned)
+
+
 @functools.lru_cache(maxsize=128)
 def compiled_flat_aggregate(sig: dscan.ScanSig):
     """jit(run, row_lo, row_hi, read_hi, read_lo, rexp_hi, rexp_lo,

@@ -93,6 +93,10 @@ class ScanSig:
                         # merge degenerates to elementwise masks (no
                         # segment ops / gathers) — the post-compaction
                         # fast path
+    lookback: int = 0   # run's max versions per key group (0 = unknown/
+                        # flat): small bounds unlock the shifted-mask
+                        # resolve (ops.lookback_fold) instead of
+                        # segmented scans
 
 
 # -- the program ------------------------------------------------------------

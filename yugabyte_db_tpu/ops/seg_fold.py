@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from yugabyte_db_tpu.ops import agg_fold, flat_fold
+from yugabyte_db_tpu.ops import flat_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import le2
 
@@ -138,7 +138,6 @@ def compiled_seg_aggregate(sig: dscan.ScanSig):
         live_any, _ = _suffix_first(
             alive & run["live"] & ~expired,
             (jnp.zeros_like(ht_hi),), gs)
-        col_has = {}
         col_notnull = {}
         col_val = {}
         for cs in sig.cols:
@@ -149,73 +148,10 @@ def compiled_seg_aggregate(sig: dscan.ScanSig):
             if "arith" in c:
                 payload["arith"] = c["arith"]
             has, latest = _suffix_first(cand, payload, gs)
-            col_has[cs.col_id] = has
             col_notnull[cs.col_id] = has & ~latest["null"] & ~latest["exp"]
             col_val[cs.col_id] = latest
 
-        exists = live_any
-        for cs in sig.cols:
-            exists = exists | col_notnull[cs.col_id]
-
-        B, R = valid.shape
-        gidx = (lax.broadcasted_iota(jnp.int32, (B, R), 0) * R
-                + lax.broadcasted_iota(jnp.int32, (B, R), 1))
-        result = gs & exists & (gidx >= row_lo) & (gidx < row_hi)
-        for i, ps in enumerate(sig.preds):
-            latest = col_val[ps.col_id]
-            result = result & col_notnull[ps.col_id] & \
-                flat_fold._eval_pred_flat(ps, latest["cmp"],
-                                          latest.get("arith"),
-                                          pred_lits[i])
-
-        scanned = jnp.sum(result, dtype=jnp.int32)
-        acc = []
-        for ag in sig.aggs:
-            if ag.fn == "count":
-                m = (result if ag.col_id is None
-                     else result & col_notnull[ag.col_id])
-                acc.append({"count": jnp.sum(m, dtype=jnp.int32)})
-                continue
-            latest = col_val[ag.col_id]
-            m = result & col_notnull[ag.col_id]
-            n = jnp.sum(m, dtype=jnp.int32)
-            if ag.fn == "sum":
-                if ag.kind in ("f32", "f64"):
-                    s1 = jnp.sum(jnp.where(m, latest["arith"], 0.0),
-                                 axis=1)
-                    acc.append({"fsum": jnp.sum(s1),
-                                "fcomp": jnp.float32(0), "n": n})
-                else:
-                    m_i32 = m.astype(jnp.int32)
-                    digits = [jnp.int32(0)] * agg_fold.DIGITS
-                    if ag.kind == "i32":
-                        digits = flat_fold._masked_plane_limbs(
-                            latest["cmp"][..., 0], m_i32, digits, 0)
-                    else:
-                        digits = flat_fold._masked_plane_limbs(
-                            latest["cmp"][..., 1], m_i32, digits, 0)
-                        digits = flat_fold._masked_plane_limbs(
-                            latest["cmp"][..., 0], m_i32, digits, 2)
-                    acc.append({"digits": jnp.stack(digits), "n": n})
-            else:
-                is_max = ag.fn == "max"
-                red = jnp.max if is_max else jnp.min
-                if ag.kind == "f32":
-                    fill = jnp.float32(-jnp.inf if is_max else jnp.inf)
-                    acc.append({"fext": red(
-                        jnp.where(m, latest["arith"], fill)), "n": n})
-                elif ag.kind == "i32":
-                    fill = I32_MIN if is_max else flat_fold.I32_MAX
-                    acc.append({"ext": red(jnp.where(
-                        m, latest["cmp"][..., 0], fill)), "n": n})
-                else:
-                    fill = I32_MIN if is_max else flat_fold.I32_MAX
-                    hi = latest["cmp"][..., 0]
-                    lo = latest["cmp"][..., 1]
-                    ext_hi = red(jnp.where(m, hi, fill))
-                    ext_lo = red(jnp.where(m & (hi == ext_hi), lo, fill))
-                    acc.append({"ext_hi": ext_hi, "ext_lo": ext_lo,
-                                "n": n})
-        return agg_fold.pack(sig.aggs, acc, scanned)
+        return flat_fold.finish_groups(sig, gs, live_any, col_notnull,
+                                       col_val, row_lo, row_hi, pred_lits)
 
     return jax.jit(fn)

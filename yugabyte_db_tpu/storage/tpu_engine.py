@@ -1440,18 +1440,36 @@ class TpuStorageEngine(StorageEngine):
         dev_aggs, lowering = agg_fold.lower_aggs(
             spec.aggregates, self._name_to_id, self._kinds)
 
+        from yugabyte_db_tpu.ops import lookback_fold, seg_fold
+
         R = crun.R
         K = agg_fold.safe_window_blocks(R, agg_fold.FULL_WINDOW_BLOCKS)
+        flat = crun.max_group_versions <= 1
+        # lookback rides in the compile signature: set it ONLY when the
+        # lookback route can serve this run (otherwise every distinct
+        # version count would recompile the byte-identical fallbacks),
+        # and round up to a power of two so drifting counts share at
+        # most 5 compiled variants.
+        lb = 0
+        if not flat and \
+                crun.max_group_versions <= lookback_fold.MAX_LOOKBACK:
+            lb = 1 << (crun.max_group_versions - 1).bit_length()
         sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
                             preds=pred_sigs, aggs=dev_aggs, apply_preds=True,
-                            flat=crun.max_group_versions <= 1)
+                            flat=flat, lookback=lb)
         r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
-        from yugabyte_db_tpu.ops import seg_fold
 
         if flat_fold.supports(sig):
             # Flat run: one fused full-array program (bandwidth-roofline;
             # ops.flat_fold) instead of the serialized window fold.
             fn = flat_fold.compiled_flat_aggregate(sig)
+            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
+                            jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
+                            pred_lits)
+        elif lookback_fold.supports(sig):
+            # Bounded version counts: shifted-mask resolve at the flat
+            # path's memory roofline (ops.lookback_fold).
+            fn = lookback_fold.compiled_lookback_aggregate(sig)
             ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
                             jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
                             pred_lits)
