@@ -199,6 +199,34 @@ def unpack(sig_aggs, ivec, fvec):
     return acc, scanned
 
 
+def merge_accs(ag: dscan.AggSig, a: dict, b: dict) -> dict:
+    """Combine two unpacked accumulators over DISJOINT row sets (the
+    overlay-scan composition: primary-run partial + dirty-key overlay
+    partial). Exact for count/sum (digit adds) and order-correct for
+    min/max (lexicographic on ordered planes)."""
+    if ag.fn == "count":
+        return {"count": a["count"] + b["count"]}
+    n = a["n"] + b["n"]
+    if ag.fn == "sum":
+        if ag.kind in ("f32", "f64"):
+            return {"fsum": a["fsum"] + b["fsum"],
+                    "fcomp": a["fcomp"] + b["fcomp"], "n": n}
+        return {"digits": [int(x) + int(y)
+                           for x, y in zip(a["digits"], b["digits"])],
+                "n": n}
+    if a["n"] == 0:
+        return dict(b, n=n)
+    if b["n"] == 0:
+        return dict(a, n=n)
+    pick = max if ag.fn == "max" else min
+    if ag.kind == "f32":
+        return {"fext": pick(a["fext"], b["fext"]), "n": n}
+    if ag.kind == "i32":
+        return {"ext": pick(a["ext"], b["ext"]), "n": n}
+    best = pick((a["ext_hi"], a["ext_lo"]), (b["ext_hi"], b["ext_lo"]))
+    return {"ext_hi": best[0], "ext_lo": best[1], "n": n}
+
+
 def finalize(ag: dscan.AggSig, a: dict, fn_name: str):
     """Accumulator -> python value (fn_name is the user fn: avg uses a sum
     accumulator)."""

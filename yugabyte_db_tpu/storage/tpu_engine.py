@@ -71,6 +71,20 @@ class TpuRun:
         return self._pallas_tensors
 
 
+class _MaskedRun:
+    """A TpuRun view with substituted device arrays (the delta overlay's
+    valid-masked primary). Shares the source's ColumnarRun."""
+
+    class _Dev:
+        def __init__(self, B, arrays):
+            self.B = B
+            self.arrays = arrays
+
+    def __init__(self, source: "TpuRun", arrays: dict):
+        self.crun = source.crun
+        self.dev = _MaskedRun._Dev(source.dev.B, arrays)
+
+
 class TpuStorageEngine(StorageEngine):
     def __init__(self, schema: Schema, options: dict | None = None):
         super().__init__(schema, options)
@@ -88,6 +102,12 @@ class TpuStorageEngine(StorageEngine):
         # changes (flush/compact). Holds strong refs to its TpuRuns, so
         # id(trun) keys can't be reused while cached.
         self._plan_cache: dict = {}
+        # Delta-overlay cache for multi-source scans: (source runs,
+        # memtable ref, memtable version count, state | None). Validity
+        # is judged by identity + the monotone version counter, and the
+        # tuple holds strong refs so nothing it names can be collected
+        # and identity-reused underneath it.
+        self._overlay_cache = None
         from yugabyte_db_tpu.storage.run_io import RunPersistence
 
         self.persist = RunPersistence(self.options.get("data_dir"))
@@ -843,12 +863,21 @@ class TpuStorageEngine(StorageEngine):
                 plan = self._plan_grouped_aggregate(runs[0], spec, exact)
                 if plan is not None:
                     return plan
-            eligible = (single_source and not superset and not host_only
+            eligible = (not superset and not host_only
                         and not spec.group_by and not has_expr
                         and self._aggs_device_eligible(spec))
-            if eligible and runs:
+            if eligible and single_source and runs:
                 outs, fin = self._plan_device_aggregate(runs[0], spec, exact)
                 return ("issued", outs, fin)
+            if eligible and not single_source and (runs or mem_live):
+                # Multi-source (overlapping runs / live memtable): the
+                # cached delta overlay keeps this a pure device scan —
+                # primary run with dirty keys masked out of its valid
+                # plane + a mini-run holding the dirty keys' full merged
+                # version sets (disjoint partials, combined on host).
+                ov = self._overlay(mem)
+                if ov is not None:
+                    return self._plan_overlay_aggregate(ov, spec, exact)
             if single_source and runs:
                 return ("gather", self._plan_gather(
                     runs[0], spec, pred_split, aggregate=True))
@@ -1424,9 +1453,101 @@ class TpuStorageEngine(StorageEngine):
                 out.append(merged.get(cid))
         return out
 
+    # -- delta overlay (multi-source scans as two device dispatches) --------
+    def _overlay(self, mem):
+        """The cached delta-overlay pair for the current engine content:
+        (masked_primary, overlay_trun).
+
+        Multi-source reads (overlapping runs and/or a live memtable)
+        previously merged EVERY key on host — correct, but ~100x slower
+        than a device scan. The overlay makes them device-resident again:
+
+        - dirty keys = every key present in any non-primary source;
+        - overlay run = a mini columnar run holding each dirty key's FULL
+          version set merged across ALL sources (primary included) — the
+          same build path a flush uses;
+        - masked primary = the primary run's device arrays with dirty
+          keys' rows cleared from the ``valid`` plane.
+
+        The two sources then cover disjoint key sets, so any scan = one
+        dispatch over each + an exact partial combine. Rebuilds are
+        amortized: content is keyed by (run set identity, memtable
+        version counter), so write→scan phases build once and every scan
+        until the next write reuses it. Reference contract:
+        IntentAwareIterator's multi-source merge
+        (src/yb/docdb/intent_aware_iterator.h:81), restaged TPU-side.
+        Returns None (host fallback) when the dirty set approaches the
+        primary's size — at that shape a compaction is the real answer."""
+        runs = list(self.runs)
+        if not runs:
+            return None
+        cache = self._overlay_cache
+        if cache is not None:
+            c_runs, c_mem, c_ver, state = cache
+            if c_runs == runs and c_mem is mem and \
+                    c_ver == mem.num_versions:
+                return state
+        primary = max(runs, key=lambda t: t.crun.total_rows())
+        deltas = [t for t in runs if t is not primary]
+
+        dirty: dict[bytes, list] = {}
+        for t in deltas:
+            for key, versions in t.crun.iter_entries():
+                dirty.setdefault(key, []).extend(versions)
+        for key in mem.scan_keys(b"", b""):
+            dirty.setdefault(key, []).extend(mem.versions(key))
+        state = None
+        if dirty and len(dirty) * 2 <= max(primary.crun.total_rows(), 64):
+            entries = []
+            mask = np.zeros((primary.dev.B, primary.crun.R), dtype=bool)
+            flat = mask.reshape(-1)
+            for key in sorted(dirty):
+                versions = list(dirty[key])
+                pversions = primary.crun.find_versions(key)
+                if pversions:
+                    start = primary.crun.lower_row(key)
+                    flat[start:start + len(pversions)] = True
+                    versions.extend(pversions)
+                versions.sort(key=lambda r: (r.ht, r.write_id),
+                              reverse=True)
+                entries.append((key, versions))
+            overlay_trun = TpuRun(ColumnarRun.build(
+                self.schema, entries, self.rows_per_block))
+            masked_valid = primary.dev.arrays["valid"] & jnp.asarray(~mask)
+            masked_arrays = dict(primary.dev.arrays, valid=masked_valid)
+            masked_primary = _MaskedRun(primary, masked_arrays)
+            state = (masked_primary, overlay_trun)
+        self._overlay_cache = (runs, mem, mem.num_versions, state)
+        return state
+
+    def _plan_overlay_aggregate(self, ov, spec: ScanSpec, exact_preds):
+        """Two raw device aggregates (masked primary + overlay run) with
+        an exact host combine of the disjoint partials."""
+        masked_primary, overlay_trun = ov
+        dev_aggs, lowering = agg_fold.lower_aggs(
+            spec.aggregates, self._name_to_id, self._kinds)
+        o1, f1 = self._plan_device_aggregate(masked_primary, spec,
+                                             exact_preds, raw=True)
+        o2, f2 = self._plan_device_aggregate(overlay_trun, spec,
+                                             exact_preds, raw=True)
+
+        def finish(fetched):
+            acc1, s1 = f1(fetched[:2])
+            acc2, s2 = f2(fetched[2:])
+            merged = [agg_fold.merge_accs(ag, a, b)
+                      for ag, a, b in zip(dev_aggs, acc1, acc2)]
+            out_row, names = [], []
+            for a, (fn_name, di) in zip(spec.aggregates, lowering):
+                names.append(f"{a.fn}({a.column or '*'})")
+                out_row.append(agg_fold.finalize(dev_aggs[di], merged[di],
+                                                 fn_name))
+            return ScanResult(names, [tuple(out_row)], None, s1 + s2)
+
+        return ("issued", o1 + o2, finish)
+
     # -- device aggregate path ---------------------------------------------
     def _plan_device_aggregate(self, trun: TpuRun, spec: ScanSpec,
-                               exact_preds):
+                               exact_preds, raw: bool = False):
         """Single-dispatch full-run aggregate: the device fori_loops every
         window and returns two packed vectors (ops.agg_fold) — one dispatch
         plus two small transfers per scan, because the host link pays
@@ -1493,6 +1614,8 @@ class TpuStorageEngine(StorageEngine):
         def finish(f):
             iv, fv = f
             acc, scanned = agg_fold.unpack(dev_aggs, iv, fv)
+            if raw:
+                return acc, scanned
             out_row, names = [], []
             for a, (fn_name, di) in zip(spec.aggregates, lowering):
                 names.append(f"{a.fn}({a.column or '*'})")
