@@ -236,7 +236,7 @@ def bench_multisource(schema, tpu, cpu, max_ht, S, waves=4):
             i = rng.randrange(NUM_KEYS)
             ht += 1
             key = schema.encode_primary_key(
-                {"k": f"user{i:06d}", "r": 0},
+                {"k": f"user{i:06d}", "r": i % 7},
                 compute_hash_code(schema, {"k": f"user{i:06d}"}))
             batch.append(RowVersion(
                 key, ht=ht,
@@ -531,29 +531,60 @@ def bench_cluster_write(n_rows=60_000, writers=4, batch=256):
 
 
 def bench_compact(schema, rows, max_ht, make_engine):
+    """4-run merge with REAL history GC: base load + 3 update/delete
+    waves over the same keyspace (multi-version groups, tombstones),
+    compacted at the max cutoff — the shape update traffic actually
+    leaves behind (a disjoint-run merge would never exercise the
+    retention filter). Output content is pinned to the CPU oracle."""
+    from yugabyte_db_tpu.models.partition import compute_hash_code
+    from yugabyte_db_tpu.storage.row_version import RowVersion
+
+    cid = {c.name: c.col_id for c in schema.value_columns}
+    per_wave = max(1, int(NUM_KEYS * 0.35))
+
     def load(name):
         e = make_engine(name, schema, {"rows_per_block": 2048})
-        per = max(1, len(rows) // 4)
-        for i in range(0, len(rows), per):
-            e.apply(rows[i:i + per])
+        e.apply(rows)
+        e.flush()
+        rng = random.Random(9)
+        ht = max_ht
+        for _wave in range(3):
+            batch = []
+            for _ in range(per_wave):
+                i = rng.randrange(NUM_KEYS)
+                ht += 1
+                key = schema.encode_primary_key(
+                    {"k": f"user{i:06d}", "r": i % 7},
+                    compute_hash_code(schema, {"k": f"user{i:06d}"}))
+                if rng.random() < 0.1:
+                    batch.append(RowVersion(key, ht=ht, tombstone=True))
+                else:
+                    batch.append(RowVersion(
+                        key, ht=ht,
+                        columns={cid["d"]: rng.randrange(-10**6, 10**6)}))
+            e.apply(batch)
             e.flush()
-        return e
+        return e, ht
 
-    tpu = load("tpu")
-    tpu.compact(max_ht)  # includes one-time kernel compile
-    tpu2 = load("tpu")
+    n_versions = len(rows) + 3 * per_wave
+    tpu, cut = load("tpu")
+    tpu.compact(cut)  # includes one-time compile/warm costs
+    tpu2, cut = load("tpu")
     t0 = time.perf_counter()
-    tpu2.compact(max_ht)
+    tpu2.compact(cut)
     tdt = time.perf_counter() - t0
-    cpu = load("cpu")
+    cpu, cut2 = load("cpu")
     t0 = time.perf_counter()
-    cpu.compact(max_ht)
+    cpu.compact(cut2)
     cdt = time.perf_counter() - t0
-    assert [k for k, _ in cpu.dump_entries()] == \
-        [k for k, _ in tpu2.dump_entries()]
+    ca, cb = cpu.dump_entries(), tpu2.dump_entries()
+    assert [k for k, _ in ca] == [k for k, _ in cb]
+    for (k1, v1), (_k2, v2) in zip(ca, cb):
+        assert [(r.ht, r.tombstone, r.columns) for r in v1] == \
+            [(r.ht, r.tombstone, r.columns) for r in v2], k1
     return {
         "metric": "compaction_versions_per_sec",
-        "value": round(len(rows) / tdt, 1),
+        "value": round(n_versions / tdt, 1),
         "unit": "versions/s (4-run merge + full history GC)",
         "vs_baseline": None,  # no comparable in-reference microbenchmark
         "vs_cpu_engine": round(cdt / tdt, 2),

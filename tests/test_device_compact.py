@@ -132,3 +132,21 @@ def test_long_keys_fall_back_to_host():
     cpu.compact(ht)
     tpu.compact(ht)
     assert _entries_signature(cpu) == _entries_signature(tpu)
+
+
+def test_resident_device_mask_route(monkeypatch):
+    """Force the device-resident retention mask (the large-union route,
+    normally gated behind HOST_GC_MASK_MAX) and pin it to the oracle —
+    a regression in its index mapping/padding must not hide behind the
+    host-twin default."""
+    import yugabyte_db_tpu.storage.tpu_engine as TE
+
+    monkeypatch.setattr(TE, "HOST_GC_MASK_MAX", 0)
+    schema, cpu, tpu = _mk_engines()
+    ht = _random_load(schema, (cpu, tpu), seed=23)
+    cpu.compact(ht // 2)
+    tpu.compact(ht // 2)
+    assert _entries_signature(cpu) == _entries_signature(tpu)
+    a = cpu.scan(ScanSpec(read_ht=ht + 1))
+    b = tpu.scan(ScanSpec(read_ht=ht + 1))
+    assert a.rows == b.rows

@@ -82,9 +82,11 @@ class ColumnarRun:
         self.exp_hi = self.exp_lo = None            # [B, R] i32
         self.tomb = self.live = self.valid = self.group_start = None  # [B, R] bool
         self.cols: dict[int, ColumnData] = {}       # col_id -> ColumnData
-        # Host-side exact data for ties/materialization/compaction:
-        self.row_keys: list[list[bytes]] = []       # per block, len R (b"" pad)
-        self.row_versions: list[list[RowVersion | None]] = []
+        # Host-side exact data for ties/materialization/compaction —
+        # [B, R] OBJECT ndarrays (bytes / RowVersion / key-value lists)
+        # so compaction slices whole blocks as views:
+        self.row_keys: np.ndarray | None = None     # [B, R] object (b"" pad)
+        self.row_versions: np.ndarray | None = None  # [B, R] object
         self.min_key = b""
         self.max_key = b""
         self.max_ht = 0
@@ -191,9 +193,12 @@ class ColumnarRun:
                 varlen=([[None] * R for _ in range(B)]
                         if not c.dtype.is_fixed_width else None),
             )
-        self.row_keys = [[b""] * R for _ in range(B)]
-        self.row_versions = [[None] * R for _ in range(B)]
-        self.row_key_vals = [[None] * R for _ in range(B)]
+        # Object NDARRAYS (not lists): compaction slices whole blocks of
+        # row payloads as views instead of per-row pointer copies.
+        self.row_keys = np.empty((B, R), dtype=object)
+        self.row_keys[:] = b""
+        self.row_versions = np.empty((B, R), dtype=object)
+        self.row_key_vals = np.empty((B, R), dtype=object)
         self.blocks = [BlockMeta(b"", b"", 0) for _ in range(B)]
 
     def _fill_block(self, b: int, group_list) -> None:
