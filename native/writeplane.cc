@@ -764,6 +764,315 @@ PyObject* py_block_ht_range(PyObject*, PyObject* arg) {
   return Py_BuildValue("(LL)", (long long)lo, (long long)hi);
 }
 
+// -- page server -------------------------------------------------------------
+//
+// The YCSB-E hot path — LIMIT-k pages from a run's host mirror,
+// entirely in C: binary search over the run's key blob for the range
+// bounds, binary search of the precomputed match index, then direct
+// row-tuple emission from the plane buffers (decoding the ordered int32
+// planes back to int64/float64 inline). serve_page handles one page
+// (with an optional upper bound); serve_page_batch serves a whole
+// same-structure page GROUP per call so buffer acquisition and colspec
+// parsing amortize. Both share one emit core. The Python path
+// (storage/host_page.py) is the spec and the fallback.
+
+struct BufView {
+  Py_buffer view{};
+  bool held = false;
+  ~BufView() { if (held) PyBuffer_Release(&view); }
+  bool get(PyObject* obj, const char* what) {
+    if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) < 0) {
+      PyErr_Format(PyExc_TypeError, "serve_page: %s must support the "
+                   "buffer protocol", what);
+      return false;
+    }
+    held = true;
+    return true;
+  }
+  const int64_t* i64() const { return (const int64_t*)view.buf; }
+  const int32_t* i32() const { return (const int32_t*)view.buf; }
+  const unsigned char* u8() const {
+    return (const unsigned char*)view.buf;
+  }
+  size_t n(size_t itemsize) const { return (size_t)view.len / itemsize; }
+};
+
+// memcmp-order compare of blob key i vs (p, n).
+static int key_cmp(const char* blob, const int64_t* offs, size_t i,
+                   const char* p, size_t n) {
+  size_t a0 = (size_t)offs[i], a1 = (size_t)offs[i + 1];
+  size_t alen = a1 - a0;
+  int c = memcmp(blob + a0, p, alen < n ? alen : n);
+  if (c != 0) return c;
+  return alen < n ? -1 : (alen > n ? 1 : 0);
+}
+
+// first index i in [0, nv) with key[i] >= (p, n)
+static size_t key_lower_bound(const char* blob, const int64_t* offs,
+                              size_t nv, const char* p, size_t n) {
+  size_t lo = 0, hi = nv;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (key_cmp(blob, offs, mid, p, n) < 0) lo = mid + 1;
+    else hi = mid;
+  }
+  return lo;
+}
+
+static size_t i64_lower_bound(const int64_t* a, size_t n, int64_t v) {
+  size_t lo = 0, hi = n;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (a[mid] < v) lo = mid + 1;
+    else hi = mid;
+  }
+  return lo;
+}
+
+static inline uint64_t planes_u64(int32_t hi, int32_t lo) {
+  uint32_t uh = (uint32_t)hi ^ 0x80000000u;
+  uint32_t ul = (uint32_t)lo ^ 0x80000000u;
+  return ((uint64_t)uh << 32) | ul;
+}
+
+// Parsed per-column emit specs (see host_page._native_colspecs):
+//   ("obj", list)            list[g] (value as-is; key columns)
+//   ("objnn", list, nn_u8)   nn[g] ? list[g] : None (str/f32 payloads)
+//   ("i32"|"bool", cmp_i32, nn_u8)
+//   ("i64"|"f64", cmp2_i32 (two interleaved planes), nn_u8)
+struct ColEmit {
+  enum Kind { C_OBJ, C_OBJNN, C_I32, C_BOOL, C_I64, C_F64 };
+  std::vector<Kind> kinds;
+  std::vector<PyObject*> objs;
+  std::vector<BufView> cmps;
+  std::vector<BufView> nns;
+
+  bool parse(PyObject* colspecs) {
+    if (!PyTuple_Check(colspecs)) {
+      PyErr_SetString(PyExc_TypeError,
+                      "serve_page: colspecs must be a tuple");
+      return false;
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(colspecs);
+    kinds.resize(n);
+    objs.assign(n, nullptr);
+    cmps = std::vector<BufView>(n);
+    nns = std::vector<BufView>(n);
+    for (Py_ssize_t c = 0; c < n; c++) {
+      PyObject* spec = PyTuple_GET_ITEM(colspecs, c);
+      const char* tag = PyUnicode_AsUTF8(PyTuple_GET_ITEM(spec, 0));
+      if (tag == nullptr) return false;
+      if (strcmp(tag, "obj") == 0) {
+        kinds[c] = C_OBJ;
+        objs[c] = PyTuple_GET_ITEM(spec, 1);
+      } else if (strcmp(tag, "objnn") == 0) {
+        kinds[c] = C_OBJNN;
+        objs[c] = PyTuple_GET_ITEM(spec, 1);
+        if (!nns[c].get(PyTuple_GET_ITEM(spec, 2), "nn")) return false;
+      } else {
+        kinds[c] = strcmp(tag, "i32") == 0 ? C_I32
+                   : strcmp(tag, "bool") == 0 ? C_BOOL
+                   : strcmp(tag, "i64") == 0 ? C_I64 : C_F64;
+        if (!cmps[c].get(PyTuple_GET_ITEM(spec, 1), "cmp")) return false;
+        if (!nns[c].get(PyTuple_GET_ITEM(spec, 2), "nn")) return false;
+      }
+    }
+    return true;
+  }
+
+  // One row tuple for global row g, or nullptr on error.
+  PyObject* row(int64_t g) const {
+    Py_ssize_t n = (Py_ssize_t)kinds.size();
+    PyObject* tup = PyTuple_New(n);
+    if (tup == nullptr) return nullptr;
+    for (Py_ssize_t c = 0; c < n; c++) {
+      PyObject* v = nullptr;
+      switch (kinds[c]) {
+        case C_OBJ:
+          v = PyList_GET_ITEM(objs[c], (Py_ssize_t)g);
+          Py_INCREF(v);
+          break;
+        case C_OBJNN:
+          if (nns[c].u8()[g]) {
+            v = PyList_GET_ITEM(objs[c], (Py_ssize_t)g);
+            Py_INCREF(v);
+          } else {
+            v = Py_NewRef(Py_None);
+          }
+          break;
+        case C_I32:
+          v = nns[c].u8()[g] ? PyLong_FromLong(cmps[c].i32()[g])
+                             : Py_NewRef(Py_None);
+          break;
+        case C_BOOL:
+          v = nns[c].u8()[g]
+                  ? PyBool_FromLong(cmps[c].i32()[g] != 0)
+                  : Py_NewRef(Py_None);
+          break;
+        case C_I64: {
+          if (!nns[c].u8()[g]) { v = Py_NewRef(Py_None); break; }
+          uint64_t u = planes_u64(cmps[c].i32()[2 * g],
+                                  cmps[c].i32()[2 * g + 1]);
+          v = PyLong_FromLongLong((long long)(u ^ (1ULL << 63)));
+          break;
+        }
+        case C_F64: {
+          if (!nns[c].u8()[g]) { v = Py_NewRef(Py_None); break; }
+          uint64_t flipped = planes_u64(cmps[c].i32()[2 * g],
+                                        cmps[c].i32()[2 * g + 1]);
+          uint64_t bits = (flipped >> 63) ? (flipped & ~(1ULL << 63))
+                                          : ~flipped;
+          double d;
+          memcpy(&d, &bits, 8);
+          v = PyFloat_FromDouble(d);
+          break;
+        }
+      }
+      if (v == nullptr) { Py_DECREF(tup); return nullptr; }
+      PyTuple_SET_ITEM(tup, c, v);
+    }
+    return tup;
+  }
+};
+
+// Serve one page -> (rows, scanned, resume|None) tuple, or nullptr.
+static PyObject* emit_page(const char* blob, const BufView& offs,
+                           const BufView& valid, const BufView& match,
+                           const BufView& exists, const ColEmit& cols,
+                           const char* lower, size_t lower_n,
+                           const char* upper, size_t upper_n,
+                           Py_ssize_t limit) {
+  size_t nv = valid.n(8);
+  size_t nm = match.n(8);
+  size_t ne = exists.n(8);
+
+  size_t lo_i = key_lower_bound(blob, offs.i64(), nv, lower, lower_n);
+  int64_t row_lo = lo_i < nv ? valid.i64()[lo_i] : INT64_MAX;
+  int64_t row_hi = INT64_MAX;
+  if (upper_n > 0) {
+    size_t hi_i = key_lower_bound(blob, offs.i64(), nv, upper, upper_n);
+    row_hi = hi_i < nv ? valid.i64()[hi_i] : INT64_MAX;
+  }
+  size_t i0 = i64_lower_bound(match.i64(), nm, row_lo);
+  size_t i1 = row_hi == INT64_MAX
+                  ? nm
+                  : i64_lower_bound(match.i64(), nm, row_hi);
+  if (i1 < i0) i1 = i0;
+  size_t take = i1 - i0;
+  if (limit >= 0 && (size_t)limit < take) take = (size_t)limit;
+  bool hit_limit = limit >= 0 && take >= (size_t)limit && take > 0;
+
+  PyObject* rows = PyList_New((Py_ssize_t)take);
+  if (rows == nullptr) return nullptr;
+  for (size_t j = 0; j < take; j++) {
+    PyObject* tup = cols.row(match.i64()[i0 + j]);
+    if (tup == nullptr) { Py_DECREF(rows); return nullptr; }
+    PyList_SET_ITEM(rows, (Py_ssize_t)j, tup);
+  }
+
+  // scanned: existing rows examined through the last consumed row.
+  int64_t hi_row = take > 0 ? match.i64()[i0 + take - 1] + 1 : row_hi;
+  size_t e1 = hi_row == INT64_MAX
+                  ? ne
+                  : i64_lower_bound(exists.i64(), ne, hi_row);
+  size_t e0 = i64_lower_bound(exists.i64(), ne, row_lo);
+
+  PyObject* resume;
+  if (hit_limit) {
+    int64_t g_last = match.i64()[i0 + take - 1];
+    size_t pos = i64_lower_bound(valid.i64(), nv, g_last);
+    size_t k0 = (size_t)offs.i64()[pos], k1 = (size_t)offs.i64()[pos + 1];
+    resume = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)(k1 - k0 + 1));
+    if (resume == nullptr) { Py_DECREF(rows); return nullptr; }
+    char* rp = PyBytes_AS_STRING(resume);
+    memcpy(rp, blob + k0, k1 - k0);
+    rp[k1 - k0] = '\0';
+  } else {
+    resume = Py_NewRef(Py_None);
+  }
+  PyObject* out = PyTuple_New(3);
+  if (out == nullptr) {
+    Py_DECREF(rows);
+    Py_DECREF(resume);
+    return nullptr;
+  }
+  PyTuple_SET_ITEM(out, 0, rows);
+  PyObject* sc = PyLong_FromLongLong((long long)(e1 - e0));
+  if (sc == nullptr) { Py_DECREF(out); Py_DECREF(resume); return nullptr; }
+  PyTuple_SET_ITEM(out, 1, sc);
+  PyTuple_SET_ITEM(out, 2, resume);
+  return out;
+}
+
+// serve_page(blob, offsets, valid_rows, match_idx, exists_idx, colspecs,
+//            lower, upper, limit) -> (rows, scanned, resume|None)
+//   upper b"" = unbounded; limit -1 = none.
+PyObject* py_serve_page(PyObject*, PyObject* args) {
+  const char *blob, *lower, *upper;
+  Py_ssize_t blob_n, lower_n, upper_n, limit;
+  PyObject *offs_o, *valid_o, *match_o, *exists_o, *colspecs;
+  if (!PyArg_ParseTuple(args, "y#OOOOOy#y#n", &blob, &blob_n, &offs_o,
+                        &valid_o, &match_o, &exists_o, &colspecs,
+                        &lower, &lower_n, &upper, &upper_n, &limit)) {
+    return nullptr;
+  }
+  BufView offs, valid, match, exists;
+  if (!offs.get(offs_o, "offsets") || !valid.get(valid_o, "valid_rows") ||
+      !match.get(match_o, "match_idx") ||
+      !exists.get(exists_o, "exists_idx")) {
+    return nullptr;
+  }
+  ColEmit cols;
+  if (!cols.parse(colspecs)) return nullptr;
+  return emit_page(blob, offs, valid, match, exists, cols, lower,
+                   (size_t)lower_n, upper, (size_t)upper_n, limit);
+}
+
+// serve_page_batch(blob, offsets, valid_rows, match_idx, exists_idx,
+//                  colspecs, lowers: list[bytes], limit) ->
+//   [(rows, scanned, resume|None)]
+PyObject* py_serve_page_batch(PyObject*, PyObject* args) {
+  const char* blob;
+  Py_ssize_t blob_n, limit;
+  PyObject *offs_o, *valid_o, *match_o, *exists_o, *colspecs, *lowers;
+  if (!PyArg_ParseTuple(args, "y#OOOOOOn", &blob, &blob_n, &offs_o,
+                        &valid_o, &match_o, &exists_o, &colspecs,
+                        &lowers, &limit)) {
+    return nullptr;
+  }
+  if (!PyList_Check(lowers)) {
+    PyErr_SetString(PyExc_TypeError,
+                    "serve_page_batch: lowers must be a list");
+    return nullptr;
+  }
+  BufView offs, valid, match, exists;
+  if (!offs.get(offs_o, "offsets") || !valid.get(valid_o, "valid_rows") ||
+      !match.get(match_o, "match_idx") ||
+      !exists.get(exists_o, "exists_idx")) {
+    return nullptr;
+  }
+  ColEmit cols;
+  if (!cols.parse(colspecs)) return nullptr;
+
+  Py_ssize_t npages = PyList_GET_SIZE(lowers);
+  PyObject* results = PyList_New(npages);
+  if (results == nullptr) return nullptr;
+  for (Py_ssize_t pi = 0; pi < npages; pi++) {
+    char* lower;
+    Py_ssize_t lower_n;
+    if (PyBytes_AsStringAndSize(PyList_GET_ITEM(lowers, pi), &lower,
+                                &lower_n) < 0) {
+      Py_DECREF(results);
+      return nullptr;
+    }
+    PyObject* entry = emit_page(blob, offs, valid, match, exists, cols,
+                                lower, (size_t)lower_n, "", 0, limit);
+    if (entry == nullptr) { Py_DECREF(results); return nullptr; }
+    PyList_SET_ITEM(results, pi, entry);
+  }
+  return results;
+}
+
 // -- Memtable ----------------------------------------------------------------
 
 struct Ver {
@@ -1051,6 +1360,12 @@ PyMethodDef kMethods[] = {
      "encode_ops(desc, ops, starts) -> per-partition (nrows, block)"},
     {"encode_rows", py_encode_rows, METH_O,
      "encode_rows(row_versions) -> block bytes"},
+    {"serve_page_batch", py_serve_page_batch, METH_VARARGS,
+     "serve_page_batch(blob, offsets, valid_rows, match_idx, exists_idx, "
+     "colspecs, lowers, limit) -> [(rows, scanned, resume|None)]"},
+    {"serve_page", py_serve_page, METH_VARARGS,
+     "serve_page(blob, offsets, valid_rows, match_idx, exists_idx, "
+     "colspecs, lower, upper, limit) -> (rows, scanned, resume|None)"},
     {"stamp_block", py_stamp_block, METH_VARARGS,
      "stamp_block(block, ht, logical_shift) -> stamped block"},
     {"block_count", py_block_count, METH_O, "row count of a block"},

@@ -74,6 +74,7 @@ class HostPageIndex:
                 col.cmp_planes.reshape(n, col.cmp_planes.shape[-1]))
         self._lock = threading.Lock()
         self._masks: dict = {}
+        self._colspec_cache: dict = {}  # native emit specs (serve_pages)
 
     def masks(self, read_planes, pred_items):
         """(match_idx, exists_idx, notnull{cid}) for one read point +
@@ -257,6 +258,203 @@ def decode_pages(engine, pages: list[HostPage]) -> list[ScanResult]:
         # ScanResult.columns as read-only (every engine path does).
         out.append(p.result(rows_all[off:off + n], cols_list))
         off += n
+    return out
+
+
+# -- native page server -------------------------------------------------
+
+try:
+    from yugabyte_db_tpu.native import yb_wp as _native
+except Exception:  # noqa: BLE001 — pure-Python fallback
+    _native = None
+if _native is not None and not hasattr(_native, "serve_page"):
+    _native = None  # stale extension build
+
+
+def _native_key_ctx(trun):
+    """(blob, offsets i64, valid_rows i64) for C binary search over the
+    run's keys — built once per run."""
+    ctx = getattr(trun, "_page_key_ctx", None)
+    if ctx is None:
+        crun = trun.crun
+        keys: list[bytes] = []
+        rows = []
+        for b in range(crun.B):
+            nv = crun.blocks[b].num_valid
+            if nv:
+                keys.extend(crun.row_keys[b, :nv].tolist())
+                rows.append(np.arange(b * crun.R, b * crun.R + nv,
+                                      dtype=np.int64))
+        offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+        if keys:
+            np.cumsum(np.fromiter(map(len, keys), np.int64, len(keys)),
+                      out=offsets[1:])
+        blob = b"".join(keys)
+        valid_rows = (np.concatenate(rows) if rows
+                      else np.zeros(0, np.int64))
+        ctx = trun._page_key_ctx = (blob, offsets, valid_rows)
+    return ctx
+
+
+def _native_obj_col(engine, trun, cid):
+    """Global-row-indexed value list for a str/f32 column (exact host
+    payloads) — built once per (run, column)."""
+    cache = getattr(trun, "_page_obj_cols", None)
+    if cache is None:
+        cache = trun._page_obj_cols = {}
+    vals = cache.get(cid)
+    if vals is None:
+        crun = trun.crun
+        vals = [None] * (crun.B * crun.R)
+        for b in range(crun.B):
+            nv = crun.blocks[b].num_valid
+            rvs = crun.row_versions[b]
+            base = b * crun.R
+            for r in range(nv):
+                rv = rvs[r]
+                if rv is not None:
+                    vals[base + r] = rv.columns.get(cid)
+        cache[cid] = vals
+    return vals
+
+
+# Runs above this size don't eagerly materialize the O(run) object
+# lists the native emitter needs for key/str/f32 columns — those pages
+# take the per-touched-block numpy path instead (the documented
+# small-page latency property).
+NATIVE_PAGE_OBJ_MAX = 2_000_000
+
+
+def _native_colspecs(engine, trun, projection, notnull):
+    """Per-column emit specs for yb_wp.serve_page, or None when this
+    projection would require an eager O(run) object materialization on
+    a run too large to pay it (caller falls back to plan/decode)."""
+    key_col_pos = {c.name: i
+                   for i, c in enumerate(engine.schema.key_columns)}
+    crun = trun.crun
+    big = crun.B * crun.R > NATIVE_PAGE_OBJ_MAX
+    kv_lists = None
+    specs = []
+    for nm in projection:
+        if nm in key_col_pos:
+            if kv_lists is None:
+                kv_cache = getattr(trun, "_page_kv_lists", None)
+                if kv_cache is None:
+                    if big:
+                        return None
+                    kv_cache = trun._page_kv_lists = [
+                        a.tolist()
+                        for a in trun.crun.key_col_arrays(None)]
+                kv_lists = kv_cache
+            specs.append(("obj", kv_lists[key_col_pos[nm]]))
+            continue
+        cid = engine._name_to_id[nm]
+        kind = engine._kinds[cid]
+        nn = notnull[cid]
+        if kind in ("str", "f32"):
+            if big and cid not in getattr(trun, "_page_obj_cols", {}):
+                return None
+            specs.append(("objnn", _native_obj_col(engine, trun, cid), nn))
+        elif kind in ("i64", "f64"):
+            specs.append((kind, trun.host_index.cols[cid][2], nn))
+        elif engine._dtypes[cid] == DataType.BOOL:
+            specs.append(("bool", trun.host_index.cols[cid][2][:, 0], nn))
+        else:
+            specs.append(("i32", trun.host_index.cols[cid][2][:, 0], nn))
+    return tuple(specs)
+
+
+def serve_pages(engine, items):
+    """Serve many pages through the native page server (yb_wp.serve_page:
+    C binary search over the run's key blob + direct row emission from
+    the plane buffers). items is [(trun, spec, pred_items)]; falls back
+    to the vectorized-numpy plan/decode pipeline when the extension is
+    unavailable. Returns [ScanResult] in items order."""
+    if _native is None:
+        planned = plan_pages(engine, items)
+        groups: dict = {}
+        for i, pg in enumerate(planned):
+            groups.setdefault(pg.struct_key, []).append((i, pg))
+        out = [None] * len(items)
+        for members in groups.values():
+            decoded = decode_pages(engine, [pg for _i, pg in members])
+            for (i, _pg), res in zip(members, decoded):
+                out[i] = res
+        return out
+
+    out = [None] * len(items)
+    cs_cache: dict = {}
+    batch_groups: dict = {}
+
+    def ctx_for(trun, spec, pred_items):
+        idx = trun.host_index
+        if idx is None:
+            idx = trun.host_index = HostPageIndex(trun.crun)
+        read_planes = engine._read_plane_ints(spec)
+        masks = idx.masks(read_planes, pred_items)
+        projection = tuple(spec.projection
+                           or (c.name for c in engine.schema.columns))
+        ck = (id(trun), read_planes, pred_items, projection)
+        cached = cs_cache.get(ck)
+        if cached is None:
+            with idx._lock:
+                cached = idx._colspec_cache.get(ck)
+            if cached is None:
+                specs = _native_colspecs(engine, trun, projection,
+                                         masks[2])
+                cached = ((list(projection), specs)
+                          if specs is not None else None)
+                with idx._lock:
+                    if len(idx._colspec_cache) >= 2 * _MASK_CACHE_ENTRIES:
+                        idx._colspec_cache.pop(
+                            next(iter(idx._colspec_cache)))
+                    idx._colspec_cache[ck] = cached
+            cs_cache[ck] = cached
+        return ck, masks, cached
+
+    fallback: list = []
+    for i, (trun, spec, pred_items) in enumerate(items):
+        ck, masks, cached = ctx_for(trun, spec, pred_items)
+        if cached is None:  # too-big eager materialization: numpy path
+            fallback.append((i, (trun, spec, pred_items)))
+            continue
+        if not spec.upper and spec.limit is not None:
+            # The server shape (forward LIMIT page, no upper bound):
+            # group for ONE amortized native call per structure.
+            g = batch_groups.get(ck + (spec.limit,))
+            if g is None:
+                g = batch_groups[ck + (spec.limit,)] = (
+                    trun, masks, cached, spec.limit, [], [])
+            g[4].append(i)
+            g[5].append(spec.lower)
+            continue
+        cols_list, colspecs = cached
+        match_idx, exists_idx, _nn = masks
+        blob, offsets, valid_rows = _native_key_ctx(trun)
+        rows, scanned, resume = _native.serve_page(
+            blob, offsets, valid_rows, match_idx, exists_idx, colspecs,
+            spec.lower, spec.upper or b"",
+            -1 if spec.limit is None else spec.limit)
+        out[i] = ScanResult(cols_list, rows, resume, scanned)
+
+    for trun, masks, cached, limit, idxs, lowers in batch_groups.values():
+        cols_list, colspecs = cached
+        match_idx, exists_idx, _nn = masks
+        blob, offsets, valid_rows = _native_key_ctx(trun)
+        served = _native.serve_page_batch(
+            blob, offsets, valid_rows, match_idx, exists_idx, colspecs,
+            lowers, limit)
+        for i, (rows, scanned, resume) in zip(idxs, served):
+            out[i] = ScanResult(cols_list, rows, resume, scanned)
+    if fallback:
+        planned = plan_pages(engine, [it for _i, it in fallback])
+        groups: dict = {}
+        for (i, _it), pg in zip(fallback, planned):
+            groups.setdefault(pg.struct_key, []).append((i, pg))
+        for members in groups.values():
+            decoded = decode_pages(engine, [pg for _i, pg in members])
+            for (i, _pg), res in zip(members, decoded):
+                out[i] = res
     return out
 
 

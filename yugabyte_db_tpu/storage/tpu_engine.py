@@ -526,13 +526,16 @@ class TpuStorageEngine(StorageEngine):
         scatter(run.row_keys, all_keys[kept_src])
         scatter(run.row_versions, all_vers[kept_src])
         scatter(run.row_key_vals, all_kvs[kept_src])
+        has_varlen = any(run.cols[cid].varlen is not None
+                         for cid in col_ids)
         for b, (s0, n) in enumerate(ranges):
-            for cid in col_ids:
-                col = run.cols[cid]
-                if col.varlen is not None:
-                    sel = kept_src[s0:s0 + n]
-                    vl = varlen_all[cid]
-                    col.varlen[b][:n] = [vl[i] for i in sel.tolist()]
+            if has_varlen:
+                sel_list = kept_src[s0:s0 + n].tolist()
+                for cid in col_ids:
+                    col = run.cols[cid]
+                    if col.varlen is not None:
+                        vl = varlen_all[cid]
+                        col.varlen[b][:n] = [vl[i] for i in sel_list]
             run.blocks[b] = BlockMeta(run.row_keys[b][0],
                                       run.row_keys[b][n - 1], n)
         run.min_key = run.row_keys[0][0]
@@ -771,11 +774,9 @@ class TpuStorageEngine(StorageEngine):
                 issued_outs.append((pi, plan[1], plan[2]))
             else:
                 gathers.append((pi, plan[1]))
-        pages = []
-        if page_items:
-            planned = host_page.plan_pages(
-                self, [it for _pi, it in page_items])
-            pages = [(pi, pg) for (pi, _it), pg in zip(page_items, planned)]
+        # Page items defer wholesale to finish() (device work first);
+        # host_page.serve_pages runs them through the native page server.
+        pages = page_items
 
         states = dict(gathers)
         pending = {pi: st.pending for pi, st in gathers if st.pending}
@@ -1769,17 +1770,13 @@ class _AsyncBatch:
         # Host-path scans first: device work is already in flight.
         for pi, fin in self.host_plans:
             results[pi] = fin()
-        # Host page-cache scans: group same-structure pages so the whole
-        # batch decodes with one vectorized pass per column.
+        # Host page-cache scans through the native page server (numpy
+        # plan/decode fallback inside serve_pages).
         if self.pages:
-            by_struct: dict = {}
-            for pi, pg in self.pages:
-                by_struct.setdefault(pg.struct_key, []).append((pi, pg))
-            for members in by_struct.values():
-                decoded = host_page.decode_pages(
-                    eng, [pg for _pi, pg in members])
-                for (pi, _pg), res in zip(members, decoded):
-                    results[pi] = res
+            served = host_page.serve_pages(
+                eng, [it for _pi, it in self.pages])
+            for (pi, _it), res in zip(self.pages, served):
+                results[pi] = res
         # One fetch for everything issued in round 1 (device_get reuses
         # buffers the async copies already landed).
         disp_bufs, issued_np = jax.device_get(
