@@ -59,13 +59,18 @@ class YBClient:
             return self._req_counter
 
     def __init__(self, transport, master_uuids: list[str],
-                 default_rpc_timeout_s: float = 10.0):
+                 default_rpc_timeout_s: float = 10.0, cloud_info=None):
         import threading
         import uuid as uuid_mod
 
         self.transport = transport
         self.master_uuids = list(master_uuids)
         self.default_rpc_timeout_s = default_rpc_timeout_s
+        # The client's own locality labels: stale follower reads prefer
+        # a replica in the same (cloud, region, zone) — the reference's
+        # read-replica / closest-replica selection (TabletInvoker with
+        # YBConsistencyLevel + CloudInfoPB proximity).
+        self.cloud_info = cloud_info or {}
         self.meta_cache = MetaCache(self)
         self._master_leader_hint: str | None = None
         # Exactly-once write identity: every write carries
@@ -157,13 +162,19 @@ class YBClient:
             raise RuntimeError(f"create_table {name}: {resp}")
         return self.open_table(name)
 
-    def create_index(self, table: str, column: str,
-                     index_name: str | None = None) -> str:
-        """Create a secondary index; returns the index table's name."""
+    def create_index(self, table: str, columns,
+                     index_name: str | None = None, include=()) -> str:
+        """Create a secondary index on one or more columns, optionally
+        covering (INCLUDE) extra value columns; returns the index
+        table's name."""
+        if isinstance(columns, str):
+            columns = [columns]
         resp = self.master_rpc("master.create_index", {
-            "table": table, "column": column, "index_name": index_name})
+            "table": table, "columns": list(columns),
+            "include": list(include), "index_name": index_name})
         if resp.get("code") not in ("ok", "already_present"):
-            raise RuntimeError(f"create_index on {table}.{column}: {resp}")
+            raise RuntimeError(
+                f"create_index on {table}{tuple(columns)}: {resp}")
         return resp["index_table"]
 
     def alter_table(self, name: str, new_schema_dict: dict) -> None:
@@ -196,9 +207,14 @@ class YBClient:
 
     # -- tablet path (TabletInvoker) -----------------------------------------
     def tablet_rpc(self, table_name: str, loc: TabletLocation, method: str,
-                   payload: dict, timeout_s: float | None = None) -> dict:
+                   payload: dict, timeout_s: float | None = None,
+                   prefer: str | None = None,
+                   mark_leader: bool = True) -> dict:
         """Invoke a tablet RPC against its leader, with hint-following and
-        replica fallback (reference: TabletInvoker::Execute)."""
+        replica fallback (reference: TabletInvoker::Execute). ``prefer``
+        puts one replica first in the try order (stale same-zone reads);
+        ``mark_leader=False`` suppresses leader learning for responses a
+        follower may legitimately serve."""
         deadline = time.monotonic() + (timeout_s or self.default_rpc_timeout_s)
         payload = dict(payload, tablet_id=loc.tablet_id)
         payload.setdefault("propagated_ht", self.last_observed_ht)
@@ -207,6 +223,8 @@ class YBClient:
         while time.monotonic() < deadline:
             targets = ([loc.leader] if loc.leader else []) + \
                 [r for r in loc.replicas if r != loc.leader]
+            if prefer is not None and prefer in loc.replicas:
+                targets = [prefer] + [t for t in targets if t != prefer]
             for target in targets:
                 try:
                     resp = self.transport.send(target, method, payload,
@@ -226,9 +244,10 @@ class YBClient:
                     last = resp
                     continue  # replica being moved/created: try others
                 if code == "ok":
-                    self.meta_cache.mark_leader(table_name, loc.tablet_id,
-                                                target)
-                    loc.leader = target
+                    if mark_leader:
+                        self.meta_cache.mark_leader(table_name,
+                                                    loc.tablet_id, target)
+                        loc.leader = target
                     seen = max(resp.get("ht") or 0,
                                resp.get("read_ht") or 0,
                                resp.get("commit_ht") or 0)

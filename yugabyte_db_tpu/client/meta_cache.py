@@ -18,6 +18,8 @@ class TabletLocation:
     partition_end: int
     replicas: list[str] = field(default_factory=list)
     leader: str | None = None
+    # replica uuid -> {"cloud", "region", "zone"} (zone-aware routing)
+    replica_clouds: dict = field(default_factory=dict)
 
     def contains(self, hash_code: int) -> bool:
         return self.partition_start <= hash_code < self.partition_end
@@ -50,7 +52,9 @@ class MetaCache:
         for t in resp["tablets"]:
             locs.tablets.append(TabletLocation(
                 t["tablet_id"], t["partition_start"], t["partition_end"],
-                [r["uuid"] for r in t["replicas"]], t.get("leader")))
+                [r["uuid"] for r in t["replicas"]], t.get("leader"),
+                {r["uuid"]: r.get("cloud_info") or {}
+                 for r in t["replicas"]}))
         with self._lock:
             self._tables[table_name] = locs
         return locs

@@ -311,15 +311,31 @@ class YBSession:
         return res.rows[0] if res.rows else None
 
     # -- scans ---------------------------------------------------------------
+    def _stale_prefer(self, loc) -> str | None:
+        """Same-zone replica for a stale read (read-replica routing):
+        prefer a replica matching the client's locality labels."""
+        ci = self.client.cloud_info
+        if not ci:
+            return None
+        for r in loc.replicas:
+            if loc.replica_clouds.get(r) == ci:
+                return r
+        return None
+
     def scan(self, table: YBTable, spec: ScanSpec,
-             timeout_s: float = 30.0) -> ScanResult:
+             timeout_s: float = 30.0, stale_ok: bool = False) -> ScanResult:
         """Fan a scan out over the table's tablets and merge.
 
         Row scans: tablets are visited in partition order, honoring
         spec.limit across tablets with per-tablet paging. Aggregates:
-        per-tablet partials combined client-side (avg via sum+count)."""
+        per-tablet partials combined client-side (avg via sum+count).
+
+        ``stale_ok``: serve from ANY replica at its applied state
+        (bounded-staleness read-replica reads) — same-zone replicas are
+        preferred when the client carries locality labels (reference:
+        follower reads / read replicas, master.proto read_replicas)."""
         if spec.is_aggregate:
-            return self._scan_aggregate(table, spec, timeout_s)
+            return self._scan_aggregate(table, spec, timeout_s, stale_ok)
         locs = self.client.meta_cache.locations(table.name)
         out_rows: list[tuple] = []
         columns: list[str] = []
@@ -339,9 +355,14 @@ class YBSession:
                                projection=spec.projection,
                                limit=remaining,
                                group_by=spec.group_by)
+                payload = {"spec": wire.encode_spec(sub)}
+                if stale_ok:
+                    payload["allow_stale"] = True
                 resp = self.client.tablet_rpc(
-                    table.name, loc, "ts.scan",
-                    {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+                    table.name, loc, "ts.scan", payload,
+                    timeout_s=timeout_s,
+                    prefer=self._stale_prefer(loc) if stale_ok else None,
+                    mark_leader=not stale_ok)
                 if "read_ht" in resp:
                     read_ht = resp["read_ht"]
                 res = wire.decode_result(resp)
@@ -358,7 +379,8 @@ class YBSession:
         return ScanResult(columns, out_rows, None, scanned)
 
     def _scan_aggregate(self, table: YBTable, spec: ScanSpec,
-                        timeout_s: float) -> ScanResult:
+                        timeout_s: float,
+                        stale_ok: bool = False) -> ScanResult:
         # Decompose avg into sum+count partials (reference: per-tablet
         # EvalAggregate partials recombined above the scan).
         partial_aggs: list[AggSpec] = []
@@ -397,7 +419,7 @@ class YBSession:
         # per-tablet path below; the host combine here remains only the
         # cross-tserver (and fallback) merge.
         remaining_tablets = list(locs.tablets)
-        if not gb and table.engine == "tpu":
+        if not gb and table.engine == "tpu" and not stale_ok:
             by_leader: dict[str, list] = {}
             for loc in locs.tablets:
                 if loc.leader:
@@ -426,9 +448,13 @@ class YBSession:
             sub = ScanSpec(lower=spec.lower, upper=spec.upper,
                            read_ht=read_ht, predicates=spec.predicates,
                            aggregates=partial_aggs, group_by=spec.group_by)
+            payload = {"spec": wire.encode_spec(sub)}
+            if stale_ok:
+                payload["allow_stale"] = True
             resp = self.client.tablet_rpc(
-                table.name, loc, "ts.scan",
-                {"spec": wire.encode_spec(sub)}, timeout_s=timeout_s)
+                table.name, loc, "ts.scan", payload, timeout_s=timeout_s,
+                prefer=self._stale_prefer(loc) if stale_ok else None,
+                mark_leader=not stale_ok)
             consume(resp)
         if not groups and not gb:
             groups[()] = []

@@ -8,7 +8,8 @@ the old and new row states and issues them to the index table.
 
 from yugabyte_db_tpu.index.maintenance import (index_entry, index_mutations,
                                                index_schema,
-                                               index_table_name)
+                                               index_table_name,
+                                               normalize_index)
 
 __all__ = ["index_entry", "index_mutations", "index_schema",
-           "index_table_name"]
+           "index_table_name", "normalize_index"]

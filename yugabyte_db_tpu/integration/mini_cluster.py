@@ -27,13 +27,16 @@ class MiniCluster:
                  raft_opts: RaftOptions = FAST_RAFT, fsync: bool = False,
                  engine_options: dict | None = None,
                  ts_unresponsive_timeout_s: float = 2.0,
-                 heartbeat_interval_s: float = 0.2):
+                 heartbeat_interval_s: float = 0.2,
+                 ts_cloud_info: dict | None = None):
         self.data_root = data_root
         self.raft_opts = raft_opts
         self.fsync = fsync
         self.engine_options = engine_options
         self.heartbeat_interval_s = heartbeat_interval_s
         self.ts_unresponsive_timeout_s = ts_unresponsive_timeout_s
+        # uuid -> {"cloud","region","zone"} labels (zone-aware placement)
+        self.ts_cloud_info = ts_cloud_info or {}
         self.master_uuids = [f"m-{i}" for i in range(num_masters)]
         self.tserver_uuids = [f"ts-{i}" for i in range(num_tservers)]
         self.masters: dict[str, Master] = {}
@@ -89,7 +92,8 @@ class MiniCluster:
                           raft_opts=self.raft_opts,
                           engine_options=self.engine_options,
                           fsync=self.fsync,
-                          heartbeat_interval_s=self.heartbeat_interval_s)
+                          heartbeat_interval_s=self.heartbeat_interval_s,
+                          cloud_info=self.ts_cloud_info.get(uuid))
         ts.advertised_addr = self._wire_handler(uuid, ts.handle)
         self.tservers[uuid] = ts
         ts.start()
@@ -126,10 +130,13 @@ class MiniCluster:
             self.transport.close()
 
     # -- helpers ------------------------------------------------------------
-    def client(self, name: str = "client") -> YBClient:
+    def client(self, name: str = "client",
+               cloud_info: dict | None = None) -> YBClient:
         if self.transport_kind == "local":
-            return YBClient(self.transport.bind(name), self.master_uuids)
-        return YBClient(self.transport, self.master_uuids)
+            return YBClient(self.transport.bind(name), self.master_uuids,
+                            cloud_info=cloud_info)
+        return YBClient(self.transport, self.master_uuids,
+                        cloud_info=cloud_info)
 
     def start_webservers(self) -> dict:
         """Start an embedded HTTP server (metrics/varz/tablets) on every

@@ -48,6 +48,9 @@ class CatalogState:
         # Roles/permissions ride the same replicated catalog pipeline
         # (reference: role records in the sys catalog, master.proto:1383).
         self.auth = RoleStore()
+        # User-defined types: name -> [(field, dtype int)] (reference:
+        # UDTypeInfo records in the sys catalog, pt_create_type.cc).
+        self.types: dict[str, list] = {}
 
     def apply(self, op: dict) -> None:
         kind = op["op"]
@@ -61,6 +64,12 @@ class CatalogState:
                 pass
             return
         with self._lock:
+            if kind == "create_type":
+                self.types[op["name"]] = [tuple(f) for f in op["fields"]]
+                return
+            if kind == "drop_type":
+                self.types.pop(op["name"], None)
+                return
             if kind == "create_table":
                 t = TableInfo(op["table_id"], op["name"], op["schema"],
                               op["num_tablets"], engine=op.get("engine", "cpu"))

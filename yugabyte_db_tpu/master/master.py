@@ -228,9 +228,17 @@ class Master:
             num_tablets, hash_partitioned=schema.num_hash > 0
         ).create_partitions()
         tablets = []
+        # Topology-aware placement: spread each tablet's replicas across
+        # the fewest-used (cloud, region, zone) groups, least-loaded
+        # tserver within a group; load counts include this table's own
+        # placements so tablets spread too (reference:
+        # CatalogManager::SelectReplicas honoring PlacementInfoPB,
+        # src/yb/master/master.proto:186-197).
+        load = {d.uuid: d.num_live_tablets for d in live}
         for i, part in enumerate(parts):
-            # Round-robin placement over the least-loaded live tservers.
-            replicas = [live[(i + j) % len(live)].uuid for j in range(rf)]
+            replicas = self._select_replicas(live, rf, load)
+            for r in replicas:
+                load[r] += 1
             tablets.append({
                 "tablet_id": f"{table_id}-t{i:04d}",
                 "partition_start": part.start,
@@ -248,6 +256,41 @@ class Master:
         if errors:
             return {"code": "partial", "table_id": table_id, "errors": errors}
         return {"code": "ok", "table_id": table_id}
+
+    @staticmethod
+    def _zone_of(desc) -> tuple:
+        ci = desc.cloud_info or {}
+        return (ci.get("cloud", ""), ci.get("region", ""),
+                ci.get("zone", ""))
+
+    def _select_replicas(self, live, rf: int, load: dict,
+                         exclude=(), existing_zones=()) -> list[str]:
+        """Pick up to ``rf`` tservers spreading across availability
+        zones: each pick takes the least-used zone (counting
+        ``existing_zones`` — the zones of replicas the tablet already
+        has), then the least-loaded tserver within it. Falls back to
+        packing zones once every zone is used (small clusters)."""
+        import collections as _c
+
+        by_zone: dict[tuple, list] = {}
+        for d in live:
+            if d.uuid in exclude:
+                continue
+            by_zone.setdefault(self._zone_of(d), []).append(d)
+        for descs in by_zone.values():
+            descs.sort(key=lambda d: load.get(d.uuid, 0))
+        used = _c.Counter(existing_zones)
+        picks: list[str] = []
+        for _ in range(rf):
+            candidates = [(used[z], load.get(descs[0].uuid, 0), z)
+                          for z, descs in by_zone.items() if descs]
+            if not candidates:
+                break
+            _u, _l, z = min(candidates)
+            d = by_zone[z].pop(0)
+            picks.append(d.uuid)
+            used[z] += 1
+        return picks
 
     @staticmethod
     def _create_tablet_req(tablet_id: str, table_name: str, schema,
@@ -331,16 +374,21 @@ class Master:
         base = self.catalog.table_by_name(p["table"])
         if base is None:
             return {"code": "not_found"}
-        column = p["column"]
-        name = p.get("index_name") or f"{p['table']}_{column}_idx"
+        columns = list(p.get("columns") or
+                       ([p["column"]] if p.get("column") else []))
+        include = list(p.get("include") or [])
+        if not columns:
+            return {"code": "error", "message": "no indexed columns"}
+        name = p.get("index_name") or \
+            f"{p['table']}_{'_'.join(columns)}_idx"
         if any(i["name"] == name for i in base.indexes):
             return {"code": "already_present", "index_table":
                     next(i["index_table"] for i in base.indexes
                          if i["name"] == name)}
         base_schema = Schema.from_dict(base.schema)
-        itable = index_table_name(p["table"], column, p.get("index_name"))
+        itable = index_table_name(p["table"], columns, p.get("index_name"))
         try:
-            ischema = index_schema(base_schema, column, itable)
+            ischema = index_schema(base_schema, columns, itable, include)
         except (ValueError, KeyError) as e:
             return {"code": "error", "message": str(e)}
         # Inherit the base table's replication factor (its tablets'
@@ -356,7 +404,8 @@ class Master:
         if create["code"] not in ("ok", "partial", "already_present"):
             return create
         op = {"op": "create_index", "table_id": base.table_id,
-              "index": {"name": name, "column": column,
+              "index": {"name": name, "column": columns[0],
+                        "columns": columns, "include": include,
                         "index_table": itable}}
         try:
             self.raft.replicate("catalog", op)
@@ -442,7 +491,8 @@ class Master:
                 "partition_start": info.partition_start,
                 "partition_end": info.partition_end,
                 "replicas": [
-                    {"uuid": r, "addr": self.ts_manager.addr_of(r)}
+                    {"uuid": r, "addr": self.ts_manager.addr_of(r),
+                     "cloud_info": self.ts_manager.cloud_info_of(r)}
                     for r in info.replicas
                 ],
                 "leader": self.ts_manager.leader_of(info.tablet_id),
@@ -499,11 +549,44 @@ class Master:
             return self._not_leader()
         return {"code": "ok", "auth": self.catalog.auth.to_dict()}
 
+    def _h_master_type_op(self, p: dict):
+        """CREATE/DROP TYPE through the replicated catalog (reference:
+        CatalogManager::CreateUDType/DeleteUDType)."""
+        if not self.raft.is_leader():
+            return self._not_leader()
+        action = p["action"]
+        name = p["name"]
+        if action == "create":
+            if name in self.catalog.types:
+                return {"code": "already_present"}
+            op = {"op": "create_type", "name": name,
+                  "fields": [list(f) for f in p["fields"]]}
+        else:
+            if name not in self.catalog.types:
+                return {"code": "not_found"}
+            for t in self.catalog.list_tables():
+                for c in t.schema.get("columns", []):
+                    if c.get("udt") == name:
+                        return {"code": "error", "message":
+                                f"type {name} in use by table {t.name}"}
+            op = {"op": "drop_type", "name": name}
+        try:
+            self.raft.replicate("catalog", op)
+        except NotLeader:
+            return self._not_leader()
+        return {"code": "ok"}
+
+    def _h_master_list_types(self, p: dict):
+        return {"code": "ok", "types": {
+            n: [list(f) for f in fs]
+            for n, fs in self.catalog.types.items()}}
+
     def _h_master_list_tservers(self, p: dict):
         now_dead = {d.uuid for d in self.ts_manager.dead_tservers()}
         return {"code": "ok", "tservers": [
             {"uuid": d.uuid, "addr": d.addr, "alive": d.uuid not in now_dead,
-             "num_live_tablets": d.num_live_tablets}
+             "num_live_tablets": d.num_live_tablets,
+             "cloud_info": dict(d.cloud_info)}
             for d in self.ts_manager.all_tservers()
         ]}
 
@@ -619,13 +702,19 @@ class Master:
                     continue
                 if now - self._fixing.get(info.tablet_id, 0) < 10.0:
                     continue  # a fix is already in flight
-                candidates = [d.uuid for d in live
-                              if d.uuid not in info.replicas]
-                if not candidates:
+                without_dead = [r for r in info.replicas if r != bad[0]]
+                # Zone-aware replacement: avoid the zones the surviving
+                # replicas already occupy when another zone has capacity.
+                live_by_uuid = {d.uuid: d for d in live}
+                existing_zones = [self._zone_of(live_by_uuid[r])
+                                  for r in without_dead if r in live_by_uuid]
+                picks = self._select_replicas(
+                    live, 1, {d.uuid: d.num_live_tablets for d in live},
+                    exclude=set(info.replicas), existing_zones=existing_zones)
+                if not picks:
                     continue
                 self._fixing[info.tablet_id] = now
-                replacement = candidates[0]
-                without_dead = [r for r in info.replicas if r != bad[0]]
+                replacement = picks[0]
                 with_new = without_dead + [replacement]
                 leader = self.ts_manager.leader_of(info.tablet_id)
                 if leader is None or leader in dead or leader not in \

@@ -20,6 +20,9 @@ class TSDescriptor:
     last_heartbeat: float = 0.0
     num_live_tablets: int = 0
     tablet_roles: dict = field(default_factory=dict)  # tablet_id -> role
+    # Topology labels (reference: CloudInfoPB, master.proto:172):
+    # {"cloud", "region", "zone"} — empty for unlabeled tservers.
+    cloud_info: dict = field(default_factory=dict)
 
 
 class TSManager:
@@ -47,6 +50,7 @@ class TSManager:
                 d = TSDescriptor(req["ts_uuid"])
                 self._descs[d.uuid] = d
             d.addr = req.get("addr")
+            d.cloud_info = req.get("cloud_info") or {}
             d.last_heartbeat = now
             d.num_live_tablets = req.get("num_live_tablets", 0)
             d.tablet_roles = {t["tablet_id"]: t["role"]
@@ -94,3 +98,8 @@ class TSManager:
         with self._lock:
             d = self._descs.get(uuid)
             return d.addr if d else None
+
+    def cloud_info_of(self, uuid: str) -> dict:
+        with self._lock:
+            d = self._descs.get(uuid)
+            return dict(d.cloud_info) if d else {}

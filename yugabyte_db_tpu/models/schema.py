@@ -31,6 +31,11 @@ class ColumnSchema:
     # Column ids are stable across ALTER TABLE (reference schema.h ColumnId);
     # assigned by Schema/catalog.
     col_id: int = -1
+    # User-defined type name when this column is a (frozen) UDT; the
+    # storage dtype is MAP, the declared type rides here for literal
+    # validation + driver metadata (reference: QLType::udtype_field_names,
+    # src/yb/yql/cql/ql/ptree/pt_create_type.cc).
+    udt: str | None = None
 
     @property
     def is_key(self) -> bool:
@@ -59,7 +64,8 @@ class Schema:
             if c.col_id < 0:
                 while next_id in used:
                     next_id += 1
-                c = ColumnSchema(c.name, c.dtype, c.kind, c.nullable, next_id)
+                c = ColumnSchema(c.name, c.dtype, c.kind, c.nullable,
+                                 next_id, c.udt)
                 used.add(next_id)
                 next_id += 1
             self.columns.append(c)
@@ -150,7 +156,8 @@ class Schema:
             "next_col_id": self.next_col_id,
             "columns": [
                 {"name": c.name, "dtype": int(c.dtype), "kind": int(c.kind),
-                 "nullable": c.nullable, "col_id": c.col_id}
+                 "nullable": c.nullable, "col_id": c.col_id,
+                 **({"udt": c.udt} if c.udt else {})}
                 for c in self.columns
             ],
         }
@@ -159,7 +166,7 @@ class Schema:
     def from_dict(d: dict) -> "Schema":
         cols = [
             ColumnSchema(c["name"], DataType(c["dtype"]), ColumnKind(c["kind"]),
-                         c["nullable"], c["col_id"])
+                         c["nullable"], c["col_id"], c.get("udt"))
             for c in d["columns"]
         ]
         return Schema(cols, d.get("table_id", ""), d.get("version", 0),

@@ -57,6 +57,7 @@ class Heartbeater:
         req = {
             "ts_uuid": self.server.uuid,
             "addr": self.server.advertised_addr,
+            "cloud_info": getattr(self.server, "cloud_info", None) or {},
             "tablets": self.server.tablet_manager.tablet_reports(),
             "num_live_tablets": len(self.server.tablet_manager.peers()),
         }

@@ -30,7 +30,7 @@ class TabletServer:
                  engine_options: dict | None = None,
                  fsync: bool = True,
                  heartbeat_interval_s: float = 0.5,
-                 advertised_addr=None, options=None):
+                 advertised_addr=None, options=None, cloud_info=None):
         # Structured options (server.options.TabletServerOptions) override
         # the loose kwargs when provided (reference:
         # TabletServerOptions over gflags, server_base_options.h).
@@ -38,10 +38,12 @@ class TabletServer:
             fsync = options.fsync
             heartbeat_interval_s = options.heartbeat_interval_s
             engine_options = options.engine_options or engine_options
+            cloud_info = getattr(options, "cloud_info", None) or cloud_info
         self.options = options
         self.uuid = uuid
         self.transport = transport
         self.advertised_addr = advertised_addr  # (host, port) when on TCP
+        self.cloud_info = cloud_info or {}  # zone-aware placement labels
         # Data-dir identity: formats on first open, refuses a directory
         # owned by another server (reference: FsManager::Open,
         # src/yb/fs/fs_manager.cc).
@@ -397,13 +399,16 @@ class TabletServer:
         so maintenance treats the old state as absent — no tombstones
         are emitted. A later duplicate_key rejection then leaves at most
         a stale (base-verified-away) extra entry, never a removed one."""
-        from yugabyte_db_tpu.index import index_mutations
+        from yugabyte_db_tpu.index import index_mutations, normalize_index
         from yugabyte_db_tpu.models.encoding import decode_doc_key
 
         schema = peer.tablet.meta.schema
         key_names = [c.name for c in schema.key_columns]
-        indexed_cids = {schema.column(i["column"]).col_id
-                        for i in peer.tablet.meta.indexes}
+        indexed_cids = set()
+        for i in peer.tablet.meta.indexes:
+            ni = normalize_index(i)
+            for cname in ni["columns"] + ni["include"]:
+                indexed_cids.add(schema.column(cname).col_id)
         for row in rows:
             # Writes that can't change any indexed value skip the old-row
             # read entirely (the hot non-indexed-update path).

@@ -14,6 +14,32 @@ from yugabyte_db_tpu.models.schema import ColumnSchema
 from yugabyte_db_tpu.utils.status import InvalidArgument
 
 
+def coerce_udt(col: ColumnSchema, value, fields):
+    """Coerce a UDT literal ({field: value} map) against the type's
+    declared fields: unknown fields rejected, missing fields NULL, each
+    field coerced to its declared type; normalized to declared field
+    order so replicas/serializers agree."""
+    if value is None:
+        return None
+    if not isinstance(value, dict):
+        raise InvalidArgument(
+            f"bad value {value!r} for {col.name} (UDT {col.udt})")
+    declared = {f[0] for f in fields}
+    for k in value:
+        if k not in declared:
+            raise InvalidArgument(
+                f"unknown field {k!r} for UDT {col.udt}")
+    out = {}
+    for fname, fdtype in fields:
+        v = value.get(fname)
+        if v is None:
+            out[fname] = None
+            continue
+        fcol = ColumnSchema(f"{col.name}.{fname}", DataType(fdtype))
+        out[fname] = coerce_value(fcol, v)
+    return out
+
+
 def coerce_value(col: ColumnSchema, value):
     """Coerce a resolved (marker-free) literal to the column's type."""
     if value is None:
@@ -69,10 +95,13 @@ def evolve_schema(handle, action: str, column: str | None,
         if action == "add":
             return schema.with_added_column(column, dtype)
         if action == "drop":
-            if any(i["column"] == column
-                   for i in getattr(handle, "indexes", [])):
-                raise InvalidArgument(
-                    f"column {column} is indexed; drop the index first")
+            from yugabyte_db_tpu.index import normalize_index
+
+            for i in getattr(handle, "indexes", []):
+                ni = normalize_index(i)
+                if column in ni["columns"] or column in ni["include"]:
+                    raise InvalidArgument(
+                        f"column {column} is indexed; drop the index first")
             return schema.with_dropped_column(column)
         return schema.with_renamed_column(column, new_name)
     except (ValueError, KeyError) as e:

@@ -27,6 +27,7 @@ class ColumnDef:
     name: str
     dtype: DataType
     is_static: bool = False
+    udt: str | None = None         # declared user-defined type name
 
 
 @dataclass
@@ -146,8 +147,25 @@ class DropTable:
 class CreateIndex:
     name: str                      # index name
     table: str                     # base table (possibly qualified)
-    column: str                    # single indexed column
+    columns: list                  # indexed columns (compound hash)
     if_not_exists: bool = False
+    include: list = field(default_factory=list)  # covered columns
+
+
+@dataclass
+class CreateType:
+    """CREATE TYPE name (field type, ...) — reference:
+    src/yb/yql/cql/ql/ptree/pt_create_type.cc."""
+
+    name: str
+    fields: list                   # [(field_name, DataType)]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropType:
+    name: str
+    if_exists: bool = False
 
 
 @dataclass

@@ -166,11 +166,51 @@ class ClientCluster:
         handle.schema = new_schema
 
     def create_index(self, base: RemoteTable, name: str,
-                     column: str) -> str:
-        itable = self.client.create_index(base.name, column, name)
-        base.indexes.append({"name": name, "column": column,
+                     columns, include=()) -> str:
+        if isinstance(columns, str):
+            columns = [columns]
+        itable = self.client.create_index(base.name, columns, name,
+                                          include)
+        base.indexes.append({"name": name, "column": columns[0],
+                             "columns": list(columns),
+                             "include": list(include),
                              "index_table": itable})
         return itable
+
+    # -- user-defined types -------------------------------------------------
+    def create_type(self, name: str, fields: list) -> None:
+        from yugabyte_db_tpu.utils.status import InvalidArgument
+
+        resp = self.client.master_rpc("master.type_op", {
+            "action": "create", "name": name,
+            "fields": [list(f) for f in fields]})
+        if resp.get("code") not in ("ok", "already_present"):
+            raise InvalidArgument(f"create type {name}: {resp}")
+        self._types_cache = None
+
+    def drop_type(self, name: str) -> None:
+        from yugabyte_db_tpu.utils.status import InvalidArgument
+
+        resp = self.client.master_rpc("master.type_op", {
+            "action": "drop", "name": name})
+        if resp.get("code") != "ok":
+            raise InvalidArgument(f"drop type {name}: {resp}")
+        self._types_cache = None
+
+    def get_type(self, name: str):
+        # The fetched registry is authoritative until a local type op
+        # invalidates it — unknown names don't refetch per lookup.
+        cache = getattr(self, "_types_cache", None)
+        if cache is None:
+            cache = self.list_types()
+        return cache.get(name)
+
+    def list_types(self) -> dict:
+        resp = self.client.master_rpc("master.list_types", {})
+        cache = self._types_cache = {
+            n: [tuple(f) for f in fs]
+            for n, fs in resp.get("types", {}).items()}
+        return cache
 
     def drop_index(self, base: RemoteTable, name: str) -> None:
         idx = next(i for i in base.indexes if i["name"] == name)

@@ -347,15 +347,33 @@ class Parser:
             name = self.ident()
             self._skip_with()
             return ast.CreateKeyspace(name, ine)
+        if self.take_kw("TYPE"):
+            ine = self._if_not_exists()
+            tname = self.qualified_name()
+            self.expect_sym("(")
+            fields = [(self.ident(), self._type())]
+            while self.take_sym(","):
+                fields.append((self.ident(), self._type()))
+            self.expect_sym(")")
+            return ast.CreateType(tname, fields, ine)
         if self.take_kw("INDEX"):
             ine = self._if_not_exists()
             iname = self.ident()
             self.expect_kw("ON")
             table = self.qualified_name()
             self.expect_sym("(")
-            column = self.ident()
+            columns = [self.ident()]
+            while self.take_sym(","):
+                columns.append(self.ident())
             self.expect_sym(")")
-            return ast.CreateIndex(iname, table, column, ine)
+            include = []
+            if self.take_kw("INCLUDE"):
+                self.expect_sym("(")
+                include.append(self.ident())
+                while self.take_sym(","):
+                    include.append(self.ident())
+                self.expect_sym(")")
+            return ast.CreateIndex(iname, table, columns, ine, include)
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         name = self.qualified_name()
@@ -385,18 +403,37 @@ class Parser:
                 self.expect_sym(")")
             else:
                 cname = self.ident()
-                dtype = self._type()
+                dtype, udt = self._type_with_udt()
                 is_static = bool(self.take_kw("STATIC"))
                 if self.take_kw("PRIMARY"):
                     self.expect_kw("KEY")
                     hash_keys.append(cname)
-                cols.append(ast.ColumnDef(cname, dtype, is_static))
+                cols.append(ast.ColumnDef(cname, dtype, is_static, udt))
             if not self.take_sym(","):
                 break
         self.expect_sym(")")
         if not hash_keys:
             raise InvalidArgument("table needs a primary key")
         return cols, hash_keys, range_keys
+
+    def _type_with_udt(self):
+        """A column type: native (possibly FROZEN<...>-wrapped) -> (dtype,
+        None); an unknown name is a user-defined type reference ->
+        (MAP, udt_name) — UDT values store as frozen field maps."""
+        t = self.peek()
+        if t is not None and t.kind == "name" and \
+                t.text.upper() == "FROZEN":
+            self.ident()
+            self.expect_sym("<")
+            inner = self._type_with_udt()
+            self.expect_sym(">")
+            return inner
+        if t is not None and t.kind == "name":
+            try:
+                DataType.parse(t.text)
+            except ValueError:
+                return DataType.MAP, self.ident()
+        return self._type(), None
 
     def _type(self) -> DataType:
         name = self.ident()
@@ -442,6 +479,9 @@ class Parser:
         if self.take_kw("INDEX"):
             ie = self._if_exists()
             return ast.DropIndex(self.ident(), ie)
+        if self.take_kw("TYPE"):
+            ie = self._if_exists()
+            return ast.DropType(self.qualified_name(), ie)
         self.expect_kw("TABLE")
         ie = self._if_exists()
         return ast.DropTable(self.qualified_name(), ie)

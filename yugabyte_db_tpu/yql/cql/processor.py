@@ -81,6 +81,8 @@ class LocalCluster:
         self.fsync = fsync
         self.clock = HybridClock()
         self.tables: dict[str, TableHandle] = {}
+        # User-defined types: name -> [(field, dtype int)].
+        self.types: dict[str, list] = {}
         from yugabyte_db_tpu.auth import RoleStore
 
         self._auth = RoleStore()
@@ -130,13 +132,18 @@ class LocalCluster:
         return handle.tablets[idx]
 
     def create_index(self, base: TableHandle, name: str,
-                     column: str) -> str:
+                     columns, include=()) -> str:
         from yugabyte_db_tpu.index import index_schema, index_table_name
 
-        itable = index_table_name(base.name, column, name)
-        ischema = index_schema(base.schema, column, itable)
+        if isinstance(columns, str):
+            columns = [columns]
+
+        itable = index_table_name(base.name, columns, name)
+        ischema = index_schema(base.schema, columns, itable, include)
         self.create_table(itable, ischema, num_tablets=len(base.tablets))
-        base.indexes.append({"name": name, "column": column,
+        base.indexes.append({"name": name, "column": columns[0],
+                             "columns": list(columns),
+                             "include": list(include),
                              "index_table": itable})
         return itable
 
@@ -144,6 +151,24 @@ class LocalCluster:
         idx = next(i for i in base.indexes if i["name"] == name)
         base.indexes.remove(idx)
         self.drop_table(idx["index_table"])
+
+    # -- user-defined types -------------------------------------------------
+    def create_type(self, name: str, fields: list) -> None:
+        self.types[name] = [tuple(f) for f in fields]
+
+    def drop_type(self, name: str) -> None:
+        for h in self.tables.values():
+            for c in h.schema.columns:
+                if c.udt == name:
+                    raise InvalidArgument(
+                        f"type {name} in use by table {h.name}")
+        self.types.pop(name, None)
+
+    def get_type(self, name: str):
+        return self.types.get(name)
+
+    def list_types(self) -> dict:
+        return dict(self.types)
 
     def alter_table(self, handle: TableHandle, new_schema: Schema) -> None:
         for t in handle.tablets:
@@ -217,6 +242,8 @@ class QLProcessor:
             ast.AlterTable: self._exec_alter_table,
             ast.CreateIndex: self._exec_create_index,
             ast.DropIndex: self._exec_drop_index,
+            ast.CreateType: self._exec_create_type,
+            ast.DropType: self._exec_drop_type,
             ast.Insert: self._exec_insert,
             ast.Update: self._exec_update,
             ast.Delete: self._exec_delete,
@@ -412,8 +439,17 @@ class QLProcessor:
                     c.dtype in (DataType.FLOAT, DataType.DOUBLE):
                 raise InvalidArgument(
                     f"floating-point column {c.name} cannot be a key column")
+            udt = None
+            if getattr(c, "udt", None):
+                udt = self._qualify(c.udt) if "." not in c.udt else c.udt
+                if self.cluster.get_type(udt) is None:
+                    raise InvalidArgument(f"unknown type {c.udt}")
+                if kind != ColumnKind.REGULAR:
+                    raise InvalidArgument(
+                        f"UDT column {c.name} cannot be a key column")
             cols.append(ColumnSchema(c.name, c.dtype, kind,
-                                     nullable=kind == ColumnKind.REGULAR))
+                                     nullable=kind == ColumnKind.REGULAR,
+                                     udt=udt))
         schema = Schema(cols, table_id=name)
         num_tablets = stmt.properties.get("tablets")
         self.cluster.create_table(name, schema, num_tablets)
@@ -449,6 +485,31 @@ class QLProcessor:
         return None
 
     # -- secondary indexes --------------------------------------------------
+    # -- user-defined types -------------------------------------------------
+    def _exec_create_type(self, stmt: ast.CreateType):
+        name = self._qualify(stmt.name)
+        if self.cluster.get_type(name) is not None:
+            if stmt.if_not_exists:
+                return None
+            raise AlreadyPresent(f"type {name} exists")
+        seen = set()
+        for fname, _dt in stmt.fields:
+            if fname in seen:
+                raise InvalidArgument(f"duplicate field {fname}")
+            seen.add(fname)
+        self.cluster.create_type(
+            name, [(f, int(dt)) for f, dt in stmt.fields])
+        return None
+
+    def _exec_drop_type(self, stmt: ast.DropType):
+        name = self._qualify(stmt.name)
+        if self.cluster.get_type(name) is None:
+            if stmt.if_exists:
+                return None
+            raise NotFound(f"type {name} not found")
+        self.cluster.drop_type(name)
+        return None
+
     def _exec_create_index(self, stmt: ast.CreateIndex):
         handle = self.cluster.table(self._qualify(stmt.table))
         if any(i["name"] == stmt.name
@@ -456,12 +517,22 @@ class QLProcessor:
             if stmt.if_not_exists:
                 return None
             raise AlreadyPresent(f"index {stmt.name} exists")
-        if not handle.schema.has_column(stmt.column):
-            raise InvalidArgument(f"unknown column {stmt.column}")
-        if handle.schema.column(stmt.column).is_key:
-            raise InvalidArgument(f"cannot index key column {stmt.column}")
-        itable = self.cluster.create_index(handle, stmt.name, stmt.column)
-        self._backfill_index(handle, stmt.column, itable)
+        if len(set(stmt.columns)) != len(stmt.columns):
+            raise InvalidArgument("duplicate indexed column")
+        for col in list(stmt.columns) + list(stmt.include):
+            if not handle.schema.has_column(col):
+                raise InvalidArgument(f"unknown column {col}")
+            if handle.schema.column(col).is_key:
+                raise InvalidArgument(f"cannot index key column {col}")
+        for col in stmt.include:
+            if col in stmt.columns:
+                raise InvalidArgument(
+                    f"covered column {col} is already indexed")
+        itable = self.cluster.create_index(handle, stmt.name,
+                                           list(stmt.columns),
+                                           list(stmt.include))
+        self._backfill_index(handle, list(stmt.columns), itable,
+                             list(stmt.include))
         return None
 
     def _exec_drop_index(self, stmt: ast.DropIndex):
@@ -478,63 +549,98 @@ class QLProcessor:
             raise NotFound(f"index {stmt.name} not found")
         return None
 
-    def _backfill_index(self, handle: TableHandle, column: str,
-                        itable: str) -> None:
+    def _backfill_index(self, handle: TableHandle, columns,
+                        itable: str, include=()) -> None:
         """Populate the index from existing base rows. Writes land
         through the normal index-table write path; concurrent base
         writes during the scan are covered by their own maintenance."""
         from yugabyte_db_tpu.index import index_entry
 
+        if isinstance(columns, str):
+            columns = [columns]
+        include = list(include)
         ih = self.cluster.table(itable)
         key_names = [c.name for c in handle.schema.key_columns]
-        proj = key_names + [column]
+        nk = len(key_names)
+        proj = key_names + list(columns) + include
         for tablet in handle.tablets:
             spec = ScanSpec(read_ht=tablet.read_time().value,
                             projection=proj)
             res = tablet.scan(spec)
             for row in res.rows:
-                value = row[-1]
-                if value is None:
+                values = list(row[nk:nk + len(columns)])
+                if any(v is None for v in values):
                     continue
-                base_kv = dict(zip(key_names, row[:-1]))
-                hc, rv = index_entry(ih.schema, value, base_kv)
+                base_kv = dict(zip(key_names, row[:nk]))
+                covered = dict(zip(include, row[nk + len(columns):]))
+                hc, rv = index_entry(ih.schema, values, base_kv, covered)
                 self.cluster.tablet_for_hash(ih, hc).write([rv])
 
     def _index_for_predicates(self, handle, predicates):
-        """(index info, eq predicate) when an '='-bound column is indexed."""
-        for pred in predicates:
-            if pred.op != "=":
-                continue
-            for idx in getattr(handle, "indexes", []):
-                if idx["column"] == pred.column:
-                    return idx, pred
+        """(index info, [eq preds in index-column order]) when EVERY
+        indexed column is '='-bound (compound-hash lookups need the full
+        hash tuple; reference: index selection in pt_select.cc)."""
+        from yugabyte_db_tpu.index import normalize_index
+
+        eq = {p.column: p for p in predicates if p.op == "="}
+        for idx in getattr(handle, "indexes", []):
+            ni = normalize_index(idx)
+            if ni["columns"] and all(c in eq for c in ni["columns"]):
+                return ni, [eq[c] for c in ni["columns"]]
         return None, None
 
-    def _run_index_lookup(self, handle, stmt, plan, idx, pred):
+    def _run_index_lookup(self, handle, stmt, plan, idx, preds):
         """Index-driven SELECT: hash-routed scan of the index table for
         base PKs, then base-row point reads re-verifying predicates (a
         stale index entry — possible while an index write has landed but
-        its base write failed — filters out here). Reference:
-        the SELECT planning that routes through an index table
-        (src/yb/yql/cql/ql/ptree/pt_select.cc index selection)."""
+        its base write failed — filters out here). A COVERED query —
+        projection and remaining predicates within indexed + key +
+        INCLUDE columns — is answered from the index table alone, never
+        touching the base table (reference: index-only scans over
+        IndexInfo's covered columns, src/yb/common/index.h; SELECT
+        planning in src/yb/yql/cql/ql/ptree/pt_select.cc). Contract
+        note: the reference maintains indexes transactionally, so
+        index-only results are always consistent; here maintenance is
+        index-write-first best-effort, so a covered read can briefly
+        surface an entry whose base write failed mid-flight — the
+        non-covered path's base re-verification filters those, covered
+        reads trade that window for never touching the base table."""
         ih = self.cluster.table(idx["index_table"])
         ischema = ih.schema
-        value = self._coerce(handle.schema.column(pred.column), pred.value)
-        hc = compute_hash_code(ischema, {pred.column: value})
+        values = [self._coerce(handle.schema.column(p.column), p.value)
+                  for p in preds]
+        kv = {p.column: v for p, v in zip(preds, values)}
+        hc = compute_hash_code(ischema, kv)
         prefix = encode_doc_key_prefix(
-            hc, [(value, ischema.hash_columns[0].dtype)], [])
+            hc, [(kv[c.name], c.dtype) for c in ischema.hash_columns], [])
         key_names = [c.name for c in handle.schema.key_columns]
-        itablet = self.cluster.tablet_for_hash(ih, hc)
-        ires = itablet.scan(ScanSpec(
-            lower=prefix, upper=prefix_successor(prefix),
-            read_ht=itablet.read_time().value, projection=key_names))
 
         projection = plan.projection or [c.name for c in
                                          handle.schema.columns]
         names = ([it.output_name for it in stmt.items] if stmt.items
                  else list(projection))
-        out = ResultSet(columns=names)
         limit = self._coerce_limit(stmt.limit)
+        itablet = self.cluster.tablet_for_hash(ih, hc)
+
+        eq_cols = {p.column for p in preds}
+        index_cols = {c.name for c in ischema.columns}
+        residual = [p for p in plan.predicates if p.column not in eq_cols]
+        covered = (set(projection) <= index_cols and
+                   all(p.column in index_cols for p in residual))
+        if covered:
+            ires = itablet.scan(ScanSpec(
+                lower=prefix, upper=prefix_successor(prefix),
+                read_ht=itablet.read_time().value,
+                predicates=residual, projection=list(projection),
+                limit=limit))
+            out = ResultSet(columns=names)
+            out.rows.extend(ires.rows)
+            return out
+
+        ires = itablet.scan(ScanSpec(
+            lower=prefix, upper=prefix_successor(prefix),
+            read_ht=itablet.read_time().value, projection=key_names))
+        out = ResultSet(columns=names)
         for irow in ires.rows:
             base_kv = dict(zip(key_names, irow))
             bkey, btablet = self._key_and_tablet(handle, base_kv)
@@ -569,9 +675,15 @@ class QLProcessor:
 
     # -- writes ------------------------------------------------------------
     def _coerce(self, col: ColumnSchema, value):
-        from yugabyte_db_tpu.yql.common import coerce_value
+        from yugabyte_db_tpu.yql.common import coerce_udt, coerce_value
 
-        return coerce_value(col, self._resolve_marker(value))
+        value = self._resolve_marker(value)
+        if col.udt:
+            fields = self.cluster.get_type(col.udt)
+            if fields is None:
+                raise InvalidArgument(f"unknown type {col.udt}")
+            return coerce_udt(col, value, fields)
+        return coerce_value(col, value)
 
     def _key_and_tablet(self, handle: TableHandle, key_values: dict):
         from yugabyte_db_tpu.yql.common import key_and_tablet
@@ -775,8 +887,13 @@ class QLProcessor:
         seam's tserver leaders maintain indexes in their own write path."""
         if getattr(handle, "indexes", None) and \
                 getattr(self.cluster, "maintain_indexes", None):
-            indexed_cids = {handle.schema.column(i["column"]).col_id
-                            for i in handle.indexes}
+            from yugabyte_db_tpu.index import normalize_index
+
+            indexed_cids = set()
+            for i in handle.indexes:
+                ni = normalize_index(i)
+                for cname in ni["columns"] + ni["include"]:
+                    indexed_cids.add(handle.schema.column(cname).col_id)
             if row.tombstone or (indexed_cids & row.columns.keys()):
                 # Local maintenance only runs over real in-process
                 # Tablets, which own the canonical old-state read.

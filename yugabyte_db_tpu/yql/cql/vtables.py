@@ -37,7 +37,7 @@ _CQL_TYPE_NAMES = {
 
 VIRTUAL_TABLES = ("system.local", "system.peers",
                   "system_schema.keyspaces", "system_schema.tables",
-                  "system_schema.columns")
+                  "system_schema.columns", "system_schema.types")
 
 
 def is_virtual(qualified: str) -> bool:
@@ -157,12 +157,36 @@ def _columns_rows(processor):
     return rows
 
 
+def _types_rows(processor):
+    """system_schema.types: the UDT registry, as stock drivers read it
+    for schema metadata (reference: yql_types_vtable.cc)."""
+    from yugabyte_db_tpu.models.datatypes import DataType
+
+    rows = []
+    try:
+        types = processor.cluster.list_types()
+    except Exception:  # noqa: BLE001 — masterless moment: empty listing
+        types = {}
+    for name, fields in sorted((types or {}).items()):
+        ks, _, tname = name.rpartition(".")
+        rows.append({
+            "keyspace_name": ks or "default",
+            "type_name": tname or name,
+            "field_names": [f[0] for f in fields],
+            "field_types": [
+                _CQL_TYPE_NAMES.get(DataType(f[1]), "text")
+                for f in fields],
+        })
+    return rows
+
+
 _BUILDERS = {
     "system.local": _local_rows,
     "system.peers": _peers_rows,
     "system_schema.keyspaces": _keyspaces_rows,
     "system_schema.tables": _tables_rows,
     "system_schema.columns": _columns_rows,
+    "system_schema.types": _types_rows,
 }
 
 # Column order when a vtable has no rows to infer from (drivers break
@@ -171,6 +195,8 @@ _EMPTY_COLUMNS = {
     "system.peers": ["peer", "data_center", "host_id", "preferred_ip",
                      "rack", "release_version", "rpc_address",
                      "schema_version", "tokens"],
+    "system_schema.types": ["keyspace_name", "type_name", "field_names",
+                            "field_types"],
 }
 
 

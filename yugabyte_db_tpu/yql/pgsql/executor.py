@@ -313,8 +313,13 @@ class PgProcessor:
                    row: RowVersion, if_not_exists: bool = False) -> None:
         if getattr(handle, "indexes", None) and \
                 getattr(self.cluster, "maintain_indexes", None):
-            indexed_cids = {handle.schema.column(i["column"]).col_id
-                            for i in handle.indexes}
+            from yugabyte_db_tpu.index import normalize_index
+
+            indexed_cids = set()
+            for i in handle.indexes:
+                ni = normalize_index(i)
+                for cname in ni["columns"] + ni["include"]:
+                    indexed_cids.add(handle.schema.column(cname).col_id)
             if row.tombstone or (indexed_cids & row.columns.keys()):
                 # Conditional INSERT: the row must not exist, so the old
                 # state is absent by contract — no tombstones. A later
@@ -821,13 +826,18 @@ class PgProcessor:
                 if got is not None:
                     yield got[1]
                 return
+        from yugabyte_db_tpu.index import normalize_index
+
         idx_info = None
         for rel in where:
             if rel.op != "=":
                 continue
             for idx in getattr(handle, "indexes", []):
-                if idx["column"] == rel.column:
-                    idx_info = (idx, rel)
+                ni = normalize_index(idx)
+                # The SQL planner lowers only single-column indexes; a
+                # compound index needs every hash column bound.
+                if ni["columns"] == [rel.column]:
+                    idx_info = (ni, rel)
                     break
             if idx_info:
                 break
