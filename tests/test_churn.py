@@ -1,0 +1,126 @@
+"""Consistency-under-churn rig: a chained write workload against a real
+MULTI-PROCESS cluster while tservers are SIGKILLed and restarted in a
+loop, then full-chain invariant verification plus cross-replica
+checksums (ysck).
+
+Reference analog: the linked_list-test.cc discipline —
+TestWorkload-style sustained load under ExternalMiniCluster process
+kills, verified with ClusterVerifier (checksum scans) afterwards
+(src/yb/integration-tests/linked_list-test.cc, cluster_verifier.cc).
+
+Invariants checked after >= 20 kill cycles:
+- every ACK'd write is present with its chained value (no lost acks);
+- no row exists outside acked + unknown-outcome writes (no invented or
+  duplicated rows — keys are unique per op, so a duplicated replay
+  would surface as an unexpected key or wrong chain value);
+- replica checksums agree across the RF=3 groups (ysck).
+"""
+
+import os
+import random
+import signal
+import time
+
+import pytest
+
+from yugabyte_db_tpu.tools.yb_ctl import ClusterCtl, _pid_alive
+
+KILL_CYCLES = 20
+
+
+def _kill_tserver(ctl: ClusterCtl, uuid: str) -> None:
+    state = ctl.load()
+    for d in state["daemons"]:
+        if d["uuid"] == uuid and d.get("pid") and _pid_alive(d["pid"]):
+            os.kill(d["pid"], signal.SIGKILL)
+            d["pid"] = None
+    ctl.save(state)
+
+
+def test_chained_writes_survive_kill_restart_cycles(tmp_path):
+    from yugabyte_db_tpu.client.client import YBClient
+    from yugabyte_db_tpu.client.session import YBSession
+    from yugabyte_db_tpu.models.datatypes import DataType
+    from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
+    from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+    from yugabyte_db_tpu.tools.admin_client import AdminClient
+    from yugabyte_db_tpu.tools.ysck import Ysck
+
+    ctl = ClusterCtl(os.path.join(str(tmp_path), "c"))
+    ctl.create(num_masters=1, num_tservers=3)
+    try:
+        ctl.wait_tservers_registered()
+        client = YBClient.connect(ctl.master_addresses())
+        client.create_table("chain", [
+            ColumnSchema("k", DataType.INT64, ColumnKind.HASH),
+            ColumnSchema("prev", DataType.INT64),
+        ], num_tablets=4)
+        table = client.open_table("chain")
+
+        rnd = random.Random(17)
+        acked: set[int] = set()
+        unknown: set[int] = set()
+        next_key = 0
+        tserver_uuids = ["ts-0", "ts-1", "ts-2"]
+
+        def write_batch(n=40):
+            nonlocal next_key
+            s = YBSession(client)
+            batch = list(range(next_key, next_key + n))
+            next_key += n
+            for i in batch:
+                s.insert(table, {"k": i, "prev": i - 1})
+            try:
+                s.flush(timeout_s=8.0)
+                acked.update(batch)
+            except Exception:  # noqa: BLE001 — outcome unknown
+                unknown.update(batch)
+
+        for cycle in range(KILL_CYCLES):
+            write_batch()
+            victim = rnd.choice(tserver_uuids)
+            _kill_tserver(ctl, victim)
+            # Keep writing into the degraded cluster (leaders re-elect;
+            # RF=3 tolerates one dead replica).
+            for _ in range(3):
+                write_batch()
+            ctl.start()  # respawns the killed daemon
+            write_batch()
+
+        # Let the cluster settle and the client recover addresses.
+        deadline = time.monotonic() + 60.0
+        rows = None
+        while time.monotonic() < deadline:
+            try:
+                client.refresh_tserver_addresses()
+                res = YBSession(client).scan(
+                    table, ScanSpec(projection=["k", "prev"]),
+                    timeout_s=30.0)
+                rows = {r[0]: r[1] for r in res.rows}
+                if acked <= set(rows):
+                    break
+            except Exception:  # noqa: BLE001 — retried until deadline
+                pass
+            time.sleep(1.0)
+        assert rows is not None, "cluster never became readable"
+
+        assert len(acked) >= KILL_CYCLES * 100, "workload too small"
+        missing = acked - set(rows)
+        assert not missing, f"LOST {len(missing)} acked writes: " \
+                            f"{sorted(missing)[:10]}"
+        invented = set(rows) - acked - unknown
+        assert not invented, f"rows outside acked+unknown: " \
+                             f"{sorted(invented)[:10]}"
+        bad_chain = [k for k, prev in rows.items() if prev != k - 1]
+        assert not bad_chain, f"chain values corrupted: {bad_chain[:10]}"
+
+        # Cross-replica consistency (the ClusterVerifier step).
+        report = Ysck(AdminClient(client.transport,
+                          client.master_uuids)).check_cluster(
+            ["chain"])
+        assert report.ok, report.summary()
+    finally:
+        try:
+            ctl.stop()
+        except Exception:  # noqa: BLE001
+            pass

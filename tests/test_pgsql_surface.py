@@ -1,0 +1,134 @@
+"""PG surface round 4: RIGHT/FULL JOIN, views, sequences, SAVEPOINT.
+
+Reference parity targets: the full PG 11.2 surface (src/postgres/);
+these close the VERDICT-flagged gaps incrementally.
+"""
+
+import pytest
+
+from yugabyte_db_tpu.integration import MiniCluster
+from yugabyte_db_tpu.utils.status import InvalidArgument, NotFound
+from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+from yugabyte_db_tpu.yql.cql.processor import LocalCluster
+from yugabyte_db_tpu.yql.pgsql.executor import PgProcessor
+
+
+@pytest.fixture
+def pg():
+    cluster = LocalCluster(num_tablets=2)
+    yield PgProcessor(cluster)
+    cluster.close()
+
+
+@pytest.fixture
+def dist_pg(tmp_path):
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3).start()
+    c.wait_tservers_registered()
+    yield PgProcessor(ClientCluster(c.client()))
+    c.shutdown()
+
+
+def _load(pg):
+    pg.execute("CREATE TABLE emp (id INT PRIMARY KEY, dept TEXT, "
+               "salary BIGINT)")
+    pg.execute("CREATE TABLE dept (name TEXT PRIMARY KEY, region TEXT)")
+    for i in range(9):
+        pg.execute(f"INSERT INTO emp (id, dept, salary) VALUES "
+                   f"({i}, 'd{i % 3}', {i * 100})")
+    pg.execute("INSERT INTO dept (name, region) VALUES ('d0', 'east')")
+    pg.execute("INSERT INTO dept (name, region) VALUES ('d1', 'west')")
+    pg.execute("INSERT INTO dept (name, region) VALUES ('dx', 'void')")
+
+
+def test_right_join_preserves_unmatched_right(pg):
+    _load(pg)
+    rows = pg.execute(
+        "SELECT emp.id, dept.name FROM emp RIGHT JOIN dept "
+        "ON emp.dept = dept.name").rows
+    ids_by_dept = {}
+    for i, name in rows:
+        ids_by_dept.setdefault(name, []).append(i)
+    assert sorted(ids_by_dept["d0"]) == [0, 3, 6]
+    assert ids_by_dept["dx"] == [None]
+    assert "d2" not in ids_by_dept  # left-only depts drop on RIGHT join
+
+
+def test_full_join_preserves_both_sides(pg):
+    _load(pg)
+    rows = pg.execute(
+        "SELECT emp.id, emp.dept, dept.name FROM emp FULL JOIN dept "
+        "ON emp.dept = dept.name").rows
+    # 9 matched-or-left rows + 1 right-only (dx)
+    assert len(rows) == 10
+    assert (None, None, "dx") in rows
+    d2 = [r for r in rows if r[1] == "d2"]
+    assert d2 and all(r[2] is None for r in d2)  # left preserved
+
+
+def test_full_join_where_applies_after_join(pg):
+    _load(pg)
+    rows = pg.execute(
+        "SELECT emp.id, dept.name FROM emp FULL JOIN dept "
+        "ON emp.dept = dept.name WHERE dept.region = 'void'").rows
+    assert rows == [(None, "dx")]
+
+
+@pytest.mark.parametrize("fixture", ["pg", "dist_pg"])
+def test_views_round_trip(fixture, request):
+    pg = request.getfixturevalue(fixture)
+    _load(pg)
+    pg.execute("CREATE VIEW rich AS SELECT id, salary FROM emp "
+               "WHERE salary >= 400")
+    rows = pg.execute("SELECT id FROM rich WHERE salary < 700 "
+                      "ORDER BY id").rows
+    assert rows == [(4,), (5,), (6,)]
+    assert len(pg.execute("SELECT * FROM rich").rows) == 5
+    with pytest.raises(InvalidArgument):
+        pg.execute("CREATE VIEW rich AS SELECT id FROM emp")
+    pg.execute("CREATE OR REPLACE VIEW rich AS SELECT id FROM emp "
+               "WHERE salary >= 800")
+    assert pg.execute("SELECT * FROM rich").rows == [(8,)]
+    pg.execute("DROP VIEW rich")
+    with pytest.raises((InvalidArgument, NotFound)):
+        pg.execute("SELECT * FROM rich")
+
+
+@pytest.mark.parametrize("fixture", ["pg", "dist_pg"])
+def test_sequences(fixture, request):
+    pg = request.getfixturevalue(fixture)
+    pg.execute("CREATE SEQUENCE ids")
+    assert pg.execute("SELECT nextval('ids')").rows == [(1,)]
+    assert pg.execute("SELECT nextval('ids')").rows == [(2,)]
+    assert pg.execute("SELECT currval('ids')").rows == [(2,)]
+    pg.execute("CREATE TABLE st (id INT PRIMARY KEY, v INT)")
+    pg.execute("INSERT INTO st (id, v) VALUES (nextval('ids'), 7)")
+    assert pg.execute("SELECT id, v FROM st").rows == [(3, 7)]
+    with pytest.raises(InvalidArgument):
+        pg.execute("SELECT nextval('nope')")
+    pg.execute("DROP SEQUENCE ids")
+    with pytest.raises(InvalidArgument):
+        pg.execute("SELECT nextval('ids')")
+
+
+def test_savepoints(dist_pg):
+    pg = dist_pg
+    pg.execute("CREATE TABLE acc (id INT PRIMARY KEY, bal INT)")
+    pg.execute("BEGIN")
+    pg.execute("INSERT INTO acc (id, bal) VALUES (1, 100)")
+    pg.execute("SAVEPOINT s1")
+    pg.execute("INSERT INTO acc (id, bal) VALUES (2, 200)")
+    pg.execute("SAVEPOINT s2")
+    pg.execute("INSERT INTO acc (id, bal) VALUES (3, 300)")
+    pg.execute("ROLLBACK TO SAVEPOINT s2")   # drops id=3
+    pg.execute("INSERT INTO acc (id, bal) VALUES (4, 400)")
+    pg.execute("ROLLBACK TO s1")             # drops 2 and 4
+    pg.execute("INSERT INTO acc (id, bal) VALUES (5, 500)")
+    pg.execute("RELEASE SAVEPOINT s1")
+    pg.execute("COMMIT")
+    rows = sorted(pg.execute("SELECT id, bal FROM acc").rows)
+    assert rows == [(1, 100), (5, 500)]
+    # rollback-to a released/unknown savepoint fails the block
+    pg.execute("BEGIN")
+    with pytest.raises(Exception):
+        pg.execute("ROLLBACK TO SAVEPOINT nope")
+    pg.execute("ROLLBACK")

@@ -763,6 +763,7 @@ class RaftConsensus:
         min_sleep = min(0.02, self.opts.heartbeat_interval_s / 2)
         while True:
             start_election = False
+            retry_sync = 0
             with self._lock:
                 if not self._running:
                     return
@@ -774,6 +775,14 @@ class RaftConsensus:
                         self._last_broadcast = now
                         self._signal_peers_locked()
                         due = now + self.opts.heartbeat_interval_s
+                        if self._durable_index < self._last_index and \
+                                len(self.cmeta.active_config.peers) == 1:
+                            # A failed group-commit sync left a buffered
+                            # tail; only SINGLE-peer groups need the
+                            # heartbeat retry (multi-peer leaders defer
+                            # fsync to the replication threads by design
+                            # — syncing here would block the timer).
+                            retry_sync = self._last_index
                     sleep_s = due - now
                 elif self.cmeta.active_config.has_peer(self.uuid):
                     deadline = self._last_heartbeat_recv + \
@@ -785,6 +794,11 @@ class RaftConsensus:
                         sleep_s = deadline - now
                 else:
                     sleep_s = self.opts.election_timeout_s
+            if retry_sync:
+                try:
+                    self._ensure_durable(retry_sync)
+                except Exception:  # noqa: BLE001 — retried next beat
+                    pass
             if start_election:
                 self._start_election()
             time.sleep(max(min_sleep, min(sleep_s, 0.5)))
@@ -853,7 +867,17 @@ class RaftConsensus:
             # prior-term entries (reference appends a NO_OP on election).
             entry = self._leader_append_locked("no_op", None, None)
             self._own_term_noop = (term, entry.op_id.index)
-        self._ensure_durable(entry.op_id.index)
+        try:
+            self._ensure_durable(entry.op_id.index)
+        except Exception:  # noqa: BLE001 — e.g. an injected sync fault
+            # The no_op stays buffered; leadership stands (leader_ready
+            # remains false until it lands) and the timer loop retries
+            # durability — an election thread must never die on a
+            # transient storage error.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s: leader no_op durability deferred", self.uuid)
 
     def _sync_peer_threads_locked(self) -> None:
         """Make replication threads match the active config."""

@@ -51,6 +51,11 @@ class CatalogState:
         # User-defined types: name -> [(field, dtype int)] (reference:
         # UDTypeInfo records in the sys catalog, pt_create_type.cc).
         self.types: dict[str, list] = {}
+        # SQL views (name -> defining query) and sequences (name -> next
+        # value) — replicated catalog records (reference: pg_rewrite /
+        # sequence relations in the PG fork's catalog).
+        self.views: dict[str, str] = {}
+        self.sequences: dict[str, int] = {}
 
     def apply(self, op: dict) -> None:
         kind = op["op"]
@@ -64,6 +69,22 @@ class CatalogState:
                 pass
             return
         with self._lock:
+            if kind == "create_view":
+                self.views[op["name"]] = op["query"]
+                return
+            if kind == "drop_view":
+                self.views.pop(op["name"], None)
+                return
+            if kind == "create_sequence":
+                self.sequences.setdefault(op["name"], 1)
+                return
+            if kind == "drop_sequence":
+                self.sequences.pop(op["name"], None)
+                return
+            if kind == "sequence_alloc":
+                self.sequences[op["name"]] = \
+                    self.sequences.get(op["name"], 1) + op["n"]
+                return
             if kind == "create_type":
                 self.types[op["name"]] = [tuple(f) for f in op["fields"]]
                 return

@@ -77,6 +77,7 @@ class Master:
         # restart) are repaired through a config cycle instead
         # (_repair_live_missing_replicas).
         self._failed_creates: set[tuple[str, str]] = set()
+        self._seq_lock = threading.Lock()  # serializes sequence allocs
         # (table_id, tablet_id) whose leaders haven't adopted the latest
         # catalog schema yet; the balancer retries delivery.
         self._pending_alters: set[tuple[str, str]] = set()
@@ -570,6 +571,56 @@ class Master:
                         return {"code": "error", "message":
                                 f"type {name} in use by table {t.name}"}
             op = {"op": "drop_type", "name": name}
+        try:
+            self.raft.replicate("catalog", op)
+        except NotLeader:
+            return self._not_leader()
+        return {"code": "ok"}
+
+    def _h_master_misc_op(self, p: dict):
+        """Views + sequences through the replicated catalog; sequence
+        allocation is serialized here so every allocation returns a
+        distinct base (holes on crash/retry are allowed — PG nextval's
+        own contract)."""
+        action = p["action"]
+        if action == "get_view":
+            q = self.catalog.views.get(p["name"])
+            return ({"code": "ok", "query": q} if q is not None
+                    else {"code": "not_found"})
+        if not self.raft.is_leader():
+            return self._not_leader()
+        if action == "create_view":
+            if p["name"] in self.catalog.views and not p.get("replace"):
+                return {"code": "already_present"}
+            op = {"op": "create_view", "name": p["name"],
+                  "query": p["query"]}
+        elif action == "drop_view":
+            if p["name"] not in self.catalog.views:
+                return {"code": "not_found"}
+            op = {"op": "drop_view", "name": p["name"]}
+        elif action == "create_sequence":
+            if p["name"] in self.catalog.sequences:
+                return {"code": "already_present"}
+            op = {"op": "create_sequence", "name": p["name"]}
+        elif action == "drop_sequence":
+            if p["name"] not in self.catalog.sequences:
+                return {"code": "not_found"}
+            op = {"op": "drop_sequence", "name": p["name"]}
+        elif action == "sequence_next":
+            if p["name"] not in self.catalog.sequences:
+                return {"code": "not_found"}
+            n = int(p.get("n", 1))
+            with self._seq_lock:
+                base = self.catalog.sequences[p["name"]]
+                try:
+                    self.raft.replicate("catalog", {
+                        "op": "sequence_alloc", "name": p["name"],
+                        "n": n})
+                except NotLeader:
+                    return self._not_leader()
+            return {"code": "ok", "base": base}
+        else:
+            return {"code": "error", "message": f"bad action {action}"}
         try:
             self.raft.replicate("catalog", op)
         except NotLeader:

@@ -132,6 +132,11 @@ class YBTransaction:
         self._own: dict[bytes, RowVersion] = {}
         self._own_tables: dict[bytes, YBTable] = {}
         self._state = "pending"
+        # SAVEPOINT marks over the CLIENT-BUFFERED write set (ops flush
+        # as intents only at commit, so rolling back to a savepoint is a
+        # pure buffer truncation — reference: PG subtransaction aborts).
+        self._savepoints: list[tuple[str, tuple]] = []
+        self._flush_count = 0
         self._last_heartbeat = time.monotonic()
         # Max hybrid time observed from intent writes; propagated to the
         # coordinator at commit so commit_ht exceeds every intent write.
@@ -172,11 +177,48 @@ class YBTransaction:
         self._own[row.key] = row
         self._own_tables[row.key] = table
 
+    # -- savepoints ----------------------------------------------------------
+    def savepoint(self, name: str) -> None:
+        self._check_pending()
+        self._savepoints.append(
+            (name, (len(self._ops), self._flush_count, dict(self._own),
+                    dict(self._own_tables))))
+
+    def rollback_to_savepoint(self, name: str) -> None:
+        self._check_pending()
+        for i in range(len(self._savepoints) - 1, -1, -1):
+            if self._savepoints[i][0] == name:
+                n_ops, fc, own, own_tables = self._savepoints[i][1]
+                if fc != self._flush_count:
+                    # Intents sent since the savepoint cannot be
+                    # retracted (they live at the participants); refuse
+                    # rather than silently committing them.
+                    raise KeyError(
+                        f"savepoint {name} predates a flush of intents")
+                del self._ops[n_ops:]
+                self._own = dict(own)
+                self._own_tables = dict(own_tables)
+                # the savepoint itself survives (PG semantics); later
+                # ones are destroyed
+                del self._savepoints[i + 1:]
+                return
+        raise KeyError(f"savepoint {name} does not exist")
+
+    def release_savepoint(self, name: str) -> None:
+        self._check_pending()
+        for i in range(len(self._savepoints) - 1, -1, -1):
+            if self._savepoints[i][0] == name:
+                del self._savepoints[i:]
+                return
+        raise KeyError(f"savepoint {name} does not exist")
+
     # -- intents flush -------------------------------------------------------
     def flush(self, timeout_s: float = 15.0) -> int:
         """Send buffered rows as intents, one RPC per tablet."""
         self._check_pending()
         ops, self._ops = self._ops, []
+        if ops:
+            self._flush_count += 1
         by_tablet: dict[str, tuple[YBTable, object, list]] = {}
         for table, hash_code, row in ops:
             loc = self.client.meta_cache.lookup_by_hash(table.name,

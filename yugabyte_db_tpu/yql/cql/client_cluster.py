@@ -212,6 +212,60 @@ class ClientCluster:
             for n, fs in resp.get("types", {}).items()}
         return cache
 
+    # -- views / sequences --------------------------------------------------
+    def _misc_op(self, action: str, payload: dict) -> dict:
+        resp = self.client.master_rpc("master.misc_op",
+                                      dict(payload, action=action))
+        return resp
+
+    def create_view(self, name: str, query_sql: str,
+                    replace: bool = False) -> None:
+        from yugabyte_db_tpu.utils.status import AlreadyPresent
+
+        resp = self._misc_op("create_view", {
+            "name": name, "query": query_sql, "replace": replace})
+        if resp.get("code") == "already_present":
+            raise AlreadyPresent(f"view {name} exists")
+        if resp.get("code") != "ok":
+            raise RuntimeError(f"create view {name}: {resp}")
+
+    def drop_view(self, name: str) -> None:
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        resp = self._misc_op("drop_view", {"name": name})
+        if resp.get("code") == "not_found":
+            raise NotFound(f"view {name} not found")
+
+    def get_view(self, name: str):
+        resp = self._misc_op("get_view", {"name": name})
+        return resp.get("query") if resp.get("code") == "ok" else None
+
+    def create_sequence(self, name: str) -> None:
+        from yugabyte_db_tpu.utils.status import AlreadyPresent
+
+        resp = self._misc_op("create_sequence", {"name": name})
+        if resp.get("code") == "already_present":
+            raise AlreadyPresent(f"sequence {name} exists")
+        if resp.get("code") != "ok":
+            raise RuntimeError(f"create sequence {name}: {resp}")
+
+    def drop_sequence(self, name: str) -> None:
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        resp = self._misc_op("drop_sequence", {"name": name})
+        if resp.get("code") == "not_found":
+            raise NotFound(f"sequence {name} not found")
+
+    def sequence_next(self, name: str, n: int = 1) -> int:
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        resp = self._misc_op("sequence_next", {"name": name, "n": n})
+        if resp.get("code") == "not_found":
+            raise NotFound(f"sequence {name} not found")
+        if resp.get("code") != "ok":
+            raise RuntimeError(f"nextval {name}: {resp}")
+        return resp["base"]
+
     def drop_index(self, base: RemoteTable, name: str) -> None:
         idx = next(i for i in base.indexes if i["name"] == name)
         resp = self.client.master_rpc("master.drop_index", {

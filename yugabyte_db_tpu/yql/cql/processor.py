@@ -83,6 +83,12 @@ class LocalCluster:
         self.tables: dict[str, TableHandle] = {}
         # User-defined types: name -> [(field, dtype int)].
         self.types: dict[str, list] = {}
+        # SQL views (name -> defining query SQL) and sequences
+        # (name -> next value) — in-process registries; the distributed
+        # seam replicates them through the master catalog.
+        self.views: dict[str, str] = {}
+        self.sequences: dict[str, int] = {}
+        self._seq_lock = __import__("threading").Lock()
         from yugabyte_db_tpu.auth import RoleStore
 
         self._auth = RoleStore()
@@ -169,6 +175,41 @@ class LocalCluster:
 
     def list_types(self) -> dict:
         return dict(self.types)
+
+    # -- views / sequences --------------------------------------------------
+    def create_view(self, name: str, query_sql: str,
+                    replace: bool = False) -> None:
+        if not replace and name in self.views:
+            raise AlreadyPresent(f"view {name} exists")
+        self.views[name] = query_sql
+
+    def drop_view(self, name: str) -> None:
+        if name not in self.views:
+            raise NotFound(f"view {name} not found")
+        del self.views[name]
+
+    def get_view(self, name: str):
+        return self.views.get(name)
+
+    def create_sequence(self, name: str) -> None:
+        if name in self.sequences:
+            raise AlreadyPresent(f"sequence {name} exists")
+        self.sequences[name] = 1
+
+    def drop_sequence(self, name: str) -> None:
+        if name not in self.sequences:
+            raise NotFound(f"sequence {name} not found")
+        del self.sequences[name]
+
+    def sequence_next(self, name: str, n: int = 1) -> int:
+        """Allocate ``n`` values; returns the first (PG nextval blocks
+        may leave holes — same contract)."""
+        with self._seq_lock:
+            if name not in self.sequences:
+                raise NotFound(f"sequence {name} not found")
+            base = self.sequences[name]
+            self.sequences[name] = base + n
+            return base
 
     def alter_table(self, handle: TableHandle, new_schema: Schema) -> None:
         for t in handle.tablets:

@@ -62,10 +62,50 @@ class DropIndex:
 
 
 @dataclass
-class TxnControl:
-    """BEGIN / COMMIT / ROLLBACK."""
+class CreateView:
+    """CREATE [OR REPLACE] VIEW name AS <select> — the defining query is
+    stored as SQL text and re-planned at use (reference: pg_rewrite)."""
 
-    kind: str                  # "begin" | "commit" | "rollback"
+    name: str
+    query_sql: str
+    select: object             # parsed ast.Select (validation)
+    replace: bool = False
+
+
+@dataclass
+class DropView:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateSequence:
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class SeqFunc:
+    """nextval('s') / currval('s') in a VALUES list or bare SELECT."""
+
+    kind: str                  # "nextval" | "currval"
+    sequence: str
+
+
+@dataclass
+class TxnControl:
+    """BEGIN / COMMIT / ROLLBACK / SAVEPOINT name / ROLLBACK TO name /
+    RELEASE name."""
+
+    kind: str                  # "begin" | "commit" | "rollback" |
+                               # "savepoint" | "rollback_to" | "release"
+    name: str | None = None    # savepoint name
 
 
 @dataclass

@@ -74,6 +74,7 @@ class PgProcessor:
         self._txn = None
         self._txn_failed = False  # aborted block awaiting COMMIT/ROLLBACK
         self._yb_tables: dict = {}
+        self._currvals: dict[str, int] = {}  # per-session currval state
 
     @property
     def in_txn(self) -> bool:
@@ -108,6 +109,10 @@ class PgProcessor:
             ast.Update: self._exec_update,
             ast.Delete: self._exec_delete,
             ast.Select: self._exec_select,
+            ast.CreateView: self._exec_create_view,
+            ast.DropView: self._exec_drop_view,
+            ast.CreateSequence: self._exec_create_sequence,
+            ast.DropSequence: self._exec_drop_sequence,
         }[type(stmt)]
         try:
             return fn(stmt)
@@ -135,6 +140,29 @@ class PgProcessor:
                     "transactions require a distributed cluster")
             self._txn = mgr_fn().begin()
             return PgResult(command="BEGIN")
+        if stmt.kind in ("savepoint", "rollback_to", "release"):
+            if self._txn_failed:
+                # Divergence from PG, stated plainly: a failed statement
+                # aborts the WHOLE block here (statement-level
+                # subtransactions are not implemented), so a savepoint
+                # cannot resurrect it.
+                raise FailedTransaction(
+                    "current transaction is aborted (savepoints cannot "
+                    "recover a failed block in this implementation)")
+            if self._txn is None:
+                raise InvalidArgument(
+                    "SAVEPOINT can only be used in transaction blocks")
+            if stmt.kind == "savepoint":
+                self._txn.savepoint(stmt.name)
+                return PgResult(command="SAVEPOINT")
+            try:
+                if stmt.kind == "rollback_to":
+                    self._txn.rollback_to_savepoint(stmt.name)
+                    return PgResult(command="ROLLBACK")
+                self._txn.release_savepoint(stmt.name)
+                return PgResult(command="RELEASE")
+            except KeyError as e:
+                raise InvalidArgument(str(e)) from None
         if self._txn_failed:
             # COMMIT of a failed block is a rollback (PG reports it so)
             self._txn_failed = False
@@ -172,6 +200,8 @@ class PgProcessor:
             except IndexError:
                 raise InvalidArgument(
                     f"bind marker ${value.index + 1} has no value") from None
+        if isinstance(value, ast.SeqFunc):
+            return self._resolve_seq_func(value)
         return value
 
     def _coerce(self, col: ColumnSchema, value):
@@ -558,7 +588,184 @@ class PgProcessor:
         return PgResult(command=f"DELETE {n}")
 
     # -- SELECT ------------------------------------------------------------
+    # -- views / sequences --------------------------------------------------
+    def _exec_create_view(self, stmt):
+        from yugabyte_db_tpu.utils.status import AlreadyPresent
+
+        try:
+            self.cluster.create_view(stmt.name, stmt.query_sql,
+                                     stmt.replace)
+        except AlreadyPresent:
+            raise InvalidArgument(f"view {stmt.name} exists") from None
+        return PgResult(command="CREATE VIEW")
+
+    def _exec_drop_view(self, stmt):
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        try:
+            self.cluster.drop_view(stmt.name)
+        except NotFound:
+            if not stmt.if_exists:
+                raise InvalidArgument(
+                    f"view {stmt.name} does not exist") from None
+        return PgResult(command="DROP VIEW")
+
+    def _exec_create_sequence(self, stmt):
+        from yugabyte_db_tpu.utils.status import AlreadyPresent
+
+        try:
+            self.cluster.create_sequence(stmt.name)
+        except AlreadyPresent:
+            if not stmt.if_not_exists:
+                raise InvalidArgument(
+                    f"sequence {stmt.name} exists") from None
+        return PgResult(command="CREATE SEQUENCE")
+
+    def _exec_drop_sequence(self, stmt):
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        try:
+            self.cluster.drop_sequence(stmt.name)
+        except NotFound:
+            if not stmt.if_exists:
+                raise InvalidArgument(
+                    f"sequence {stmt.name} does not exist") from None
+        return PgResult(command="DROP SEQUENCE")
+
+    def _resolve_seq_func(self, f):
+        if f.kind == "nextval":
+            from yugabyte_db_tpu.utils.status import NotFound
+
+            try:
+                v = self.cluster.sequence_next(f.sequence)
+            except NotFound:
+                raise InvalidArgument(
+                    f"sequence {f.sequence} does not exist") from None
+            self._currvals[f.sequence] = v
+            return v
+        v = self._currvals.get(f.sequence)
+        if v is None:
+            raise InvalidArgument(
+                f"currval of sequence {f.sequence} is not yet defined "
+                "in this session")
+        return v
+
+    def _view_sql(self, name: str):
+        """The defining query if ``name`` is a view. Local registries
+        answer from memory; the distributed seam is consulted only when
+        the name is not a known TABLE (so the read hot path never pays
+        a master round trip for plain tables)."""
+        if not hasattr(self.cluster, "get_view"):
+            return None
+        views = getattr(self.cluster, "views", None)
+        if views is not None:  # in-process registry: free lookup
+            return views.get(name)
+        if name in self._yb_tables:
+            return None
+        try:
+            self._yb_table(name)
+            return None        # a real table
+        except Exception:      # noqa: BLE001 — unknown name: try views
+            return self.cluster.get_view(name)
+
+    def _select_from_view(self, stmt: ast.Select, view_sql: str):
+        """A SELECT whose FROM names a view: run the stored defining
+        query, then evaluate the outer projection / WHERE / DISTINCT /
+        ORDER BY / LIMIT over its rows in memory (views inside JOINs are
+        not supported yet)."""
+        if stmt.joins:
+            raise InvalidArgument("views cannot be joined yet")
+        self._view_depth = getattr(self, "_view_depth", 0) + 1
+        try:
+            if self._view_depth > 8:
+                raise InvalidArgument(
+                    "view nesting too deep (cyclic definition?)")
+            inner = self._exec_select(parse_statement(view_sql))
+        finally:
+            self._view_depth -= 1
+        dicts = [dict(zip(inner.columns, r)) for r in inner.rows]
+        where = []
+        for rel in self._resolved_where(stmt.where):
+            if rel.column not in inner.columns:
+                raise InvalidArgument(f"column {rel.column} not in view")
+            val = self._resolve(rel.value)
+            where.append((rel.column, rel.op,
+                          tuple(val) if rel.op == "IN" else val))
+        from yugabyte_db_tpu.storage.scan_spec import Predicate
+
+        preds = [Predicate(c, op, v) for c, op, v in where]
+        dicts = [d for d in dicts
+                 if all(p.matches(d.get(p.column)) for p in preds)]
+        if stmt.group_by or any(
+                getattr(it, "expr", None) is not None and
+                not isinstance(it.expr, str) and
+                it.expr.__class__.__name__ == "Agg"
+                for it in stmt.items):
+            raise InvalidArgument(
+                "aggregates over views are not supported yet")
+        names = []
+        if len(stmt.items) == 1 and stmt.items[0].expr == "*":
+            names = list(inner.columns)
+            rows = [tuple(d[c] for c in names) for d in dicts]
+        else:
+            getters = []
+            for it in stmt.items:
+                from yugabyte_db_tpu.storage import expr as X
+
+                e = it.expr
+                if not isinstance(e, X.Col):
+                    raise InvalidArgument(
+                        "views support plain column projections")
+                if e.name not in inner.columns:
+                    raise InvalidArgument(
+                        f"column {e.name} not in view")
+                names.append(it.alias or e.name)
+                getters.append(e.name)
+            rows = [tuple(d[g] for g in getters) for d in dicts]
+        if stmt.distinct:
+            seen, uniq = set(), []
+            for r in rows:
+                if r not in seen:
+                    seen.add(r)
+                    uniq.append(r)
+            rows = uniq
+        if stmt.order_by:
+            for ob in reversed(stmt.order_by):
+                if ob.column not in names:
+                    raise InvalidArgument(
+                        f"ORDER BY {ob.column} not in output")
+                i = names.index(ob.column)
+                rows.sort(key=lambda r: (r[i] is None, r[i]),
+                          reverse=ob.desc)
+        limit = self._resolve(stmt.limit) if stmt.limit is not None             else None
+        if limit is not None:
+            rows = rows[:int(limit)]
+        return PgResult(columns=names, rows=rows,
+                        command=f"SELECT {len(rows)}")
+
     def _exec_select(self, stmt: ast.Select):
+        if stmt.table is None:
+            # FROM-less SELECT: constant / sequence-function items.
+            names, row = [], []
+            from yugabyte_db_tpu.storage import expr as X
+
+            for i, it in enumerate(stmt.items):
+                e = it.expr
+                if isinstance(e, ast.SeqFunc):
+                    names.append(it.alias or e.kind)
+                    row.append(self._resolve_seq_func(e))
+                elif isinstance(e, X.Const):
+                    names.append(it.alias or f"?column?")
+                    row.append(e.value)
+                else:
+                    raise InvalidArgument(
+                        "FROM-less SELECT supports constants and "
+                        "sequence functions")
+            return PgResult(columns=names, rows=[tuple(row)],
+                            command="SELECT 1")
+        view_sql = self._view_sql(stmt.table)
+        if view_sql is not None:
+            return self._select_from_view(stmt, view_sql)
         if not stmt.joins:
             from yugabyte_db_tpu.yql.pgsql import vtables as PV
 
@@ -685,7 +892,11 @@ class PgProcessor:
                 index.setdefault(kt, []).append(d)
             null_right = {f"{a}.{c.name}": None
                           for c in handles[a].schema.columns}
+            null_left = {f"{la}.{c.name}": None
+                         for la in seen_aliases
+                         for c in handles[la].schema.columns}
             out = []
+            matched_right: set[int] = set()
             for ld in joined:
                 kt = tuple(ld[k] for k in lkeys)
                 matches = (index.get(kt)
@@ -695,10 +906,21 @@ class PgProcessor:
                         m = dict(ld)
                         m.update(rd)
                         out.append(m)
-                elif j.kind == "left":
+                        if j.kind in ("right", "full"):
+                            matched_right.add(id(rd))
+                elif j.kind in ("left", "full"):
                     m = dict(ld)
                     m.update(null_right)
                     out.append(m)
+            if j.kind in ("right", "full"):
+                # Right side preserved: NULL-extend every column
+                # accumulated so far for unmatched right rows (also
+                # rows whose join key is NULL — they never match).
+                for rd in rows_by_alias[a]:
+                    if id(rd) not in matched_right:
+                        m = dict(null_left)
+                        m.update(rd)
+                        out.append(m)
             joined = out
             seen_aliases.add(a)
 
@@ -712,7 +934,8 @@ class PgProcessor:
         # Re-verify WHERE post-join: predicates pushed below a LEFT JOIN's
         # right side must still filter NULL-extended rows (PG applies
         # WHERE after the join).
-        if where and any(j.kind == "left" for j in stmt.joins):
+        if where and any(j.kind in ("left", "right", "full")
+                         for j in stmt.joins):
             post = []
             for rel in where:
                 a, c = qualify(rel.column)

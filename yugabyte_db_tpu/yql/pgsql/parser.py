@@ -51,11 +51,12 @@ _TYPES = {
 
 
 class Token:
-    __slots__ = ("kind", "text")
+    __slots__ = ("kind", "text", "pos")
 
-    def __init__(self, kind, text):
+    def __init__(self, kind, text, pos=0):
         self.kind = kind
         self.text = text
+        self.pos = pos
 
     def __repr__(self):
         return f"{self.kind}:{self.text}"
@@ -72,13 +73,14 @@ def tokenize(sql: str) -> list[Token]:
         for kind in ("string", "number", "param", "name", "op", "sym"):
             text = m.group(kind)
             if text is not None:
-                out.append(Token(kind, text))
+                out.append(Token(kind, text, m.start(kind)))
                 break
     return out
 
 
 class Parser:
     def __init__(self, sql: str):
+        self.raw = sql
         self.toks = tokenize(sql)
         self.i = 0
 
@@ -151,6 +153,9 @@ class Parser:
             return -v if neg else v
         if t.kind == "name" and not neg:
             up = t.text.upper()
+            if up in ("NEXTVAL", "CURRVAL") and self.at_sym("("):
+                self.i -= 1  # re-read the function name
+                return self._seq_func()
             if up == "TRUE":
                 return True
             if up == "FALSE":
@@ -171,6 +176,19 @@ class Parser:
                 return self._create_table()
             if self.at_kw("INDEX", "UNIQUE"):
                 return self._create_index()
+            if self.take_kw("OR"):
+                self.expect_kw("REPLACE")
+                self.expect_kw("VIEW")
+                return self._create_view(replace=True)
+            if self.take_kw("VIEW"):
+                return self._create_view(replace=False)
+            if self.take_kw("SEQUENCE"):
+                ine = False
+                if self.take_kw("IF"):
+                    self.expect_kw("NOT")
+                    self.expect_kw("EXISTS")
+                    ine = True
+                return ast.CreateSequence(self.ident(), ine)
             raise InvalidArgument(f"cannot CREATE {self.peek()}")
         if head == "DROP":
             self.next()
@@ -178,6 +196,10 @@ class Parser:
                 return ast.DropTable(*self._name_if_exists())
             if self.take_kw("INDEX"):
                 return ast.DropIndex(*self._name_if_exists())
+            if self.take_kw("VIEW"):
+                return ast.DropView(*self._name_if_exists())
+            if self.take_kw("SEQUENCE"):
+                return ast.DropSequence(*self._name_if_exists())
             raise InvalidArgument(f"cannot DROP {self.peek()}")
         if head in ("BEGIN", "START"):
             self.next()
@@ -194,9 +216,25 @@ class Parser:
             return ast.TxnControl("commit")
         if head in ("ROLLBACK", "ABORT"):
             self.next()
+            if self.take_kw("TO"):
+                self.take_kw("SAVEPOINT")
+                name = self.ident()
+                self.take_sym(";")
+                return ast.TxnControl("rollback_to", name)
             self.take_kw("TRANSACTION", "WORK")
             self.take_sym(";")
             return ast.TxnControl("rollback")
+        if head == "SAVEPOINT":
+            self.next()
+            name = self.ident()
+            self.take_sym(";")
+            return ast.TxnControl("savepoint", name)
+        if head == "RELEASE":
+            self.next()
+            self.take_kw("SAVEPOINT")
+            name = self.ident()
+            self.take_sym(";")
+            return ast.TxnControl("release", name)
         if head == "ALTER":
             return self._alter_table()
         if head == "INSERT":
@@ -383,12 +421,29 @@ class Parser:
                    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS",
                    "ON", "HAVING", "AND", "OR", "DESC", "ASC")
 
+    def _create_view(self, replace: bool):
+        name = self.ident()
+        self.expect_kw("AS")
+        t = self.peek()
+        if t is None:
+            raise InvalidArgument("CREATE VIEW needs a query")
+        query_sql = self.raw[t.pos:].rstrip().rstrip(";")
+        select = self._select()  # validated now, re-parsed at use
+        return ast.CreateView(name, query_sql, select, replace)
+
     def _select(self) -> ast.Select:
         self.expect_kw("SELECT")
         distinct = bool(self.take_kw("DISTINCT"))
         items = [self._select_item()]
         while self.take_sym(","):
             items.append(self._select_item())
+        if not self.at_kw("FROM"):
+            # FROM-less SELECT: constant/sequence-function items
+            # (PG: SELECT nextval('s')); column references need a FROM.
+            for it in items:
+                if isinstance(it.expr, Col) or it.expr == "*":
+                    raise InvalidArgument("SELECT needs a FROM clause")
+            return ast.Select(items, None)
         self.expect_kw("FROM")
         table = self._table_name()
         alias = self._table_alias()
@@ -404,6 +459,16 @@ class Parser:
                 self.take_kw("OUTER")
                 self.expect_kw("JOIN")
                 kind = "left"
+            elif self.at_kw("RIGHT"):
+                self.next()
+                self.take_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = "right"
+            elif self.at_kw("FULL"):
+                self.next()
+                self.take_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = "full"
             else:
                 break
             jtable = self._table_name()
@@ -497,8 +562,24 @@ class Parser:
             alias = self.ident()
         return ast.SelectItem(expr, alias)
 
+    def _seq_func(self):
+        """nextval('s') / currval('s') — the only SQL functions the
+        value grammar knows (used from VALUES lists and select items)."""
+        fn = self.ident().lower()
+        self.expect_sym("(")
+        seq = self.next()
+        if seq.kind != "string":
+            raise InvalidArgument(f"{fn} takes a sequence name string")
+        self.expect_sym(")")
+        return ast.SeqFunc(fn, seq.text[1:-1])
+
     def _item_expr(self):
         t = self.peek()
+        if (t is not None and t.kind == "name"
+                and t.text.upper() in ("NEXTVAL", "CURRVAL")
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1].text == "("):
+            return self._seq_func()
         if (t is not None and t.kind == "name"
                 and t.text.lower() in AGG_FNS
                 and self.i + 1 < len(self.toks)
