@@ -4,8 +4,12 @@ Workloads (all on the real chip, identical data/queries verified against
 the CPU oracle engine):
 
   aggregate   TPC-H-Q6-flavored aggregate range scan (the headline)
-  ycsb_e      YCSB-E-shaped row scans: concurrent LIMIT-100 pages with a
-              predicate, batched through scan_batch (the server shape)
+  ycsb_e      YCSB-E-shaped row scans: concurrent LIMIT-100 pages served
+              as serialized CQL wire bytes (native page server)
+  point_read  YCSB-C / CassandraKeyValue-shaped exact-key GETs
+  ycsb_a/f    mixed read/update and read-modify-write over a live
+              memtable (bloom-pruned point path)
+  redis       pipelined GET/SET through the RESP proxy over MiniCluster
   tpch_q1/q6  grouped / expression aggregates over lineitem
   write       batched write throughput into the engine (apply+flush)
   compact     multi-run merge + history GC throughput
@@ -132,15 +136,17 @@ def bench_aggregate(schema, rows, max_ht, make_engine, S, n_concurrent=32,
     }
 
 
-def bench_ycsb_e(schema, tpu, cpu, max_ht, S, n_pages=256, depth=6,
-                 n_batches=24):
+def bench_ycsb_e(schema, tpu, cpu, max_ht, S, n_pages=256, n_batches=40):
     """Steady-state server throughput: batches of concurrent LIMIT-100
-    predicate pages, pipelined `depth` batches deep through the async
-    scan API (issue batch N+depth before finishing batch N). The tunnel
-    link charges ~1 RTT per synchronous fetch cycle regardless of size;
-    pipelining amortizes it across whole batches — the same shape a
-    tserver uses to serve concurrent clients. Also reports the
-    single-batch synchronous latency (no pipelining) for honesty."""
+    predicate pages served as SERIALIZED CQL WIRE BYTES — the shape the
+    reference actually measures (YCSB-E ops return rows_data the CQL
+    service forwards; src/yb/common/ql_rowblock.h:66). scan_batch_wire
+    emits every page's result-frame cells straight from the run's plane
+    buffers in C (native serve_page_wire_batch): no Python value object
+    is ever constructed on the hot path. Byte-parity with the CPU
+    oracle's scan + Python serialization is asserted on a full batch.
+    The row-tuple API path (scan_batch, the r4 metric) rides along as a
+    detail for round-over-round continuity."""
     import collections
 
     from yugabyte_db_tpu.models.partition import compute_hash_code
@@ -162,47 +168,284 @@ def bench_ycsb_e(schema, tpu, cpu, max_ht, S, n_pages=256, depth=6,
 
     batches = [make_batch(n_pages) for _ in range(n_batches)]
 
-    # Correctness: identical rows engine-vs-engine on one full batch.
-    a = cpu.scan_batch(batches[0])
-    b = tpu.scan_batch(batches[0])
+    # Correctness: wire bytes identical to the CPU oracle's serialized
+    # pages (independent implementations: C plane emitter vs Python
+    # scan + models.wirefmt), and identical row tuples engine-vs-engine.
+    aw = cpu.scan_batch_wire(batches[0], "cql")
+    bw = tpu.scan_batch_wire(batches[0], "cql")
+    assert [(p.data, p.nrows, p.resume) for p in aw] == \
+        [(p.data, p.nrows, p.resume) for p in bw]
+    a = cpu.scan_batch(batches[1])
+    b = tpu.scan_batch(batches[1])
     assert [r.rows for r in a] == [r.rows for r in b]
 
-    def pipeline(bs):
-        q = collections.deque()
-        nrows = 0
-        for batch in bs:
-            q.append(tpu.scan_batch_async(batch))
-            if len(q) > depth:
-                nrows += sum(len(r.rows) for r in q.popleft().finish())
-        while q:
-            nrows += sum(len(r.rows) for r in q.popleft().finish())
-        return nrows
-
-    pipeline(batches[: depth + 2])  # warm every compile bucket
+    tpu.scan_batch_wire(batches[0], "cql")  # warm blob/mask caches
     t0 = time.perf_counter()
-    nrows = pipeline(batches)
+    nrows = nbytes = 0
+    for batch in batches:
+        for pg in tpu.scan_batch_wire(batch, "cql"):
+            nrows += pg.nrows
+            nbytes += len(pg.data)
     tdt = time.perf_counter() - t0
     ops_s = n_pages * n_batches / tdt
 
     # CPU oracle on identical work (2 batches, extrapolated linearly).
     t0 = time.perf_counter()
-    cpu.scan_batch(batches[0])
-    cpu.scan_batch(batches[1])
+    cpu.scan_batch_wire(batches[0], "cql")
+    cpu.scan_batch_wire(batches[1], "cql")
     cdt = (time.perf_counter() - t0) / 2 * n_batches
 
-    lat = _median(lambda: tpu.scan_batch(batches[2][:64]), iters=3)
-    page_lat = _median(lambda: tpu.scan(batches[2][0]), iters=7)
+    # r4-continuity detail: the row-tuple scan path, depth-pipelined.
+    def pipeline(bs, depth=6):
+        q = collections.deque()
+        n = 0
+        for batch in bs:
+            q.append(tpu.scan_batch_async(batch))
+            if len(q) > depth:
+                n += sum(len(r.rows) for r in q.popleft().finish())
+        while q:
+            n += sum(len(r.rows) for r in q.popleft().finish())
+        return n
+
+    pipeline(batches[:8])  # warm
+    t0 = time.perf_counter()
+    pipeline(batches[:12])
+    tup_dt = time.perf_counter() - t0
+    tup_ops_s = n_pages * 12 / tup_dt
+
+    page_lat = _median(
+        lambda: tpu.scan_batch_wire([batches[2][0]], "cql"), iters=7)
     return {
         "metric": "ycsb_e_scan_ops_per_sec",
         "value": round(ops_s, 1),
-        "unit": (f"scan-ops/s (LIMIT-100 pages, {n_pages} concurrent, "
-                 f"depth-{depth} pipeline)"),
+        "unit": (f"scan-ops/s (LIMIT-100 pages as serialized CQL wire "
+                 f"bytes, batches of {n_pages})"),
         "vs_baseline": round(ops_s / CPP_NODE_YCSBE_OPS_S, 2),
         "vs_cpu_engine": round(cdt / tdt, 2),
         "result_rows_per_sec": round(nrows / tdt, 1),
-        "sync_batch64_latency_ms": round(lat * 1000, 1),
+        "wire_mb_per_sec": round(nbytes / tdt / 1e6, 1),
+        "rowtuple_ops_per_sec": round(tup_ops_s, 1),
+        "rowtuple_vs_baseline": round(tup_ops_s / CPP_NODE_YCSBE_OPS_S, 2),
         "single_page_latency_ms": round(page_lat * 1000, 3),
     }
+
+
+def bench_point_reads(schema, tpu, cpu, max_ht, S, n_ops=256,
+                      n_batches=40):
+    """YCSB-C / CassandraKeyValue-shaped point reads: batched exact-key
+    GETs ([key, key+0xff), LIMIT 1) served as wire bytes. Baseline:
+    CassandraKeyValue reads 220K ops/s across 3 nodes => ~73.3K
+    ops/s/node (docs/yb-perf-v1.0.7.md:7)."""
+    from yugabyte_db_tpu.models.partition import compute_hash_code
+
+    rng = random.Random(13)
+
+    def make_batch(k):
+        out = []
+        for _ in range(k):
+            i = rng.randrange(NUM_KEYS)
+            key = schema.encode_primary_key(
+                {"k": f"user{i:06d}", "r": i % 7},
+                compute_hash_code(schema, {"k": f"user{i:06d}"}))
+            out.append(S.ScanSpec(
+                lower=key, upper=key + b"\xff", read_ht=max_ht + 1,
+                projection=["k", "r", "a", "d"], limit=1))
+        return out
+
+    batches = [make_batch(n_ops) for _ in range(n_batches)]
+    aw = cpu.scan_batch_wire(batches[0], "cql")
+    bw = tpu.scan_batch_wire(batches[0], "cql")
+    assert [(p.data, p.nrows) for p in aw] == \
+        [(p.data, p.nrows) for p in bw]
+
+    t0 = time.perf_counter()
+    hits = 0
+    for batch in batches:
+        for pg in tpu.scan_batch_wire(batch, "cql"):
+            hits += pg.nrows
+    tdt = time.perf_counter() - t0
+    ops_s = n_ops * n_batches / tdt
+
+    t0 = time.perf_counter()
+    cpu.scan_batch_wire(batches[0], "cql")
+    cpu.scan_batch_wire(batches[1], "cql")
+    cdt = (time.perf_counter() - t0) / 2 * n_batches
+    return {
+        "metric": "point_read_ops_per_sec",
+        "value": round(ops_s, 1),
+        "unit": (f"GET ops/s (exact-key LIMIT-1 wire pages, "
+                 f"batches of {n_ops})"),
+        "vs_baseline": round(ops_s / (220_000 / 3), 2),
+        "vs_cpu_engine": round(cdt / tdt, 2),
+        "hit_rate": round(hits / (n_ops * n_batches), 3),
+    }
+
+
+def bench_ycsb_mix(make_engine, S, n_keys=None):
+    """YCSB-A (50/50 read-update) and YCSB-F (read-modify-write) on a
+    dedicated engine pair: updates land in the live memtable, reads take
+    the bloom-pruned point path over memtable + runs — the real mixed
+    steady state (the reference's YCSB numbers,
+    docs/yb-perf-v1.0.7.md:585-601; per-node = /3)."""
+    from __graft_entry__ import _make_rows, _make_schema
+    from yugabyte_db_tpu.models.partition import compute_hash_code
+    from yugabyte_db_tpu.storage.row_version import RowVersion
+
+    n_keys = n_keys or max(NUM_KEYS // 2, 10_000)
+    schema = _make_schema()
+    rows, ht = _make_rows(schema, n_keys, seed=5)
+    tpu = make_engine("tpu", schema, {"rows_per_block": 2048})
+    cpu = make_engine("cpu", schema)
+    for e in (tpu, cpu):
+        e.apply(rows)
+        e.flush()
+    cid = {c.name: c.col_id for c in schema.value_columns}
+    rng = random.Random(23)
+
+    def key_of(i):
+        return schema.encode_primary_key(
+            {"k": f"user{i:06d}", "r": i % 7},
+            compute_hash_code(schema, {"k": f"user{i:06d}"}))
+
+    def get_spec(i, rht):
+        return S.ScanSpec(lower=key_of(i), upper=key_of(i) + b"\xff",
+                          read_ht=rht, projection=["k", "r", "a", "d"],
+                          limit=1)
+
+    out = []
+    # A: 50/50 in batches of 64 reads + 64 updates.
+    n_rounds = 60
+    ops = 0
+    # Warm + parity on one round against the oracle.
+    specs = [get_spec(rng.randrange(n_keys), ht + 1) for _ in range(64)]
+    assert [p.data for p in tpu.scan_batch_wire(specs, "cql")] == \
+        [p.data for p in cpu.scan_batch_wire(specs, "cql")]
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        upd = []
+        for _ in range(64):
+            i = rng.randrange(n_keys)
+            ht += 1
+            upd.append(RowVersion(key_of(i), ht=ht, columns={
+                cid["d"]: rng.randrange(-10**6, 10**6)}))
+        tpu.apply(upd)
+        specs = [get_spec(rng.randrange(n_keys), ht + 1)
+                 for _ in range(64)]
+        for pg in tpu.scan_batch_wire(specs, "cql"):
+            pass
+        ops += 128
+    a_dt = time.perf_counter() - t0
+    out.append({
+        "metric": "ycsb_a_ops_per_sec",
+        "value": round(ops / a_dt, 1),
+        "unit": "ops/s (50/50 point-read/update, live memtable)",
+        "vs_baseline": round(ops / a_dt / (107_120 / 3), 2),
+    })
+    # F: read-modify-write (read the row, rewrite column d).
+    ops = 0
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        idxs = [rng.randrange(n_keys) for _ in range(64)]
+        specs = [get_spec(i, ht + 1) for i in idxs]
+        pages = tpu.scan_batch_wire(specs, "cql")
+        upd = []
+        for i, pg in zip(idxs, pages):
+            ht += 1
+            upd.append(RowVersion(key_of(i), ht=ht, columns={
+                cid["d"]: pg.nrows + 1}))
+        tpu.apply(upd)
+        ops += 64
+    f_dt = time.perf_counter() - t0
+    # Spot-check: the mixed state still matches the oracle that applied
+    # nothing — only on keys never updated is that meaningful, so replay
+    # the tpu updates into the oracle lazily via dump comparison cost is
+    # excessive; instead verify a fresh parity batch through the point
+    # path (memtable + run merge) against the SAME engine's row API.
+    specs = [get_spec(rng.randrange(n_keys), ht + 1) for _ in range(32)]
+    pages = tpu.scan_batch_wire(specs, "cql")
+    rows_api = tpu.scan_batch(specs)
+    from yugabyte_db_tpu.models.wirefmt import serialize_rows
+    for pg, rr, sp in zip(pages, rows_api, specs):
+        dts = [schema.column(n).dtype for n in rr.columns]
+        assert pg.data == serialize_rows("cql", dts, rr.rows)
+    out.append({
+        "metric": "ycsb_f_ops_per_sec",
+        "value": round(ops / f_dt, 1),
+        "unit": "RMW ops/s (point read + rewrite, live memtable)",
+        "vs_baseline": round(ops / f_dt / (72_185 / 3), 2),
+    })
+    return out
+
+
+def bench_redis(n_keys=20_000, pipeline=256):
+    """Redis proxy over the RF=3 MiniCluster through a real RESP socket,
+    pipelined (the RedisPipelinedKeyValue shape): SET load then GET
+    sweep. Baselines per node: pipelined reads 538K/3 => ~179K ops/s,
+    writes 536K/3 => ~179K (docs/yb-perf-v1.0.7.md:18-19)."""
+    import socket
+    import tempfile
+
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+    from yugabyte_db_tpu.yql.redis import RedisServer
+
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            server = RedisServer(mc.client("redis-bench"))
+            host, port = server.listen("127.0.0.1", 0)
+            sock = socket.create_connection((host, port))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            f = sock.makefile("rwb")
+
+            def run(cmds):
+                n = 0
+                for c0 in range(0, len(cmds), pipeline):
+                    chunk = cmds[c0:c0 + pipeline]
+                    f.write(b"".join(chunk))
+                    f.flush()
+                    for _ in chunk:
+                        line = f.readline()
+                        if line[:1] == b"$":
+                            ln = int(line[1:])
+                            if ln >= 0:
+                                f.read(ln + 2)
+                        n += 1
+                return n
+
+            def resp(*args):
+                parts = [b"*%d\r\n" % len(args)]
+                for a in args:
+                    b = a if isinstance(a, bytes) else str(a).encode()
+                    parts.append(b"$%d\r\n%s\r\n" % (len(b), b))
+                return b"".join(parts)
+
+            sets = [resp("SET", f"bk{i:07d}", f"val{i}")
+                    for i in range(n_keys)]
+            t0 = time.perf_counter()
+            run(sets)
+            set_dt = time.perf_counter() - t0
+            rng = random.Random(3)
+            gets = [resp("GET", f"bk{rng.randrange(n_keys):07d}")
+                    for _ in range(n_keys)]
+            t0 = time.perf_counter()
+            run(gets)
+            get_dt = time.perf_counter() - t0
+            sock.close()
+            server.shutdown()
+        finally:
+            mc.shutdown()
+    return [{
+        "metric": "redis_pipelined_get_ops_per_sec",
+        "value": round(n_keys / get_dt, 1),
+        "unit": f"GET ops/s (RESP socket, pipeline {pipeline}, RF=3)",
+        "vs_baseline": round(n_keys / get_dt / (538_000 / 3), 2),
+    }, {
+        "metric": "redis_pipelined_set_ops_per_sec",
+        "value": round(n_keys / set_dt, 1),
+        "unit": f"SET ops/s (RESP socket, pipeline {pipeline}, RF=3)",
+        "vs_baseline": round(n_keys / set_dt / (536_000 / 3), 2),
+    }]
 
 
 def bench_multisource(schema, tpu, cpu, max_ht, S, waves=4):
@@ -608,6 +851,9 @@ def main():
         schema, rows, max_ht, make_engine, S)
     for sub in (
         bench_ycsb_e(schema, tpu, cpu, max_ht, S),
+        bench_point_reads(schema, tpu, cpu, max_ht, S),
+        *bench_ycsb_mix(make_engine, S),
+        *bench_redis(),
         bench_multisource(schema, tpu, cpu, max_ht, S),
         *bench_kernel_scan(),
         *bench_tpch(make_engine),

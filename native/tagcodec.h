@@ -18,7 +18,14 @@
 namespace ybtag {
 
 enum Tag : unsigned char {
-  T_NONE = 0, T_TRUE, T_FALSE, T_INT, T_F64, T_STR, T_BYTES, T_LIST, T_MAP
+  T_NONE = 0, T_TRUE, T_FALSE, T_INT, T_F64, T_STR, T_BYTES, T_LIST,
+  T_MAP,
+  // Rich QL scalars (DECIMAL/VARINT-beyond-64-bit/UUID/TIMEUUID/INET/
+  // DATE/TIME): varint length + the byte-comparable key-component
+  // encoding (models/encoding.py). Native code skips them structurally;
+  // decoding materializes through the Python helper (these ride the
+  // host-payload path, never the native hot loops).
+  T_EXT
 };
 
 constexpr int kMaxDepth = 200;
@@ -149,6 +156,40 @@ inline bool encode_obj(Buf* b, PyObject* v, int depth) {
     }
     return true;
   }
+  // Rich QL scalars (Decimal, UUID/TimeUuid, Inet, date, time): emit
+  // T_EXT with the byte-comparable component encoding produced by the
+  // Python helper (these never ride the native hot loops).
+  {
+    static PyObject* fn = nullptr;
+    if (fn == nullptr) {
+      PyObject* mod =
+          PyImport_ImportModule("yugabyte_db_tpu.models.encoding");
+      if (mod != nullptr) {
+        fn = PyObject_GetAttrString(mod, "encode_component_value");
+        Py_DECREF(mod);
+      }
+      PyErr_Clear();
+    }
+    if (fn != nullptr) {
+      PyObject* raw = PyObject_CallOneArg(fn, v);
+      if (raw == nullptr) {
+        PyErr_Clear();
+      } else if (PyBytes_Check(raw)) {
+        char* p;
+        Py_ssize_t n;
+        if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
+          Py_DECREF(raw);
+          return false;
+        }
+        bool ok = buf_putc(b, T_EXT) && write_varint(b, (uint64_t)n) &&
+                  buf_put(b, p, (size_t)n);
+        Py_DECREF(raw);
+        return ok;
+      } else {
+        Py_DECREF(raw);
+      }
+    }
+  }
   PyErr_Format(PyExc_TypeError, "codec cannot encode %s",
                Py_TYPE(v)->tp_name);
   return false;
@@ -253,6 +294,30 @@ inline PyObject* decode_obj(Reader* r, int depth) {
       }
       return list;
     }
+    case T_EXT: {
+      uint64_t n;
+      if (!read_varint(r, &n) || !need(r, n)) return nullptr;
+      PyObject* raw = PyBytes_FromStringAndSize(
+          (const char*)(r->data + r->pos), (Py_ssize_t)n);
+      r->pos += n;
+      if (raw == nullptr) return nullptr;
+      static PyObject* fn = nullptr;
+      if (fn == nullptr) {
+        PyObject* mod =
+            PyImport_ImportModule("yugabyte_db_tpu.models.encoding");
+        if (mod != nullptr) {
+          fn = PyObject_GetAttrString(mod, "decode_component_value");
+          Py_DECREF(mod);
+        }
+        if (fn == nullptr) {
+          Py_DECREF(raw);
+          return nullptr;
+        }
+      }
+      PyObject* out = PyObject_CallOneArg(fn, raw);
+      Py_DECREF(raw);
+      return out;
+    }
     case T_MAP: {
       uint64_t n;
       if (!read_varint(r, &n)) return nullptr;
@@ -310,7 +375,7 @@ inline bool skip_obj(Reader* r, int depth) {
       if (!need(r, 8)) return false;
       r->pos += 8;
       return true;
-    case T_STR: case T_BYTES:
+    case T_STR: case T_BYTES: case T_EXT:
       if (!read_varint(r, &n) || !need(r, n)) return false;
       r->pos += n;
       return true;

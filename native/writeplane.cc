@@ -807,12 +807,44 @@ static int key_cmp(const char* blob, const int64_t* offs, size_t i,
   return alen < n ? -1 : (alen > n ? 1 : 0);
 }
 
-// first index i in [0, nv) with key[i] >= (p, n)
+// First 4 bytes of key i as a big-endian u32 (0-padded) — the
+// interpolation coordinate. DocKeys start with the hash tag + 16-bit
+// hash code, so this is near-uniform over a tablet's key space.
+static inline uint32_t key_prefix4(const char* blob, const int64_t* offs,
+                                   size_t i) {
+  size_t a0 = (size_t)offs[i], a1 = (size_t)offs[i + 1];
+  uint32_t v = 0;
+  for (size_t j = 0; j < 4; j++)
+    v = (v << 8) | (a0 + j < a1 ? (unsigned char)blob[a0 + j] : 0);
+  return v;
+}
+
+// first index i in [0, nv) with key[i] >= (p, n). Interpolation probes
+// (cold binary search over a multi-MB blob is ~half the per-page fixed
+// cost) alternating with binary halving so skewed key spaces keep the
+// O(log n) bound.
 static size_t key_lower_bound(const char* blob, const int64_t* offs,
                               size_t nv, const char* p, size_t n) {
   size_t lo = 0, hi = nv;
+  uint32_t tp = 0;
+  for (size_t j = 0; j < 4; j++)
+    tp = (tp << 8) | (j < n ? (unsigned char)p[j] : 0);
+  bool interp = true;
   while (lo < hi) {
-    size_t mid = (lo + hi) / 2;
+    size_t mid;
+    if (interp && hi - lo > 16) {
+      uint32_t lp = key_prefix4(blob, offs, lo);
+      uint32_t hp = key_prefix4(blob, offs, hi - 1);
+      if (hp > lp && tp > lp && tp < hp) {
+        mid = lo + (size_t)((uint64_t)(tp - lp) * (hi - 1 - lo) /
+                            (hp - lp));
+      } else {
+        mid = (lo + hi) / 2;
+      }
+    } else {
+      mid = (lo + hi) / 2;
+    }
+    interp = !interp;
     if (key_cmp(blob, offs, mid, p, n) < 0) lo = mid + 1;
     else hi = mid;
   }
@@ -821,8 +853,17 @@ static size_t key_lower_bound(const char* blob, const int64_t* offs,
 
 static size_t i64_lower_bound(const int64_t* a, size_t n, int64_t v) {
   size_t lo = 0, hi = n;
+  bool interp = true;  // values are near-uniform row indices
   while (lo < hi) {
-    size_t mid = (lo + hi) / 2;
+    size_t mid;
+    if (interp && hi - lo > 16 && a[hi - 1] > a[lo] && v > a[lo] &&
+        v < a[hi - 1]) {
+      mid = lo + (size_t)((uint64_t)(v - a[lo]) * (hi - 1 - lo) /
+                          (uint64_t)(a[hi - 1] - a[lo]));
+    } else {
+      mid = (lo + hi) / 2;
+    }
+    interp = !interp;
     if (a[mid] < v) lo = mid + 1;
     else hi = mid;
   }
@@ -1067,6 +1108,340 @@ PyObject* py_serve_page_batch(PyObject*, PyObject* args) {
     }
     PyObject* entry = emit_page(blob, offs, valid, match, exists, cols,
                                 lower, (size_t)lower_n, "", 0, limit);
+    if (entry == nullptr) { Py_DECREF(results); return nullptr; }
+    PyList_SET_ITEM(results, pi, entry);
+  }
+  return results;
+}
+
+// -- wire page server --------------------------------------------------------
+//
+// Result pages serialized straight to protocol bytes from the plane
+// buffers — the hot path never constructs a Python value object per
+// cell. The reference serializes each row block once into rows_data
+// (src/yb/common/ql_rowblock.h:66 Serialize) and the CQL/PG layers
+// forward the bytes; this is the same contract restaged over the
+// columnar host mirror.
+//
+// Wire colspecs (host_page._native_wirespecs):
+//   ("wblob", offsets_i64, blob_bytes[, nn_u8])  pre-encoded payloads;
+//       cell = [len][blob slice]; with nn, nn[g]==0 emits NULL
+//   ("wi64", cmp2_i32, nn_u8)   ordered planes -> int64
+//   ("wi32", cmp_i32, nn_u8[, width])  int32; fmt 0 emits the low
+//       `width` bytes BE (4 default; 2 smallint, 1 tinyint)
+//   ("wf64", cmp2_i32, nn_u8)   ordered planes -> double bits
+//   ("wbool", cmp_i32, nn_u8)   bool
+// fmt 0 (CQL): cell = int32 BE length + binary payload (i64 -> 8B BE,
+//   i32 -> 4B BE, f64 -> IEEE bits BE, bool -> 1 byte), NULL = len -1 —
+//   byte-identical to yql.cql.wire_protocol.encode_value.
+// fmt 1 (PG text): each row is a complete DataRow message ('D' +
+//   int32 msglen + int16 ncols + cells); ints render as ascii, bool as
+//   t/f — byte-identical to yql.pgsql.wire.data_row (floats/strings
+//   ride pre-encoded wblob payloads so repr parity is exact).
+
+struct WireEmit {
+  enum Kind { W_BLOB, W_BLOBNN, W_I64, W_I32, W_F64, W_BOOL };
+  std::vector<Kind> kinds;
+  std::vector<int> widths;     // W_I32: cell byte width (fmt 0)
+  std::vector<BufView> offs;   // W_BLOB*: payload offsets
+  std::vector<BufView> blobs;  // W_BLOB*: payload bytes
+  std::vector<BufView> cmps;
+  std::vector<BufView> nns;
+
+  bool parse(PyObject* wirespecs) {
+    if (!PyTuple_Check(wirespecs)) {
+      PyErr_SetString(PyExc_TypeError,
+                      "serve_page_wire: wirespecs must be a tuple");
+      return false;
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(wirespecs);
+    kinds.resize(n);
+    widths.assign(n, 4);
+    offs = std::vector<BufView>(n);
+    blobs = std::vector<BufView>(n);
+    cmps = std::vector<BufView>(n);
+    nns = std::vector<BufView>(n);
+    for (Py_ssize_t c = 0; c < n; c++) {
+      PyObject* spec = PyTuple_GET_ITEM(wirespecs, c);
+      const char* tag = PyUnicode_AsUTF8(PyTuple_GET_ITEM(spec, 0));
+      if (tag == nullptr) return false;
+      if (strcmp(tag, "wblob") == 0) {
+        bool has_nn = PyTuple_GET_SIZE(spec) > 3 &&
+                      PyTuple_GET_ITEM(spec, 3) != Py_None;
+        kinds[c] = has_nn ? W_BLOBNN : W_BLOB;
+        if (!offs[c].get(PyTuple_GET_ITEM(spec, 1), "offsets") ||
+            !blobs[c].get(PyTuple_GET_ITEM(spec, 2), "blob")) {
+          return false;
+        }
+        if (has_nn && !nns[c].get(PyTuple_GET_ITEM(spec, 3), "nn")) {
+          return false;
+        }
+      } else {
+        kinds[c] = strcmp(tag, "wi64") == 0 ? W_I64
+                   : strcmp(tag, "wi32") == 0 ? W_I32
+                   : strcmp(tag, "wf64") == 0 ? W_F64 : W_BOOL;
+        if (!cmps[c].get(PyTuple_GET_ITEM(spec, 1), "cmp")) return false;
+        if (!nns[c].get(PyTuple_GET_ITEM(spec, 2), "nn")) return false;
+        if (kinds[c] == W_I32 && PyTuple_GET_SIZE(spec) > 3) {
+          widths[c] = (int)PyLong_AsLong(PyTuple_GET_ITEM(spec, 3));
+          if (widths[c] != 1 && widths[c] != 2 && widths[c] != 4) {
+            PyErr_SetString(PyExc_ValueError,
+                            "serve_page_wire: wi32 width must be 1/2/4");
+            return false;
+          }
+        }
+      }
+    }
+    return true;
+  }
+
+  static void put_i32be(std::string* out, int32_t v) {
+    unsigned char b[4] = {(unsigned char)(v >> 24), (unsigned char)(v >> 16),
+                          (unsigned char)(v >> 8), (unsigned char)v};
+    out->append((const char*)b, 4);
+  }
+  static inline void stamp_i32be(char* p, int32_t v) {
+    p[0] = (char)(v >> 24);
+    p[1] = (char)(v >> 16);
+    p[2] = (char)(v >> 8);
+    p[3] = (char)v;
+  }
+  static inline void stamp_u64be(char* p, uint64_t v) {
+    for (int i = 0; i < 8; i++) p[i] = (char)(v >> (56 - 8 * i));
+  }
+
+  // Append one cell (fmt 0 binary / fmt 1 text); PG msglen patching is
+  // the caller's job. Each cell lands in ONE append (two for blob
+  // payloads) — per-byte push_back was the measured per-row hot spot.
+  void cell(std::string* out, Py_ssize_t c, int64_t g, int fmt) const {
+    char tmp[28];
+    switch (kinds[c]) {
+      case W_BLOB:
+      case W_BLOBNN: {
+        if (kinds[c] == W_BLOBNN && !nns[c].u8()[g]) {
+          put_i32be(out, -1);
+          return;
+        }
+        int64_t o0 = offs[c].i64()[g], o1 = offs[c].i64()[g + 1];
+        if (o0 < 0) { put_i32be(out, -1); return; }  // NULL sentinel
+        put_i32be(out, (int32_t)(o1 - o0));
+        out->append((const char*)blobs[c].u8() + o0, (size_t)(o1 - o0));
+        return;
+      }
+      case W_I64: {
+        if (!nns[c].u8()[g]) { put_i32be(out, -1); return; }
+        uint64_t u = planes_u64(cmps[c].i32()[2 * g],
+                                cmps[c].i32()[2 * g + 1]);
+        int64_t v = (int64_t)(u ^ (1ULL << 63));
+        if (fmt == 0) {
+          stamp_i32be(tmp, 8);
+          stamp_u64be(tmp + 4, (uint64_t)v);
+          out->append(tmp, 12);
+        } else {
+          int n = snprintf(tmp + 4, sizeof(tmp) - 4, "%lld", (long long)v);
+          stamp_i32be(tmp, n);
+          out->append(tmp, (size_t)n + 4);
+        }
+        return;
+      }
+      case W_I32: {
+        if (!nns[c].u8()[g]) { put_i32be(out, -1); return; }
+        int32_t v = cmps[c].i32()[g];
+        if (fmt == 0) {
+          int w = widths[c];
+          stamp_i32be(tmp, w);
+          for (int i = 0; i < w; i++)
+            tmp[4 + i] = (char)((uint32_t)v >> (8 * (w - 1 - i)));
+          out->append(tmp, (size_t)w + 4);
+        } else {
+          int n = snprintf(tmp + 4, sizeof(tmp) - 4, "%d", v);
+          stamp_i32be(tmp, n);
+          out->append(tmp, (size_t)n + 4);
+        }
+        return;
+      }
+      case W_F64: {
+        if (!nns[c].u8()[g]) { put_i32be(out, -1); return; }
+        uint64_t flipped = planes_u64(cmps[c].i32()[2 * g],
+                                      cmps[c].i32()[2 * g + 1]);
+        uint64_t bits = (flipped >> 63) ? (flipped & ~(1ULL << 63))
+                                        : ~flipped;
+        stamp_i32be(tmp, 8);
+        stamp_u64be(tmp + 4, bits);  // fmt 1 floats ride wblob
+        out->append(tmp, 12);
+        return;
+      }
+      case W_BOOL: {
+        if (!nns[c].u8()[g]) { put_i32be(out, -1); return; }
+        bool v = cmps[c].i32()[g] != 0;
+        stamp_i32be(tmp, 1);
+        tmp[4] = fmt == 0 ? (v ? '\x01' : '\x00') : (v ? 't' : 'f');
+        out->append(tmp, 5);
+        return;
+      }
+    }
+  }
+
+  // Hint the lines a future row will touch (the emit loop runs ~8 rows
+  // ahead): page rows are near-consecutive but cold on first touch.
+  void prefetch(int64_t g) const {
+    for (size_t c = 0; c < kinds.size(); c++) {
+      switch (kinds[c]) {
+        case W_BLOB:
+        case W_BLOBNN:
+          __builtin_prefetch(&offs[c].i64()[g]);
+          if (kinds[c] == W_BLOBNN) __builtin_prefetch(&nns[c].u8()[g]);
+          break;
+        case W_I64:
+        case W_F64:
+          __builtin_prefetch(&cmps[c].i32()[2 * g]);
+          __builtin_prefetch(&nns[c].u8()[g]);
+          break;
+        default:
+          __builtin_prefetch(&cmps[c].i32()[g]);
+          __builtin_prefetch(&nns[c].u8()[g]);
+      }
+    }
+  }
+};
+
+// One wire page -> (data, nrows, scanned, resume|None), or nullptr.
+static PyObject* emit_wire_page(const char* blob, const BufView& offs,
+                                const BufView& valid, const BufView& match,
+                                const BufView& exists, const WireEmit& cols,
+                                const char* lower, size_t lower_n,
+                                const char* upper, size_t upper_n,
+                                Py_ssize_t limit, int fmt,
+                                std::string* scratch) {
+  size_t nv = valid.n(8);
+  size_t nm = match.n(8);
+  size_t ne = exists.n(8);
+
+  size_t lo_i = key_lower_bound(blob, offs.i64(), nv, lower, lower_n);
+  int64_t row_lo = lo_i < nv ? valid.i64()[lo_i] : INT64_MAX;
+  int64_t row_hi = INT64_MAX;
+  if (upper_n > 0) {
+    size_t hi_i = key_lower_bound(blob, offs.i64(), nv, upper, upper_n);
+    row_hi = hi_i < nv ? valid.i64()[hi_i] : INT64_MAX;
+  }
+  size_t i0 = i64_lower_bound(match.i64(), nm, row_lo);
+  size_t i1 = row_hi == INT64_MAX
+                  ? nm
+                  : i64_lower_bound(match.i64(), nm, row_hi);
+  if (i1 < i0) i1 = i0;
+  size_t take = i1 - i0;
+  if (limit >= 0 && (size_t)limit < take) take = (size_t)limit;
+  bool hit_limit = limit >= 0 && take >= (size_t)limit && take > 0;
+
+  std::string& out = *scratch;
+  out.clear();
+  size_t ncols = cols.kinds.size();
+  if (out.capacity() < take * (ncols * 16 + 16))
+    out.reserve(take * (ncols * 16 + 16));
+  for (size_t j = 0; j < take; j++) {
+    int64_t g = match.i64()[i0 + j];
+    if (j + 8 < take) cols.prefetch(match.i64()[i0 + j + 8]);
+    if (fmt == 1) {
+      out.push_back('D');
+      size_t len_at = out.size();
+      WireEmit::put_i32be(&out, 0);  // patched below
+      out.push_back((char)(ncols >> 8));
+      out.push_back((char)(ncols & 0xff));
+      for (size_t c = 0; c < ncols; c++) cols.cell(&out, (Py_ssize_t)c, g, 1);
+      int32_t msglen = (int32_t)(out.size() - len_at);
+      out[len_at] = (char)(msglen >> 24);
+      out[len_at + 1] = (char)(msglen >> 16);
+      out[len_at + 2] = (char)(msglen >> 8);
+      out[len_at + 3] = (char)msglen;
+    } else {
+      for (size_t c = 0; c < ncols; c++) cols.cell(&out, (Py_ssize_t)c, g, 0);
+    }
+  }
+
+  int64_t hi_row = take > 0 ? match.i64()[i0 + take - 1] + 1 : row_hi;
+  size_t e1 = hi_row == INT64_MAX
+                  ? ne
+                  : i64_lower_bound(exists.i64(), ne, hi_row);
+  size_t e0 = i64_lower_bound(exists.i64(), ne, row_lo);
+
+  PyObject* data = PyBytes_FromStringAndSize(out.data(),
+                                             (Py_ssize_t)out.size());
+  if (data == nullptr) return nullptr;
+  PyObject* resume;
+  if (hit_limit) {
+    int64_t g_last = match.i64()[i0 + take - 1];
+    size_t pos = i64_lower_bound(valid.i64(), nv, g_last);
+    size_t k0 = (size_t)offs.i64()[pos], k1 = (size_t)offs.i64()[pos + 1];
+    resume = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)(k1 - k0 + 1));
+    if (resume == nullptr) { Py_DECREF(data); return nullptr; }
+    char* rp = PyBytes_AS_STRING(resume);
+    memcpy(rp, blob + k0, k1 - k0);
+    rp[k1 - k0] = '\0';
+  } else {
+    resume = Py_NewRef(Py_None);
+  }
+  return Py_BuildValue("(NnnN)", data, (Py_ssize_t)take,
+                       (Py_ssize_t)(e1 - e0), resume);
+}
+
+// serve_page_wire_batch(blob, offsets, valid_rows, match_idx, exists_idx,
+//                       wirespecs, lowers: list[bytes], uppers: list[bytes]
+//                       | None, limit, fmt) ->
+//   [(data, nrows, scanned, resume|None)]
+PyObject* py_serve_page_wire_batch(PyObject*, PyObject* args) {
+  const char* blob;
+  Py_ssize_t blob_n, limit, fmt;
+  PyObject *offs_o, *valid_o, *match_o, *exists_o, *wirespecs, *lowers,
+      *uppers;
+  if (!PyArg_ParseTuple(args, "y#OOOOOOOnn", &blob, &blob_n, &offs_o,
+                        &valid_o, &match_o, &exists_o, &wirespecs,
+                        &lowers, &uppers, &limit, &fmt)) {
+    return nullptr;
+  }
+  if (!PyList_Check(lowers)) {
+    PyErr_SetString(PyExc_TypeError,
+                    "serve_page_wire_batch: lowers must be a list");
+    return nullptr;
+  }
+  bool has_uppers = uppers != Py_None;
+  if (has_uppers && (!PyList_Check(uppers) ||
+                     PyList_GET_SIZE(uppers) != PyList_GET_SIZE(lowers))) {
+    PyErr_SetString(PyExc_TypeError,
+                    "serve_page_wire_batch: uppers must match lowers");
+    return nullptr;
+  }
+  BufView offs, valid, match, exists;
+  if (!offs.get(offs_o, "offsets") || !valid.get(valid_o, "valid_rows") ||
+      !match.get(match_o, "match_idx") ||
+      !exists.get(exists_o, "exists_idx")) {
+    return nullptr;
+  }
+  WireEmit cols;
+  if (!cols.parse(wirespecs)) return nullptr;
+
+  Py_ssize_t npages = PyList_GET_SIZE(lowers);
+  PyObject* results = PyList_New(npages);
+  if (results == nullptr) return nullptr;
+  std::string scratch;
+  for (Py_ssize_t pi = 0; pi < npages; pi++) {
+    char* lower;
+    Py_ssize_t lower_n;
+    if (PyBytes_AsStringAndSize(PyList_GET_ITEM(lowers, pi), &lower,
+                                &lower_n) < 0) {
+      Py_DECREF(results);
+      return nullptr;
+    }
+    char* upper = nullptr;
+    Py_ssize_t upper_n = 0;
+    if (has_uppers &&
+        PyBytes_AsStringAndSize(PyList_GET_ITEM(uppers, pi), &upper,
+                                &upper_n) < 0) {
+      Py_DECREF(results);
+      return nullptr;
+    }
+    PyObject* entry = emit_wire_page(
+        blob, offs, valid, match, exists, cols, lower, (size_t)lower_n,
+        upper ? upper : "", (size_t)upper_n, limit, (int)fmt, &scratch);
     if (entry == nullptr) { Py_DECREF(results); return nullptr; }
     PyList_SET_ITEM(results, pi, entry);
   }
@@ -1366,6 +1741,11 @@ PyMethodDef kMethods[] = {
     {"serve_page", py_serve_page, METH_VARARGS,
      "serve_page(blob, offsets, valid_rows, match_idx, exists_idx, "
      "colspecs, lower, upper, limit) -> (rows, scanned, resume|None)"},
+    {"serve_page_wire_batch", py_serve_page_wire_batch, METH_VARARGS,
+     "serve_page_wire_batch(blob, offsets, valid_rows, match_idx, "
+     "exists_idx, wirespecs, lowers, uppers|None, limit, fmt) -> "
+     "[(data, nrows, scanned, resume|None)] (fmt 0=CQL cells, 1=PG "
+     "DataRow messages)"},
     {"stamp_block", py_stamp_block, METH_VARARGS,
      "stamp_block(block, ht, logical_shift) -> stamped block"},
     {"block_count", py_block_count, METH_O, "row count of a block"},

@@ -1,0 +1,226 @@
+"""SQL executor features: CTEs, OFFSET, correlated subqueries, scalar
+functions, col-vs-col predicates.
+
+Reference capability: everything stock PG 11.2's executor provides above
+the FDW scans (src/postgres/src/backend/executor — nodeCtescan.c,
+nodeSubplan.c, utils/adt scalar functions); test style follows
+src/yb/yql/pgwrapper/pg_libpq-test.cc.
+"""
+
+import pytest
+
+from yugabyte_db_tpu.utils.status import InvalidArgument
+from yugabyte_db_tpu.yql.pgsql import PgProcessor
+from yugabyte_db_tpu.yql.cql.processor import LocalCluster
+
+
+@pytest.fixture(params=["cpu", "tpu"])
+def pg(request, tmp_path):
+    cluster = LocalCluster(str(tmp_path), num_tablets=2,
+                           engine=request.param,
+                           engine_options={"rows_per_block": 16})
+    proc = PgProcessor(cluster)
+    yield proc
+    cluster.close()
+
+
+def seed(pg):
+    pg.execute("CREATE TABLE items (id bigint PRIMARY KEY, cat text, "
+               "price bigint, qty int, name text)")
+    data = [
+        (1, "a", 100, 3, "apple"),
+        (2, "a", 250, 1, "avocado"),
+        (3, "b", 80, 7, "banana"),
+        (4, "b", 300, 2, "berry"),
+        (5, "b", 150, 5, "bread"),
+        (6, "c", 40, 9, "candy"),
+    ]
+    for row in data:
+        pg.execute("INSERT INTO items (id, cat, price, qty, name) VALUES "
+                   f"({row[0]}, '{row[1]}', {row[2]}, {row[3]}, "
+                   f"'{row[4]}')")
+    return data
+
+
+# -- OFFSET ------------------------------------------------------------------
+
+def test_offset_with_order_and_limit(pg):
+    seed(pg)
+    r = pg.execute("SELECT id FROM items ORDER BY price DESC "
+                   "LIMIT 2 OFFSET 1")
+    assert r.rows == [(2,), (5,)]
+    # OFFSET alone and OFFSET-before-LIMIT order both parse.
+    r = pg.execute("SELECT id FROM items ORDER BY id OFFSET 4")
+    assert r.rows == [(5,), (6,)]
+    r = pg.execute("SELECT id FROM items ORDER BY id OFFSET 2 LIMIT 2")
+    assert r.rows == [(3,), (4,)]
+    r = pg.execute("SELECT id FROM items ORDER BY id OFFSET 99")
+    assert r.rows == []
+
+
+def test_offset_without_order(pg):
+    seed(pg)
+    all_ids = {r[0] for r in pg.execute("SELECT id FROM items").rows}
+    got = pg.execute("SELECT id FROM items OFFSET 2").rows
+    assert len(got) == 4 and {r[0] for r in got} <= all_ids
+
+
+# -- CTEs --------------------------------------------------------------------
+
+def test_cte_basic(pg):
+    seed(pg)
+    r = pg.execute(
+        "WITH cheap AS (SELECT id, cat, price FROM items "
+        "WHERE price < 200) "
+        "SELECT id FROM cheap ORDER BY id")
+    assert r.rows == [(1,), (3,), (5,), (6,)]
+
+
+def test_cte_aggregate_over_cte(pg):
+    seed(pg)
+    r = pg.execute(
+        "WITH b AS (SELECT * FROM items WHERE cat = 'b') "
+        "SELECT count(*), sum(price), min(qty) FROM b")
+    assert r.rows == [(3, 530, 2)]
+    r = pg.execute(
+        "WITH t AS (SELECT cat, price FROM items) "
+        "SELECT cat, sum(price) FROM t GROUP BY cat ORDER BY cat")
+    assert r.rows == [("a", 350), ("b", 530), ("c", 40)]
+
+
+def test_cte_chained_and_filtered(pg):
+    seed(pg)
+    r = pg.execute(
+        "WITH b AS (SELECT id, price FROM items WHERE cat = 'b'), "
+        "pricey AS (SELECT id, price FROM b WHERE price >= 150) "
+        "SELECT id FROM pricey ORDER BY price DESC LIMIT 1")
+    assert r.rows == [(4,)]
+
+
+def test_cte_expressions_and_alias(pg):
+    seed(pg)
+    r = pg.execute(
+        "WITH t AS (SELECT id, price * qty AS total FROM items) "
+        "SELECT id, total FROM t c WHERE c.total >= 500 ORDER BY id")
+    assert r.rows == [(3, 560), (4, 600), (5, 750)]
+
+
+def test_cte_name_shadows_table(pg):
+    seed(pg)
+    r = pg.execute(
+        "WITH items AS (SELECT id FROM items WHERE cat = 'c') "
+        "SELECT count(*) FROM items")
+    assert r.rows == [(1,)]
+
+
+# -- correlated subqueries ---------------------------------------------------
+
+def test_correlated_scalar_subquery(pg):
+    seed(pg)
+    # Rows at their category's max price.
+    r = pg.execute(
+        "SELECT id FROM items i WHERE price = "
+        "(SELECT max(price) FROM items i2 WHERE i2.cat = i.cat) "
+        "ORDER BY id")
+    assert r.rows == [(2,), (4,), (6,)]
+
+
+def test_correlated_inequality(pg):
+    seed(pg)
+    # Rows above their category's average price.
+    r = pg.execute(
+        "SELECT id FROM items i WHERE price > "
+        "(SELECT avg(price) FROM items i2 WHERE i2.cat = i.cat) "
+        "ORDER BY id")
+    assert r.rows == [(2,), (4,)]
+
+
+def test_correlated_in_subquery(pg):
+    seed(pg)
+    pg.execute("CREATE TABLE tags (id bigint PRIMARY KEY, item bigint, "
+               "tag text)")
+    for i, (item, tag) in enumerate([(1, "x"), (3, "x"), (4, "y")]):
+        pg.execute(f"INSERT INTO tags (id, item, tag) VALUES "
+                   f"({i}, {item}, 'x')" if tag == "x" else
+                   f"INSERT INTO tags (id, item, tag) VALUES "
+                   f"({i}, {item}, 'y')")
+    r = pg.execute(
+        "SELECT id FROM items i WHERE id IN "
+        "(SELECT item FROM tags t WHERE t.tag = 'x') ORDER BY id")
+    assert r.rows == [(1,), (3,)]
+
+
+def test_uncorrelated_subquery_still_works(pg):
+    seed(pg)
+    r = pg.execute(
+        "SELECT id FROM items WHERE price = "
+        "(SELECT max(price) FROM items)")
+    assert r.rows == [(4,)]
+
+
+def test_col_vs_col_predicate(pg):
+    seed(pg)
+    r = pg.execute("SELECT id FROM items WHERE qty > price ORDER BY id")
+    assert r.rows == []
+    r = pg.execute("SELECT id FROM items i WHERE i.price > i.qty "
+                   "ORDER BY id")
+    assert len(r.rows) == 6
+
+
+# -- scalar functions --------------------------------------------------------
+
+def test_scalar_functions_projection(pg):
+    seed(pg)
+    r = pg.execute(
+        "SELECT upper(name), lower(cat), length(name), abs(0 - price) "
+        "FROM items WHERE id = 1")
+    assert r.rows == [("APPLE", "a", 5, 100)]
+    r = pg.execute("SELECT coalesce(name, 'none'), nullif(cat, 'a') "
+                   "FROM items WHERE id = 1")
+    assert r.rows == [("apple", None)]
+    r = pg.execute("SELECT greatest(price, qty), least(price, qty) "
+                   "FROM items WHERE id = 3")
+    assert r.rows == [(80, 7)]
+    r = pg.execute("SELECT concat(cat, '-', name), substring(name, 2, 3)"
+                   " FROM items WHERE id = 6")
+    assert r.rows == [("c-candy", "and")]
+    r = pg.execute("SELECT mod(price, 7), round(price * 3), floor(qty), "
+                   "ceil(qty) FROM items WHERE id = 5")
+    assert r.rows == [(150 % 7, 450, 5, 5)]
+
+
+def test_scalar_functions_nest_in_exprs(pg):
+    seed(pg)
+    r = pg.execute("SELECT length(name) + qty, abs(qty - length(name)) "
+                   "FROM items WHERE id = 3")
+    assert r.rows == [(13, 1)]
+    r = pg.execute("SELECT id FROM items WHERE id = 1")
+    assert r.rows == [(1,)]
+
+
+def test_scalar_functions_over_cte_and_view(pg):
+    seed(pg)
+    r = pg.execute(
+        "WITH t AS (SELECT name, qty FROM items WHERE cat = 'b') "
+        "SELECT upper(name) FROM t ORDER BY name LIMIT 2")
+    assert r.rows == [("BANANA",), ("BERRY",)]
+    pg.execute("CREATE VIEW v AS SELECT name, price FROM items "
+               "WHERE cat = 'a'")
+    r = pg.execute("SELECT concat(name, '!') FROM v ORDER BY name")
+    assert r.rows == [("apple!",), ("avocado!",)]
+
+
+def test_functions_null_semantics(pg):
+    pg.execute("CREATE TABLE nv (id bigint PRIMARY KEY, s text, n int)")
+    pg.execute("INSERT INTO nv (id) VALUES (1)")
+    r = pg.execute("SELECT upper(s), length(s), abs(n), "
+                   "coalesce(s, 'dflt'), concat(s, 'x'), "
+                   "greatest(n, id), nullif(id, 99) FROM nv")
+    assert r.rows == [(None, None, None, "dflt", "x", 1, 1)]
+
+
+def test_with_recursive_rejected(pg):
+    seed(pg)
+    with pytest.raises(InvalidArgument):
+        pg.execute("WITH RECURSIVE r AS (SELECT id FROM items) "
+                   "SELECT * FROM r")

@@ -37,12 +37,32 @@ class DataType(enum.IntEnum):
     SET = 13
     MAP = 14
     JSONB = 15      # parsed JSON value (reference: common/jsonb.cc)
+    # Extended QL scalar surface (reference: common.proto:65-99 DECIMAL/
+    # VARINT/INET/UUID/TIMEUUID/DATE/TIME, util/decimal.h ordering,
+    # util/uuid.cc comparable encoding). Values are rich host objects
+    # (decimal.Decimal, int, yb UUID/Inet wrappers, datetime.date/time,
+    # tuples, frozen containers); keys get dedicated byte-comparable
+    # encodings (models.encoding), value columns ride the varlen host-
+    # payload path (host-exact predicates).
+    DECIMAL = 16    # arbitrary-precision decimal (decimal.Decimal)
+    VARINT = 17     # arbitrary-precision integer (int)
+    UUID = 18       # uuid.UUID, lexicographic byte order
+    TIMEUUID = 19   # TimeUuid (v1), ordered by embedded timestamp
+    INET = 20       # Inet wrapper (v4 sorts before v6)
+    DATE = 21       # datetime.date
+    TIME = 22       # datetime.time (ns precision per CQL)
+    TUPLE = 23      # python tuple of scalar values
+    FROZEN = 24     # frozen collection (normalized list/set/map)
 
     @property
     def is_fixed_width(self) -> bool:
         return self not in (DataType.STRING, DataType.BINARY,
                             DataType.LIST, DataType.SET, DataType.MAP,
-                            DataType.JSONB)
+                            DataType.JSONB, DataType.DECIMAL,
+                            DataType.VARINT, DataType.UUID,
+                            DataType.TIMEUUID, DataType.INET,
+                            DataType.DATE, DataType.TIME,
+                            DataType.TUPLE, DataType.FROZEN)
 
     @property
     def is_integer(self) -> bool:
@@ -87,6 +107,16 @@ class DataType(enum.IntEnum):
     @staticmethod
     def parse(name: str) -> "DataType":
         aliases = {
+            "DECIMAL": DataType.DECIMAL,
+            "NUMERIC": DataType.DECIMAL,
+            "VARINT": DataType.VARINT,
+            "UUID": DataType.UUID,
+            "TIMEUUID": DataType.TIMEUUID,
+            "INET": DataType.INET,
+            "DATE": DataType.DATE,
+            "TIME": DataType.TIME,
+            "TUPLE": DataType.TUPLE,
+            "FROZEN": DataType.FROZEN,
             "INT8": DataType.INT8,
             "INT16": DataType.INT16,
             "INT64": DataType.INT64,
@@ -119,10 +149,125 @@ class DataType(enum.IntEnum):
         return aliases[key]
 
 
+class TimeUuid:
+    """A v1 (time-based) UUID ordered by its embedded timestamp, then
+    raw bytes — CQL timeuuid comparison semantics (reference:
+    src/yb/util/uuid.cc ToComparable's time-component reordering)."""
+
+    __slots__ = ("u",)
+
+    def __init__(self, u):
+        import uuid as _uuid
+
+        self.u = u if isinstance(u, _uuid.UUID) else _uuid.UUID(str(u))
+
+    @property
+    def bytes(self) -> bytes:
+        return self.u.bytes
+
+    def sort_key(self):
+        return (self.u.time, self.u.bytes)
+
+    def __eq__(self, other):
+        o = other.u if isinstance(other, TimeUuid) else other
+        return self.u == o
+
+    def __hash__(self):
+        return hash(self.u)
+
+    def __lt__(self, other):
+        return self.sort_key() < TimeUuid(
+            other.u if isinstance(other, TimeUuid) else other).sort_key()
+
+    def __le__(self, other):
+        return self == other or self < other
+
+    def __gt__(self, other):
+        return not self <= other
+
+    def __ge__(self, other):
+        return not self < other
+
+    def __str__(self):
+        return str(self.u)
+
+    def __repr__(self):
+        return f"TimeUuid('{self.u}')"
+
+
+class Inet:
+    """An IPv4/IPv6 address; v4 sorts before v6, then by packed bytes
+    (one column may mix families — plain ipaddress objects refuse to
+    compare across versions)."""
+
+    __slots__ = ("version", "packed")
+
+    def __init__(self, addr):
+        import ipaddress
+
+        if isinstance(addr, Inet):
+            self.version, self.packed = addr.version, addr.packed
+            return
+        a = ipaddress.ip_address(addr)
+        self.version = a.version
+        self.packed = a.packed
+
+    def __eq__(self, other):
+        o = Inet(other) if not isinstance(other, Inet) else other
+        return (self.version, self.packed) == (o.version, o.packed)
+
+    def __hash__(self):
+        return hash((self.version, self.packed))
+
+    def __lt__(self, other):
+        o = Inet(other) if not isinstance(other, Inet) else other
+        return (self.version, self.packed) < (o.version, o.packed)
+
+    def __le__(self, other):
+        return self == other or self < other
+
+    def __gt__(self, other):
+        return not self <= other
+
+    def __ge__(self, other):
+        return not self < other
+
+    def __str__(self):
+        import ipaddress
+
+        return str(ipaddress.ip_address(self.packed))
+
+    def __repr__(self):
+        return f"Inet('{self}')"
+
+
 def python_value_matches(dtype: DataType, value) -> bool:
     """Loose runtime type check for a python value against a logical type."""
+    import datetime
+    import decimal
+    import uuid as _uuid
+
     if value is None:
         return True
+    if dtype == DataType.DECIMAL:
+        return isinstance(value, (decimal.Decimal, int))
+    if dtype == DataType.VARINT:
+        return isinstance(value, int) and not isinstance(value, bool)
+    if dtype == DataType.UUID:
+        return isinstance(value, (_uuid.UUID, TimeUuid))
+    if dtype == DataType.TIMEUUID:
+        return isinstance(value, (TimeUuid, _uuid.UUID))
+    if dtype == DataType.INET:
+        return isinstance(value, Inet)
+    if dtype == DataType.DATE:
+        return isinstance(value, datetime.date) and \
+            not isinstance(value, datetime.datetime)
+    if dtype == DataType.TIME:
+        return isinstance(value, datetime.time)
+    if dtype == DataType.TUPLE:
+        return isinstance(value, tuple)
+    if dtype == DataType.FROZEN:
+        return isinstance(value, (list, dict, tuple, set, frozenset))
     if dtype.is_integer:
         return isinstance(value, int) and not isinstance(value, bool)
     if dtype in (DataType.FLOAT, DataType.DOUBLE):

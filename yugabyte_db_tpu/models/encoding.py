@@ -42,12 +42,148 @@ TAG_NULL = 0x04
 TAG_FALSE = 0x10
 TAG_TRUE = 0x11
 TAG_INT = 0x20      # all integer types normalize to int64 in keys
+TAG_DATE = 0x22     # days since epoch, offset-binary uint32
+TAG_TIME = 0x23     # nanoseconds since midnight, uint64
+TAG_DECIMAL = 0x24  # comparable decimal (util/decimal.h semantics)
+TAG_VARINT = 0x26   # comparable arbitrary-precision integer
 TAG_DOUBLE = 0x28   # float/double normalize to float64 in keys
 TAG_STRING = 0x30
 TAG_BINARY = 0x32
+TAG_UUID = 0x34     # 16 raw bytes (lexicographic)
+TAG_TIMEUUID = 0x35  # [8B v1 timestamp][16 raw bytes]
+TAG_INET = 0x36     # [version byte][packed address]
+TAG_TUPLE = 0x38    # components (value-inferred tags) + GROUP_END
+TAG_FROZEN = 0x3A   # [container kind][components] + GROUP_END
 TAG_HASH = 0x08     # 2-byte partition-hash prelude (reference kUInt16Hash)
 
 _STRING_TERM = b"\x00\x00"
+
+
+# -- comparable varint / decimal (reference: util/decimal.h ordering,
+#    util/memcmpable_varint.cc technique restated) ---------------------------
+
+def _encode_cmp_varint(v: int) -> bytes:
+    """Arbitrary-precision int -> self-delimiting bytes whose memcmp
+    order is numeric order: [0xC0+n][n-byte magnitude] for v >= 0,
+    [0x3F-n][complemented magnitude] for v < 0 (longer negative
+    magnitudes get smaller prefixes; magnitudes <= 62 bytes, i.e.
+    ~496 bits — plenty beyond the reference's practical range)."""
+    if v >= 0:
+        mag = v.to_bytes((v.bit_length() + 7) // 8, "big") if v else b""
+        if len(mag) > 62:
+            raise ValueError("varint key value too large")
+        return bytes([0xC0 + len(mag)]) + mag
+    m = -v
+    mag = m.to_bytes((m.bit_length() + 7) // 8, "big")
+    if len(mag) > 62:
+        raise ValueError("varint key value too large")
+    return bytes([0x3F - len(mag)]) + bytes(0xFF - b for b in mag)
+
+
+def _decode_cmp_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    first = buf[pos]
+    pos += 1
+    if first >= 0xC0:
+        n = first - 0xC0
+        mag = buf[pos:pos + n]
+        return (int.from_bytes(mag, "big") if n else 0), pos + n
+    n = 0x3F - first
+    mag = bytes(0xFF - b for b in buf[pos:pos + n])
+    return -int.from_bytes(mag, "big"), pos + n
+
+
+def _encode_decimal(value) -> bytes:
+    """decimal.Decimal -> comparable payload: class byte (0x10 neg /
+    0x20 zero / 0x30 pos), then comparable (adjusted exponent, digit
+    string) — negatives complemented so order reverses. Matches the
+    reference's ordering contract (src/yb/util/decimal.h): trailing
+    zeros are insignificant, exponent dominates, digits tiebreak."""
+    import decimal
+
+    d = decimal.Decimal(value)
+    if d.is_nan() or d.is_infinite():
+        raise ValueError("NaN/Infinity decimals are not storable")
+    if d == 0:
+        return b"\x20"
+    sign, digits, exp = d.normalize().as_tuple()
+    adj = exp + len(digits) - 1
+    body = _encode_cmp_varint(adj) + bytes(dd + 1 for dd in digits) \
+        + b"\x00"
+    if sign:
+        return b"\x10" + bytes(0xFF - b for b in body)
+    return b"\x30" + body
+
+
+def _decode_decimal(buf: bytes, pos: int):
+    import decimal
+
+    cls = buf[pos]
+    pos += 1
+    if cls == 0x20:
+        return decimal.Decimal(0), pos
+    neg = cls == 0x10
+    if neg:
+        # Complement lazily: find the complemented terminator (0xFF).
+        first = 0xFF - buf[pos]
+        n = (first - 0xC0) if first >= 0xC0 else (0x3F - first)
+        vpos = pos + 1 + n
+        adj, _ = _decode_cmp_varint(
+            bytes(0xFF - b for b in buf[pos:vpos]), 0)
+        digits = []
+        while buf[vpos] != 0xFF:
+            digits.append((0xFF - buf[vpos]) - 1)
+            vpos += 1
+        pos = vpos + 1
+    else:
+        adj, vpos = _decode_cmp_varint(buf, pos)
+        digits = []
+        while buf[vpos] != 0x00:
+            digits.append(buf[vpos] - 1)
+            vpos += 1
+        pos = vpos + 1
+    ds = "".join(str(dd) for dd in digits)
+    text = f"{'-' if neg else ''}{ds[0]}.{ds[1:] or '0'}E{adj}"
+    return decimal.Decimal(text).normalize(), pos
+
+
+def _infer_component_dtype(value) -> DataType:
+    """Runtime dtype of a tuple/frozen element (elements self-describe
+    via their tags, so nested containers need no schema plumbing)."""
+    import datetime
+    import decimal
+    import uuid as _uuid
+
+    from yugabyte_db_tpu.models.datatypes import Inet, TimeUuid
+
+    if isinstance(value, bool):
+        return DataType.BOOL
+    if isinstance(value, int):
+        return DataType.INT64
+    if isinstance(value, float):
+        return DataType.DOUBLE
+    if isinstance(value, str):
+        return DataType.STRING
+    if isinstance(value, (bytes, bytearray)):
+        return DataType.BINARY
+    if isinstance(value, decimal.Decimal):
+        return DataType.DECIMAL
+    if isinstance(value, TimeUuid):
+        return DataType.TIMEUUID
+    if isinstance(value, _uuid.UUID):
+        return DataType.UUID
+    if isinstance(value, Inet):
+        return DataType.INET
+    if isinstance(value, datetime.datetime):
+        raise ValueError("datetime not valid in a key component")
+    if isinstance(value, datetime.date):
+        return DataType.DATE
+    if isinstance(value, datetime.time):
+        return DataType.TIME
+    if isinstance(value, tuple):
+        return DataType.TUPLE
+    if isinstance(value, (list, set, frozenset, dict)):
+        return DataType.FROZEN
+    raise ValueError(f"cannot infer key dtype of {type(value)}")
 
 
 def _encode_int64(v: int) -> bytes:
@@ -109,8 +245,21 @@ def encode_key_component(value, dtype: DataType) -> bytes:
         return bytes([TAG_NULL])
     if dtype == DataType.BOOL:
         return bytes([TAG_TRUE if value else TAG_FALSE])
+    if dtype == DataType.DATE:
+        import datetime
+
+        days = (value - datetime.date(1970, 1, 1)).days
+        return bytes([TAG_DATE]) + struct.pack(">I", days + (1 << 31))
+    if dtype == DataType.TIME:
+        ns = ((value.hour * 60 + value.minute) * 60
+              + value.second) * 10**9 + value.microsecond * 1000
+        return bytes([TAG_TIME]) + struct.pack(">Q", ns)
     if dtype.is_integer:
         return bytes([TAG_INT]) + _encode_int64(int(value))
+    if dtype == DataType.VARINT:
+        return bytes([TAG_VARINT]) + _encode_cmp_varint(int(value))
+    if dtype == DataType.DECIMAL:
+        return bytes([TAG_DECIMAL]) + _encode_decimal(value)
     if dtype in (DataType.FLOAT, DataType.DOUBLE):
         return bytes([TAG_DOUBLE]) + _encode_double(float(value))
     if dtype == DataType.STRING:
@@ -118,7 +267,60 @@ def encode_key_component(value, dtype: DataType) -> bytes:
             value.encode("utf-8", "surrogateescape"))
     if dtype == DataType.BINARY:
         return bytes([TAG_BINARY]) + _encode_str_bytes(bytes(value))
+    if dtype == DataType.UUID:
+        return bytes([TAG_UUID]) + value.bytes  # UUID or TimeUuid
+    if dtype == DataType.TIMEUUID:
+        from yugabyte_db_tpu.models.datatypes import TimeUuid
+
+        tu = value if isinstance(value, TimeUuid) else TimeUuid(value)
+        return bytes([TAG_TIMEUUID]) + struct.pack(">Q", tu.u.time) \
+            + tu.bytes
+    if dtype == DataType.INET:
+        from yugabyte_db_tpu.models.datatypes import Inet
+
+        inet = value if isinstance(value, Inet) else Inet(value)
+        return bytes([TAG_INET, inet.version]) + inet.packed
+    if dtype == DataType.TUPLE:
+        out = bytearray([TAG_TUPLE])
+        for el in value:
+            out += encode_key_component(
+                el, _infer_component_dtype(el) if el is not None
+                else DataType.NULL)
+        out.append(GROUP_END)
+        return bytes(out)
+    if dtype == DataType.FROZEN:
+        return bytes([TAG_FROZEN]) + _encode_frozen(value)
     raise ValueError(f"type {dtype} not valid in a key")
+
+
+def _encode_frozen(value) -> bytes:
+    """Canonical comparable bytes of a frozen container: kind byte
+    (list 0x05 / set 0x06 / map 0x07), then self-describing element
+    components, GROUP_END-terminated (sets sorted; maps sorted by key,
+    flattened k,v — CQL frozen-collection comparison semantics)."""
+    def comp(el):
+        return encode_key_component(
+            el, _infer_component_dtype(el) if el is not None
+            else DataType.NULL)
+
+    out = bytearray()
+    if isinstance(value, (list, tuple)):
+        out.append(0x05)
+        items = list(value)
+    elif isinstance(value, (set, frozenset)):
+        out.append(0x06)
+        items = sorted(value, key=comp)
+    elif isinstance(value, dict):
+        out.append(0x07)
+        items = []
+        for k in sorted(value, key=comp):
+            items += [k, value[k]]
+    else:
+        raise ValueError(f"not a frozen container: {type(value)}")
+    for el in items:
+        out += comp(el)
+    out.append(GROUP_END)
+    return bytes(out)
 
 
 def decode_key_component(buf: bytes, pos: int) -> tuple[object, int]:
@@ -133,6 +335,25 @@ def decode_key_component(buf: bytes, pos: int) -> tuple[object, int]:
         return True, pos
     if tag == TAG_INT:
         return _decode_int64(buf[pos:pos + 8]), pos + 8
+    if tag == TAG_DATE:
+        import datetime
+
+        days = struct.unpack(">I", buf[pos:pos + 4])[0] - (1 << 31)
+        return (datetime.date(1970, 1, 1)
+                + datetime.timedelta(days=days)), pos + 4
+    if tag == TAG_TIME:
+        import datetime
+
+        ns = struct.unpack(">Q", buf[pos:pos + 8])[0]
+        us, _ = divmod(ns, 1000)
+        s, us = divmod(us, 10**6)
+        m, s = divmod(s, 60)
+        h, m = divmod(m, 60)
+        return datetime.time(h, m, s, us), pos + 8
+    if tag == TAG_VARINT:
+        return _decode_cmp_varint(buf, pos)
+    if tag == TAG_DECIMAL:
+        return _decode_decimal(buf, pos)
     if tag == TAG_DOUBLE:
         return _decode_double(buf[pos:pos + 8]), pos + 8
     if tag == TAG_STRING:
@@ -140,6 +361,42 @@ def decode_key_component(buf: bytes, pos: int) -> tuple[object, int]:
         return raw.decode("utf-8", "surrogateescape"), pos
     if tag == TAG_BINARY:
         return _decode_str_bytes(buf, pos)
+    if tag == TAG_UUID:
+        import uuid as _uuid
+
+        return _uuid.UUID(bytes=bytes(buf[pos:pos + 16])), pos + 16
+    if tag == TAG_TIMEUUID:
+        from yugabyte_db_tpu.models.datatypes import TimeUuid
+        import uuid as _uuid
+
+        raw = bytes(buf[pos + 8:pos + 24])
+        return TimeUuid(_uuid.UUID(bytes=raw)), pos + 24
+    if tag == TAG_INET:
+        from yugabyte_db_tpu.models.datatypes import Inet
+
+        version = buf[pos]
+        n = 4 if version == 4 else 16
+        return Inet(bytes(buf[pos + 1:pos + 1 + n])), pos + 1 + n
+    if tag == TAG_TUPLE:
+        out = []
+        while buf[pos] != GROUP_END:
+            v, pos = decode_key_component(buf, pos)
+            out.append(v)
+        return tuple(out), pos + 1
+    if tag == TAG_FROZEN:
+        kind = buf[pos]
+        pos += 1
+        items = []
+        while buf[pos] != GROUP_END:
+            v, pos = decode_key_component(buf, pos)
+            items.append(v)
+        pos += 1
+        if kind == 0x05:
+            return items, pos
+        if kind == 0x06:
+            return items, pos  # sets normalize to sorted lists
+        pairs = dict(zip(items[::2], items[1::2]))
+        return pairs, pos
     raise ValueError(f"unknown key tag 0x{tag:02x} at {pos - 1}")
 
 
@@ -204,6 +461,34 @@ def hashed_prefix(buf: bytes) -> bytes:
     while pos < len(buf) and buf[pos] != GROUP_END:
         _v, pos = decode_key_component(buf, pos)
     return bytes(buf[:pos + 1])
+
+
+_EXT_TYPES = None
+
+
+def encode_component_value(v) -> bytes | None:
+    """Rich QL scalar -> its byte-comparable component bytes, or None
+    when v is not one (the tagged codec's T_EXT payload; utils/codec.py
+    and native/tagcodec.h both call this)."""
+    global _EXT_TYPES
+    if _EXT_TYPES is None:
+        import datetime
+        import decimal
+        import uuid as _uuid
+
+        from yugabyte_db_tpu.models.datatypes import Inet, TimeUuid
+
+        _EXT_TYPES = (decimal.Decimal, _uuid.UUID, TimeUuid, Inet,
+                      datetime.date, datetime.time)
+    if not isinstance(v, _EXT_TYPES):
+        return None
+    return encode_key_component(v, _infer_component_dtype(v))
+
+
+def decode_component_value(raw: bytes):
+    """T_EXT payload -> the rich scalar value."""
+    v, _pos = decode_key_component(raw, 0)
+    return v
 
 
 def prefix_successor(prefix: bytes) -> bytes:

@@ -90,6 +90,21 @@ class StorageEngine(abc.ABC):
         serving hundreds of concurrent YCSB clients per tserver."""
         return [self.scan(s) for s in specs]
 
+    def scan_batch_wire(self, specs: list[ScanSpec], fmt: str = "cql"):
+        """Execute many scans and return each result as serialized
+        protocol bytes (host_page.WirePage): fmt "cql" = CQL binary
+        cells, "pg" = PG text DataRow messages. This base implementation
+        scans then serializes in Python (models.wirefmt — the format
+        definition); the TPU engine overrides the LIMIT-page path with
+        the native wire page server, which emits the same bytes straight
+        from plane buffers. Reference contract: rows serialize once into
+        rows_data (src/yb/common/ql_rowblock.h:66) and the YQL frontends
+        forward bytes."""
+        from yugabyte_db_tpu.storage.host_page import wire_from_result
+
+        return [wire_from_result(self, r, fmt)
+                for r in self.scan_batch(specs)]
+
     # -- lifecycle ---------------------------------------------------------
     @abc.abstractmethod
     def flush(self) -> None:

@@ -458,6 +458,245 @@ def serve_pages(engine, items):
     return out
 
 
+# -- native wire pages ---------------------------------------------------
+#
+# Result pages serialized straight to protocol bytes (CQL cells / PG
+# DataRow messages) by native/writeplane.cc's WireEmit — the hot path
+# never constructs a Python value object per cell. Plane-resident types
+# (ints, doubles, bools) encode inline in C; varlen/f32 payloads and key
+# columns ride per-run pre-encoded blobs (one-time O(run) cost, like the
+# reference encoding each SSTable block once). Reference contract:
+# QLRowBlock::Serialize rows_data (src/yb/common/ql_rowblock.h:66),
+# forwarded untouched by the CQL service (cql_processor.cc).
+
+WIRE_CQL = 0
+WIRE_PG = 1
+
+
+class WirePage:
+    """One serialized result page (scan_batch_wire output)."""
+
+    __slots__ = ("columns", "data", "nrows", "resume", "scanned",
+                 "read_ht")
+
+    def __init__(self, columns, data, nrows, resume, scanned,
+                 read_ht=None):
+        self.columns = columns
+        self.data = data
+        self.nrows = nrows
+        self.resume = resume
+        self.scanned = scanned
+        self.read_ht = read_ht
+
+
+def _wire_blob_cache(trun):
+    cache = getattr(trun, "_wire_blobs", None)
+    if cache is None:
+        cache = trun._wire_blobs = {}
+    return cache
+
+
+def _encode_blob(values, enc):
+    """Value list -> (offsets int64[n+1], payload blob). None -> empty
+    payload (the nn mask gates NULL at emit time; key columns are never
+    None on valid rows)."""
+    enc_vals = [b"" if v is None else enc(v) for v in values]
+    offsets = np.zeros(len(enc_vals) + 1, dtype=np.int64)
+    if enc_vals:
+        np.cumsum(np.fromiter(map(len, enc_vals), np.int64,
+                              len(enc_vals)), out=offsets[1:])
+    return offsets, b"".join(enc_vals)
+
+
+def _key_wire_blob(engine, trun, pos, fmt):
+    """Pre-encoded payload blob for key column `pos` (per run+fmt)."""
+    cache = _wire_blob_cache(trun)
+    hit = cache.get(("key", pos, fmt))
+    if hit is not None:
+        return hit
+    crun = trun.crun
+    if crun.B * crun.R > NATIVE_PAGE_OBJ_MAX:
+        return None
+    from yugabyte_db_tpu.models import wirefmt
+
+    dt = engine.schema.key_columns[pos].dtype
+    vals = crun.key_col_arrays(None)[pos].tolist()
+    if fmt == WIRE_CQL:
+        w = wirefmt.CQL_INT_WIDTH.get(dt)
+        if w is not None:
+            # Vectorized: big-endian fixed-width ints straight to bytes.
+            arr = np.array([0 if v is None else v for v in vals],
+                           dtype=np.int64)
+            blob = arr.astype({1: ">i1", 2: ">i2", 4: ">i4",
+                               8: ">i8"}[w]).tobytes()
+            offsets = np.arange(len(vals) + 1, dtype=np.int64) * w
+            entry = (offsets, blob)
+        else:
+            entry = _encode_blob(vals, lambda v: wirefmt.cql_cell(dt, v)
+                                 or b"")
+    else:
+        entry = _encode_blob(vals, wirefmt.pg_text)
+    cache[("key", pos, fmt)] = entry
+    return entry
+
+
+def _obj_wire_blob(engine, trun, cid, fmt):
+    """Pre-encoded payload blob for a host-payload value column."""
+    cache = _wire_blob_cache(trun)
+    hit = cache.get(("val", cid, fmt))
+    if hit is not None:
+        return hit
+    crun = trun.crun
+    if crun.B * crun.R > NATIVE_PAGE_OBJ_MAX:
+        return None
+    from yugabyte_db_tpu.models import wirefmt
+
+    dt = engine._dtypes[cid]
+    vals = _native_obj_col(engine, trun, cid)
+    if fmt == WIRE_CQL:
+        entry = _encode_blob(vals, lambda v: wirefmt.cql_cell(dt, v)
+                             or b"")
+    else:
+        entry = _encode_blob(vals, wirefmt.pg_text)
+    cache[("val", cid, fmt)] = entry
+    return entry
+
+
+def _native_wirespecs(engine, trun, projection, notnull, fmt):
+    """Per-column wire emit specs for yb_wp.serve_page_wire_batch, or
+    None when this projection can't be wire-served natively (caller
+    falls back to rows + Python serialization)."""
+    from yugabyte_db_tpu.models.wirefmt import CQL_INT_WIDTH
+
+    key_col_pos = {c.name: i
+                   for i, c in enumerate(engine.schema.key_columns)}
+    hi_cols = trun.host_index.cols
+    specs = []
+    for nm in projection:
+        if nm in key_col_pos:
+            kb = _key_wire_blob(engine, trun, key_col_pos[nm], fmt)
+            if kb is None:
+                return None
+            specs.append(("wblob", kb[0], kb[1]))
+            continue
+        cid = engine._name_to_id.get(nm)
+        if cid is None:
+            return None
+        kind = engine._kinds[cid]
+        dt = engine._dtypes[cid]
+        nn = notnull[cid]
+        if kind == "i64":
+            specs.append(("wi64", hi_cols[cid][2], nn))
+        elif kind == "f64":
+            if fmt == WIRE_CQL:
+                specs.append(("wf64", hi_cols[cid][2], nn))
+            else:  # PG text floats: repr parity via pre-encoded payloads
+                ob = _obj_wire_blob(engine, trun, cid, fmt)
+                if ob is None:
+                    return None
+                specs.append(("wblob", ob[0], ob[1], nn))
+        elif dt == DataType.BOOL:
+            specs.append(("wbool", hi_cols[cid][2], nn))
+        elif kind == "i32":
+            w = CQL_INT_WIDTH.get(dt)
+            if fmt == WIRE_CQL and w is None:
+                return None
+            specs.append(("wi32", hi_cols[cid][2], nn, w or 4))
+        else:  # str / f32 / opaque payloads
+            ob = _obj_wire_blob(engine, trun, cid, fmt)
+            if ob is None:
+                return None
+            specs.append(("wblob", ob[0], ob[1], nn))
+    return tuple(specs)
+
+
+def serve_pages_wire(engine, items, fmt):
+    """Serve pages as wire bytes: items is [(trun, spec, pred_items)];
+    returns [WirePage | None] in items order (None = not natively
+    servable; caller falls back). Pages sharing (run, read point,
+    predicates, projection, limit) ride ONE native call."""
+    out = [None] * len(items)
+    if _native is None or not hasattr(_native, "serve_page_wire_batch"):
+        return out
+    groups: dict = {}
+    cs_cache: dict = {}
+    for i, (trun, spec, pred_items) in enumerate(items):
+        idx = trun.host_index
+        if idx is None:
+            idx = trun.host_index = HostPageIndex(trun.crun)
+        read_planes = engine._read_plane_ints(spec)
+        projection = tuple(spec.projection
+                           or (c.name for c in engine.schema.columns))
+        ck = (id(trun), read_planes, pred_items, projection, fmt,
+              spec.limit)
+        g = groups.get(ck)
+        if g is None:
+            cached = cs_cache.get(ck)
+            if cached is None:
+                with idx._lock:
+                    cached = idx._colspec_cache.get(ck)
+                if cached is None:
+                    masks = idx.masks(read_planes, pred_items)
+                    specs = _native_wirespecs(engine, trun, projection,
+                                              masks[2], fmt)
+                    cached = ((list(projection), specs, masks)
+                              if specs is not None else False)
+                    with idx._lock:
+                        if len(idx._colspec_cache) >= \
+                                2 * _MASK_CACHE_ENTRIES:
+                            idx._colspec_cache.pop(
+                                next(iter(idx._colspec_cache)))
+                        idx._colspec_cache[ck] = cached
+                cs_cache[ck] = cached
+            if cached is False:
+                continue  # not wire-servable: leave None
+            g = groups[ck] = (trun, cached, [], [], [])
+        g[2].append(i)
+        g[3].append(spec.lower)
+        g[4].append(spec.upper or b"")
+    for trun, (cols_list, wirespecs, masks), idxs, lowers, uppers \
+            in groups.values():
+        match_idx, exists_idx, _nn = masks
+        blob, offsets, valid_rows = _native_key_ctx(trun)
+        ulist = uppers if any(uppers) else None
+        limit = items[idxs[0]][1].limit
+        served = _native.serve_page_wire_batch(
+            blob, offsets, valid_rows, match_idx, exists_idx, wirespecs,
+            lowers, ulist, -1 if limit is None else limit, fmt)
+        for i, (data, nrows, scanned, resume) in zip(idxs, served):
+            out[i] = WirePage(cols_list, data, nrows, resume, scanned)
+    return out
+
+
+def wire_from_result(engine, res: ScanResult, fmt) -> WirePage:
+    """ScanResult -> WirePage via the Python serializer (the fallback
+    twin of the native emitter; models.wirefmt defines the bytes)."""
+    from yugabyte_db_tpu.models import wirefmt
+
+    fmt_name = "cql" if fmt in (WIRE_CQL, "cql") else "pg"
+    by_name = {c.name: c.dtype for c in engine.schema.columns}
+    dts = []
+    for i, nm in enumerate(res.columns):
+        dt = by_name.get(nm)
+        if dt is None:  # computed column (aggregate): infer from values
+            dt = DataType.INT64
+            for row in res.rows:
+                v = row[i]
+                if v is None:
+                    continue
+                dt = (DataType.BOOL if isinstance(v, bool)
+                      else DataType.INT64 if isinstance(v, int)
+                      else DataType.DOUBLE if isinstance(v, float)
+                      else DataType.BINARY
+                      if isinstance(v, (bytes, bytearray))
+                      else DataType.STRING)
+                break
+        dts.append(dt)
+    data = wirefmt.serialize_rows(fmt_name, dts, res.rows)
+    return WirePage(list(res.columns), data, len(res.rows),
+                    res.resume_key, res.rows_scanned)
+
+
 def _decode_value_col(engine, trun, name, sel, notnull):
     crun = trun.crun
     cid = engine._name_to_id[name]

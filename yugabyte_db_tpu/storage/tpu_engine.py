@@ -112,6 +112,8 @@ class TpuStorageEngine(StorageEngine):
         # tuple holds strong refs so nothing it names can be collected
         # and identity-reused underneath it.
         self._overlay_cache = None
+        self._read_plane_cache: dict = {}
+        self._wire_dtype_cache: dict = {}
         from yugabyte_db_tpu.storage.run_io import RunPersistence
 
         self.persist = RunPersistence(self.options.get("data_dir"))
@@ -787,6 +789,122 @@ class TpuStorageEngine(StorageEngine):
         return _AsyncBatch(self, results, host_plans, issued_outs,
                            gathers, states, pending, dispatches, pages)
 
+    def scan_batch_wire(self, specs: list[ScanSpec], fmt: str = "cql"):
+        """Wire-serialized pages with the native fast path: LIMIT pages
+        on a single flat run with host-exact predicates serialize to
+        protocol bytes entirely in C (host_page.serve_pages_wire /
+        native serve_page_wire_batch) — no Python value objects on the
+        hot path. Point gets (exact-key ranges) keep a dedicated
+        bloom-pruned per-key path that stays fast with a live memtable
+        and overlapping runs. Everything else (multi-source range
+        scans, aggregates, superset predicates) takes the
+        scan + Python-serialize fallback, which produces identical
+        bytes (models.wirefmt)."""
+        fmt_id = host_page.WIRE_CQL if fmt == "cql" else host_page.WIRE_PG
+        out = [None] * len(specs)
+        mem = self.memtable
+        fast_ok = (len(self.runs) == 1 and mem.is_empty
+                   and self.runs[0].crun.num_versions > 0
+                   and self.runs[0].crun.max_group_versions <= 1)
+        slow_idx: list[int] = []
+        slow_specs: list[ScanSpec] = []
+        if fast_ok:
+            trun = self.runs[0]
+            items, item_idx = [], []
+            for i, spec in enumerate(specs):
+                if (spec.limit is not None
+                        and spec.limit <= host_page.MAX_PAGE_LIMIT
+                        and not spec.is_aggregate and not spec.group_by):
+                    pred_items = host_page.encode_pred_items(
+                        self, spec.predicates)
+                    if pred_items is not None:
+                        items.append((trun, spec, pred_items))
+                        item_idx.append(i)
+                        continue
+                slow_idx.append(i)
+                slow_specs.append(spec)
+            if items:
+                served = host_page.serve_pages_wire(self, items, fmt_id)
+                for i, pg in zip(item_idx, served):
+                    if pg is None:
+                        slow_idx.append(i)
+                        slow_specs.append(specs[i])
+                    else:
+                        out[i] = pg
+        else:
+            for i, spec in enumerate(specs):
+                if self._is_point_get(spec):
+                    out[i] = self._point_get_wire(spec, fmt_id, mem)
+                else:
+                    slow_idx.append(i)
+                    slow_specs.append(spec)
+        if slow_specs:
+            for i, pg in zip(slow_idx,
+                             super().scan_batch_wire(slow_specs, fmt)):
+                out[i] = pg
+        return out
+
+    @staticmethod
+    def _is_point_get(spec: ScanSpec) -> bool:
+        """Exact-key range (the processor's point-read shape:
+        [key, key + 0xff)): at most one doc key can fall inside because
+        doc-key encodings are prefix-free."""
+        return (bool(spec.lower) and not spec.is_aggregate
+                and not spec.group_by
+                and spec.upper == spec.lower + b"\xff")
+
+    def _point_get_wire(self, spec: ScanSpec, fmt_id, mem):
+        """Bloom-pruned per-key read that stays O(log run) with a live
+        memtable and overlapping runs: per-run binary search for the
+        key's versions + memtable lookup + host merge — the reference's
+        DocRowwiseIterator point-get over the IntentAwareIterator
+        (src/yb/docdb/doc_rowwise_iterator.cc) without the scan
+        machinery. Serialization is the Python twin (one row)."""
+        from yugabyte_db_tpu.models import wirefmt
+        from yugabyte_db_tpu.models.encoding import hashed_prefix
+
+        key = spec.lower
+        versions: list[RowVersion] = []
+        hp = hashed_prefix(key)
+        for t in self.runs:
+            crun = t.crun
+            if crun.num_versions == 0 or crun.max_key < key \
+                    or crun.min_key > key:
+                continue
+            if hp and not crun.may_contain_hashed(hp):
+                continue
+            versions.extend(crun.find_versions(key))
+        versions.extend(mem.versions(key))
+        projection = spec.projection or [c.name for c in
+                                         self.schema.columns]
+        rows: list[tuple] = []
+        if versions:
+            merged = merge_versions(key, versions, spec.read_ht)
+            if merged.exists:
+                key_vals = self.mat.key_values(key)
+                if self.mat.matches(spec, key_vals, merged):
+                    rows.append(tuple(
+                        self.mat.value(nm, key_vals, merged)
+                        for nm in projection))
+        dts = self._wire_dtypes(tuple(projection))
+        data = wirefmt.serialize_rows(
+            "cql" if fmt_id == host_page.WIRE_CQL else "pg", dts, rows)
+        resume = (key + b"\x00" if spec.limit is not None
+                  and len(rows) >= spec.limit else None)
+        return host_page.WirePage(list(projection), data, len(rows),
+                                  resume, 1 if versions else 0)
+
+    def _wire_dtypes(self, projection: tuple):
+        dts = self._wire_dtype_cache.get(projection)
+        if dts is None:
+            by_name = {c.name: c.dtype for c in self.schema.columns}
+            dts = [by_name[nm] for nm in projection]
+            if len(self._wire_dtype_cache) >= 64:
+                self._wire_dtype_cache.pop(
+                    next(iter(self._wire_dtype_cache)))
+            self._wire_dtype_cache[projection] = dts
+        return dts
+
     def _issue_round(self, states, pending):
         """Group every active gather's pending param-rows by (signature,
         run) into vmapped dispatches; returns [(chunk, out_array)]."""
@@ -1233,9 +1351,18 @@ class TpuStorageEngine(StorageEngine):
                            w_last, row_hi)
 
     def _read_plane_ints(self, spec: ScanSpec):
+        # Tiny keyed cache: servers issue thousands of pages at the same
+        # read point and the plane math costs ~µs/page at wire rates.
+        cached = self._read_plane_cache.get(spec.read_ht)
+        if cached is not None:
+            return cached
         r_hi, r_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT))
         e_hi, e_lo = P.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
-        return (r_hi, r_lo, e_hi, e_lo)
+        planes = (r_hi, r_lo, e_hi, e_lo)
+        if len(self._read_plane_cache) >= 64:
+            self._read_plane_cache.pop(next(iter(self._read_plane_cache)))
+        self._read_plane_cache[spec.read_ht] = planes
+        return planes
 
     def _gather_sig(self, ctx, M, packed=True, K=WINDOW_BLOCKS):
         from yugabyte_db_tpu.ops import row_gather

@@ -407,6 +407,12 @@ class Tablet:
     def scan(self, spec: ScanSpec) -> ScanResult:
         return self.engine.scan(spec)
 
+    def scan_wire(self, spec: ScanSpec, fmt: str = "cql"):
+        """Scan serving serialized protocol bytes (storage page server;
+        reference: rows_data serialized once at the tablet,
+        src/yb/common/ql_rowblock.h:66)."""
+        return self.engine.scan_batch_wire([spec], fmt)[0]
+
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         """Flush memtable to a durable run, advance the replay frontier,

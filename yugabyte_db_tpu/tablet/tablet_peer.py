@@ -344,6 +344,16 @@ class TabletPeer:
               res.rows_scanned)
         return res
 
+    def scan_wire(self, spec: ScanSpec, fmt: str = "cql",
+                  allow_stale: bool = False):
+        """Wire-serialized scan (leader-with-lease gate as scan)."""
+        if not allow_stale:
+            if not self.raft.is_leader():
+                raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+            if not self.raft.has_lease():
+                raise NotLeader(self.node_uuid, None)
+        return self.tablet.scan_wire(spec, fmt)
+
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         with self._maintenance_lock:

@@ -739,6 +739,40 @@ class TabletServer:
         out["read_ht"] = spec.read_ht
         return out
 
+    def _h_ts_scan_wire(self, p: dict):
+        """Scan returning SERIALIZED result-page bytes (fmt "cql" = CQL
+        cells, "pg" = PG DataRow messages) — the reference's rows_data
+        contract (src/yb/common/ql_rowblock.h:66): rows serialize once
+        at the tablet and every layer above forwards bytes."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if p.get("propagated_ht"):
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
+
+            peer.tablet.clock.update(_HT(p["propagated_ht"]))
+        spec = wire.decode_spec(p["spec"])
+        if spec.read_ht == wire.MAX_HT:
+            spec.read_ht = peer.read_time().value
+        else:
+            err = self._pin_read_point(peer, spec.read_ht,
+                                       p.get("timeout", 4.0))
+            if err is not None:
+                return err
+        err = self._resolve_read_intents(peer, spec)
+        if err is not None:
+            return err
+        TRACE("read point resolved (wire)")
+        try:
+            pg = peer.scan_wire(spec, p.get("fmt", "cql"),
+                                allow_stale=p.get("allow_stale", False))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        return {"code": "ok", "data": pg.data, "nrows": pg.nrows,
+                "resume": pg.resume, "columns": pg.columns,
+                "read_ht": spec.read_ht}
+
     def _resolve_read_intents(self, peer, spec) -> dict | None:
         """Intent-aware read gate (the IntentAwareIterator contract,
         src/yb/docdb/intent_aware_iterator.h:62-81, as a pre-scan gate):

@@ -9,13 +9,15 @@ Wire grammar (tag byte, then payload):
   N 0x00 | T 0x01 | F 0x02 | I 0x03 varint(zigzag) | D 0x04 8B f64 LE
   S 0x05 varint len + utf8 | B 0x06 varint len + bytes
   L 0x07 varint count + items | M 0x08 varint count + key/value pairs
+  X 0x09 varint len + rich-scalar component bytes (models.encoding)
 """
 
 from __future__ import annotations
 
 import struct
 
-_T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_F64, _T_STR, _T_BYTES, _T_LIST, _T_MAP = range(9)
+(_T_NONE, _T_TRUE, _T_FALSE, _T_INT, _T_F64, _T_STR, _T_BYTES, _T_LIST,
+ _T_MAP, _T_EXT) = range(10)
 
 
 def _write_varint(out: bytearray, v: int) -> None:
@@ -84,7 +86,14 @@ def _encode_into(out: bytearray, v) -> None:
             _encode_into(out, k)
             _encode_into(out, val)
     else:
-        raise TypeError(f"codec cannot encode {type(v).__name__}")
+        from yugabyte_db_tpu.models.encoding import encode_component_value
+
+        comp = encode_component_value(v)
+        if comp is None:
+            raise TypeError(f"codec cannot encode {type(v).__name__}")
+        out.append(_T_EXT)
+        _write_varint(out, len(comp))
+        out += comp
 
 
 def _py_encode(v) -> bytes:
@@ -129,6 +138,11 @@ def _decode_from(buf: bytes, pos: int):
             item, pos = _decode_from(buf, pos)
             items.append(item)
         return items, pos
+    if tag == _T_EXT:
+        from yugabyte_db_tpu.models.encoding import decode_component_value
+
+        n, pos = _read_varint(buf, pos)
+        return decode_component_value(buf[pos:pos + n]), pos + n
     if tag == _T_MAP:
         n, pos = _read_varint(buf, pos)
         d = {}

@@ -8,6 +8,8 @@ executor and pggate use, src/yb/common/ql_value.h, partition.h:204).
 
 from __future__ import annotations
 
+from decimal import InvalidOperation as decimal_InvalidOperation
+
 from yugabyte_db_tpu.models.datatypes import DataType, python_value_matches
 from yugabyte_db_tpu.models.partition import compute_hash_code
 from yugabyte_db_tpu.models.schema import ColumnSchema
@@ -59,6 +61,59 @@ def coerce_value(col: ColumnSchema, value):
         value = float(value)
     if dt == DataType.BINARY and isinstance(value, str):
         value = value.encode("utf-8")
+    # Extended scalar surface: accept the natural literal spellings and
+    # normalize to the rich storage value (reference type parsing:
+    # src/yb/util/decimal.cc, date_time.cc, net/inetaddress.cc).
+    try:
+        if dt == DataType.DECIMAL:
+            import decimal
+
+            if isinstance(value, (int, float, str)):
+                value = decimal.Decimal(str(value))
+            if isinstance(value, decimal.Decimal) and \
+                    (value.is_nan() or value.is_infinite()):
+                raise InvalidArgument(
+                    f"non-finite DECIMAL for {col.name}")
+        elif dt == DataType.VARINT and isinstance(value, str):
+            value = int(value)
+        elif dt in (DataType.UUID, DataType.TIMEUUID) and \
+                isinstance(value, str):
+            import uuid as _uuid
+
+            from yugabyte_db_tpu.models.datatypes import TimeUuid
+
+            u = _uuid.UUID(value)
+            value = TimeUuid(u) if dt == DataType.TIMEUUID else u
+        elif dt == DataType.TIMEUUID:
+            import uuid as _uuid
+
+            from yugabyte_db_tpu.models.datatypes import TimeUuid
+
+            if isinstance(value, _uuid.UUID):
+                value = TimeUuid(value)
+        elif dt == DataType.INET and isinstance(value, (str, bytes)):
+            from yugabyte_db_tpu.models.datatypes import Inet
+
+            value = Inet(value)
+        elif dt == DataType.DATE and isinstance(value, str):
+            import datetime
+
+            value = datetime.date.fromisoformat(value)
+        elif dt == DataType.TIME and isinstance(value, str):
+            import datetime
+
+            value = datetime.time.fromisoformat(value)
+        elif dt == DataType.TUPLE and isinstance(value, (list, tuple)):
+            value = tuple(value)
+        elif dt == DataType.FROZEN and isinstance(value, (set, frozenset)):
+            value = sorted(value, key=lambda v: (type(v).__name__, v))
+        elif dt == DataType.FROZEN and isinstance(value, dict):
+            value = dict(sorted(value.items(),
+                                key=lambda kv: (type(kv[0]).__name__,
+                                                kv[0])))
+    except (ValueError, TypeError, decimal_InvalidOperation) as e:
+        raise InvalidArgument(
+            f"bad {dt.name} literal for {col.name}: {e}") from None
     if not python_value_matches(dt, value):
         raise InvalidArgument(
             f"bad value {value!r} for {col.name} ({dt.name})")

@@ -61,6 +61,19 @@ class RemoteTablet:
         res.read_ht = resp.get("read_ht")
         return res
 
+    def scan_wire(self, spec: ScanSpec, fmt: str = "cql"):
+        """Scan returning serialized page bytes the proxy forwards
+        verbatim (rows_data contract; tserver _h_ts_scan_wire)."""
+        from yugabyte_db_tpu.storage.host_page import WirePage
+
+        resp = self.client.tablet_rpc(
+            self.table_name, self.loc, "ts.scan_wire",
+            {"spec": wire.encode_spec(spec), "fmt": fmt})
+        pg = WirePage(resp.get("columns"), resp["data"], resp["nrows"],
+                      resp.get("resume"), 0)
+        pg.read_ht = resp.get("read_ht")
+        return pg
+
 
 class RemoteTable:
     def __init__(self, client: YBClient, name: str, schema: Schema,

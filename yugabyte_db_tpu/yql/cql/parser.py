@@ -427,7 +427,14 @@ class Parser:
             self.expect_sym("<")
             inner = self._type_with_udt()
             self.expect_sym(">")
-            return inner
+            dt, udt = inner
+            if udt is None and dt in (DataType.LIST, DataType.SET,
+                                      DataType.MAP, DataType.TUPLE):
+                # frozen<collection>: immutable, byte-comparable, valid
+                # in primary keys (reference: common.proto FROZEN +
+                # primitive_value.h kFrozen key encoding).
+                return DataType.FROZEN, None
+            return inner  # frozen<udt> / frozen<scalar>: unchanged
         if t is not None and t.kind == "name":
             try:
                 DataType.parse(t.text)
@@ -441,12 +448,12 @@ class Parser:
             dt = DataType.parse(name)
         except ValueError as e:
             raise InvalidArgument(str(e))
-        if dt in (DataType.LIST, DataType.SET, DataType.MAP) and \
-                self.take_sym("<"):
+        if dt in (DataType.LIST, DataType.SET, DataType.MAP,
+                  DataType.TUPLE) and self.take_sym("<"):
             # element types accepted and discarded: values are stored as
             # host containers; element validation is container-level
             self._type()
-            if self.take_sym(","):
+            while self.take_sym(","):
                 self._type()
             self.expect_sym(">")
         return dt

@@ -46,6 +46,11 @@ class ResultSet:
     # Continuation token when a page filled before the scan finished
     # (reference: QLPagingStatePB riding the RESULT message).
     paging_state: bytes | None = None
+    # Wire path: when set, the result is pre-serialized CQL cell bytes
+    # (wire_rows rows) the server forwards verbatim — rows stays empty
+    # (the rows_data contract, src/yb/common/ql_rowblock.h:66).
+    wire_data: bytes | None = None
+    wire_rows: int = 0
 
     def __iter__(self):
         return iter(self.rows)
@@ -263,16 +268,21 @@ class QLProcessor:
     # -- entry points ------------------------------------------------------
     def execute(self, sql, params: list | None = None,
                 page_size: int | None = None,
-                paging_state: bytes | None = None) -> ResultSet | None:
+                paging_state: bytes | None = None,
+                wire_results: bool = False) -> ResultSet | None:
         """Run one statement. ``sql`` may be a string or a pre-parsed AST
         (the prepared-statement cache passes ASTs). ``params`` binds ``?``
         markers by position; ``page_size``/``paging_state`` drive SELECT
         paging (reference: QLProcessor::RunAsync with a paged
-        StatementParameters, ql_processor.h:86)."""
+        StatementParameters, ql_processor.h:86). ``wire_results=True``
+        (the CQL socket server) lets eligible SELECTs return
+        pre-serialized cell bytes (ResultSet.wire_data) instead of row
+        tuples — the rows_data contract."""
         stmt = parse_statement(sql) if isinstance(sql, str) else sql
         self._params = params or []
         self._page_size = page_size
         self._paging_state = paging_state
+        self._wire_results = wire_results
         self._enforce(stmt)
         fn = {
             ast.CreateKeyspace: self._exec_create_keyspace,
@@ -967,9 +977,37 @@ class QLProcessor:
                 res = self._apply_order_by(stmt, self._run_index_lookup(
                     handle, scan_stmt, plan, idx, pred))
                 return self._slice_limit(stmt, res) if ordered else res
+        if not ordered and getattr(self, "_wire_results", False) and \
+                self._wire_eligible(handle, stmt, plan):
+            return self._run_rows(handle, scan_stmt, plan, wire=True)
         res = self._apply_order_by(
             stmt, self._run_rows(handle, scan_stmt, plan))
         return self._slice_limit(stmt, res) if ordered else res
+
+    def _wire_eligible(self, handle, stmt, plan) -> bool:
+        """Plain row SELECTs whose projection is scalar columns ride the
+        wire path: tablets return serialized CQL cell bytes the server
+        forwards verbatim (reference: rows_data,
+        src/yb/common/ql_rowblock.h:66 -> cql_processor.cc). Aggregates,
+        ORDER BY, aliased/rewritten items, and opaque-typed columns
+        (collections/UDTs serialize driver-specifically) take the row
+        path."""
+        if plan.aggregates or getattr(stmt, "order_by", None):
+            return False
+        schema = handle.schema
+        projection = plan.projection or [c.name for c in schema.columns]
+        if stmt.items and [it.output_name for it in stmt.items] != \
+                list(projection):
+            return False  # aliases: names differ from engine columns
+        for name in projection:
+            dt = schema.column(name).dtype
+            if not dt.is_fixed_width and dt not in (DataType.STRING,
+                                                    DataType.BINARY):
+                return False
+            if getattr(schema.column(name), "udt", None):
+                return False
+        tablets = self._target_tablets(handle, plan)
+        return all(hasattr(t, "scan_wire") for t in tablets)
 
     def _slice_limit(self, stmt, rs: ResultSet) -> ResultSet:
         limit = self._coerce_limit(stmt.limit)
@@ -1112,7 +1150,8 @@ class QLProcessor:
             return [self.cluster.tablet_for_hash(handle, plan.hash_code)]
         return handle.tablets
 
-    def _run_rows(self, handle: TableHandle, stmt: ast.Select, plan):
+    def _run_rows(self, handle: TableHandle, stmt: ast.Select, plan,
+                  wire: bool = False):
         from yugabyte_db_tpu.utils import codec
 
         schema = handle.schema
@@ -1122,6 +1161,7 @@ class QLProcessor:
         else:
             names = list(projection)
         out = ResultSet(columns=names)
+        wire_parts: list[bytes] = []
         tablets = self._target_tablets(handle, plan)
         # Paging token: (tablet index, resume key, LIMIT budget left,
         # pinned read time) — the QLPagingStatePB shape
@@ -1135,6 +1175,12 @@ class QLProcessor:
             start_idx, resume, limit, read_ht = codec.decode(
                 self._paging_state)
         page_left = self._page_size
+
+        def finish():
+            if wire:
+                out.wire_data = b"".join(wire_parts)
+            return out
+
         for idx in range(start_idx, len(tablets)):
             tablet = tablets[idx]
             lower = resume if idx == start_idx else plan.lower
@@ -1146,32 +1192,40 @@ class QLProcessor:
                              else tablet.read_time().value),
                     predicates=plan.predicates,
                     projection=projection, limit=sub_limit)
-                res = tablet.scan(spec)
+                if wire:
+                    res = tablet.scan_wire(spec)
+                    wire_parts.append(res.data)
+                    out.wire_rows += res.nrows
+                    n = res.nrows
+                    resume_key = res.resume
+                else:
+                    res = tablet.scan(spec)
+                    out.rows.extend(res.rows)
+                    n = len(res.rows)
+                    resume_key = res.resume_key
                 if read_ht is None:
                     # Pin the first sub-scan's (server-chosen) read time
                     # for the rest of the scan and for later pages.
                     read_ht = getattr(res, "read_ht", None) or spec.read_ht
-                out.rows.extend(res.rows)
-                n = len(res.rows)
                 if limit is not None:
                     limit -= n
                     if limit <= 0:
-                        return out
+                        return finish()
                 if page_left is not None:
                     page_left -= n
                     if page_left <= 0:
                         # Page full: remember where the scan resumes.
-                        if res.resume_key is not None:
+                        if resume_key is not None:
                             out.paging_state = codec.encode(
-                                [idx, res.resume_key, limit, read_ht])
+                                [idx, resume_key, limit, read_ht])
                         elif idx + 1 < len(tablets):
                             out.paging_state = codec.encode(
                                 [idx + 1, plan.lower, limit, read_ht])
-                        return out
-                if res.resume_key is None:
+                        return finish()
+                if resume_key is None:
                     break
-                lower = res.resume_key
-        return out
+                lower = resume_key
+        return finish()
 
     def _coerce_limit(self, limit):
         return self._require_nonneg_int(self._resolve_marker(limit),

@@ -202,7 +202,8 @@ class CQLServiceImpl:
              paging_state) -> bytes:
         res = processor.execute(stmt, params=params,
                                 page_size=page_size,
-                                paging_state=paging_state)
+                                paging_state=paging_state,
+                                wire_results=True)
         if isinstance(stmt, ast.UseKeyspace):
             return W.set_keyspace_result(stream, stmt.name)
         if isinstance(stmt, (ast.CreateKeyspace, ast.DropKeyspace)):
@@ -223,6 +224,13 @@ class CQLServiceImpl:
     def _rows(self, processor, stream: int, stmt, res: ResultSet) -> bytes:
         table = getattr(stmt, "table", "") or ""
         dts = self._result_types(processor, stmt, res)
+        if res.wire_data is not None:
+            # Pre-serialized cells from the storage wire path: forward
+            # verbatim under the metadata header (rows_data contract).
+            return W.rows_result_wire(
+                stream, processor.keyspace, table.split(".")[-1],
+                list(zip(res.columns, dts)), res.wire_rows,
+                res.wire_data, paging_state=res.paging_state)
         return W.rows_result(
             stream, processor.keyspace, table.split(".")[-1],
             list(zip(res.columns, dts)), res.rows,

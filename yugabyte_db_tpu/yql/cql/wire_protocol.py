@@ -56,11 +56,18 @@ T_BIGINT = 0x0002
 T_BLOB = 0x0003
 T_BOOLEAN = 0x0004
 T_COUNTER = 0x0005
+T_DECIMAL = 0x0006
 T_DOUBLE = 0x0007
 T_FLOAT = 0x0008
 T_INT = 0x0009
 T_TIMESTAMP = 0x000B
+T_UUID = 0x000C
 T_VARCHAR = 0x000D
+T_VARINT = 0x000E
+T_TIMEUUID = 0x000F
+T_INET = 0x0010
+T_DATE = 0x0011
+T_TIME = 0x0012
 T_SMALLINT = 0x0013
 T_TINYINT = 0x0014
 
@@ -76,6 +83,15 @@ _DT_TO_CQL = {
     DataType.BINARY: T_BLOB,
     DataType.TIMESTAMP: T_TIMESTAMP,
     DataType.COUNTER: T_COUNTER,
+    DataType.DECIMAL: T_DECIMAL,
+    DataType.VARINT: T_VARINT,
+    DataType.UUID: T_UUID,
+    DataType.TIMEUUID: T_TIMEUUID,
+    DataType.INET: T_INET,
+    DataType.DATE: T_DATE,
+    DataType.TIME: T_TIME,
+    # TUPLE/FROZEN ship as blobs (self-describing element payloads);
+    # full 0x0031 tuple metadata would need per-element type plumbing.
 }
 
 _INT_WIDTH = {T_TINYINT: 1, T_SMALLINT: 2, T_INT: 4, T_BIGINT: 8,
@@ -188,21 +204,12 @@ def error_frame(stream: int, code: int, message: str) -> bytes:
 # -- typed values (§6) -------------------------------------------------------
 
 def encode_value(dt: DataType, v) -> bytes | None:
-    """Python value -> CQL serialized bytes (None -> null)."""
-    if v is None:
-        return None
-    tid = cql_type_id(dt)
-    if tid in _INT_WIDTH:
-        return int(v).to_bytes(_INT_WIDTH[tid], "big", signed=True)
-    if tid == T_BOOLEAN:
-        return b"\x01" if v else b"\x00"
-    if tid == T_DOUBLE:
-        return struct.pack(">d", float(v))
-    if tid == T_FLOAT:
-        return struct.pack(">f", float(v))
-    if tid == T_VARCHAR:
-        return str(v).encode("utf-8")
-    return bytes(v)  # BLOB
+    """Python value -> CQL serialized bytes (None -> null). The cell
+    format definition lives in models.wirefmt (shared with the native
+    wire page server)."""
+    from yugabyte_db_tpu.models.wirefmt import cql_cell
+
+    return cql_cell(dt, v)
 
 
 def decode_value(dt: DataType, b: bytes | None):
@@ -220,6 +227,39 @@ def decode_value(dt: DataType, b: bytes | None):
         return struct.unpack(">f", b)[0]
     if tid == T_VARCHAR:
         return b.decode("utf-8")
+    if tid == T_VARINT:
+        return int.from_bytes(b, "big", signed=True)
+    if tid == T_DECIMAL:
+        import decimal
+
+        scale = struct.unpack(">i", b[:4])[0]
+        unscaled = int.from_bytes(b[4:], "big", signed=True)
+        return decimal.Decimal(unscaled).scaleb(-scale)
+    if tid in (T_UUID, T_TIMEUUID):
+        import uuid as _uuid
+
+        from yugabyte_db_tpu.models.datatypes import TimeUuid
+
+        u = _uuid.UUID(bytes=b)
+        return TimeUuid(u) if tid == T_TIMEUUID else u
+    if tid == T_INET:
+        from yugabyte_db_tpu.models.datatypes import Inet
+
+        return Inet(b)
+    if tid == T_DATE:
+        import datetime
+
+        days = struct.unpack(">I", b)[0] - (1 << 31)
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+    if tid == T_TIME:
+        import datetime
+
+        ns = struct.unpack(">q", b)[0]
+        us, _ = divmod(ns, 1000)
+        s, us = divmod(us, 10**6)
+        m, s = divmod(s, 60)
+        h, m = divmod(m, 60)
+        return datetime.time(h, m, s, us)
     return b
 
 
@@ -243,6 +283,29 @@ def rows_result(stream: int, keyspace: str, table: str,
         for (name, dt), v in zip(columns, row):
             w.bytes_(encode_value(dt, v))
     return frame(OP_RESULT, stream, w.getvalue())
+
+
+def rows_result_wire(stream: int, keyspace: str, table: str,
+                     columns: list[tuple[str, DataType]], nrows: int,
+                     rows_data: bytes,
+                     paging_state: bytes | None = None) -> bytes:
+    """Rows RESULT from pre-serialized cell bytes (the rows_data
+    contract: the storage layer emitted the cells; this adds only the
+    metadata header). Byte-identical to rows_result over the same
+    rows."""
+    w = Writer().int32(RESULT_ROWS)
+    flags = 0x0001  # global_tables_spec
+    if paging_state is not None:
+        flags |= 0x0002
+    w.int32(flags).int32(len(columns))
+    if paging_state is not None:
+        w.bytes_(paging_state)
+    w.string(keyspace).string(table)
+    for name, dt in columns:
+        w.string(name).short(cql_type_id(dt))
+    w.int32(nrows)
+    body = w.getvalue() + rows_data
+    return frame(OP_RESULT, stream, body)
 
 
 def void_result(stream: int) -> bytes:

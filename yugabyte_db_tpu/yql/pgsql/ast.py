@@ -160,6 +160,18 @@ class Agg:
 
 
 @dataclass
+class Func:
+    """Scalar function call (abs/upper/lower/length/coalesce/round/
+    floor/ceil/concat/mod/substring/nullif/greatest/least), evaluated
+    host-side above the storage seam — the work stock PG's executor does
+    above the FDW (reference capability:
+    src/postgres/src/backend/utils/adt)."""
+
+    name: str
+    args: list
+
+
+@dataclass
 class SelectItem:
     expr: object               # "*" | storage.expr tree | Agg
     alias: str | None = None
@@ -211,3 +223,7 @@ class Select:
     alias: str | None = None           # base-table alias
     joins: list[Join] = field(default_factory=list)
     having: list[HavingRel] = field(default_factory=list)
+    offset: object | None = None       # LIMIT ... OFFSET n
+    # WITH clause: [(name, Select)] evaluated before the body; later
+    # CTEs and the body may reference earlier names as tables.
+    ctes: list = field(default_factory=list)

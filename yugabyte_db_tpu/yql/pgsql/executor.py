@@ -525,6 +525,10 @@ class PgProcessor:
         for rel in where:
             if isinstance(rel.value, ast.SubQuery):
                 rel = self._resolve_subquery(rel)
+            if isinstance(rel.value, X.Col):
+                raise InvalidArgument(
+                    f"column reference {rel.value.name} cannot be used "
+                    f"as a comparison value in this clause")
             if not schema.has_column(rel.column):
                 raise InvalidArgument(f"unknown column {rel.column}")
             col = schema.column(rel.column)
@@ -670,9 +674,8 @@ class PgProcessor:
 
     def _select_from_view(self, stmt: ast.Select, view_sql: str):
         """A SELECT whose FROM names a view: run the stored defining
-        query, then evaluate the outer projection / WHERE / DISTINCT /
-        ORDER BY / LIMIT over its rows in memory (views inside JOINs are
-        not supported yet)."""
+        query, then evaluate the outer query over its rows in memory
+        (views inside JOINs are not supported yet)."""
         if stmt.joins:
             raise InvalidArgument("views cannot be joined yet")
         self._view_depth = getattr(self, "_view_depth", 0) + 1
@@ -683,67 +686,114 @@ class PgProcessor:
             inner = self._exec_select(parse_statement(view_sql))
         finally:
             self._view_depth -= 1
-        dicts = [dict(zip(inner.columns, r)) for r in inner.rows]
-        where = []
+        return self._select_over_rows(stmt, inner.columns, inner.rows)
+
+    def _select_over_rows(self, stmt: ast.Select, columns: list[str],
+                          in_rows: list[tuple]) -> PgResult:
+        """Evaluate a SELECT over an in-memory relation (view result or
+        CTE): WHERE (incl. subquery values), expression/function items,
+        aggregates + GROUP BY + HAVING, DISTINCT, ORDER BY,
+        LIMIT/OFFSET — the executor work stock PG runs over a
+        tuplestore scan (nodeCtescan.c / nodeSubqueryscan.c)."""
+        prefix = (stmt.alias + ".") if stmt.alias else None
+        dicts = []
+        for r in in_rows:
+            d = dict(zip(columns, r))
+            if prefix:
+                for c, v in zip(columns, r):
+                    d[prefix + c] = v
+            dicts.append(d)
+        known = set(columns) | ({prefix + c for c in columns}
+                                if prefix else set())
         for rel in self._resolved_where(stmt.where):
-            if rel.column not in inner.columns:
-                raise InvalidArgument(f"column {rel.column} not in view")
+            if rel.column not in known:
+                raise InvalidArgument(
+                    f"column {rel.column} is not in the relation")
             val = self._resolve(rel.value)
-            where.append((rel.column, rel.op,
-                          tuple(val) if rel.op == "IN" else val))
-        from yugabyte_db_tpu.storage.scan_spec import Predicate
-
-        preds = [Predicate(c, op, v) for c, op, v in where]
-        dicts = [d for d in dicts
-                 if all(p.matches(d.get(p.column)) for p in preds)]
-        if stmt.group_by or any(
-                getattr(it, "expr", None) is not None and
-                not isinstance(it.expr, str) and
-                it.expr.__class__.__name__ == "Agg"
-                for it in stmt.items):
-            raise InvalidArgument(
-                "aggregates over views are not supported yet")
-        names = []
-        if len(stmt.items) == 1 and stmt.items[0].expr == "*":
-            names = list(inner.columns)
-            rows = [tuple(d[c] for c in names) for d in dicts]
-        else:
-            getters = []
-            for it in stmt.items:
-                from yugabyte_db_tpu.storage import expr as X
-
-                e = it.expr
-                if not isinstance(e, X.Col):
+            if isinstance(val, X.Col):
+                if val.name not in known:
                     raise InvalidArgument(
-                        "views support plain column projections")
-                if e.name not in inner.columns:
+                        f"column {val.name} is not in the relation")
+                op = rel.op
+                dicts = [d for d in dicts
+                         if self._cmp(op, d.get(rel.column),
+                                      d.get(val.name))]
+                continue
+            p = Predicate(rel.column, rel.op,
+                          tuple(val) if rel.op == "IN" else val)
+            dicts = [d for d in dicts if p.matches(d.get(p.column))]
+        names, exprs = [], []
+        for it in stmt.items:
+            if it.expr == "*":
+                names.extend(columns)
+                exprs.extend(X.Col(c) for c in columns)
+                continue
+            if isinstance(it.expr, ast.Agg):
+                arg = it.expr.arg
+                names.append(it.alias or
+                             f"{it.expr.fn}({'*' if arg is None else '...'})")
+            elif isinstance(it.expr, X.Col):
+                names.append(it.alias or it.expr.name.split(".")[-1])
+            else:
+                names.append(it.alias or "?column?")
+            exprs.append(it.expr)
+        for e in exprs:
+            for c in self._item_columns(e):
+                if c not in known:
                     raise InvalidArgument(
-                        f"column {e.name} not in view")
-                names.append(it.alias or e.name)
-                getters.append(e.name)
-            rows = [tuple(d[g] for g in getters) for d in dicts]
+                        f"column {c} is not in the relation")
+        has_agg = (stmt.group_by
+                   or any(isinstance(e, ast.Agg) for e in exprs)
+                   or any(isinstance(h.expr, ast.Agg)
+                          for h in stmt.having))
+        limit = self._limit(stmt)
+        if has_agg:
+            rows = self._host_aggregate(stmt, dicts, exprs)
+            if stmt.distinct:
+                rows = list(dict.fromkeys(rows))
+            rows = self._order_and_limit(stmt, names, rows, limit)
+            return PgResult(columns=names, rows=rows)
+        hidden = 0
+        for ob in stmt.order_by:
+            if ob.column not in names and ob.column in known:
+                names.append(ob.column)
+                exprs.append(X.Col(ob.column))
+                hidden += 1
+        rows = [tuple(self._eval_item(e, d) for e in exprs)
+                for d in dicts]
         if stmt.distinct:
-            seen, uniq = set(), []
-            for r in rows:
-                if r not in seen:
-                    seen.add(r)
-                    uniq.append(r)
-            rows = uniq
-        if stmt.order_by:
-            for ob in reversed(stmt.order_by):
-                if ob.column not in names:
-                    raise InvalidArgument(
-                        f"ORDER BY {ob.column} not in output")
-                i = names.index(ob.column)
-                rows.sort(key=lambda r: (r[i] is None, r[i]),
-                          reverse=ob.desc)
-        limit = self._resolve(stmt.limit) if stmt.limit is not None             else None
-        if limit is not None:
-            rows = rows[:int(limit)]
-        return PgResult(columns=names, rows=rows,
-                        command=f"SELECT {len(rows)}")
+            if hidden:
+                raise InvalidArgument(
+                    "for SELECT DISTINCT, ORDER BY expressions must "
+                    "appear in the select list")
+            rows = list(dict.fromkeys(rows))
+        rows = self._order_and_limit(stmt, names, rows, limit)
+        if hidden:
+            rows = [r[:-hidden] for r in rows]
+            names = names[:-hidden]
+        return PgResult(columns=names, rows=rows)
 
     def _exec_select(self, stmt: ast.Select):
+        if getattr(stmt, "ctes", None):
+            # WITH: evaluate each CTE once (PG materializes CTEs); later
+            # CTEs and the body see earlier names. Bindings are scoped
+            # to this statement and restored after (nested statements
+            # keep their own view of the stack).
+            saved = dict(getattr(self, "_cte_results", {}) or {})
+            self._cte_results = dict(saved)
+            try:
+                for name, sel in stmt.ctes:
+                    self._cte_results[name] = self._exec_select(sel)
+                import dataclasses as _dc
+
+                return self._exec_select(_dc.replace(stmt, ctes=[]))
+            finally:
+                self._cte_results = saved
+        cte = (getattr(self, "_cte_results", None) or {}).get(stmt.table)
+        if cte is not None:
+            if stmt.joins:
+                raise InvalidArgument("CTEs cannot be joined yet")
+            return self._select_over_rows(stmt, cte.columns, cte.rows)
         if stmt.table is None:
             # FROM-less SELECT: constant / sequence-function items.
             names, row = [], []
@@ -809,6 +859,8 @@ class PgProcessor:
             return e
 
         needs = (any("." in r.column for r in stmt.where)
+                 or any(isinstance(r.value, X.Col) and "." in r.value.name
+                        for r in stmt.where)
                  or any("." in g for g in stmt.group_by)
                  or any("." in o.column for o in stmt.order_by))
         items = [ast.SelectItem(fix_expr(it.expr)
@@ -820,10 +872,14 @@ class PgProcessor:
             return stmt
         return ast.Select(
             items, stmt.table,
-            [ast.Rel(fix(r.column), r.op, r.value) for r in stmt.where],
+            [ast.Rel(fix(r.column), r.op,
+                     X.Col(fix(r.value.name))
+                     if isinstance(r.value, X.Col) else r.value)
+             for r in stmt.where],
             [fix(g) for g in stmt.group_by],
             [ast.OrderBy(fix(o.column), o.desc) for o in stmt.order_by],
-            stmt.limit, stmt.distinct, stmt.alias, [], having)
+            stmt.limit, stmt.distinct, stmt.alias, [], having,
+            offset=stmt.offset)
 
     # -- joins (above the storage seam; reference capability: the PG
     # executor's hash/merge joins over FDW scans, src/postgres executor) --
@@ -951,10 +1007,28 @@ class PgProcessor:
 
         return self._finish_select(stmt, joined, tables, handles, qualify)
 
-    @staticmethod
-    def _eval_item(expr, d: dict):
-        """Evaluate one select-item expression over a row dict (scalar
-        trees via storage.expr; jsonb paths host-side)."""
+    @classmethod
+    def _eval_item(cls, expr, d: dict):
+        """Evaluate one select-item expression over a row dict: scalar
+        trees (Col/Const/BinOp with SQL NULL propagation), scalar
+        function calls (ast.Func), jsonb paths — the expression work
+        stock PG's executor does above the FDW."""
+        if isinstance(expr, X.Col):
+            return d.get(expr.name)
+        if isinstance(expr, X.Const):
+            return expr.value
+        if isinstance(expr, X.BinOp):
+            left = cls._eval_item(expr.left, d)
+            right = cls._eval_item(expr.right, d)
+            if left is None or right is None:
+                return None
+            return {"+": lambda: left + right,
+                    "-": lambda: left - right,
+                    "*": lambda: left * right}[expr.op]()
+        if isinstance(expr, ast.Func):
+            return cls._eval_func(expr.name,
+                                  [cls._eval_item(a, d)
+                                   for a in expr.args])
         if isinstance(expr, ast.JsonPath):
             import json
 
@@ -978,13 +1052,206 @@ class PgProcessor:
         return X.eval_expr(expr, lambda n: d.get(n))
 
     @staticmethod
-    def _item_columns(expr) -> set:
+    def _eval_func(name: str, args: list):
+        """SQL scalar-function semantics (PG behavior: NULL in -> NULL
+        out except coalesce/concat/greatest/least/nullif)."""
+        if name == "coalesce":
+            return next((a for a in args if a is not None), None)
+        if name == "nullif":
+            a, b = args
+            return None if a == b else a
+        if name == "greatest":
+            vals = [a for a in args if a is not None]
+            return max(vals) if vals else None
+        if name == "least":
+            vals = [a for a in args if a is not None]
+            return min(vals) if vals else None
+        if name == "concat":  # PG concat() treats NULL as ''
+            return "".join("" if a is None else
+                           ("t" if a is True else "f") if isinstance(
+                               a, bool) else str(a) for a in args)
+        if any(a is None for a in args):
+            return None
+        if name == "abs":
+            return abs(args[0])
+        if name == "upper":
+            return str(args[0]).upper()
+        if name == "lower":
+            return str(args[0]).lower()
+        if name == "length":
+            return len(str(args[0]))
+        if name == "round":
+            import math
+
+            v = args[0]
+            if len(args) == 2:
+                # PG rounds halves away from zero (Python: to even).
+                nd = int(args[1])
+                if isinstance(v, int):
+                    if nd >= 0:
+                        return v
+                    scale = 10 ** (-nd)
+                    q = (abs(v) + scale // 2) // scale * scale
+                    return -q if v < 0 else q
+                scale = 10.0 ** nd
+                scaled = v * scale
+                r = (math.floor(scaled + 0.5) if scaled >= 0
+                     else math.ceil(scaled - 0.5))
+                return r / scale
+            if isinstance(v, int):
+                return v
+            return float(math.floor(v + 0.5) if v >= 0
+                         else math.ceil(v - 0.5))
+        if name == "floor":
+            import math
+
+            return (args[0] if isinstance(args[0], int)
+                    else float(math.floor(args[0])))
+        if name in ("ceil", "ceiling"):
+            import math
+
+            return (args[0] if isinstance(args[0], int)
+                    else float(math.ceil(args[0])))
+        if name == "mod":
+            a, b = args
+            # PG mod() takes the dividend's sign (Python %: divisor's);
+            # exact int arithmetic (math.fmod loses >2^53 precision).
+            if isinstance(a, int) and isinstance(b, int):
+                r = abs(a) % abs(b)
+                return -r if a < 0 else r
+            import math
+
+            return math.fmod(a, b)
+        if name in ("substring", "substr"):
+            s = str(args[0])
+            start = int(args[1])
+            ln = int(args[2]) if len(args) > 2 else None
+            # PG 1-based; start can be <= 0 (consumes into the length).
+            if ln is None:
+                return s[max(start - 1, 0):]
+            end = start - 1 + ln
+            return s[max(start - 1, 0):max(end, 0)]
+        raise InvalidArgument(f"unknown function {name}")
+
+    @classmethod
+    def _item_columns(cls, expr) -> set:
         if isinstance(expr, ast.JsonPath):
             return {expr.column}
+        if isinstance(expr, ast.Func):
+            out: set = set()
+            for a in expr.args:
+                out |= cls._item_columns(a)
+            return out
+        if isinstance(expr, ast.Agg):
+            return (cls._item_columns(expr.arg)
+                    if expr.arg is not None else set())
+        if isinstance(expr, X.BinOp):
+            return cls._item_columns(expr.left) | \
+                cls._item_columns(expr.right)
         return X.columns_of(expr)
+
+    def _outer_refs(self, sub: ast.Select, outer_schema,
+                    outer_alias: str):
+        """Outer-column references inside a subquery's WHERE: values
+        spelled as column refs that resolve to the OUTER relation
+        (qualified with its alias, or unqualified names the inner table
+        lacks). Returns {ref_name: outer_column} or None when the
+        subquery is uncorrelated."""
+        try:
+            inner_schema = (self.cluster.table(sub.table).schema
+                            if sub.table else None)
+        except Exception:  # noqa: BLE001 — CTE/view inner: treat plain
+            inner_schema = None
+        prefix = outer_alias + "."
+        refs = {}
+        for rel in sub.where:
+            v = rel.value
+            if not isinstance(v, X.Col):
+                continue
+            name = v.name
+            if name.startswith(prefix):
+                refs[name] = name[len(prefix):]
+            elif "." not in name and inner_schema is not None \
+                    and not inner_schema.has_column(name) \
+                    and outer_schema.has_column(name):
+                refs[name] = name
+        return refs or None
+
+    def _eval_correlated(self, rel: ast.Rel, refs: dict, d: dict,
+                         cache: dict) -> bool:
+        """One correlated-subquery conjunct against one outer row: bind
+        the outer refs to the row's values, run the subquery (memoized
+        on the binding tuple), compare (PG subplan semantics: NULL /
+        empty scalar never matches; >1 scalar row errors)."""
+        key = tuple(d.get(c) for c in refs.values())
+        hit = cache.get(key)
+        if hit is None:
+            import dataclasses as _dc
+
+            sub = rel.value.select
+            new_where = []
+            for r in sub.where:
+                if isinstance(r.value, X.Col) and r.value.name in refs:
+                    new_where.append(ast.Rel(
+                        r.column, r.op, d.get(refs[r.value.name])))
+                else:
+                    new_where.append(r)
+            res = self._exec_select(_dc.replace(sub, where=new_where))
+            if len(res.columns) != 1:
+                raise InvalidArgument(
+                    "subquery must return a single column")
+            hit = cache[key] = [r[0] for r in res.rows]
+        if rel.op == "IN":
+            left = d.get(rel.column)
+            return left is not None and any(
+                left == v for v in hit if v is not None)
+        if len(hit) > 1:
+            raise InvalidArgument(
+                "more than one row returned by a subquery used as "
+                "an expression")
+        v = hit[0] if hit else None
+        return v is not None and self._cmp(rel.op, d.get(rel.column), v)
 
     def _select_rows(self, handle, stmt: ast.Select):
         schema = handle.schema
+        outer_alias = stmt.alias or stmt.table
+        plain, correlated, colcol = [], [], []
+        for rel in stmt.where:
+            if isinstance(rel.value, X.Col):
+                for name in (rel.column, rel.value.name):
+                    if not schema.has_column(name):
+                        raise InvalidArgument(f"unknown column {name}")
+                colcol.append(rel)  # col-vs-col: host filter
+                continue
+            refs = (self._outer_refs(rel.value.select, schema,
+                                     outer_alias)
+                    if isinstance(rel.value, ast.SubQuery) else None)
+            if refs is not None:
+                correlated.append((rel, refs, {}))
+            else:
+                plain.append(rel)
+        if correlated or colcol:
+            import dataclasses as _dc
+
+            # Fetch candidates with the plain predicates pushed down,
+            # then run each correlated subplan per outer row (memoized
+            # per outer-binding tuple — PG's SubPlan rescan shape) and
+            # col-vs-col filters, and finish projection/order/limit
+            # over the survivors.
+            preds = self._predicates(schema, plain)
+            all_names = [c.name for c in schema.columns]
+            survivors = []
+            for d in self._scan_dicts(handle, plain, preds, all_names,
+                                      None):
+                if not all(self._cmp(r.op, d.get(r.column),
+                                     d.get(r.value.name))
+                           for r in colcol):
+                    continue
+                if all(self._eval_correlated(rel, refs, d, cache)
+                       for rel, refs, cache in correlated):
+                    survivors.append(tuple(d.get(c) for c in all_names))
+            return self._select_over_rows(
+                _dc.replace(stmt, where=[]), all_names, survivors)
         preds = self._predicates(schema, stmt.where)
         all_names = [c.name for c in schema.columns]
         names, exprs = [], []
@@ -1010,9 +1277,12 @@ class PgProcessor:
                 hidden += 1
         needed = sorted({c for e in exprs for c in self._item_columns(e)})
         limit = self._limit(stmt)
+        offset = self._offset(stmt)
         # Engine-level LIMIT is only a safe pushdown when no later sort
-        # reorders rows and a single tablet preserves global key order.
-        push_limit = (limit if not stmt.order_by
+        # reorders rows and a single tablet preserves global key order;
+        # OFFSET rows are still consumed host-side, so push their count.
+        push_limit = (limit + (offset or 0)
+                      if limit is not None and not stmt.order_by
                       and len(handle.tablets) == 1 else None)
         if stmt.distinct:
             if hidden:
@@ -1377,8 +1647,15 @@ class PgProcessor:
             raise InvalidArgument("LIMIT must be a non-negative integer")
         return limit
 
-    @staticmethod
-    def _order_and_limit(stmt: ast.Select, names: list[str], rows, limit):
+    def _offset(self, stmt: ast.Select):
+        off = self._resolve(getattr(stmt, "offset", None))
+        if off is not None and (not isinstance(off, int)
+                                or isinstance(off, bool) or off < 0):
+            raise InvalidArgument("OFFSET must be a non-negative integer")
+        return off
+
+    def _order_and_limit(self, stmt: ast.Select, names: list[str], rows,
+                         limit):
         if stmt.order_by:
             pos = {}
             for ob in stmt.order_by:
@@ -1392,6 +1669,9 @@ class PgProcessor:
                 # PG defaults: ASC -> NULLS LAST, DESC -> NULLS FIRST
                 rows.sort(key=lambda r: ((r[i] is None), r[i]),
                           reverse=ob.desc)
+        offset = self._offset(stmt)
+        if offset:
+            rows = rows[offset:]
         if limit is not None:
             rows = rows[:limit]
         return rows

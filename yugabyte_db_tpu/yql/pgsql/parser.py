@@ -47,6 +47,11 @@ _TYPES = {
     "BYTEA": DataType.BINARY,
     "JSONB": DataType.JSONB, "JSON": DataType.JSONB,
     "TIMESTAMP": DataType.TIMESTAMP,  # microseconds since epoch (int64)
+    "NUMERIC": DataType.DECIMAL, "DECIMAL": DataType.DECIMAL,
+    "UUID": DataType.UUID,
+    "INET": DataType.INET,
+    "DATE": DataType.DATE,
+    "TIME": DataType.TIME,
 }
 
 
@@ -245,7 +250,30 @@ class Parser:
             return self._delete()
         if head == "SELECT":
             return self._select()
+        if head == "WITH":
+            return self._with_select()
         raise InvalidArgument(f"unsupported statement {head}")
+
+    def _with_select(self):
+        """WITH name AS (select) [, name AS (select)]* SELECT ... — CTEs
+        (reference capability: stock PG CTE scans above the FDW,
+        src/postgres/src/backend/executor/nodeCtescan.c)."""
+        self.expect_kw("WITH")
+        if self.at_kw("RECURSIVE"):
+            raise InvalidArgument("WITH RECURSIVE is not supported")
+        ctes = []
+        while True:
+            name = self.ident()
+            self.expect_kw("AS")
+            self.expect_sym("(")
+            sel = self._select()
+            self.expect_sym(")")
+            ctes.append((name, sel))
+            if not self.take_sym(","):
+                break
+        body = self._select()
+        body.ctes = ctes
+        return body
 
     def _name_if_exists(self):
         if_exists = False
@@ -267,8 +295,10 @@ class Parser:
         dt = _TYPES.get(name)
         if dt is None:
             raise InvalidArgument(f"unknown type {name}")
-        if self.take_sym("("):  # VARCHAR(n) / CHAR(n): length ignored
+        if self.take_sym("("):  # VARCHAR(n) / NUMERIC(p,s): args ignored
             self.literal()
+            if self.take_sym(","):
+                self.literal()
             self.expect_sym(")")
         return dt
 
@@ -417,9 +447,13 @@ class Parser:
         return ast.Delete(table, where)
 
     # -- SELECT ------------------------------------------------------------
-    _CLAUSE_KWS = ("FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS",
-                   "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS",
-                   "ON", "HAVING", "AND", "OR", "DESC", "ASC")
+    _CLAUSE_KWS = ("FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "OFFSET",
+                   "AS", "JOIN", "INNER", "LEFT", "RIGHT", "FULL",
+                   "CROSS", "ON", "HAVING", "AND", "OR", "DESC", "ASC")
+
+    SCALAR_FNS = ("abs", "upper", "lower", "length", "coalesce", "round",
+                  "floor", "ceil", "ceiling", "concat", "mod",
+                  "substring", "substr", "nullif", "greatest", "least")
 
     def _create_view(self, replace: bool):
         name = self.ident()
@@ -509,11 +543,17 @@ class Parser:
                 if not self.take_sym(","):
                     break
         limit = None
-        if self.take_kw("LIMIT"):
-            limit = self.literal()
+        offset = None
+        while True:  # PG accepts LIMIT/OFFSET in either order
+            if limit is None and self.take_kw("LIMIT"):
+                limit = self.literal()
+            elif offset is None and self.take_kw("OFFSET"):
+                offset = self.literal()
+            else:
+                break
         self.take_sym(";")
         return ast.Select(items, table, where, group_by, order_by, limit,
-                          distinct, alias, joins, having)
+                          distinct, alias, joins, having, offset=offset)
 
     def _kw_ahead(self, n: int, kw: str) -> bool:
         t = self.toks[self.i + n] if self.i + n < len(self.toks) else None
@@ -619,6 +659,21 @@ class Parser:
         t = self.peek()
         if t is not None and (t.kind == "number" or self.at_sym("-")):
             return Const(self.literal())
+        if t is not None and t.kind == "string":
+            return Const(self.literal())
+        if (t is not None and t.kind == "name"
+                and t.text.lower() in self.SCALAR_FNS
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1].text == "("):
+            fn = self.ident().lower()
+            self.expect_sym("(")
+            args = []
+            if not self.at_sym(")"):
+                args.append(self._scalar())
+                while self.take_sym(","):
+                    args.append(self._scalar())
+            self.expect_sym(")")
+            return ast.Func(fn, args)
         name = self._colref()
         # jsonb path: col -> 'key' -> 0 ->> 'leaf'
         steps = []
@@ -680,8 +735,19 @@ class Parser:
                 if t.kind != "op":
                     raise InvalidArgument(f"expected operator, got {t}")
                 op = "!=" if t.text == "<>" else t.text
-                value = (self._subquery() if self._at_subquery()
-                         else self.literal())
+                if self._at_subquery():
+                    value = self._subquery()
+                else:
+                    v = self.peek()
+                    if (v is not None and v.kind == "name"
+                            and v.text.upper() not in ("TRUE", "FALSE",
+                                                       "NULL")):
+                        # Column reference as the rhs: col-vs-col inside
+                        # a subquery is how correlation is spelled; the
+                        # executor resolves outer refs per row.
+                        value = Col(self._colref())
+                    else:
+                        value = self.literal()
                 rels.append(ast.Rel(col, op, value))
             if not self.take_kw("AND"):
                 break

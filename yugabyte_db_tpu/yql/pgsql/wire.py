@@ -104,15 +104,10 @@ def row_description(res: PgResult) -> bytes:
 
 
 def _text(v) -> bytes:
-    if isinstance(v, bool):
-        return b"t" if v else b"f"
-    if isinstance(v, (bytes, bytearray)):
-        return b"\\x" + bytes(v).hex().encode()
-    if isinstance(v, (dict, list)):  # jsonb / collections: json text
-        import json
+    # Format definition shared with the native wire page server.
+    from yugabyte_db_tpu.models.wirefmt import pg_text
 
-        return json.dumps(v, separators=(",", ":")).encode()
-    return str(v).encode("utf-8", "replace")
+    return pg_text(v)
 
 
 def data_row(row: tuple) -> bytes:
