@@ -1665,6 +1665,307 @@ PyObject* mt_drain_sorted(MemtableObject* self, PyObject*) {
   return out;
 }
 
+// drain_run(R, key_words, coldesc) — the native flush: walk the sorted
+// memtable ONCE and emit everything ColumnarRun needs as flat packed
+// buffers (block-packing included) so Python's only remaining work is
+// vectorized plane math + scatters. No per-row Python on the flush hot
+// path (reference analog: rocksdb flush building the SSTable straight
+// from the memtable iterator, src/yb/rocksdb/db/flush_job.cc).
+//
+// coldesc: [(col_id, kind)]; kind 0 = int-like (emit int64),
+// 1 = double, 2 = float32-source (emit double), 3 = varlen (emit 8-byte
+// BE prefix + the value objects; container values land in "pyfix" for
+// host-side prefix computation). Unsupported value shapes raise
+// ValueError — the caller falls back to the Python build.
+//
+// Returns a dict of bytes buffers (frombuffer-ready), object lists, and
+// per-column sub-dicts; see storage/columnar.py build_from_memtable.
+PyObject* mt_drain_run(MemtableObject* self, PyObject* args) {
+  Py_ssize_t R, key_words;
+  PyObject* coldesc;
+  if (!PyArg_ParseTuple(args, "nnO", &R, &key_words, &coldesc)) {
+    return nullptr;
+  }
+  if (!PyList_Check(coldesc)) {
+    PyErr_SetString(PyExc_TypeError, "drain_run: coldesc must be a list");
+    return nullptr;
+  }
+  struct ColBuf {
+    uint32_t col_id;
+    int kind;
+    std::vector<int32_t> rows, null_rows;
+    std::vector<int64_t> ivals;
+    std::vector<double> dvals;
+    std::vector<uint64_t> prefix;
+    PyObject* pyvals = nullptr;   // varlen payload objects
+    PyObject* pyfix = nullptr;    // varlen rows needing host prefixes
+    size_t maxlen = 0;
+  };
+  std::vector<ColBuf> cols(PyList_GET_SIZE(coldesc));
+  std::unordered_map<uint32_t, size_t> colpos;
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(coldesc); i++) {
+    PyObject* item = PyList_GET_ITEM(coldesc, i);
+    cols[i].col_id = (uint32_t)PyLong_AsUnsignedLong(
+        PyTuple_GET_ITEM(item, 0));
+    cols[i].kind = (int)PyLong_AsLong(PyTuple_GET_ITEM(item, 1));
+    if (cols[i].kind == 3) {
+      cols[i].pyvals = PyList_New(0);
+      cols[i].pyfix = PyList_New(0);
+      if (cols[i].pyvals == nullptr || cols[i].pyfix == nullptr) {
+        for (auto& c : cols) { Py_XDECREF(c.pyvals); Py_XDECREF(c.pyfix); }
+        return nullptr;
+      }
+    }
+    colpos[cols[i].col_id] = (size_t)i;
+  }
+  static PyObject* rv_cls = nullptr;
+  if (rv_cls == nullptr) {
+    PyObject* mod =
+        PyImport_ImportModule("yugabyte_db_tpu.storage.row_version");
+    if (mod != nullptr) {
+      rv_cls = PyObject_GetAttrString(mod, "RowVersion");
+      Py_DECREF(mod);
+    }
+    if (rv_cls == nullptr) {
+      for (auto& c : cols) { Py_XDECREF(c.pyvals); Py_XDECREF(c.pyfix); }
+      return nullptr;
+    }
+  }
+
+  MtData* d = self->data;
+  d->ensure_index();
+  size_t ngroups = d->index.size();
+  size_t n = self->num_versions;
+
+  auto fail = [&](PyObject* a, PyObject* b, PyObject* c) -> PyObject* {
+    Py_XDECREF(a);
+    Py_XDECREF(b);
+    Py_XDECREF(c);
+    for (auto& cb : cols) { Py_XDECREF(cb.pyvals); Py_XDECREF(cb.pyfix); }
+    return nullptr;
+  };
+
+  PyObject* keys = PyList_New((Py_ssize_t)ngroups);
+  PyObject* versions = PyList_New((Py_ssize_t)n);
+  if (keys == nullptr || versions == nullptr) {
+    return fail(keys, versions, nullptr);
+  }
+  std::vector<uint64_t> ht(n), exp(n);
+  std::vector<uint8_t> tomb(n), live(n);
+  std::vector<int32_t> gsizes(ngroups);
+  std::string keyblob;
+  keyblob.resize(n * (size_t)key_words * 4, '\0');
+  std::vector<int32_t> ranges;  // (g0, gn, rows) per block
+  size_t max_key_len = 0, max_group = 0;
+  int64_t g0 = 0, gn = 0, fill = 0;
+
+  size_t row = 0;
+  Py_ssize_t gi = 0;
+  for (const std::string* kp : d->index) {
+    const std::string& key = *kp;
+    std::vector<Ver>& vers = d->map[key];
+    size_t nv = vers.size();
+    if ((Py_ssize_t)nv > R) {
+      PyErr_Format(PyExc_ValueError,
+                   "key has %zu versions > rows_per_block=%zd; "
+                   "GC history (compact with a cutoff) to shrink it",
+                   nv, R);
+      return fail(keys, versions, nullptr);
+    }
+    if (fill + (int64_t)nv > R && fill > 0) {
+      ranges.push_back((int32_t)g0);
+      ranges.push_back((int32_t)gn);
+      ranges.push_back((int32_t)fill);
+      g0 = gi;
+      gn = 0;
+      fill = 0;
+    }
+    gn += 1;
+    fill += (int64_t)nv;
+    if (nv > max_group) max_group = nv;
+    if (key.size() > max_key_len) max_key_len = key.size();
+    gsizes[(size_t)gi] = (int32_t)nv;
+    if (nv > 1) {
+      std::stable_sort(vers.begin(), vers.end(),
+                       [](const Ver& a, const Ver& b) {
+                         if (a.ht != b.ht) return a.ht > b.ht;
+                         return a.write_id > b.write_id;
+                       });
+    }
+    PyObject* kb = PyBytes_FromStringAndSize(key.data(),
+                                             (Py_ssize_t)key.size());
+    if (kb == nullptr) return fail(keys, versions, nullptr);
+    PyList_SET_ITEM(keys, gi, kb);  // list owns the ref
+    gi++;
+    for (const Ver& v : vers) {
+      ht[row] = v.ht;
+      exp[row] = v.expire_ht;
+      tomb[row] = (v.flags & 1) ? 1 : 0;
+      live[row] = (v.flags & 2) ? 1 : 0;
+      size_t w = key.size() < (size_t)key_words * 4
+                     ? key.size() : (size_t)key_words * 4;
+      memcpy(&keyblob[row * (size_t)key_words * 4], key.data(), w);
+      // Columns: one parse builds the RowVersion dict AND the plane
+      // records.
+      PyObject* dict = PyDict_New();
+      if (dict == nullptr) return fail(keys, versions, nullptr);
+      ybtag::Reader r{(const unsigned char*)v.cols.data(), v.cols.size(),
+                      0};
+      bool ok = true;
+      for (uint16_t ci = 0; ci < v.ncols && ok; ci++) {
+        if (r.len - r.pos < 4) { ok = false; break; }
+        uint32_t col_id = get_u32(r.data + r.pos);
+        r.pos += 4;
+        PyObject* val = ybtag::decode_obj(&r, 0);
+        if (val == nullptr) { ok = false; break; }
+        PyObject* idk = PyLong_FromUnsignedLong(col_id);
+        if (idk == nullptr || PyDict_SetItem(dict, idk, val) < 0) {
+          Py_XDECREF(idk);
+          Py_DECREF(val);
+          ok = false;
+          break;
+        }
+        Py_DECREF(idk);
+        auto cp = colpos.find(col_id);
+        if (cp != colpos.end()) {
+          ColBuf& cb = cols[cp->second];
+          if (val == Py_None) {
+            cb.rows.push_back((int32_t)row);
+            cb.null_rows.push_back((int32_t)row);
+          } else if (cb.kind == 0) {
+            long long x;
+            if (val == Py_True) {
+              x = 1;
+            } else if (val == Py_False) {
+              x = 0;
+            } else {
+              x = PyLong_AsLongLong(val);
+              if (x == -1 && PyErr_Occurred()) ok = false;
+            }
+            if (ok) {
+              cb.rows.push_back((int32_t)row);
+              cb.ivals.push_back((int64_t)x);
+            }
+          } else if (cb.kind == 1 || cb.kind == 2) {
+            double x = PyFloat_AsDouble(val);
+            if (x == -1.0 && PyErr_Occurred()) {
+              ok = false;
+            } else {
+              cb.rows.push_back((int32_t)row);
+              cb.dvals.push_back(x);
+            }
+          } else {  // varlen
+            const char* p = nullptr;
+            Py_ssize_t plen = 0;
+            if (PyUnicode_Check(val)) {
+              p = PyUnicode_AsUTF8AndSize(val, &plen);
+              if (p == nullptr) {
+                PyErr_Clear();  // surrogates etc.: host fallback row
+              }
+            } else if (PyBytes_Check(val)) {
+              p = PyBytes_AS_STRING(val);
+              plen = PyBytes_GET_SIZE(val);
+            }
+            cb.rows.push_back((int32_t)row);
+            if (PyList_Append(cb.pyvals, val) < 0) ok = false;
+            if (ok && p != nullptr) {
+              uint64_t pre = 0;
+              for (int bi = 0; bi < 8; bi++) {
+                pre = (pre << 8) |
+                      (bi < plen ? (unsigned char)p[bi] : 0);
+              }
+              cb.prefix.push_back(pre);
+              if ((size_t)plen > cb.maxlen) cb.maxlen = (size_t)plen;
+            } else if (ok) {
+              cb.prefix.push_back(0);
+              PyObject* ri = PyLong_FromSize_t(row);
+              if (ri == nullptr ||
+                  PyList_Append(cb.pyfix, ri) < 0) {
+                Py_XDECREF(ri);
+                ok = false;
+              } else {
+                Py_DECREF(ri);
+              }
+            }
+          }
+        }
+        Py_DECREF(val);
+      }
+      if (!ok) {
+        Py_DECREF(dict);
+        return fail(keys, versions, nullptr);
+      }
+      PyObject* ttl = (v.ttl_us < 0) ? Py_NewRef(Py_None)
+                                     : PyLong_FromLongLong(v.ttl_us);
+      PyObject* rv = ttl == nullptr ? nullptr : PyObject_CallFunction(
+          rv_cls, "OLOOOLOk", PyList_GET_ITEM(keys, gi - 1),
+          (long long)v.ht, (v.flags & 1) ? Py_True : Py_False,
+          (v.flags & 2) ? Py_True : Py_False, dict,
+          (long long)v.expire_ht, ttl, (unsigned long)v.write_id);
+      Py_XDECREF(ttl);
+      Py_DECREF(dict);
+      if (rv == nullptr) {
+        return fail(keys, versions, nullptr);
+      }
+      PyList_SET_ITEM(versions, (Py_ssize_t)row, rv);
+      row++;
+    }
+  }
+  if ((fill > 0 || ranges.empty()) && gn > 0) {
+    ranges.push_back((int32_t)g0);
+    ranges.push_back((int32_t)gn);
+    ranges.push_back((int32_t)fill);
+  }
+
+  auto vec_bytes = [](const void* p, size_t nbytes) {
+    return PyBytes_FromStringAndSize((const char*)p, (Py_ssize_t)nbytes);
+  };
+  PyObject* colout = PyDict_New();
+  if (colout == nullptr) return fail(keys, versions, nullptr);
+  for (ColBuf& cb : cols) {
+    PyObject* entry = Py_BuildValue(
+        "{s:i,s:N,s:N,s:N,s:N,s:N,s:N,s:n}",
+        "kind", cb.kind,
+        "rows", vec_bytes(cb.rows.data(), cb.rows.size() * 4),
+        "nulls", vec_bytes(cb.null_rows.data(), cb.null_rows.size() * 4),
+        "ivals", vec_bytes(cb.ivals.data(), cb.ivals.size() * 8),
+        "dvals", vec_bytes(cb.dvals.data(), cb.dvals.size() * 8),
+        "prefix", vec_bytes(cb.prefix.data(), cb.prefix.size() * 8),
+        "pyvals", cb.pyvals ? cb.pyvals : Py_NewRef(Py_None),
+        "maxlen", (Py_ssize_t)cb.maxlen);
+    cb.pyvals = nullptr;  // Py_BuildValue 'N' owns it (even on failure)
+    PyObject* idk = entry ? PyLong_FromUnsignedLong(cb.col_id) : nullptr;
+    if (entry == nullptr || idk == nullptr ||
+        PyDict_SetItem(colout, idk, entry) < 0 ||
+        (cb.pyfix != nullptr &&
+         PyDict_SetItemString(entry, "pyfix", cb.pyfix) < 0)) {
+      Py_XDECREF(entry);
+      Py_XDECREF(idk);
+      Py_DECREF(colout);
+      return fail(keys, versions, nullptr);
+    }
+    Py_XDECREF(cb.pyfix);
+    cb.pyfix = nullptr;
+    Py_DECREF(entry);
+    Py_DECREF(idk);
+  }
+  return Py_BuildValue(
+      "{s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:N,s:n,s:n,s:n}",
+      "ranges", vec_bytes(ranges.data(), ranges.size() * 4),
+      "group_sizes", vec_bytes(gsizes.data(), gsizes.size() * 4),
+      "keys", keys,
+      "versions", versions,
+      "ht", vec_bytes(ht.data(), ht.size() * 8),
+      "exp", vec_bytes(exp.data(), exp.size() * 8),
+      "tomb", vec_bytes(tomb.data(), tomb.size()),
+      "live", vec_bytes(live.data(), live.size()),
+      "keywords", vec_bytes(keyblob.data(), keyblob.size()),
+      "cols", colout,
+      "max_key_len", (Py_ssize_t)max_key_len,
+      "max_group", (Py_ssize_t)max_group,
+      "n", (Py_ssize_t)n);
+}
+
 PyObject* mt_stats(MemtableObject* self, PyObject*) {
   return Py_BuildValue(
       "{s:n,s:n,s:N,s:N}",
@@ -1706,6 +2007,9 @@ PyMethodDef kMemtableMethods[] = {
      "has_keys(lower, upper) -> any key in [lower, upper)"},
     {"drain_sorted", (PyCFunction)mt_drain_sorted, METH_NOARGS,
      "drain_sorted() -> [(key, [row tuples ht-desc])] in key order"},
+    {"drain_run", (PyCFunction)mt_drain_run, METH_VARARGS,
+     "drain_run(R, key_words, coldesc) -> flat packed run buffers "
+     "(the native flush path; see storage/columnar.py)"},
     {"stats", (PyCFunction)mt_stats, METH_NOARGS, "summary dict"},
     {nullptr, nullptr, 0, nullptr},
 };

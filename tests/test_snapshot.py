@@ -151,3 +151,69 @@ def test_snapshot_local_tablet_both_engines():
             assert t.list_snapshots() == []
             assert os.path.isdir(t.dir)
             t.close()
+
+
+def test_master_coordinated_cluster_snapshot():
+    """The master drives create/restore/delete across every tablet and
+    tracks snapshot state in the replicated sys catalog (reference:
+    CreateSnapshot/RestoreSnapshot master RPCs over backup.proto ops);
+    the registry survives a full cluster kill + restart, and restore
+    after the restart still rolls data back."""
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            client = mc.client()
+            client.create_table("kv", [
+                ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+                ColumnSchema("v", DataType.INT64),
+            ], num_tablets=4)
+            table = client.open_table("kv")
+            s = YBSession(client)
+            for i in range(40):
+                s.insert(table, {"k": f"a{i:03d}", "v": i})
+            s.flush()
+            baseline = _rows(client, table)
+
+            admin = AdminClient(mc.transport.bind("admin2"),
+                                mc.master_uuids)
+            resp = admin.cluster_snapshot("create", "kv", "cs1")
+            assert resp["tablets"] == 4
+            reg = admin.cluster_snapshot("list")["snapshots"]
+            assert reg["cs1"]["state"] == "COMPLETE"
+            assert reg["cs1"]["table"] == "kv"
+
+            # unknown snapshot / double create fail cleanly
+            with pytest.raises(Exception):
+                admin.cluster_snapshot("restore", snapshot_id="nope")
+            with pytest.raises(Exception):
+                admin.cluster_snapshot("create", "kv", "cs1")
+
+            # diverge
+            for i in range(20):
+                s.insert(table, {"k": f"a{i:03d}", "v": i + 1000})
+            for i in range(40, 55):
+                s.insert(table, {"k": f"a{i:03d}", "v": i})
+            s.flush()
+            assert _rows(client, table) != baseline
+
+            # kill the whole cluster; registry must survive the restart
+            mc.shutdown()
+            mc = MiniCluster(root, num_tservers=3).start()
+            mc.wait_tservers_registered()
+            client = mc.client("after-restart")
+            table = client.open_table("kv")
+            admin = AdminClient(mc.transport.bind("admin3"),
+                                mc.master_uuids)
+            reg = admin.cluster_snapshot("list")["snapshots"]
+            assert reg["cs1"]["state"] == "COMPLETE"
+
+            admin.cluster_snapshot("restore", snapshot_id="cs1")
+            assert _rows(client, table) == baseline
+
+            admin.cluster_snapshot("delete", snapshot_id="cs1")
+            assert admin.cluster_snapshot("list")["snapshots"] == {}
+            with pytest.raises(Exception):
+                admin.cluster_snapshot("restore", snapshot_id="cs1")
+        finally:
+            mc.shutdown()

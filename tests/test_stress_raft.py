@@ -154,6 +154,13 @@ def test_raft_storms_keep_replicas_identical(tmp_path):
         assert not invented, sorted(invented)[:10]
         assert len(acked) > 100
         _ = keys_present
+        # Standing stall check (kernel_stack_watchdog.h analog): the
+        # storm must not have wedged an apply (threshold 5s); fsync
+        # stalls are tolerated on slow CI disks but reported.
+        from yugabyte_db_tpu.utils.watchdog import watchdog
+
+        holes = watchdog().stalls("raft.apply_hole")
+        assert not [h for h in holes if h["seconds"] > 30], holes
     finally:
         mc.shutdown()
 

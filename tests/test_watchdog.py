@@ -1,0 +1,43 @@
+"""Standing stall detector (reference: kernel_stack_watchdog.h): flags
+in-flight sections past their threshold and late completions the
+sampler missed; the stress rigs consult the records as a standing
+check."""
+
+import time
+
+from yugabyte_db_tpu.utils.watchdog import StallWatchdog
+
+
+def test_flags_inflight_and_late_sections():
+    wd = StallWatchdog(interval_s=0.05)
+    with wd.watch("fast", threshold_s=1.0):
+        pass
+    assert wd.stalls() == []
+    # In-flight past threshold: sampler flags while still running.
+    with wd.watch("slow.sampled", threshold_s=0.1):
+        time.sleep(0.4)
+    recs = wd.stalls("slow.sampled")
+    assert recs and recs[0]["seconds"] >= 0.1
+    assert any(not r["completed"] for r in recs)
+    # Late completion between samples: flagged post-hoc, once.
+    wd2 = StallWatchdog(interval_s=30.0)
+    with wd2.watch("slow.late", threshold_s=0.01):
+        time.sleep(0.05)
+    recs = wd2.stalls("slow.late")
+    assert len(recs) == 1 and recs[0]["completed"]
+    assert wd2.stall_count == 1
+    wd2.reset()
+    assert wd2.stalls() == []
+
+
+def test_wal_sync_is_watched(tmp_path):
+    """The WAL's group-commit sync registers with the process watchdog
+    (smoke: a normal sync produces no stall records)."""
+    from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
+    from yugabyte_db_tpu.utils.watchdog import watchdog
+
+    watchdog().reset()
+    log = Log(str(tmp_path), fsync=True)
+    log.append(LogEntry(OpId(1, 1), 5, "write", {"x": 1}))
+    log.sync()
+    assert watchdog().stalls("wal.sync") == []

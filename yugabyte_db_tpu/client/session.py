@@ -310,6 +310,36 @@ class YBSession:
         res = self.scan(table, spec)
         return res.rows[0] if res.rows else None
 
+    def get_many(self, table: YBTable, kv_list: list[dict],
+                 timeout_s: float = 30.0) -> list[tuple | None]:
+        """Batched point reads: keys group by tablet and each tablet
+        serves its whole group in ONE scan-batch RPC (reference: the
+        batcher packing many ops per tserver call,
+        src/yb/client/batcher.h:80). Results align with kv_list."""
+        from yugabyte_db_tpu.models.encoding import prefix_successor
+
+        groups: dict = {}
+        for i, kv in enumerate(kv_list):
+            key = table.encode_key(kv)
+            hc = table.hash_code(kv)
+            loc = self.client.meta_cache.lookup_by_hash(table.name, hc)
+            spec = ScanSpec(lower=key, upper=prefix_successor(key),
+                            limit=1)
+            g = groups.get(loc.tablet_id)
+            if g is None:
+                g = groups[loc.tablet_id] = (loc, [])
+            g[1].append((i, spec))
+        out: list = [None] * len(kv_list)
+        for loc, items in groups.values():
+            resp = self.client.tablet_rpc(
+                table.name, loc, "ts.scan_batch",
+                {"specs": [wire.encode_spec(s) for _i, s in items]},
+                timeout_s=timeout_s)
+            for (i, _s), enc in zip(items, resp["results"]):
+                res = wire.decode_result(enc)
+                out[i] = res.rows[0] if res.rows else None
+        return out
+
     # -- scans ---------------------------------------------------------------
     def _stale_prefer(self, loc) -> str | None:
         """Same-zone replica for a stale read (read-replica routing):

@@ -111,6 +111,7 @@ class RaftConsensus:
 
         self._lock = threading.RLock()
         self._apply_cond = threading.Condition(self._lock)
+        self._stall_watch = None  # open watchdog scope on an apply hole
         self._commit_cond = threading.Condition(self._lock)
         self._role = Role.FOLLOWER
         self._leader_uuid: str | None = None
@@ -671,6 +672,9 @@ class RaftConsensus:
                          self._applied_index >= self._commit_index):
                     self._apply_cond.wait(timeout=0.5)
                 if not self._running:
+                    if self._stall_watch is not None:
+                        self._stall_watch.__exit__(None, None, None)
+                        self._stall_watch = None
                     return
             self._drain_applies()
             with self._lock:
@@ -678,7 +682,18 @@ class RaftConsensus:
                 # truncation) must stall the apply, not busy-spin.
                 if not self._applying and \
                         self._applied_index < self._commit_index:
+                    # A hole that persists is an apply stall (standing
+                    # watchdog check, kernel_stack_watchdog.h analog).
+                    if self._stall_watch is None:
+                        from yugabyte_db_tpu.utils.watchdog import watchdog
+
+                        self._stall_watch = watchdog().watch(
+                            "raft.apply_hole", threshold_s=5.0)
+                        self._stall_watch.__enter__()
                     self._apply_cond.wait(timeout=0.2)
+                elif self._stall_watch is not None:
+                    self._stall_watch.__exit__(None, None, None)
+                    self._stall_watch = None
 
     def _drain_applies(self, max_entries: int | None = None) -> None:
         """Apply committed entries in strict log order, from WHATEVER

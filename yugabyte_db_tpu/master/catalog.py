@@ -56,6 +56,11 @@ class CatalogState:
         # sequence relations in the PG fork's catalog).
         self.views: dict[str, str] = {}
         self.sequences: dict[str, int] = {}
+        # Cluster snapshots: id -> {"table", "state", "tablets"} —
+        # master-coordinated registry over the per-tablet snapshot ops
+        # (reference: SysSnapshotEntryPB states driven by
+        # src/yb/tserver/backup.proto TabletSnapshotOp).
+        self.snapshots: dict[str, dict] = {}
 
     def apply(self, op: dict) -> None:
         kind = op["op"]
@@ -84,6 +89,14 @@ class CatalogState:
             if kind == "sequence_alloc":
                 self.sequences[op["name"]] = \
                     self.sequences.get(op["name"], 1) + op["n"]
+                return
+            if kind == "snapshot_record":
+                self.snapshots[op["snapshot_id"]] = {
+                    "table": op["table"], "state": op["state"],
+                    "tablets": list(op.get("tablets", ()))}
+                return
+            if kind == "snapshot_remove":
+                self.snapshots.pop(op["snapshot_id"], None)
                 return
             if kind == "create_type":
                 self.types[op["name"]] = [tuple(f) for f in op["fields"]]

@@ -627,6 +627,117 @@ class Master:
             return self._not_leader()
         return {"code": "ok"}
 
+    def _h_master_snapshot_op(self, p: dict):
+        """Master-coordinated cluster snapshots (reference: the
+        CreateSnapshot/RestoreSnapshot master RPCs fanning
+        backup.proto TabletSnapshotOp to every tablet, tracked as
+        SysSnapshotEntryPB states in the sys catalog). States:
+        CREATING -> COMPLETE | FAILED; restore/delete require
+        COMPLETE. The registry rides the replicated catalog, so it
+        survives master failover and restarts."""
+        action = p.get("action")
+        if action == "list":
+            return {"code": "ok", "snapshots": {
+                sid: dict(rec)
+                for sid, rec in self.catalog.snapshots.items()}}
+        if not self.raft.is_leader():
+            return self._not_leader()
+        sid = p.get("snapshot_id") or ""
+        if not sid:
+            return {"code": "error", "message": "missing snapshot_id"}
+        if action == "create":
+            if not p.get("table"):
+                return {"code": "error", "message": "missing table"}
+            t = self.catalog.table_by_name(p["table"])
+            if t is None:
+                return {"code": "not_found"}
+            if sid in self.catalog.snapshots:
+                return {"code": "already_present"}
+            tablets = self.catalog.tablets_of(t.table_id)
+            try:
+                self.raft.replicate("catalog", {
+                    "op": "snapshot_record", "snapshot_id": sid,
+                    "table": p["table"], "state": "CREATING",
+                    "tablets": [ti.tablet_id for ti in tablets]})
+            except NotLeader:
+                return self._not_leader()
+            errs = self._snapshot_fanout(tablets, sid, "create_snapshot")
+            state = "FAILED" if errs else "COMPLETE"
+            try:
+                self.raft.replicate("catalog", {
+                    "op": "snapshot_record", "snapshot_id": sid,
+                    "table": p["table"], "state": state,
+                    "tablets": [ti.tablet_id for ti in tablets]})
+            except NotLeader:
+                return self._not_leader()
+            if errs:
+                return {"code": "error",
+                        "message": f"snapshot {sid}: {errs[0]}"}
+            return {"code": "ok", "tablets": len(tablets)}
+        rec = self.catalog.snapshots.get(sid)
+        if rec is None:
+            return {"code": "not_found"}
+        t = self.catalog.table_by_name(rec["table"])
+        if t is None:
+            return {"code": "not_found",
+                    "message": f"table {rec['table']} gone"}
+        tablets = self.catalog.tablets_of(t.table_id)
+        if action == "restore":
+            if rec["state"] != "COMPLETE":
+                return {"code": "error",
+                        "message": f"snapshot {sid} is {rec['state']}"}
+            errs = self._snapshot_fanout(tablets, sid,
+                                         "restore_snapshot")
+            if errs:
+                return {"code": "error",
+                        "message": f"restore {sid}: {errs[0]}"}
+            return {"code": "ok", "tablets": len(tablets)}
+        if action == "delete":
+            errs = self._snapshot_fanout(tablets, sid, "delete_snapshot")
+            try:
+                self.raft.replicate("catalog", {
+                    "op": "snapshot_remove", "snapshot_id": sid})
+            except NotLeader:
+                return self._not_leader()
+            if errs:
+                return {"code": "error",
+                        "message": f"delete {sid}: {errs[0]}"}
+            return {"code": "ok"}
+        return {"code": "error", "message": f"bad action {action!r}"}
+
+    def _snapshot_fanout(self, tablets, sid: str, op: str) -> list[str]:
+        """Run one snapshot op on every tablet's LEADER (follow
+        not_leader hints); returns error strings (empty = success)."""
+        errs = []
+        for ti in tablets:
+            payload = {"tablet_id": ti.tablet_id, "snapshot_id": sid,
+                       "op": op}
+            last = "no replicas"
+            done = False
+            tried = set()
+            candidates = list(ti.replicas)
+            while candidates:
+                dst = candidates.pop(0)
+                if dst in tried:
+                    continue
+                tried.add(dst)
+                try:
+                    resp = self.transport.send(dst, "ts.snapshot_op",
+                                               payload, timeout=10.0)
+                except Exception as e:  # noqa: BLE001 — try the next
+                    last = str(e)
+                    continue
+                if resp.get("code") == "ok":
+                    done = True
+                    break
+                last = resp.get("message", resp.get("code"))
+                hint = resp.get("leader_hint")
+                if hint and hint not in tried:
+                    candidates.insert(0, hint)
+            if not done:
+                errs.append(f"{ti.tablet_id}: {last}")
+        return errs
+
     def _h_master_list_types(self, p: dict):
         return {"code": "ok", "types": {
             n: [list(f) for f in fs]

@@ -12,4 +12,5 @@ lexicographic pmax over ICI (BASELINE config 5).
 """
 
 from yugabyte_db_tpu.parallel.sharded import (ShardedTablets,
-                                              sharded_aggregate)
+                                              sharded_aggregate,
+                                              sharded_row_page)

@@ -310,3 +310,244 @@ def sharded_aggregate(st: ShardedTablets, spec: ScanSpec) -> ScanResult:
 def _kind(c):
     from yugabyte_db_tpu.ops.device_run import dtype_kind
     return dtype_kind(c.dtype)
+
+
+# -- sharded row/paging path -------------------------------------------------
+#
+# The cluster ROW read path on the mesh: each device computes the exact
+# flat-run match mask over its (tablet, block-range) shard and emits the
+# first M matching row indices; the host assembles LIMIT pages in tablet
+# order (a device's "b"-shard covers a contiguous disjoint row range, so
+# concatenating shard outputs in "b" order is already key order). This
+# is the device-sharded analog of the per-tablet parallel read fan-out
+# (reference: src/yb/client/batcher.h:80) — the reference scans one
+# tablet per thread; here tablets AND block ranges split over the mesh.
+
+_PAGE_BUCKETS = (128, 512, 2048)
+
+
+def _le2(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
+
+
+def _flat_pred_mask(kind, cmp, lit):
+    if kind == "i32":
+        v = cmp[..., 0]
+        x = lit[0]
+        return {"=": v == x, "!=": v != x, "<": v < x, "<=": v <= x,
+                ">": v > x, ">=": v >= x}
+    hi, lo = cmp[..., 0], cmp[..., 1]
+    lhi, llo = lit
+    eq = (hi == lhi) & (lo == llo)
+    lt = (hi < lhi) | ((hi == lhi) & (lo < llo))
+    return {"=": eq, "!=": ~eq, "<": lt, "<=": lt | eq,
+            ">": ~(lt | eq), ">=": ~lt}
+
+
+def _rows_body(col_ids, pred_items, Tl, Bl, R, M, run, row_lo, row_hi,
+               r_hi, r_lo, e_hi, e_lo, pred_lits):
+    """Per-device: exact flat-run match masks over the [Tl, Bl, R] shard
+    and the first M matching global row indices per local tablet.
+    Semantics mirror the host page index (storage.host_page.masks):
+    MVCC visibility at the read point, tombstones, TTL, liveness/column
+    existence, device-exact predicates."""
+    base = jax.lax.axis_index("b") * (Bl * R)
+    n = Bl * R
+    ridx = base + jnp.arange(n, dtype=jnp.int32)
+    out_idx, out_cnt = [], []
+    for t in range(Tl):
+        local = jax.tree.map(lambda a: a[t], run)
+        flat = lambda a: a.reshape((n,) + a.shape[2:])  # noqa: E731
+        visible = flat(local["valid"]) & _le2(
+            flat(local["ht_hi"]), flat(local["ht_lo"]), r_hi, r_lo)
+        expired = _le2(flat(local["exp_hi"]), flat(local["exp_lo"]),
+                       e_hi, e_lo)
+        alive = visible & ~flat(local["tomb"])
+        not_exp = ~expired
+        exists = alive & flat(local["live"]) & not_exp
+        notnull = {}
+        for cid in col_ids:
+            c = local["cols"][cid]
+            nn = alive & flat(c["set"]) & ~flat(c["isnull"]) & not_exp
+            notnull[cid] = nn
+            exists = exists | nn
+        match = exists & (ridx >= row_lo[t]) & (ridx < row_hi[t])
+        for (cid, kind, op), lit in zip(pred_items, pred_lits):
+            cmp = flat(local["cols"][cid]["cmp"])
+            match = match & notnull[cid] & \
+                _flat_pred_mask(kind, cmp, lit)[op]
+        cnt = jnp.sum(match, dtype=jnp.int32)
+        pos = jnp.nonzero(match, size=M, fill_value=n)[0]
+        out_idx.append((base + pos.astype(jnp.int32))[None, None, :])
+        out_cnt.append(cnt[None, None])
+    return (jnp.concatenate(out_idx, axis=0),
+            jnp.concatenate(out_cnt, axis=0))
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_dist_rows(cols_desc, pred_items, mesh, Tl, Bl, R, M):
+    spec_tb = P("t", "b")
+    cols = {}
+    for cid, has_arith in cols_desc:
+        entry = {"set": spec_tb, "isnull": spec_tb, "cmp": spec_tb}
+        if has_arith:
+            entry["arith"] = spec_tb
+        cols[cid] = entry
+    col_ids = tuple(cid for cid, _a in cols_desc)
+    run_spec = {
+        "valid": spec_tb, "group_start": spec_tb, "tomb": spec_tb,
+        "live": spec_tb, "ht_hi": spec_tb, "ht_lo": spec_tb,
+        "exp_hi": spec_tb, "exp_lo": spec_tb, "cols": cols,
+    }
+    body = functools.partial(_rows_body, col_ids, pred_items, Tl, Bl, R,
+                             M)
+    smapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(run_spec, P("t"), P("t"), P(), P(), P(), P(), P()),
+        out_specs=(P("t", "b"), P("t", "b")))
+    return jax.jit(smapped)
+
+
+def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
+                     resume: bytes | None = None) -> ScanResult:
+    """LIMIT page over all tablets on the mesh: ONE device dispatch
+    computes every tablet's matching rows; the host takes the first
+    `limit` in (tablet, key) order and materializes them from the host
+    mirror (result-proportional work). Constraints: flat runs, exact
+    (i32/i64/f64 value-column) predicates, no aggregates.
+
+    Cross-tablet paging: the returned resume_key encodes
+    (tablet index, last key) — pass it back as ``resume`` to continue
+    (the QLPagingStatePB next_partition_key + next_row_key shape)."""
+    if spec.is_aggregate:
+        raise ValueError("sharded_row_page serves row scans")
+    schema = st.schema
+    if any(r.max_group_versions > 1 for r in st.runs):
+        raise ValueError("sharded_row_page needs flat runs")
+    name_to_id = {c.name: c.col_id for c in schema.value_columns}
+    kinds = {c.col_id: _kind(c) for c in schema.value_columns}
+    key_names = {c.name for c in schema.key_columns}
+    pred_items, pred_lits = [], []
+    for p in spec.predicates:
+        if p.column in key_names or p.op == "IN":
+            raise ValueError(f"predicate on {p.column} not device-exact")
+        cid = name_to_id[p.column]
+        kind = kinds[cid]
+        if kind not in ("i32", "i64", "f64"):
+            raise ValueError(f"predicate kind {kind} not device-exact")
+        if kind == "i32":
+            lit = (int(p.value),)
+        elif kind == "i64":
+            phi, plo = PL.i64_to_ordered_planes(
+                np.array([int(p.value)], dtype=np.int64))
+            lit = (int(phi[0]), int(plo[0]))
+        else:
+            phi, plo = PL.f64_to_ordered_planes(
+                np.array([p.value], dtype=np.float64))
+            lit = (int(phi[0]), int(plo[0]))
+        pred_items.append((cid, kind, p.op))
+        pred_lits.append(tuple(jnp.int32(v) for v in lit))
+
+    limit = spec.limit if spec.limit is not None else _PAGE_BUCKETS[-1]
+    M = next((m for m in _PAGE_BUCKETS if m >= limit),
+             -(-limit // 128) * 128)
+    start_t = 0
+    start_key = spec.lower
+    if resume is not None:
+        from yugabyte_db_tpu.utils import codec as _codec
+
+        start_t, last_key = _codec.decode(resume)
+        start_key = max(spec.lower, last_key + b"\x00")
+    lo, hi = st.row_bounds(spec.lower, spec.upper)
+    if resume is not None:
+        for t in range(min(start_t, len(st.runs))):
+            lo[t] = hi[t]  # earlier tablets: already consumed
+        if start_t < len(st.runs):
+            lo[start_t] = max(lo[start_t],
+                              st.runs[start_t].lower_row(start_key))
+    from yugabyte_db_tpu.storage.row_version import MAX_HT
+
+    r_hi, r_lo = PL.scalar_ht_planes(min(spec.read_ht, MAX_HT))
+    e_hi, e_lo = PL.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
+    Tl = st.padded_T // st.mesh.shape["t"]
+    cols_desc = tuple(
+        (c.col_id, st.runs[0].cols[c.col_id].arith is not None)
+        for c in schema.value_columns)
+    fn = _compiled_dist_rows(cols_desc, tuple(pred_items), st.mesh, Tl,
+                             st.Bl, st.R, M)
+    idx, cnt = fn(st.arrays, jnp.asarray(lo), jnp.asarray(hi),
+                  jnp.int32(r_hi), jnp.int32(r_lo), jnp.int32(e_hi),
+                  jnp.int32(e_lo), tuple(pred_lits))
+    idx = np.asarray(idx)    # [padded_T, mesh_b, M] global row indices
+    cnt = np.asarray(cnt)    # [padded_T, mesh_b]
+
+    projection = spec.projection or [c.name for c in schema.columns]
+    key_pos = {c.name: i for i, c in enumerate(schema.key_columns)}
+    rows: list[tuple] = []
+    scanned = 0
+    resume = None
+    budget = limit
+    mesh_b = st.mesh.shape["b"]
+    shard_rows = st.Bl * st.R
+    resume_out = None
+    for t, run in enumerate(st.runs):
+        truncated = False
+        sel: list[int] = []
+        for b in range(mesh_b):
+            c = int(cnt[t, b])
+            take = min(c, M)
+            if c > M:
+                truncated = True  # tablet has matches beyond M
+            sel.extend(int(g) for g in idx[t, b, :take])
+        scanned += sum(int(cnt[t, b]) for b in range(mesh_b))
+        more_in_tablet = truncated or len(sel) > budget
+        sel = sel[:budget]
+        for g in sel:
+            rows.append(_materialize_row(run, schema, g, projection,
+                                         key_pos))
+        budget -= len(sel)
+        page_full = budget <= 0
+        if sel and (more_in_tablet
+                    or (page_full and t + 1 < len(st.runs))):
+            from yugabyte_db_tpu.utils import codec as _codec
+
+            resume_out = _codec.encode([t, run.key_at(sel[-1])])
+            break
+        if page_full:
+            break
+    return ScanResult(list(projection), rows, resume_out, scanned)
+
+
+def _materialize_row(run, schema, g, projection, key_pos):
+    """One selected global row from the run's host mirror (the same
+    payload sources the page server uses)."""
+    R = run.R
+    b, r = divmod(g, R)
+    key_vals = None
+    out = []
+    for nm in projection:
+        if nm in key_pos:
+            if key_vals is None:
+                key_vals = run.key_vals_at(g)
+            out.append(key_vals[key_pos[nm]])
+            continue
+        col = schema.column(nm)
+        cd = run.cols[col.col_id]
+        if not cd.set_[b, r] or cd.isnull[b, r]:
+            out.append(None)
+            continue
+        kind = _kind(col)
+        if kind in ("str", "f32"):
+            out.append(run.row_versions[b][r].columns[col.col_id])
+        elif kind == "i32":
+            v = int(cd.cmp_planes[b, r, 0])
+            from yugabyte_db_tpu.models.datatypes import DataType
+
+            out.append(bool(v) if col.dtype == DataType.BOOL else v)
+        elif kind == "i64":
+            out.append(int(PL.ordered_planes_to_i64(
+                cd.cmp_planes[b, r, 0:1], cd.cmp_planes[b, r, 1:2])[0]))
+        else:
+            out.append(float(PL.ordered_planes_to_f64(
+                cd.cmp_planes[b, r, 0:1], cd.cmp_planes[b, r, 1:2])[0]))
+    return tuple(out)

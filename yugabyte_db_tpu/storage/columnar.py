@@ -145,6 +145,173 @@ class ColumnarRun:
         run.num_versions = sum(len(v) for _, v in entries)
         return run
 
+    # Value-column kinds the native flush understands (drain_run).
+    _NATIVE_KIND = {
+        DataType.INT8: 0, DataType.INT16: 0, DataType.INT32: 0,
+        DataType.INT64: 0, DataType.TIMESTAMP: 0, DataType.COUNTER: 0,
+        DataType.BOOL: 0,
+        DataType.DOUBLE: 1, DataType.FLOAT: 2,
+        DataType.STRING: 3, DataType.BINARY: 3, DataType.LIST: 3,
+        DataType.SET: 3, DataType.MAP: 3, DataType.JSONB: 3,
+        DataType.DECIMAL: 3, DataType.VARINT: 3, DataType.UUID: 3,
+        DataType.TIMEUUID: 3, DataType.INET: 3, DataType.DATE: 3,
+        DataType.TIME: 3, DataType.TUPLE: 3, DataType.FROZEN: 3,
+    }
+
+    @staticmethod
+    def build_from_memtable(schema: Schema, mt,
+                            rows_per_block: int) -> "ColumnarRun | None":
+        """The native flush path: one C pass over the sorted memtable
+        (yb_wp.Memtable.drain_run) emits flat packed buffers — block
+        packing, key prefixes, per-column values, RowVersion payloads —
+        and this assembles the [B, R] planes with vectorized numpy only
+        (no per-row Python anywhere). Returns None when the memtable
+        shape needs the generic path (spilled big-int rows, value kinds
+        the C pass doesn't cover) — callers fall back to
+        drain_sorted() + build(). Reference analog: the rocksdb flush
+        building the SSTable straight off the memtable iterator
+        (src/yb/rocksdb/db/flush_job.cc)."""
+        native_mt = getattr(mt, "_mt", None)
+        if native_mt is None or getattr(mt, "_spill", None):
+            return None
+        desc = []
+        for c in schema.value_columns:
+            kind = ColumnarRun._NATIVE_KIND.get(c.dtype)
+            if kind is None:
+                return None
+            desc.append((c.col_id, kind))
+        try:
+            data = native_mt.drain_run(rows_per_block, KEY_WORDS, desc)
+        except (TypeError, ValueError):
+            return None  # value shape outside the C pass: generic path
+        n = data["n"]
+        run = ColumnarRun(schema, rows_per_block)
+        R = rows_per_block
+        ranges = np.frombuffer(data["ranges"], np.int32).reshape(-1, 3)
+        B = max(1, ranges.shape[0])
+        run.B = B
+        run._alloc(B)
+        run.max_key_len = data["max_key_len"]
+        run.max_group_versions = max(run.max_group_versions,
+                                     data["max_group"])
+        run.num_versions = n
+        sizes = np.frombuffer(data["group_sizes"], np.int32)
+        keys_list = data["keys"]
+        if n == 0:
+            return run
+        # Destination slot of packed row i: blocks keep whole key
+        # groups; rows pack densely from each block's start.
+        rows_per = ranges[:, 2]
+        block_of = np.repeat(np.arange(ranges.shape[0], dtype=np.int64),
+                             rows_per)
+        offs = np.cumsum(rows_per) - rows_per
+        dst = block_of * R + (np.arange(n, dtype=np.int64)
+                              - np.repeat(offs, rows_per))
+
+        def scatter(dest, vals):
+            dest.reshape((dest.shape[0] * R,) + dest.shape[2:])[dst] = \
+                vals
+
+        ht = np.frombuffer(data["ht"], np.uint64)
+        hi, lo = P.u64_to_planes(ht)
+        scatter(run.ht_hi, hi)
+        scatter(run.ht_lo, lo)
+        run.max_ht = int(ht.max())
+        ehi, elo = P.u64_to_planes(
+            np.frombuffer(data["exp"], np.uint64) & np.uint64(MAX_HT))
+        scatter(run.exp_hi, ehi)
+        scatter(run.exp_lo, elo)
+        scatter(run.tomb, np.frombuffer(data["tomb"], np.uint8)
+                .astype(bool))
+        scatter(run.live, np.frombuffer(data["live"], np.uint8)
+                .astype(bool))
+        run.valid.reshape(-1)[dst] = True
+        gfirst = np.cumsum(sizes) - sizes
+        gs = np.zeros(n, dtype=bool)
+        gs[gfirst] = True
+        scatter(run.group_start, gs)
+        kw = np.frombuffer(data["keywords"], ">u4").reshape(
+            n, KEY_WORDS).astype(np.uint32)
+        scatter(run.key_planes, P.u32_to_plane(kw))
+        keys_arr = np.empty(len(keys_list), dtype=object)
+        keys_arr[:] = keys_list
+        scatter(run.row_keys, np.repeat(keys_arr, sizes))
+        vers_arr = np.empty(n, dtype=object)
+        vers_arr[:] = data["versions"]
+        scatter(run.row_versions, vers_arr)
+
+        for cid, entry in data["cols"].items():
+            col = run.cols.get(cid)
+            if col is None:
+                continue
+            rows = np.frombuffer(entry["rows"], np.int32)
+            if rows.size == 0:
+                continue
+            gdst = dst[rows]
+            col.set_.reshape(-1)[gdst] = True
+            nulls = np.frombuffer(entry["nulls"], np.int32)
+            if nulls.size:
+                col.isnull.reshape(-1)[dst[nulls]] = True
+            nn = rows if not nulls.size else np.setdiff1d(
+                rows, nulls, assume_unique=True)
+            ndst = dst[nn] if nulls.size else gdst
+            kind = entry["kind"]
+            cmp_flat = col.cmp_planes.reshape(
+                -1, col.cmp_planes.shape[-1])
+            if kind == 0:
+                arr = np.frombuffer(entry["ivals"], np.int64)
+                if cmp_flat.shape[-1] == 2:
+                    chi, clo = P.i64_to_ordered_planes(arr)
+                    cmp_flat[ndst, 0] = chi
+                    cmp_flat[ndst, 1] = clo
+                else:
+                    cmp_flat[ndst, 0] = arr.astype(np.int32)
+                if col.arith is not None:
+                    col.arith.reshape(-1)[ndst] = arr.astype(np.float32)
+            elif kind in (1, 2):
+                arr = np.frombuffer(entry["dvals"], np.float64)
+                if kind == 2:
+                    f32 = arr.astype(np.float32)
+                    cmp_flat[ndst, 0] = f32.view(np.int32)
+                    col.arith.reshape(-1)[ndst] = f32
+                else:
+                    chi, clo = P.f64_to_ordered_planes(arr)
+                    cmp_flat[ndst, 0] = chi
+                    cmp_flat[ndst, 1] = clo
+                    col.arith.reshape(-1)[ndst] = arr.astype(np.float32)
+            else:  # varlen: prefixes from C; containers re-prefixed here
+                pre = np.frombuffer(entry["prefix"], np.uint64).copy()
+                pyvals = entry["pyvals"]
+                maxlen = entry["maxlen"]
+                for fix_row in entry["pyfix"]:
+                    pos = int(np.searchsorted(nn, fix_row))
+                    raw = _varlen_raw(pyvals[pos])
+                    pre[pos] = int.from_bytes(
+                        raw[:8].ljust(8, b"\x00"), "big")
+                    maxlen = max(maxlen, len(raw))
+                phi = P.u32_to_plane(
+                    (pre >> np.uint64(32)).astype(np.uint32))
+                plo = P.u32_to_plane(
+                    (pre & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+                cmp_flat[ndst, 0] = phi
+                cmp_flat[ndst, 1] = plo
+                if maxlen > run.varlen_max_len.get(cid, 0):
+                    run.varlen_max_len[cid] = maxlen
+                bpos = (ndst // R).astype(np.int64)
+                rpos = (ndst % R).astype(np.int64)
+                vl = col.varlen
+                for i in range(len(pyvals)):
+                    vl[bpos[i]][rpos[i]] = pyvals[i]
+
+        for b in range(ranges.shape[0]):
+            g0, gn, nrows = (int(ranges[b, 0]), int(ranges[b, 1]),
+                             int(ranges[b, 2]))
+            run.blocks[b] = BlockMeta(keys_list[g0],
+                                      keys_list[g0 + gn - 1], nrows)
+        run.min_key = keys_list[0]
+        run.max_key = keys_list[-1]
+        return run
+
     @staticmethod
     def pack_group_ranges(sizes: list[int], R: int):
         """Greedy packing of whole key groups into R-row blocks:

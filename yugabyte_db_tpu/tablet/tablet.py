@@ -413,6 +413,11 @@ class Tablet:
         src/yb/common/ql_rowblock.h:66)."""
         return self.engine.scan_batch_wire([spec], fmt)[0]
 
+    def scan_many(self, specs: list[ScanSpec]) -> list[ScanResult]:
+        """One engine batch for many scans (the multi-key read RPC's
+        storage hop — point gets share the bloom/merge machinery)."""
+        return self.engine.scan_batch(specs)
+
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         """Flush memtable to a durable run, advance the replay frontier,

@@ -354,6 +354,16 @@ class TabletPeer:
                 raise NotLeader(self.node_uuid, None)
         return self.tablet.scan_wire(spec, fmt)
 
+    def scan_many(self, specs, allow_stale: bool = False):
+        """Batched scans under ONE leader-with-lease gate (the
+        multi-key read RPC)."""
+        if not allow_stale:
+            if not self.raft.is_leader():
+                raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+            if not self.raft.has_lease():
+                raise NotLeader(self.node_uuid, None)
+        return self.tablet.scan_many(specs)
+
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         with self._maintenance_lock:

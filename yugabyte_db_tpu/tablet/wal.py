@@ -153,14 +153,19 @@ class Log:
 
         if maybe_fault("fault.wal_sync_failed"):
             raise FaultInjected("injected WAL sync failure")
-        with self._lock:
-            if self._file is None and self._buffer:
-                self._open_segment(max(1, self.last_appended.index))
-            self._flush_buffer()
-            if self._file is not None:
-                self._file.flush()
-                if self.fsync:
-                    os.fsync(self._file.fileno())
+        from yugabyte_db_tpu.utils.watchdog import watchdog
+
+        # Standing stall check (reference: kernel_stack_watchdog.h):
+        # a wedged fsync surfaces as a flagged stall, not silence.
+        with watchdog().watch("wal.sync", threshold_s=2.0):
+            with self._lock:
+                if self._file is None and self._buffer:
+                    self._open_segment(max(1, self.last_appended.index))
+                self._flush_buffer()
+                if self._file is not None:
+                    self._file.flush()
+                    if self.fsync:
+                        os.fsync(self._file.fileno())
 
     # -- read / replay -----------------------------------------------------
     def read_all(self, min_index: int = 0):

@@ -214,6 +214,24 @@ class AdminClient:
             n += 1
         return n
 
+    def cluster_snapshot(self, action: str, table: str | None = None,
+                         snapshot_id: str | None = None) -> dict:
+        """Master-coordinated cluster snapshot (yb-admin
+        create_snapshot / restore_snapshot / delete_snapshot /
+        list_snapshots): the MASTER fans the per-tablet ops and tracks
+        the snapshot's state in the replicated sys catalog."""
+        payload = {"action": action}
+        if table is not None:
+            payload["table"] = table
+        if snapshot_id is not None:
+            payload["snapshot_id"] = snapshot_id
+        resp = self.master_rpc("master.snapshot_op", payload)
+        if resp.get("code") != "ok":
+            raise AdminError(
+                f"snapshot {action}: "
+                f"{resp.get('message', resp.get('code'))}")
+        return resp
+
     def list_snapshots(self, table: str) -> dict[str, list[str]]:
         out = {}
         for t in self.table_locations(table):

@@ -706,30 +706,45 @@ class TabletServer:
             return {"code": "timed_out"}
         return None
 
-    def _h_ts_scan(self, p: dict):
+    def _read_gate(self, p: dict, specs: list | None = None):
+        """The shared read prologue of every scan RPC: tablet lookup,
+        HLC causality (ratchet past everything the client observed
+        BEFORE choosing the read time, so a fresh read cannot miss its
+        own writes), read-point pinning, and intent resolution. With
+        ``specs`` (the batch RPC) the gate pins once at the maximum
+        explicit read point and resolves intents per spec.
+        Returns (peer, specs, None) or (None, None, error-response)."""
         try:
             peer = self.tablet_manager.get(p["tablet_id"])
         except TabletNotFound:
-            return {"code": "not_found"}
+            return None, None, {"code": "not_found"}
         if p.get("propagated_ht"):
-            # HLC causality: ratchet past everything the client has
-            # observed (its writes, txn commits) BEFORE choosing the
-            # read time, so a fresh read cannot miss them.
             from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
 
             peer.tablet.clock.update(_HT(p["propagated_ht"]))
-        spec = wire.decode_spec(p["spec"])
-        if spec.read_ht == wire.MAX_HT:
-            spec.read_ht = peer.read_time().value
-        else:
-            err = self._pin_read_point(peer, spec.read_ht,
+        if specs is None:
+            specs = [wire.decode_spec(p["spec"])]
+        explicit = [s.read_ht for s in specs if s.read_ht != wire.MAX_HT]
+        if explicit:
+            err = self._pin_read_point(peer, max(explicit),
                                        p.get("timeout", 4.0))
             if err is not None:
-                return err
-        err = self._resolve_read_intents(peer, spec)
+                return None, None, err
+        read_ht = peer.read_time().value
+        for s in specs:
+            if s.read_ht == wire.MAX_HT:
+                s.read_ht = read_ht
+            err = self._resolve_read_intents(peer, s)
+            if err is not None:
+                return None, None, err
+        TRACE("read point resolved")
+        return peer, specs, None
+
+    def _h_ts_scan(self, p: dict):
+        peer, specs, err = self._read_gate(p)
         if err is not None:
             return err
-        TRACE("read point resolved")
+        spec = specs[0]
         try:
             res = peer.scan(spec, allow_stale=p.get("allow_stale", False))
         except NotLeader as e:
@@ -739,31 +754,33 @@ class TabletServer:
         out["read_ht"] = spec.read_ht
         return out
 
+    def _h_ts_scan_batch(self, p: dict):
+        """Many scans (typically point gets) in ONE RPC: one read gate,
+        one engine batch — the server hop of the client's multi-key
+        reads (reference: the batcher packing many ops into one
+        tserver call, src/yb/client/batcher.h:80)."""
+        peer, specs, err = self._read_gate(
+            p, [wire.decode_spec(s) for s in p["specs"]])
+        if err is not None:
+            return err
+        try:
+            results = peer.scan_many(
+                specs, allow_stale=p.get("allow_stale", False))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        out = [wire.encode_result(r) for r in results]
+        return {"code": "ok", "results": out,
+                "read_ht": max(s.read_ht for s in specs)}
+
     def _h_ts_scan_wire(self, p: dict):
         """Scan returning SERIALIZED result-page bytes (fmt "cql" = CQL
         cells, "pg" = PG DataRow messages) — the reference's rows_data
         contract (src/yb/common/ql_rowblock.h:66): rows serialize once
         at the tablet and every layer above forwards bytes."""
-        try:
-            peer = self.tablet_manager.get(p["tablet_id"])
-        except TabletNotFound:
-            return {"code": "not_found"}
-        if p.get("propagated_ht"):
-            from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
-
-            peer.tablet.clock.update(_HT(p["propagated_ht"]))
-        spec = wire.decode_spec(p["spec"])
-        if spec.read_ht == wire.MAX_HT:
-            spec.read_ht = peer.read_time().value
-        else:
-            err = self._pin_read_point(peer, spec.read_ht,
-                                       p.get("timeout", 4.0))
-            if err is not None:
-                return err
-        err = self._resolve_read_intents(peer, spec)
+        peer, specs, err = self._read_gate(p)
         if err is not None:
             return err
-        TRACE("read point resolved (wire)")
+        spec = specs[0]
         try:
             pg = peer.scan_wire(spec, p.get("fmt", "cql"),
                                 allow_stale=p.get("allow_stale", False))

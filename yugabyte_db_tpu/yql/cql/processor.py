@@ -1000,14 +1000,18 @@ class QLProcessor:
                 list(projection):
             return False  # aliases: names differ from engine columns
         for name in projection:
-            dt = schema.column(name).dtype
+            col = schema.column(name)
+            dt = col.dtype
             if not dt.is_fixed_width and dt not in (DataType.STRING,
                                                     DataType.BINARY):
                 return False
-            if getattr(schema.column(name), "udt", None):
+            if getattr(col, "udt", None):
                 return False
-        tablets = self._target_tablets(handle, plan)
-        return all(hasattr(t, "scan_wire") for t in tablets)
+        # Route capability: both seams' tablet objects expose scan_wire;
+        # probe one representative instead of resolving the target set
+        # (which _run_rows resolves again right after).
+        ts = handle.tablets
+        return bool(ts) and hasattr(ts[0], "scan_wire")
 
     def _slice_limit(self, stmt, rs: ResultSet) -> ResultSet:
         limit = self._coerce_limit(stmt.limit)

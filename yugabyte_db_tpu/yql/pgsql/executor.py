@@ -1302,6 +1302,44 @@ class PgProcessor:
             names = names[:-hidden]
         return PgResult(columns=names, rows=rows)
 
+    _SCAN_POOL = None
+    _SCAN_POOL_LOCK = __import__("threading").Lock()
+
+    @classmethod
+    def _scan_pool(cls):
+        if cls._SCAN_POOL is None:
+            with cls._SCAN_POOL_LOCK:
+                if cls._SCAN_POOL is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    cls._SCAN_POOL = ThreadPoolExecutor(
+                        max_workers=4, thread_name_prefix="pg-docop")
+        return cls._SCAN_POOL
+
+    def _prefetch_scans(self, tablets, spec_of):
+        """PgDocOp-style prefetching (reference:
+        src/yb/yql/pggate/pg_doc_op.h:111 — async batched doc ops):
+        keep several tablets' reads in flight and yield results in
+        tablet order, so the next tablet's fetch overlaps this one's
+        result consumption. Single-tablet plans stay synchronous."""
+        if len(tablets) <= 1:
+            for t in tablets:
+                yield t, t.scan(spec_of(t))
+            return
+        import collections
+
+        pool = self._scan_pool()
+        futs = collections.deque()
+        idx = 0
+        inflight = 3
+        while idx < len(tablets) or futs:
+            while idx < len(tablets) and len(futs) < inflight:
+                t = tablets[idx]
+                futs.append((t, pool.submit(t.scan, spec_of(t))))
+                idx += 1
+            t, fut = futs.popleft()
+            yield t, fut.result()
+
     def _scan_dicts(self, handle, where, preds, needed, push_limit):
         """Row dicts matching WHERE: index-driven when an '='-bound
         column is indexed (index-table hash scan -> base point reads,
@@ -1335,10 +1373,12 @@ class PgProcessor:
             if idx_info:
                 break
         if idx_info is None:
-            for tablet in handle.tablets:
-                res = tablet.scan(ScanSpec(
-                    read_ht=self._read_ht(tablet), predicates=preds,
-                    projection=needed, limit=push_limit))
+            for _tablet, res in self._prefetch_scans(
+                    handle.tablets,
+                    lambda t: ScanSpec(read_ht=self._read_ht(t),
+                                       predicates=preds,
+                                       projection=needed,
+                                       limit=push_limit)):
                 for r in res.rows:
                     yield dict(zip(res.columns, r))
             return
@@ -1599,11 +1639,13 @@ class PgProcessor:
 
         spec = ScanSpec(read_ht=MAX_HT, predicates=preds,
                         aggregates=aggs, group_by=group_by or None)
-        results = []
-        for tablet in handle.tablets:
-            results.append(tablet.scan(ScanSpec(
-                read_ht=self._read_ht(tablet), predicates=preds,
-                aggregates=aggs, group_by=group_by or None)))
+        # Per-tablet partial aggregates with PgDocOp-style prefetching:
+        # every tablet's scan is in flight while partials combine.
+        results = [res for _t, res in self._prefetch_scans(
+            handle.tablets,
+            lambda t: ScanSpec(read_ht=self._read_ht(t),
+                               predicates=preds, aggregates=aggs,
+                               group_by=group_by or None))]
         combined = combine_grouped(spec, results)
         ngb = len(group_by)
 

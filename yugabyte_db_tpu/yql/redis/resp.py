@@ -124,11 +124,14 @@ class RedisConnectionContext(ConnectionContext):
     def feed(self, data: bytes) -> list:
         self._buf.extend(data)
         cmds = parse_commands(self._buf)
-        calls = []
-        for args in cmds:
-            calls.append((self._seq, "redis", args))
-            self._seq += 1
-        return calls
+        if not cmds:
+            return []
+        # One call carries the whole pipelined burst: the service
+        # batches runs of GET/SET into multi-key reads / one flush
+        # (replies stay in command order inside the single response).
+        call = (self._seq, "redis_batch", cmds)
+        self._seq += 1
+        return [call]
 
     def serialize(self, response) -> bytes:
         _seq, status, body = response

@@ -183,6 +183,109 @@ class RedisServiceImpl:
     # -- dispatch ------------------------------------------------------------
     _PREAUTH = frozenset(["AUTH", "PING", "QUIT", "COMMAND"])
 
+    def handle_batch(self, cmds: list[list[bytes]], conn=None) -> bytes:
+        """Pipelined execution: one call per socket read's worth of
+        parsed commands. Runs of plain GETs serve through ONE batched
+        multi-key read (ts.scan_batch via session.get_many) and runs of
+        plain SETs buffer into ONE flush — the shape that makes the
+        reference's RedisPipelinedKeyValue numbers possible (its proxy
+        batches ops through the async client; docs/yb-perf-v1.0.7.md:
+        18-19). Everything else takes the per-command path."""
+        out = []
+        i = 0
+        n = len(cmds)
+        while i < n:
+            c = cmds[i]
+            name = c[0].decode().upper() if c else ""
+            # Reply-count invariant: the batch MUST emit exactly one
+            # reply per command even when a storage call throws — a
+            # short reply stream would permanently desync the RESP
+            # pairing on this connection.
+            if name == "GET" and len(c) == 2:
+                j = i
+                keys = []
+                while j < n and len(cmds[j]) == 2 and \
+                        cmds[j][0].decode().upper() == "GET":
+                    keys.append(cmds[j][1].decode("utf-8",
+                                                  "surrogateescape"))
+                    j += 1
+                if j - i > 1:
+                    try:
+                        out.append(self._batch_get(keys, conn))
+                    except Exception as e:  # noqa: BLE001
+                        out.append(resp.error(str(e)) * len(keys))
+                    self.commands_served += j - i
+                    i = j
+                    continue
+            elif name == "SET" and len(c) == 3:
+                j = i
+                sets = []
+                while j < n and len(cmds[j]) == 3 and \
+                        cmds[j][0].decode().upper() == "SET":
+                    sets.append(
+                        (cmds[j][1].decode("utf-8", "surrogateescape"),
+                         cmds[j][2].decode("utf-8", "surrogateescape")))
+                    j += 1
+                if j - i > 1:
+                    try:
+                        out.append(self._batch_set(sets, conn))
+                    except Exception as e:  # noqa: BLE001
+                        out.append(resp.error(str(e)) * len(sets))
+                    self.commands_served += j - i
+                    i = j
+                    continue
+            try:
+                out.append(self.handle(c, conn))
+            except Exception as e:  # noqa: BLE001
+                out.append(resp.error(str(e)))
+            i += 1
+        return b"".join(out)
+
+    def _enter(self, conn, name: str) -> bytes | None:
+        """Per-command session state + auth gate (callers hold _lock)."""
+        if conn is None:
+            self._cur = self._default_state
+        else:
+            st = self._states.get(conn)
+            if st is None:
+                st = self._states[conn] = _ConnState()
+            self._cur = st
+        if self.config.get("requirepass") and not self._cur.authed \
+                and name not in self._PREAUTH:
+            return resp.error("NOAUTH Authentication required.")
+        return None
+
+    def _batch_get(self, keys: list[str], conn) -> bytes:
+        with self._lock:
+            err = self._enter(conn, "GET")
+            if err is not None:
+                return err * len(keys)
+            if self._monitors:
+                for k in keys:
+                    self._feed_monitors(conn, "GET", [k])
+            rows = self.session.get_many(
+                self.table,
+                [{"rkey": self._rk(k), "field": ""} for k in keys])
+            return b"".join(
+                resp.bulk(None if r is None else r[2]) for r in rows)
+
+    def _batch_set(self, sets: list[tuple[str, str]], conn) -> bytes:
+        with self._lock:
+            err = self._enter(conn, "SET")
+            if err is not None:
+                return err * len(sets)
+            if self._monitors:
+                for k, v in sets:
+                    self._feed_monitors(conn, "SET", [k, v])
+            try:
+                for k, v in sets:
+                    self.session.insert(self.table, {
+                        "rkey": self._rk(k), "field": "", "value": v})
+                self.session.flush()
+            finally:
+                self.session._ops.clear()
+            return resp.simple("OK") * len(sets)
+
     def handle(self, args: list[bytes], conn=None) -> bytes:
         self.commands_served += 1
         name = args[0].decode().upper()
@@ -900,7 +1003,9 @@ class RedisServer:
                                         **kwargs)
 
     def listen(self, host: str = "127.0.0.1", port: int = 0):
-        def handler(conn, _method, args):
+        def handler(conn, method, args):
+            if method == "redis_batch":
+                return self.service.handle_batch(args, conn)
             return self.service.handle(args, conn)
         handler.takes_conn = True
 
