@@ -694,14 +694,17 @@ class Master:
             return {"code": "ok", "tablets": len(tablets)}
         if action == "delete":
             errs = self._snapshot_fanout(tablets, sid, "delete_snapshot")
+            if errs:
+                # Keep the registry entry so the delete is retryable;
+                # removing it would orphan per-tablet snapshot data on
+                # the replicas that did not get the op.
+                return {"code": "error",
+                        "message": f"delete {sid}: {errs[0]}"}
             try:
                 self.raft.replicate("catalog", {
                     "op": "snapshot_remove", "snapshot_id": sid})
             except NotLeader:
                 return self._not_leader()
-            if errs:
-                return {"code": "error",
-                        "message": f"delete {sid}: {errs[0]}"}
             return {"code": "ok"}
         return {"code": "error", "message": f"bad action {action!r}"}
 

@@ -449,6 +449,44 @@ def decode_doc_key(buf: bytes) -> tuple[int | None, list, list]:
     return hash_code, hashed, ranges
 
 
+def full_doc_key_of(buf: bytes, num_hash: int,
+                    num_range: int) -> bytes | None:
+    """The canonical FULL doc key when ``buf`` binds every key column,
+    else None. Accepts both spellings: the full encoded key (trailing
+    GROUP_END) and the all-components-bound prefix
+    (encode_doc_key_prefix output, no terminator) — the prefix gets its
+    terminator appended. Used to classify exact-key reads."""
+    pos = 0
+    hashed = 0
+    if num_hash:
+        if not buf or buf[0] != TAG_HASH:
+            return None
+        pos = 3
+        try:
+            while pos < len(buf) and buf[pos] != GROUP_END:
+                _v, pos = decode_key_component(buf, pos)
+                hashed += 1
+        except Exception:  # noqa: BLE001 — not a decodable key
+            return None
+        if pos >= len(buf) or hashed != num_hash:
+            return None
+        pos += 1  # hashed-section GROUP_END
+    ranges = 0
+    try:
+        while pos < len(buf) and buf[pos] != GROUP_END:
+            _v, pos = decode_key_component(buf, pos)
+            ranges += 1
+    except Exception:  # noqa: BLE001
+        return None
+    if ranges != num_range:
+        return None
+    if pos == len(buf):
+        return buf + bytes([GROUP_END])  # prefix form
+    if pos == len(buf) - 1 and buf[pos] == GROUP_END:
+        return buf  # already the full key
+    return None
+
+
 def hashed_prefix(buf: bytes) -> bytes:
     """The hashed-components section of an encoded key, INCLUDING its
     terminating GROUP_END — the unit the run bloom filters key on

@@ -453,9 +453,9 @@ def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
              -(-limit // 128) * 128)
     start_t = 0
     start_key = spec.lower
-    if resume is not None:
-        from yugabyte_db_tpu.utils import codec as _codec
+    from yugabyte_db_tpu.utils import codec as _codec
 
+    if resume is not None:
         start_t, last_key = _codec.decode(resume)
         start_key = max(spec.lower, last_key + b"\x00")
     lo, hi = st.row_bounds(spec.lower, spec.upper)
@@ -485,7 +485,6 @@ def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
     key_pos = {c.name: i for i, c in enumerate(schema.key_columns)}
     rows: list[tuple] = []
     scanned = 0
-    resume = None
     budget = limit
     mesh_b = st.mesh.shape["b"]
     shard_rows = st.Bl * st.R
@@ -509,8 +508,6 @@ def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
         page_full = budget <= 0
         if sel and (more_in_tablet
                     or (page_full and t + 1 < len(st.runs))):
-            from yugabyte_db_tpu.utils import codec as _codec
-
             resume_out = _codec.encode([t, run.key_at(sel[-1])])
             break
         if page_full:

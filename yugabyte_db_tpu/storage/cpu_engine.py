@@ -308,6 +308,43 @@ class CpuStorageEngine(StorageEngine):
                     versions.extend(src.get(key))
             yield key, merge_versions(key, versions, spec.read_ht)
 
+    def scan_batch(self, specs: list[ScanSpec]) -> list[ScanResult]:
+        """Point gets skip the k-way source merge: one map/bisect lookup
+        per source (the DocRowwiseIterator point-get shape); everything
+        else takes the generic scan. Results are identical to scan() —
+        pinned by tests/test_point_fastpath.py."""
+        from yugabyte_db_tpu.storage.scan_spec import point_key_of
+
+        out = []
+        for s in specs:
+            pk = point_key_of(s, self.schema)
+            out.append(self.scan(s) if pk is None
+                       else self._point_scan(s, pk))
+        return out
+
+    def _point_scan(self, spec: ScanSpec, key: bytes) -> ScanResult:
+        versions: list[RowVersion] = list(self.memtable.versions(key))
+        for run in self.runs:
+            versions.extend(run.get(key))
+        projection = spec.projection or [c.name for c in
+                                         self.schema.columns]
+        rows: list[tuple] = []
+        resume = None
+        scanned = 0
+        if versions:
+            scanned = 1
+            merged = merge_versions(key, versions, spec.read_ht)
+            if merged.exists:
+                key_vals = self.mat.key_values(key)
+                if self.mat.matches(spec, key_vals, merged):
+                    rows.append(tuple(
+                        self.mat.value(name, key_vals, merged)
+                        for name in projection))
+                    if spec.limit is not None and \
+                            len(rows) >= spec.limit:
+                        resume = key + b"\x00"
+        return ScanResult(projection, rows, resume, scanned)
+
     def scan(self, spec: ScanSpec) -> ScanResult:
         if spec.is_aggregate:
             return self._scan_aggregate(spec)

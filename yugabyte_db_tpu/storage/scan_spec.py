@@ -108,3 +108,29 @@ class ScanResult:
     # engine that stops exactly at the limit. Unlimited tombstone-free
     # scans agree across engines (pinned by tests/test_gather.py).
     rows_scanned: int = 0
+
+
+def point_key_of(spec: ScanSpec, schema=None) -> bytes | None:
+    """The single doc key an exact-key-range spec can contain, or None
+    when the spec is not a point read. Shapes: [key, key+0xff) (the
+    processor's exact-key convention — lower is always a FULL doc key
+    there) and, given the schema, [key, prefix_successor(key)) where
+    lower binds every hash AND range component (the client GET / CQL
+    full-PK shapes; the prefix spelling gets its terminator appended).
+    The schema check matters: a hash-prefix scan (WHERE on the hash
+    columns only) also has upper == prefix_successor(lower) but spans
+    many keys."""
+    if not spec.lower or not spec.upper or spec.is_aggregate or \
+            spec.group_by:
+        return None
+    if spec.upper == spec.lower + b"\xff":
+        return spec.lower
+    if schema is None:
+        return None
+    from yugabyte_db_tpu.models.encoding import (full_doc_key_of,
+                                                 prefix_successor)
+
+    if spec.upper != prefix_successor(spec.lower):
+        return None
+    return full_doc_key_of(spec.lower, len(schema.hash_columns),
+                           len(schema.range_columns))

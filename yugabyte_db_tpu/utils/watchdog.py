@@ -58,7 +58,11 @@ class StallWatchdog:
                 self._active.pop(token, None)
                 flagged = token in self._flagged
                 self._flagged.discard(token)
-                if dur > threshold_s and not flagged:
+                if dur > threshold_s:
+                    # Record the FINAL duration even when the sampler
+                    # already flagged the in-flight section — the
+                    # completed record is what duration-based standing
+                    # checks assert on.
                     self._record_locked(label, dur, rec[3], done=True)
 
     def _ensure_thread(self) -> None:

@@ -294,18 +294,11 @@ class RedisServiceImpl:
             return resp.error(f"unknown command '{name}'")
         try:
             with self._lock:
-                if conn is None:
-                    self._cur = self._default_state
-                else:
-                    st = self._states.get(conn)
-                    if st is None:
-                        st = self._states[conn] = _ConnState()
-                    self._cur = st
+                err = self._enter(conn, name)
+                if err is not None:
+                    return err
                 decoded = [a.decode("utf-8", "surrogateescape")
                            for a in args[1:]]
-                if (self.config.get("requirepass") and not self._cur.authed
-                        and name not in self._PREAUTH):
-                    return resp.error("NOAUTH Authentication required.")
                 self._feed_monitors(conn, name, decoded)
                 try:
                     return fn(decoded, conn) if getattr(
