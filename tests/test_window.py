@@ -1,0 +1,190 @@
+"""Window functions: fn(...) OVER (PARTITION BY ... ORDER BY ...).
+
+Reference capability: stock PG 11.2's WindowAgg node above the FDW scans
+(src/postgres/src/backend/executor/nodeWindowAgg.c); test style follows
+src/yb/yql/pgwrapper/pg_libpq-test.cc. Covers ranking functions
+(row_number/rank/dense_rank), lag/lead, aggregate windows with PG's
+default RANGE UNBOUNDED PRECEDING .. CURRENT ROW frame (peer rows share
+the running value), partitioned and unpartitioned, over base tables,
+CTEs, views, and joins.
+"""
+
+import pytest
+
+from yugabyte_db_tpu.utils.status import InvalidArgument
+from yugabyte_db_tpu.yql.pgsql import PgProcessor
+from yugabyte_db_tpu.yql.cql.processor import LocalCluster
+
+
+@pytest.fixture(params=["cpu", "tpu"])
+def pg(request, tmp_path):
+    cluster = LocalCluster(str(tmp_path), num_tablets=2,
+                           engine=request.param,
+                           engine_options={"rows_per_block": 16})
+    proc = PgProcessor(cluster)
+    yield proc
+    cluster.close()
+
+
+def seed(pg):
+    pg.execute("CREATE TABLE sales (id bigint PRIMARY KEY, rgn text, "
+               "amt bigint)")
+    for i, (rgn, amt) in enumerate([("e", 100), ("e", 300), ("e", 300),
+                                    ("w", 50), ("w", 200)], start=1):
+        pg.execute(f"INSERT INTO sales (id, rgn, amt) VALUES "
+                   f"({i}, '{rgn}', {amt})")
+
+
+def test_row_number_global(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, row_number() OVER (ORDER BY amt DESC, id) "
+                   "AS rn FROM sales ORDER BY rn")
+    assert r.rows == [(2, 1), (3, 2), (5, 3), (1, 4), (4, 5)]
+
+
+def test_row_number_partitioned(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, row_number() OVER (PARTITION BY rgn "
+                   "ORDER BY amt) AS rn FROM sales ORDER BY id")
+    assert r.rows == [(1, 1), (2, 2), (3, 3), (4, 1), (5, 2)]
+
+
+def test_rank_and_dense_rank_ties(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, rank() OVER (ORDER BY amt DESC) AS rk, "
+                   "dense_rank() OVER (ORDER BY amt DESC) AS dr "
+                   "FROM sales ORDER BY id")
+    # amts: 100,300,300,50,200 -> desc order 300,300,200,100,50
+    assert r.rows == [(1, 4, 3), (2, 1, 1), (3, 1, 1), (4, 5, 4),
+                      (5, 3, 2)]
+
+
+def test_lag_lead(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, lag(amt) OVER (PARTITION BY rgn "
+                   "ORDER BY id) AS prev, lead(amt) OVER (PARTITION BY "
+                   "rgn ORDER BY id) AS nxt FROM sales ORDER BY id")
+    assert r.rows == [(1, None, 300), (2, 100, 300), (3, 300, None),
+                      (4, None, 200), (5, 50, None)]
+
+
+def test_lag_offset_and_default(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, lag(amt, 2, 0) OVER (ORDER BY id) AS p2 "
+                   "FROM sales ORDER BY id")
+    assert r.rows == [(1, 0), (2, 0), (3, 100), (4, 300), (5, 300)]
+
+
+def test_lag_bound_param_offset_and_default(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, lag(amt, $1, $2) OVER (ORDER BY id) AS p "
+                   "FROM sales ORDER BY id", [2, -1])
+    assert r.rows == [(1, -1), (2, -1), (3, 100), (4, 300), (5, 300)]
+
+
+def test_running_sum_default_frame(pg):
+    seed(pg)
+    # PG default frame with ORDER BY: peers (equal order keys) share the
+    # running value — ids 2 and 3 are both amt=300 but distinct order
+    # keys here (ORDER BY id), so a plain prefix sum.
+    r = pg.execute("SELECT id, sum(amt) OVER (PARTITION BY rgn "
+                   "ORDER BY id) AS run FROM sales ORDER BY id")
+    assert r.rows == [(1, 100), (2, 400), (3, 700), (4, 50), (5, 250)]
+
+
+def test_running_sum_peer_rows_share(pg):
+    seed(pg)
+    # ORDER BY amt: ids 2,3 are peers (amt=300) -> both see the full
+    # 700 running total, exactly PG's RANGE-frame semantics.
+    r = pg.execute("SELECT id, sum(amt) OVER (PARTITION BY rgn "
+                   "ORDER BY amt) AS run FROM sales ORDER BY id")
+    assert r.rows == [(1, 100), (2, 700), (3, 700), (4, 50), (5, 250)]
+
+
+def test_whole_partition_aggregates(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, sum(amt) OVER (PARTITION BY rgn) AS tot, "
+                   "count(*) OVER (PARTITION BY rgn) AS n, "
+                   "avg(amt) OVER (PARTITION BY rgn) AS mean "
+                   "FROM sales ORDER BY id")
+    assert r.rows == [(1, 700, 3, 700 / 3), (2, 700, 3, 700 / 3),
+                      (3, 700, 3, 700 / 3), (4, 250, 2, 125.0),
+                      (5, 250, 2, 125.0)]
+
+
+def test_min_max_over(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, min(amt) OVER (PARTITION BY rgn) AS lo, "
+                   "max(amt) OVER (PARTITION BY rgn) AS hi "
+                   "FROM sales WHERE rgn = 'e' ORDER BY id")
+    assert r.rows == [(1, 100, 300), (2, 100, 300), (3, 100, 300)]
+
+
+def test_window_over_cte(pg):
+    seed(pg)
+    r = pg.execute("WITH big AS (SELECT id, rgn, amt FROM sales "
+                   "WHERE amt >= 100) "
+                   "SELECT id, rank() OVER (ORDER BY amt DESC) AS rk "
+                   "FROM big ORDER BY id")
+    assert r.rows == [(1, 4), (2, 1), (3, 1), (5, 3)]
+
+
+def test_window_over_view(pg):
+    seed(pg)
+    pg.execute("CREATE VIEW east AS SELECT id, amt FROM sales "
+               "WHERE rgn = 'e'")
+    r = pg.execute("SELECT id, row_number() OVER (ORDER BY amt DESC, id)"
+                   " AS rn FROM east ORDER BY rn")
+    assert r.rows == [(2, 1), (3, 2), (1, 3)]
+
+
+def test_window_over_join(pg):
+    seed(pg)
+    pg.execute("CREATE TABLE rgns (rgn text PRIMARY KEY, nm text)")
+    pg.execute("INSERT INTO rgns (rgn, nm) VALUES ('e', 'east')")
+    pg.execute("INSERT INTO rgns (rgn, nm) VALUES ('w', 'west')")
+    r = pg.execute("SELECT s.id, row_number() OVER (PARTITION BY r.nm "
+                   "ORDER BY s.amt DESC) AS rn FROM sales s "
+                   "JOIN rgns r ON s.rgn = r.rgn ORDER BY s.id")
+    assert r.rows == [(1, 3), (2, 1), (3, 2), (4, 2), (5, 1)]
+
+
+def test_window_star_projection(pg):
+    seed(pg)
+    r = pg.execute("SELECT *, row_number() OVER (ORDER BY id) AS rn "
+                   "FROM sales WHERE rgn = 'w' ORDER BY id")
+    assert [row[-1] for row in r.rows] == [1, 2]
+    assert len(r.columns) == 4
+
+
+def test_window_with_limit_offset(pg):
+    seed(pg)
+    r = pg.execute("SELECT id, row_number() OVER (ORDER BY amt DESC, id)"
+                   " AS rn FROM sales ORDER BY rn LIMIT 2 OFFSET 1")
+    assert r.rows == [(3, 2), (5, 3)]
+
+
+def test_fromless_window(pg):
+    r = pg.execute("SELECT row_number() OVER () AS rn")
+    assert r.rows == [(1,)]
+    with pytest.raises(InvalidArgument):
+        pg.execute("SELECT row_number() OVER (ORDER BY x)")
+
+
+def test_window_rejects_group_by(pg):
+    seed(pg)
+    with pytest.raises(InvalidArgument):
+        pg.execute("SELECT rgn, row_number() OVER (ORDER BY rgn) "
+                   "FROM sales GROUP BY rgn")
+
+
+def test_window_requires_over(pg):
+    seed(pg)
+    with pytest.raises(InvalidArgument):
+        pg.execute("SELECT row_number() FROM sales")
+
+
+def test_window_unknown_column(pg):
+    seed(pg)
+    with pytest.raises(InvalidArgument):
+        pg.execute("SELECT row_number() OVER (ORDER BY nope) FROM sales")

@@ -1129,20 +1129,20 @@ class TpuStorageEngine(StorageEngine):
                     runs[0], spec, pred_split, aggregate=True))
             return ("host", lambda: self._row_scan(
                 spec, runs, mem_live, pred_split, aggregate=True, mem=mem))
+        page_eligible = (single_source and runs
+                         and spec.limit is not None
+                         and spec.limit <= host_page.MAX_PAGE_LIMIT
+                         and runs[0].crun.max_group_versions <= 1
+                         and not superset and not host_only)
+        page_pred_items = (host_page.encode_pred_items(self, exact)
+                           if page_eligible else None)
         pk = self._point_key(spec)
         if pk is not None:
             # Exact-key read: the bloom-pruned per-key lookup beats both
             # the generic source-merge (~10x) and a device dispatch (the
             # link RTT). The native page server keeps flat-run LIMIT
             # point reads (it emits them in C).
-            page_ok = (single_source and runs
-                       and spec.limit is not None
-                       and spec.limit <= host_page.MAX_PAGE_LIMIT
-                       and runs[0].crun.max_group_versions <= 1
-                       and not superset and not host_only
-                       and host_page.encode_pred_items(self, exact)
-                       is not None)
-            if not page_ok:
+            if page_pred_items is None:
                 def point():
                     projection, rows, resume, scanned = \
                         self._point_get_row(spec, mem, pk)
@@ -1154,11 +1154,8 @@ class TpuStorageEngine(StorageEngine):
             # Result-bound LIMIT pages on a flat run with host-exact
             # predicates: serve from the host mirror (block-cache analog,
             # storage.host_page) — no device round trip for ~100 rows.
-            if (spec.limit is not None
-                    and spec.limit <= host_page.MAX_PAGE_LIMIT
-                    and runs[0].crun.max_group_versions <= 1
-                    and not superset and not host_only):
-                pred_items = host_page.encode_pred_items(self, exact)
+            if page_eligible:
+                pred_items = page_pred_items
                 if pred_items is not None:
                     # Deferred: scan_batch_async batch-plans all pages
                     # (one vectorized searchsorted per shared structure).

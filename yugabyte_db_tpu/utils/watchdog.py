@@ -62,8 +62,10 @@ class StallWatchdog:
                     # Record the FINAL duration even when the sampler
                     # already flagged the in-flight section — the
                     # completed record is what duration-based standing
-                    # checks assert on.
-                    self._record_locked(label, dur, rec[3], done=True)
+                    # checks assert on. One stall = one stall_count,
+                    # even when both sampler and exit record it.
+                    self._record_locked(label, dur, rec[3], done=True,
+                                        count=not flagged)
 
     def _ensure_thread(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -90,8 +92,9 @@ class StallWatchdog:
                                         done=False)
 
     def _record_locked(self, label: str, dur: float, tname: str,
-                       done: bool) -> None:
-        self.stall_count += 1
+                       done: bool, count: bool = True) -> None:
+        if count:
+            self.stall_count += 1
         if len(self._records) >= _MAX_RECORDS:
             del self._records[: _MAX_RECORDS // 2]
         self._records.append({"label": label, "seconds": round(dur, 3),

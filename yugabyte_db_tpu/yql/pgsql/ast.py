@@ -172,6 +172,25 @@ class Func:
 
 
 @dataclass
+class WindowFunc:
+    """fn(arg) OVER (PARTITION BY ... ORDER BY ...) — evaluated
+    host-side over the fetched relation, the work stock PG's
+    nodeWindowAgg.c does above the FDW (reference capability:
+    src/postgres/src/backend/executor/nodeWindowAgg.c). With ORDER BY,
+    aggregate windows use PG's default frame (RANGE UNBOUNDED PRECEDING
+    .. CURRENT ROW): running values where order-key peers share a
+    result; without ORDER BY the frame is the whole partition."""
+
+    fn: str                    # row_number|rank|dense_rank|lag|lead|
+                               # sum|count|avg|min|max
+    arg: object | None         # storage.expr tree (None: row_number etc)
+    partition_by: list[str] = field(default_factory=list)
+    order_by: list["OrderBy"] = field(default_factory=list)
+    offset: int = 1            # lag/lead displacement
+    default: object = None     # lag/lead out-of-partition fill
+
+
+@dataclass
 class SelectItem:
     expr: object               # "*" | storage.expr tree | Agg
     alias: str | None = None

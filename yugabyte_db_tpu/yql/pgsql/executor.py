@@ -761,17 +761,192 @@ class PgProcessor:
                 hidden += 1
         rows = [tuple(self._eval_item(e, d) for e in exprs)
                 for d in dicts]
-        if stmt.distinct:
-            if hidden:
+        return self._dedup_order_trim(stmt, names, rows, limit, hidden)
+
+    def _select_window(self, stmt: ast.Select) -> PgResult:
+        """SELECT with window-function items. Rewrite as a two-stage
+        plan: fetch the full relation (base table / view / CTE / join —
+        the inner SELECT reuses every existing path), then evaluate
+        windows host-side and project — the split stock PG's planner
+        makes between the scan below and WindowAgg above the FDW
+        (reference capability:
+        src/postgres/src/backend/executor/nodeWindowAgg.c)."""
+        import dataclasses as _dc
+
+        if (stmt.group_by or stmt.having
+                or any(isinstance(it.expr, ast.Agg) for it in stmt.items)):
+            raise InvalidArgument(
+                "window functions cannot be combined with GROUP BY or "
+                "plain aggregates")
+        if stmt.table is None:
+            # FROM-less window (PG: SELECT row_number() OVER () -> 1):
+            # the relation is one empty row.
+            dicts, star, known = [{}], [], set()
+        elif stmt.joins:
+            dicts, tables, handles, _q, owners = self._join_rows(stmt)
+            star = [f"{a}.{c.name}" for a, _t in tables
+                    for c in handles[a].schema.columns]
+            known = set(star) | {n for n, als in owners.items()
+                                 if len(als) == 1}
+        else:
+            stmt = self._strip_qualifiers(stmt)
+            inner = _dc.replace(stmt, items=[ast.SelectItem("*")],
+                                order_by=[], limit=None, offset=None,
+                                distinct=False)
+            base = self._exec_select(inner)
+            star = list(base.columns)
+            dicts = [dict(zip(star, r)) for r in base.rows]
+            known = set(star)
+        for it in stmt.items:
+            if it.expr == "*":
+                continue
+            for c in self._item_columns(it.expr):
+                if c not in known:
+                    raise InvalidArgument(
+                        f"column {c} is not in the relation")
+        names: list[str] = []
+        series: list[list] = []
+        for it in stmt.items:
+            e = it.expr
+            if e == "*":
+                for c in star:
+                    names.append(c.split(".")[-1])
+                    series.append([d.get(c) for d in dicts])
+                continue
+            if isinstance(e, ast.WindowFunc):
+                names.append(it.alias or e.fn)
+                series.append(self._eval_window(e, dicts))
+            else:
+                if isinstance(e, X.Col):
+                    names.append(it.alias or e.name.split(".")[-1])
+                else:
+                    names.append(it.alias or "?column?")
+                series.append([self._eval_item(e, d) for d in dicts])
+        # Hidden ORDER BY columns (may reference non-projected columns;
+        # PG allows this for non-DISTINCT selects).
+        hidden = 0
+        for ob in stmt.order_by:
+            if ob.column not in names and ob.column in known:
+                names.append(ob.column)
+                series.append([d.get(ob.column) for d in dicts])
+                hidden += 1
+        rows = [tuple(s[i] for s in series) for i in range(len(dicts))]
+        return self._dedup_order_trim(stmt, names, rows,
+                                      self._limit(stmt), hidden)
+
+    def _eval_window(self, wf: ast.WindowFunc, dicts: list[dict]) -> list:
+        """One window function over the relation: returns a value per
+        input row (input order preserved by the caller). Aggregate
+        windows with ORDER BY use PG's default frame — RANGE UNBOUNDED
+        PRECEDING .. CURRENT ROW — so order-key peers share the running
+        value; without ORDER BY the frame is the whole partition."""
+        for c in wf.partition_by + [ob.column for ob in wf.order_by]:
+            if dicts and c not in dicts[0]:
                 raise InvalidArgument(
-                    "for SELECT DISTINCT, ORDER BY expressions must "
-                    "appear in the select list")
-            rows = list(dict.fromkeys(rows))
-        rows = self._order_and_limit(stmt, names, rows, limit)
-        if hidden:
-            rows = [r[:-hidden] for r in rows]
-            names = names[:-hidden]
-        return PgResult(columns=names, rows=rows)
+                    f"column {c} is not in the relation")
+        off = self._resolve(wf.offset)
+        default = self._resolve(wf.default)
+        if wf.fn in ("lag", "lead") and (not isinstance(off, int)
+                                         or isinstance(off, bool)
+                                         or off < 0):
+            raise InvalidArgument(f"{wf.fn} offset must be a "
+                                  "non-negative integer")
+        parts: dict[tuple, list[int]] = {}
+        for i, d in enumerate(dicts):
+            parts.setdefault(tuple(d.get(c) for c in wf.partition_by),
+                             []).append(i)
+        out: list = [None] * len(dicts)
+        for order in parts.values():
+            order = list(order)  # stable within equal order keys
+            for ob in reversed(wf.order_by):
+                order.sort(key=lambda i, c=ob.column:
+                           ((dicts[i].get(c) is None), dicts[i].get(c)),
+                           reverse=ob.desc)
+            okeys = [tuple(dicts[i].get(ob.column) for ob in wf.order_by)
+                     for i in order]
+            fn = wf.fn
+            if fn == "row_number":
+                for pos, i in enumerate(order):
+                    out[i] = pos + 1
+            elif fn in ("rank", "dense_rank"):
+                rank = dense = 0
+                prev: object = object()
+                for pos, i in enumerate(order):
+                    if okeys[pos] != prev:
+                        rank, prev = pos + 1, okeys[pos]
+                        dense += 1
+                    out[i] = rank if fn == "rank" else dense
+            elif fn in ("lag", "lead"):
+                vals = [self._eval_item(wf.arg, dicts[i]) for i in order]
+                step = off if fn == "lag" else -off
+                for pos, i in enumerate(order):
+                    j = pos - step
+                    out[i] = (vals[j] if 0 <= j < len(vals)
+                              else default)
+            else:  # sum/count/avg/min/max over the frame
+                star = wf.arg is None
+                args = ([None] * len(order) if star else
+                        [self._eval_item(wf.arg, dicts[i])
+                         for i in order])
+                if not wf.order_by:
+                    val = self._win_agg(fn, args, len(order), star)
+                    for i in order:
+                        out[i] = val
+                else:
+                    # Incremental accumulator: carry count/sum/min/max
+                    # across peer-group boundaries (the frame only ever
+                    # grows), O(n) per partition.
+                    n_seen = cnt = 0
+                    total = lo = hi = None
+                    pos = 0
+                    while pos < len(order):
+                        end = pos
+                        while end < len(order) and okeys[end] == okeys[pos]:
+                            end += 1
+                        n_seen = end
+                        for v in args[pos:end]:
+                            if v is None:
+                                continue
+                            cnt += 1
+                            total = v if total is None else total + v
+                            lo = v if lo is None or v < lo else lo
+                            hi = v if hi is None or v > hi else hi
+                        if fn == "count":
+                            val = n_seen if star else cnt
+                        elif cnt == 0:
+                            val = None
+                        elif fn == "sum":
+                            val = total
+                        elif fn == "avg":
+                            val = total / cnt
+                        elif fn == "min":
+                            val = lo
+                        elif fn == "max":
+                            val = hi
+                        else:
+                            raise InvalidArgument(
+                                f"unknown window aggregate {fn}")
+                        for p in range(pos, end):
+                            out[order[p]] = val
+                        pos = end
+        return out
+
+    @staticmethod
+    def _win_agg(fn: str, args: list, n_rows: int, star: bool):
+        if fn == "count":
+            return n_rows if star else sum(v is not None for v in args)
+        vals = [v for v in args if v is not None]
+        if not vals:
+            return None
+        if fn == "sum":
+            return sum(vals)
+        if fn == "avg":
+            return sum(vals) / len(vals)
+        if fn == "min":
+            return min(vals)
+        if fn == "max":
+            return max(vals)
+        raise InvalidArgument(f"unknown window aggregate {fn}")
 
     def _exec_select(self, stmt: ast.Select):
         if getattr(stmt, "ctes", None):
@@ -789,6 +964,8 @@ class PgProcessor:
                 return self._exec_select(_dc.replace(stmt, ctes=[]))
             finally:
                 self._cte_results = saved
+        if any(isinstance(it.expr, ast.WindowFunc) for it in stmt.items):
+            return self._select_window(stmt)
         cte = (getattr(self, "_cte_results", None) or {}).get(stmt.table)
         if cte is not None:
             if stmt.joins:
@@ -856,6 +1033,13 @@ class PgProcessor:
             if isinstance(e, ast.Agg):
                 return ast.Agg(e.fn, None if e.arg is None
                                else fix_expr(e.arg))
+            if isinstance(e, ast.WindowFunc):
+                return ast.WindowFunc(
+                    e.fn, None if e.arg is None else fix_expr(e.arg),
+                    [fix(c) for c in e.partition_by],
+                    [ast.OrderBy(fix(o.column), o.desc)
+                     for o in e.order_by],
+                    offset=e.offset, default=e.default)
             return e
 
         needs = (any("." in r.column for r in stmt.where)
@@ -884,6 +1068,15 @@ class PgProcessor:
     # -- joins (above the storage seam; reference capability: the PG
     # executor's hash/merge joins over FDW scans, src/postgres executor) --
     def _select_join(self, stmt: ast.Select):
+        joined, tables, handles, qualify, _owners = self._join_rows(stmt)
+        return self._finish_select(stmt, joined, tables, handles, qualify)
+
+    def _join_rows(self, stmt: ast.Select):
+        """Produce the joined relation as dicts keyed by both qualified
+        ('a.col') and unambiguous bare names. Returns (dicts, tables,
+        handles, qualify, owners) for _finish_select / window
+        evaluation; owners maps bare column name -> owning aliases (the
+        single source of the bare-name-resolution rule)."""
         base_alias = stmt.alias or stmt.table
         tables = [(base_alias, stmt.table)]
         tables += [(j.alias or j.table, j.table) for j in stmt.joins]
@@ -1005,7 +1198,7 @@ class PgProcessor:
             joined = [d for d in joined
                       if all(p.matches(d.get(p.column)) for p in post)]
 
-        return self._finish_select(stmt, joined, tables, handles, qualify)
+        return joined, tables, handles, qualify, owners
 
     @classmethod
     def _eval_item(cls, expr, d: dict):
@@ -1145,6 +1338,12 @@ class PgProcessor:
         if isinstance(expr, ast.Agg):
             return (cls._item_columns(expr.arg)
                     if expr.arg is not None else set())
+        if isinstance(expr, ast.WindowFunc):
+            out = (cls._item_columns(expr.arg)
+                   if expr.arg is not None else set())
+            out |= set(expr.partition_by)
+            out |= {ob.column for ob in expr.order_by}
+            return out
         if isinstance(expr, X.BinOp):
             return cls._item_columns(expr.left) | \
                 cls._item_columns(expr.right)
@@ -1294,13 +1493,7 @@ class PgProcessor:
         for d in self._scan_dicts(handle, stmt.where, preds, needed,
                                   push_limit):
             rows.append(tuple(self._eval_item(e, d) for e in exprs))
-        if stmt.distinct:
-            rows = list(dict.fromkeys(rows))
-        rows = self._order_and_limit(stmt, names, rows, limit)
-        if hidden:
-            rows = [r[:-hidden] for r in rows]
-            names = names[:-hidden]
-        return PgResult(columns=names, rows=rows)
+        return self._dedup_order_trim(stmt, names, rows, limit, hidden)
 
     _SCAN_POOL = None
     _SCAN_POOL_LOCK = __import__("threading").Lock()
@@ -1475,17 +1668,7 @@ class PgProcessor:
                 hidden += 1
         rows = [tuple(self._eval_item(e, d) for e in exprs)
                 for d in dicts]
-        if stmt.distinct:
-            if hidden:
-                raise InvalidArgument(
-                    "for SELECT DISTINCT, ORDER BY expressions must "
-                    "appear in the select list")
-            rows = list(dict.fromkeys(rows))
-        rows = self._order_and_limit(stmt, names, rows, limit)
-        if hidden:
-            rows = [r[:-hidden] for r in rows]
-            names = names[:-hidden]
-        return PgResult(columns=names, rows=rows)
+        return self._dedup_order_trim(stmt, names, rows, limit, hidden)
 
     def _host_aggregate(self, stmt: ast.Select, dicts: list[dict],
                         exprs) -> list[tuple]:
@@ -1695,6 +1878,23 @@ class PgProcessor:
                                 or isinstance(off, bool) or off < 0):
             raise InvalidArgument("OFFSET must be a non-negative integer")
         return off
+
+    def _dedup_order_trim(self, stmt: ast.Select, names: list[str],
+                          rows: list[tuple], limit, hidden: int):
+        """Shared SELECT tail: DISTINCT dedup (hidden ORDER BY columns
+        are invalid under DISTINCT, as in PG), ORDER BY + LIMIT/OFFSET,
+        then trim hidden trailing columns."""
+        if stmt.distinct:
+            if hidden:
+                raise InvalidArgument(
+                    "for SELECT DISTINCT, ORDER BY expressions must "
+                    "appear in the select list")
+            rows = list(dict.fromkeys(rows))
+        rows = self._order_and_limit(stmt, names, rows, limit)
+        if hidden:
+            rows = [r[:-hidden] for r in rows]
+            names = names[:-hidden]
+        return PgResult(columns=names, rows=rows)
 
     def _order_and_limit(self, stmt: ast.Select, names: list[str], rows,
                          limit):

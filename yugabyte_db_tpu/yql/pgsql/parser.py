@@ -633,8 +633,57 @@ class Parser:
             else:
                 arg = self._scalar()
             self.expect_sym(")")
+            if self.at_kw("OVER"):
+                return self._over(fn, arg)
             return ast.Agg(fn, arg)
+        if (t is not None and t.kind == "name"
+                and t.text.lower() in self.WINDOW_FNS
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1].text == "("):
+            fn = self.ident().lower()
+            self.expect_sym("(")
+            arg, offset, default = None, 1, None
+            if not self.at_sym(")"):
+                arg = self._scalar()
+                if self.take_sym(","):
+                    offset = self.literal()
+                    if self.take_sym(","):
+                        default = self.literal()
+            self.expect_sym(")")
+            if fn in ("lag", "lead") and arg is None:
+                raise InvalidArgument(f"{fn}() needs an argument")
+            if not self.at_kw("OVER"):
+                raise InvalidArgument(f"{fn}() requires an OVER clause")
+            return self._over(fn, arg, offset, default)
         return self._scalar()
+
+    WINDOW_FNS = frozenset({"row_number", "rank", "dense_rank",
+                            "lag", "lead"})
+
+    def _over(self, fn, arg, offset=1, default=None) -> ast.WindowFunc:
+        """OVER ( [PARTITION BY cols] [ORDER BY col [ASC|DESC], ...] )."""
+        self.expect_kw("OVER")
+        self.expect_sym("(")
+        partition: list[str] = []
+        order: list[ast.OrderBy] = []
+        if self.take_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self._colref())
+            while self.take_sym(","):
+                partition.append(self._colref())
+        if self.take_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                col = self._colref()
+                desc = bool(self.take_kw("DESC"))
+                if not desc:
+                    self.take_kw("ASC")
+                order.append(ast.OrderBy(col, desc))
+                if not self.take_sym(","):
+                    break
+        self.expect_sym(")")
+        return ast.WindowFunc(fn, arg, partition, order,
+                              offset=offset, default=default)
 
     # -- scalar expressions (storage.expr trees) ---------------------------
     def _scalar(self):
