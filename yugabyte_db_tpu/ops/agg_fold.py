@@ -299,22 +299,29 @@ def lower_aggs(spec_aggs, name_to_id, kinds):
     return tuple(dev_aggs), lowering
 
 
-def pred_literal(kind: str, value):
-    """Predicate literal -> device representation for its column kind."""
+def pred_literal_host(kind: str, value):
+    """Predicate literal -> host (numpy) device representation. Kept on
+    host so batched planners can stack many specs' literals into one
+    transfer instead of queueing a tiny H2D copy per predicate."""
     if kind == "i32":
-        return jnp.int32(int(value) if not isinstance(value, bool) else int(value))
+        return np.int32(int(value))
     if kind == "f32":
-        return jnp.float32(value)
+        return np.float32(value)
     if kind == "i64":
         hi, lo = PL.i64_to_ordered_planes(np.array([int(value)], dtype=np.int64))
-        return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
+        return np.array([hi[0], lo[0]], dtype=np.int32)
     if kind == "f64":
         hi, lo = PL.f64_to_ordered_planes(np.array([value], dtype=np.float64))
-        return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
+        return np.array([hi[0], lo[0]], dtype=np.int32)
     raw = (value.encode("utf-8", "surrogateescape")
            if isinstance(value, str) else bytes(value))
     hi, lo = PL.varlen_prefix_planes([raw])
-    return jnp.asarray(np.array([hi[0], lo[0]], dtype=np.int32))
+    return np.array([hi[0], lo[0]], dtype=np.int32)
+
+
+def pred_literal(kind: str, value):
+    """Predicate literal -> device representation for its column kind."""
+    return jnp.asarray(pred_literal_host(kind, value))
 
 
 # -- the single-dispatch full-run aggregate program --------------------------

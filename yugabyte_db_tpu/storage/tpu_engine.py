@@ -30,6 +30,8 @@ IntentAwareIterator merging regular/provisional sources
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -722,13 +724,14 @@ class TpuStorageEngine(StorageEngine):
                 return False
         return True
 
-    def _pred_sig_and_literals(self, preds):
+    def _pred_sig_and_literals(self, preds, literal_fn=None):
+        lit = _literal if literal_fn is None else literal_fn
         sigs, lits = [], []
         for p in preds:
             cid = self._name_to_id[p.column]
             kind = self._kinds[cid]
             sigs.append(dscan.PredSig(cid, kind, p.op))
-            lits.append(_literal(kind, p.value))
+            lits.append(lit(kind, p.value))
         return tuple(sigs), tuple(lits)
 
     def _pred_sigs_only(self, preds):
@@ -804,7 +807,8 @@ class TpuStorageEngine(StorageEngine):
         fetch cycle costs ~1 link RTT regardless of size, but dispatches
         and async copies pipeline — so overlapping batches (issue N+1
         before finishing N) amortizes the RTT across whole batches."""
-        plans = [self._plan_scan(s) for s in specs]
+        agg_sink: list = []
+        plans = [self._plan_scan(s, agg_sink=agg_sink) for s in specs]
 
         results: list = [None] * len(plans)
         issued_outs = []
@@ -812,6 +816,7 @@ class TpuStorageEngine(StorageEngine):
         page_items: list[tuple[int, tuple]] = []
         gathers: list[tuple[int, "_GatherScan"]] = []
         pre_work = []
+        deferred: list = []
         for pi, plan in enumerate(plans):
             if plan[0] == "host":
                 host_plans.append((pi, plan[1]))
@@ -821,8 +826,16 @@ class TpuStorageEngine(StorageEngine):
                 issued_outs.append((pi, plan[1], plan[2]))
                 if len(plan) > 3:  # host work to overlap with the fetch
                     pre_work.append(plan[3])
+            elif plan[0] == "agg_deferred":
+                deferred.append(pi)
             else:
                 gathers.append((pi, plan[1]))
+        if deferred:
+            # Single-source device aggregates dispatch together: one
+            # vmapped program per (run, signature) group.
+            items = [(pi, trun, spec, exact) for pi, (trun, spec, exact)
+                     in zip(deferred, agg_sink)]
+            issued_outs.extend(self._plan_device_aggregate_batch(items))
         # Page items defer wholesale to finish() (device work first);
         # host_page.serve_pages runs them through the native page server.
         pages = page_items
@@ -1082,9 +1095,12 @@ class TpuStorageEngine(StorageEngine):
                     int(starts[off + n_take - 1])) + b"\x00"
             off += n_take
 
-    def _plan_scan(self, spec: ScanSpec):
+    def _plan_scan(self, spec: ScanSpec, agg_sink: list | None = None):
         """-> ("host", finish()) | ("issued", outs, finish(fetched))
-           | ("gather", _GatherScan)."""
+           | ("gather", _GatherScan) | ("agg_deferred",) when agg_sink
+           is given and the spec is a single-source device aggregate —
+           the caller dispatches those together (one vmapped program per
+           signature group, _plan_device_aggregate_batch)."""
         # Snapshot the memtable BEFORE the run list: flush() appends the
         # new run and THEN swaps in an empty memtable, so (old mem, runs
         # read after) can at worst see a flushed row in both sources
@@ -1113,6 +1129,9 @@ class TpuStorageEngine(StorageEngine):
                         and not spec.group_by and not has_expr
                         and self._aggs_device_eligible(spec))
             if eligible and single_source and runs:
+                if agg_sink is not None:
+                    agg_sink.append((runs[0], spec, exact))
+                    return ("agg_deferred",)
                 outs, fin = self._plan_device_aggregate(runs[0], spec, exact)
                 return ("issued", outs, fin)
             if eligible and not single_source and (runs or mem_live):
@@ -1992,23 +2011,20 @@ class TpuStorageEngine(StorageEngine):
         return ("issued", o1, finish, pre_fetch)
 
     # -- device aggregate path ---------------------------------------------
-    def _plan_device_aggregate(self, trun: TpuRun, spec: ScanSpec,
-                               exact_preds, raw: bool = False):
-        """Single-dispatch full-run aggregate: the device fori_loops every
-        window and returns two packed vectors (ops.agg_fold) — one dispatch
-        plus two small transfers per scan, because the host link pays
-        per-transfer latency (see ops/agg_fold.py docstring)."""
-        from yugabyte_db_tpu.ops import flat_fold
+    def _device_agg_prep(self, trun: TpuRun, spec: ScanSpec, exact_preds):
+        """Host-side planning shared by the single-spec and batched
+        device-aggregate paths: compile signature, fold route, scan
+        bounds, read planes (host ints), and HOST predicate literals
+        (so batched dispatch stacks them into one transfer)."""
+        from yugabyte_db_tpu.ops import flat_fold, lookback_fold, seg_fold
 
         crun = trun.crun
         row_lo = crun.lower_row(spec.lower)
         row_hi = crun.upper_row(spec.upper)
-        pred_sigs, pred_lits = self._pred_sig_and_literals(exact_preds)
+        sigs, lits = self._pred_sig_and_literals(
+            exact_preds, literal_fn=agg_fold.pred_literal_host)
         dev_aggs, lowering = agg_fold.lower_aggs(
             spec.aggregates, self._name_to_id, self._kinds)
-
-        from yugabyte_db_tpu.ops import lookback_fold, seg_fold
-
         R = crun.R
         K = agg_fold.safe_window_blocks(R, agg_fold.FULL_WINDOW_BLOCKS)
         flat = crun.max_group_versions <= 1
@@ -2022,41 +2038,40 @@ class TpuStorageEngine(StorageEngine):
                 crun.max_group_versions <= lookback_fold.MAX_LOOKBACK:
             lb = 1 << (crun.max_group_versions - 1).bit_length()
         sig = dscan.ScanSig(B=trun.dev.B, R=R, K=K, cols=self._col_sigs(),
-                            preds=pred_sigs, aggs=dev_aggs, apply_preds=True,
-                            flat=flat, lookback=lb)
-        r_hi_, r_lo_, e_hi_, e_lo_ = self._read_planes(spec)
-
+                            preds=tuple(sigs), aggs=dev_aggs,
+                            apply_preds=True, flat=flat, lookback=lb)
         if flat_fold.supports(sig):
+            route = "flat"
+        elif lookback_fold.supports(sig):
+            route = "lookback"
+        elif seg_fold.supports(sig):
+            route = "seg"
+        else:
+            route = "full"
+        planes = self._read_plane_ints(spec)
+        return (sig, route, row_lo, row_hi, planes, tuple(lits),
+                dev_aggs, lowering)
+
+    @staticmethod
+    def _agg_route_fn(route: str, sig):
+        from yugabyte_db_tpu.ops import flat_fold, lookback_fold, seg_fold
+
+        if route == "flat":
             # Flat run: one fused full-array program (bandwidth-roofline;
             # ops.flat_fold) instead of the serialized window fold.
-            fn = flat_fold.compiled_flat_aggregate(sig)
-            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
-                            jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
-                            pred_lits)
-        elif lookback_fold.supports(sig):
+            return flat_fold.compiled_flat_aggregate(sig)
+        if route == "lookback":
             # Bounded version counts: shifted-mask resolve at the flat
             # path's memory roofline (ops.lookback_fold).
-            fn = lookback_fold.compiled_lookback_aggregate(sig)
-            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
-                            jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
-                            pred_lits)
-        elif seg_fold.supports(sig):
+            return lookback_fold.compiled_lookback_aggregate(sig)
+        if route == "seg":
             # Multi-version run: fused segmented-scan resolve
-            # (ops.seg_fold) — same results as the windowed fold without
-            # the serialized window walk.
-            fn = seg_fold.compiled_seg_aggregate(sig)
-            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
-                            jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
-                            pred_lits)
-        else:
-            W = trun.dev.B // K
-            w_first, w_last = agg_fold.window_bounds(row_lo, row_hi, R, K, W)
-            fn = agg_fold.compiled_full_aggregate(sig)
-            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
-                            jnp.int32(row_hi),
-                            jnp.int32(w_first), jnp.int32(w_last),
-                            r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
+            # (ops.seg_fold) — same results as the windowed fold.
+            return seg_fold.compiled_seg_aggregate(sig)
+        return agg_fold.compiled_full_aggregate(sig)
 
+    @staticmethod
+    def _agg_finish(spec: ScanSpec, dev_aggs, lowering, raw: bool):
         def finish(f):
             iv, fv = f
             acc, scanned = agg_fold.unpack(dev_aggs, iv, fv)
@@ -2069,7 +2084,117 @@ class TpuStorageEngine(StorageEngine):
                                                  fn_name))
             return ScanResult(names, [tuple(out_row)], None, scanned)
 
-        return [ivec, fvec], finish
+        return finish
+
+    def _plan_device_aggregate(self, trun: TpuRun, spec: ScanSpec,
+                               exact_preds, raw: bool = False):
+        """Single-dispatch full-run aggregate: the device fori_loops every
+        window and returns two packed vectors (ops.agg_fold) — one dispatch
+        plus two small transfers per scan, because the host link pays
+        per-transfer latency (see ops/agg_fold.py docstring)."""
+        prep = self._device_agg_prep(trun, spec, exact_preds)
+        return self._dispatch_prepped(trun, spec, prep, raw=raw)
+
+    @staticmethod
+    @functools.lru_cache(maxsize=64)
+    def _batched_agg_fn(route: str, sig):
+        """jit(vmap) of the per-spec aggregate program: N same-signature
+        scans (distinct bounds / read points / predicate literals) in
+        ONE dispatch. The run planes broadcast; everything else maps.
+        Distinct batch sizes retrace inside the jit cache."""
+        base = TpuStorageEngine._agg_route_fn(route, sig)
+        return jax.jit(jax.vmap(base,
+                                in_axes=(None, 0, 0, 0, 0, 0, 0, 0)))
+
+    def _plan_device_aggregate_batch(self, items):
+        """Batched device aggregates: group deferred specs by
+        (run, signature, literal shapes) and dispatch each group as one
+        vmapped program — the per-dispatch host cost and the per-scan
+        transfer latency amortize across the whole group (the tserver
+        shape: many concurrent aggregate queries differing only in
+        bounds/literals). Returns [(pi, outs, finish)] entries for the
+        async batch. Reference capability analog: doc-op batching in
+        src/yb/docdb/doc_operation.cc (one RocksDB pass serving many
+        ops) — here one DEVICE pass serving many scans."""
+        preps = []
+        for pi, trun, spec, exact in items:
+            preps.append((pi, trun, spec,
+                          self._device_agg_prep(trun, spec, exact)))
+        groups: dict = {}
+        for p in preps:
+            (pi, trun, spec,
+             (sig, route, row_lo, row_hi, planes, lits, da, lo)) = p
+            lit_shapes = tuple(l.shape for l in lits)
+            if route == "full":
+                # The windowed fold's traced fori bounds don't vmap
+                # cheaply; keep it per-spec.
+                key = ("solo", pi)
+            else:
+                key = (id(trun), sig, route, lit_shapes)
+            groups.setdefault(key, []).append(p)
+        out = []
+        for key, grp in groups.items():
+            if key[0] == "solo" or len(grp) == 1:
+                for pi, trun, spec, prep in grp:
+                    outs, fin = self._dispatch_prepped(trun, spec, prep)
+                    out.append((pi, outs, fin))
+                continue
+            _pi0, trun, _s0, (sig, route, *_rest) = grp[0]
+            n = len(grp)
+            # Pad lanes to the next power of two so drifting batch sizes
+            # share at most log2(max) compiled variants (the same trick
+            # the lookback signature uses). Pad lanes scan nothing
+            # (row_lo == row_hi == 0) and their outputs are ignored.
+            m = 1 << (n - 1).bit_length()
+            row_lo_b = np.zeros(m, np.int32)
+            row_hi_b = np.zeros(m, np.int32)
+            planes_b = np.zeros((4, m), np.int32)
+            lits0 = grp[0][3][5]
+            lits_b = [np.zeros((m,) + l.shape, l.dtype) for l in lits0]
+            for i, (_pi, _t, _s, (_sig, _r, rlo, rhi, pl, lits,
+                                  _da, _lo)) in enumerate(grp):
+                row_lo_b[i] = rlo
+                row_hi_b[i] = rhi
+                planes_b[:, i] = pl
+                for k, l in enumerate(lits):
+                    lits_b[k][i] = l
+            fn = self._batched_agg_fn(route, sig)
+            ivec, fvec = fn(trun.dev.arrays, row_lo_b, row_hi_b,
+                            planes_b[0], planes_b[1], planes_b[2],
+                            planes_b[3], tuple(lits_b))
+            for i, (pi, _t, spec, (_sig, _r, _rlo, _rhi, _pl, _lits,
+                                   dev_aggs, lowering)) in enumerate(grp):
+                fin1 = self._agg_finish(spec, dev_aggs, lowering,
+                                        raw=False)
+                out.append((pi, [ivec, fvec],
+                            lambda f, i=i, fin1=fin1:
+                            fin1((f[0][i], f[1][i]))))
+        return out
+
+    def _dispatch_prepped(self, trun: TpuRun, spec: ScanSpec, prep,
+                          raw: bool = False):
+        """Dispatch one prepped aggregate (the per-spec path and the
+        solo leg of the batched planner) without re-running host
+        planning."""
+        (sig, route, row_lo, row_hi, planes, lits,
+         dev_aggs, lowering) = prep
+        r_hi_, r_lo_, e_hi_, e_lo_ = (jnp.int32(v) for v in planes)
+        pred_lits = tuple(jnp.asarray(l) for l in lits)
+        fn = self._agg_route_fn(route, sig)
+        if route == "full":
+            W = trun.dev.B // sig.K
+            w_first, w_last = agg_fold.window_bounds(row_lo, row_hi,
+                                                     sig.R, sig.K, W)
+            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
+                            jnp.int32(row_hi),
+                            jnp.int32(w_first), jnp.int32(w_last),
+                            r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
+        else:
+            ivec, fvec = fn(trun.dev.arrays, jnp.int32(row_lo),
+                            jnp.int32(row_hi), r_hi_, r_lo_, e_hi_, e_lo_,
+                            pred_lits)
+        return [ivec, fvec], self._agg_finish(spec, dev_aggs, lowering,
+                                              raw=raw)
 
 
 class _AsyncBatch:
