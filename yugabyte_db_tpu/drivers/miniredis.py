@@ -1,0 +1,122 @@
+"""Thin RESP (Redis Serialization Protocol) client (Jedis analog).
+
+Implements the client side from the RESP2 spec, independent of the
+server's codec: array-of-bulk-strings command encoding, full reply
+parsing (simple string, error, integer, bulk, nested arrays), command
+pipelining, AUTH/SELECT session setup, and the subscribe/publish
+message-stream flow.
+
+Reference analog: the Jedis usage in java/yb-jedis-tests.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+class RedisError(Exception):
+    pass
+
+
+class RedisConnection:
+    def __init__(self, host: str, port: int,
+                 password: str | None = None, db: int | None = None,
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+        if password is not None:
+            self.command("AUTH", password)
+        if db is not None:
+            self.command("SELECT", db)
+
+    # -- encoding ------------------------------------------------------------
+    @staticmethod
+    def _encode(args: tuple) -> bytes:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            if isinstance(a, (bytes, bytearray)):
+                b = bytes(a)
+            else:
+                b = str(a).encode("utf-8")
+            out.append(b"$%d\r\n" % len(b))
+            out.append(b + b"\r\n")
+        return b"".join(out)
+
+    # -- reply parsing -------------------------------------------------------
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n + 2:]
+        return data
+
+    def _read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode("utf-8")
+        if kind == b"-":
+            raise RedisError(rest.decode("utf-8"))
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            return self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RedisError(f"bad reply type {kind!r}")
+
+    # -- commands ------------------------------------------------------------
+    def command(self, *args):
+        self.sock.sendall(self._encode(args))
+        return self._read_reply()
+
+    def pipeline(self, commands: list[tuple]):
+        """Send all commands, then read all replies (errors returned
+        in-place, as redis-py pipelines do)."""
+        self.sock.sendall(b"".join(self._encode(c) for c in commands))
+        out = []
+        for _ in commands:
+            try:
+                out.append(self._read_reply())
+            except RedisError as e:
+                out.append(e)
+        return out
+
+    # -- pub/sub -------------------------------------------------------------
+    def subscribe(self, *channels: str):
+        """SUBSCRIBE and consume the per-channel confirmations."""
+        self.sock.sendall(self._encode(("SUBSCRIBE",) + channels))
+        acks = [self._read_reply() for _ in channels]
+        return acks
+
+    def get_message(self, timeout: float = 5.0):
+        """Next pushed message on a subscribed connection."""
+        self.sock.settimeout(timeout)
+        try:
+            return self._read_reply()
+        finally:
+            self.sock.settimeout(None)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
