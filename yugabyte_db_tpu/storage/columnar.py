@@ -611,6 +611,13 @@ class ColumnarRun:
         return cols
 
     # -- run pruning (hashed-prefix bloom) ----------------------------------
+    @property
+    def bloom_ready(self) -> bool:
+        """True once the lazy hash bloom exists (callers use this to
+        avoid paying the build for workloads where a binary search on
+        one or two runs is already cheap)."""
+        return self._hash_bloom is not None
+
     def may_contain_hashed(self, prefix: bytes) -> bool:
         """Can this run contain any key with the given hashed-components
         prefix? False lets point gets / single-key scans skip the run
@@ -631,6 +638,7 @@ class ColumnarRun:
             if self._hash_bloom is not None:
                 return self._hash_bloom
             bl = BloomFilter(self.num_versions or 1)
+            prefixes: list[bytes] = []
             last = None
             for b in range(self.B):
                 n = self.blocks[b].num_valid
@@ -642,8 +650,9 @@ class ColumnarRun:
                         self._hash_bloom = True  # filter inapplicable
                         return True
                     if hp != last:
-                        bl.add(hp)
+                        prefixes.append(hp)
                         last = hp
+            bl.add_many(prefixes)
             self._hash_bloom = bl
             return bl
 
