@@ -96,6 +96,26 @@ def test_cql_paging_loop(cql):
     assert {k for k, _v in res.rows} == set(range(57))
 
 
+def test_cql_pipelined_prepared_with_errors(cql):
+    """Stream-multiplexed pipelining: errors come back in-place and the
+    connection stays usable (no desync from stale frames)."""
+    cql.execute("CREATE KEYSPACE plk")
+    cql.execute("USE plk")
+    cql.execute("CREATE TABLE t (k bigint PRIMARY KEY, v bigint)")
+    ins = cql.prepare("INSERT INTO t (k, v) VALUES (?, ?)")
+    vals = [[i, i * 2] for i in range(40)]
+    vals[7] = [7, "not-an-int"]    # per-request failure mid-window
+    vals[23] = [23, "bad"]
+    out = cql.execute_prepared_many(ins, vals, window=16)
+    assert sum(isinstance(r, CqlError) for r in out) == 2
+    assert isinstance(out[7], CqlError) and isinstance(out[23], CqlError)
+    # connection still healthy: later pipelined + sync calls work
+    sel = cql.prepare("SELECT v FROM t WHERE k = ?")
+    res = cql.execute_prepared_many(sel, [[i] for i in (1, 7, 39)])
+    assert [r.rows for r in res] == [[(2,)], [], [(78,)]]
+    assert cql.execute("SELECT count(*) FROM t").rows == [(38,)]
+
+
 def test_cql_error_frame(cql):
     with pytest.raises(CqlError) as ei:
         cql.execute("SELECT * FROM nosuch.table")

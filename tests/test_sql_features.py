@@ -224,3 +224,60 @@ def test_with_recursive_rejected(pg):
     with pytest.raises(InvalidArgument):
         pg.execute("WITH RECURSIVE r AS (SELECT id FROM items) "
                    "SELECT * FROM r")
+
+
+# -- UNION / UNION ALL -------------------------------------------------------
+
+def test_union_dedup_and_all(pg):
+    seed(pg)
+    r = pg.execute("SELECT cat FROM items WHERE price < 100 "
+                   "UNION SELECT cat FROM items WHERE qty > 4 "
+                   "ORDER BY cat")
+    assert r.rows == [("b",), ("c",)]
+    r = pg.execute("SELECT cat FROM items WHERE price < 100 "
+                   "UNION ALL SELECT cat FROM items WHERE qty > 4 "
+                   "ORDER BY cat")
+    assert r.rows == [("b",), ("b",), ("b",), ("c",), ("c",)]
+
+
+def test_union_order_limit_offset_bind_to_whole(pg):
+    seed(pg)
+    r = pg.execute("SELECT id FROM items WHERE cat = 'a' "
+                   "UNION SELECT id FROM items WHERE cat = 'b' "
+                   "ORDER BY id DESC LIMIT 3 OFFSET 1")
+    assert r.rows == [(4,), (3,), (2,)]
+
+
+def test_union_three_way_mixed(pg):
+    seed(pg)
+    # left-assoc: (a UNION ALL a) UNION b -> dedups everything so far
+    r = pg.execute("SELECT cat FROM items WHERE id = 1 "
+                   "UNION ALL SELECT cat FROM items WHERE id = 2 "
+                   "UNION SELECT cat FROM items WHERE id = 3 "
+                   "ORDER BY cat")
+    assert r.rows == [("a",), ("b",)]
+
+
+def test_union_arity_mismatch(pg):
+    seed(pg)
+    with pytest.raises(InvalidArgument):
+        pg.execute("SELECT id FROM items UNION SELECT id, cat FROM items")
+
+
+def test_union_in_cte_and_view(pg):
+    seed(pg)
+    r = pg.execute("WITH u AS (SELECT id FROM items WHERE id <= 2 "
+                   "UNION SELECT id FROM items WHERE id >= 5) "
+                   "SELECT count(*) FROM u")
+    assert r.rows == [(4,)]
+    pg.execute("CREATE VIEW uv AS SELECT id FROM items WHERE cat = 'a' "
+               "UNION SELECT id FROM items WHERE cat = 'c'")
+    r = pg.execute("SELECT id FROM uv ORDER BY id")
+    assert r.rows == [(1,), (2,), (6,)]
+
+
+def test_union_with_aggregates_per_branch(pg):
+    seed(pg)
+    r = pg.execute("SELECT count(*) FROM items WHERE cat = 'a' "
+                   "UNION ALL SELECT count(*) FROM items WHERE cat = 'b'")
+    assert sorted(r.rows) == [(2,), (3,)]

@@ -269,24 +269,30 @@ class CqlConnection:
         self.sock.sendall(hdr + body)
         return self._stream
 
+    def _recv_frame(self):
+        """Next response frame (any stream): (stream, opcode, body).
+        ERROR frames are returned, not raised — callers decide."""
+        while len(self._buf) < _HEADER.size:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise CqlError(0x0000, "connection closed")
+            self._buf += chunk
+        ver, _fl, stream, opcode, ln = _HEADER.unpack_from(self._buf)
+        if ver != 0x84:
+            raise CqlError(0x000A, f"bad response version {ver:#x}")
+        total = _HEADER.size + ln
+        while len(self._buf) < total:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise CqlError(0x0000, "connection closed")
+            self._buf += chunk
+        body = self._buf[_HEADER.size:total]
+        self._buf = self._buf[total:]
+        return stream, opcode, body
+
     def _recv(self, want_stream: int):
         while True:
-            while len(self._buf) < _HEADER.size:
-                chunk = self.sock.recv(65536)
-                if not chunk:
-                    raise CqlError(0x0000, "connection closed")
-                self._buf += chunk
-            ver, _fl, stream, opcode, ln = _HEADER.unpack_from(self._buf)
-            if ver != 0x84:
-                raise CqlError(0x000A, f"bad response version {ver:#x}")
-            total = _HEADER.size + ln
-            while len(self._buf) < total:
-                chunk = self.sock.recv(65536)
-                if not chunk:
-                    raise CqlError(0x0000, "connection closed")
-                self._buf += chunk
-            body = self._buf[_HEADER.size:total]
-            self._buf = self._buf[total:]
+            stream, opcode, body = self._recv_frame()
             if stream != want_stream:
                 continue  # e.g. unsolicited EVENT frames
             if opcode == _OP_ERROR:
@@ -378,6 +384,36 @@ class CqlConnection:
             + self._query_params(values, page_size, paging_state)
         op, payload = self._call(_OP_EXECUTE, body)
         return self._parse_result(op, payload)
+
+    def execute_prepared_many(self, prep: Prepared, values_list,
+                              window: int = 128):
+        """Pipelined EXECUTEs: up to `window` requests in flight on
+        distinct stream ids before collecting responses — the stream
+        multiplexing every stock driver does on one connection.
+        Per-request errors come back in-place as CqlError values (like
+        a redis pipeline), so one bad statement neither aborts the
+        batch nor desyncs the connection."""
+        out: list = [None] * len(values_list)
+        with self._lock:
+            pending: dict[int, int] = {}  # stream -> result index
+            i = 0
+            while i < len(values_list) or pending:
+                while i < len(values_list) and len(pending) < window:
+                    body = (struct.pack(">H", len(prep.stmt_id))
+                            + prep.stmt_id
+                            + self._query_params(values_list[i]))
+                    pending[self._send(_OP_EXECUTE, body)] = i
+                    i += 1
+                stream, op, payload = self._recv_frame()
+                j = pending.pop(stream, None)
+                if j is None:
+                    continue  # e.g. unsolicited EVENT frames
+                if op == _OP_ERROR:
+                    b = _Buf(payload)
+                    out[j] = CqlError(b.int32(), b.string())
+                else:
+                    out[j] = self._parse_result(op, payload)
+        return out
 
     def fetch_all(self, query: str, values=None,
                   page_size: int = 100) -> CqlResult:

@@ -56,6 +56,9 @@ class CatalogState:
         # sequence relations in the PG fork's catalog).
         self.views: dict[str, str] = {}
         self.sequences: dict[str, int] = {}
+        # CQL keyspaces (reference: SysNamespaceEntryPB records in the
+        # sys catalog) — shared across every connection/session.
+        self.user_keyspaces: set[str] = set()
         # Cluster snapshots: id -> {"table", "state", "tablets"} —
         # master-coordinated registry over the per-tablet snapshot ops
         # (reference: SysSnapshotEntryPB states driven by
@@ -79,6 +82,12 @@ class CatalogState:
                 return
             if kind == "drop_view":
                 self.views.pop(op["name"], None)
+                return
+            if kind == "create_keyspace":
+                self.user_keyspaces.add(op["name"])
+                return
+            if kind == "drop_keyspace":
+                self.user_keyspaces.discard(op["name"])
                 return
             if kind == "create_sequence":
                 self.sequences.setdefault(op["name"], 1)

@@ -587,6 +587,9 @@ class Master:
             q = self.catalog.views.get(p["name"])
             return ({"code": "ok", "query": q} if q is not None
                     else {"code": "not_found"})
+        if action == "list_keyspaces":
+            return {"code": "ok",
+                    "keyspaces": sorted(self.catalog.user_keyspaces)}
         if not self.raft.is_leader():
             return self._not_leader()
         if action == "create_view":
@@ -598,6 +601,14 @@ class Master:
             if p["name"] not in self.catalog.views:
                 return {"code": "not_found"}
             op = {"op": "drop_view", "name": p["name"]}
+        elif action == "create_keyspace":
+            if p["name"] in self.catalog.user_keyspaces:
+                return {"code": "already_present"}
+            op = {"op": "create_keyspace", "name": p["name"]}
+        elif action == "drop_keyspace":
+            if p["name"] not in self.catalog.user_keyspaces:
+                return {"code": "not_found"}
+            op = {"op": "drop_keyspace", "name": p["name"]}
         elif action == "create_sequence":
             if p["name"] in self.catalog.sequences:
                 return {"code": "already_present"}

@@ -225,6 +225,27 @@ class ClientCluster:
             for n, fs in resp.get("types", {}).items()}
         return cache
 
+    # -- keyspaces (shared registry through the master catalog) --------------
+    def create_keyspace(self, name: str) -> None:
+        from yugabyte_db_tpu.utils.status import AlreadyPresent
+
+        resp = self._misc_op("create_keyspace", {"name": name})
+        if resp.get("code") == "already_present":
+            raise AlreadyPresent(f"keyspace {name} exists")
+        if resp.get("code") != "ok":
+            raise RuntimeError(f"create keyspace {name}: {resp}")
+
+    def drop_keyspace(self, name: str) -> None:
+        from yugabyte_db_tpu.utils.status import NotFound
+
+        resp = self._misc_op("drop_keyspace", {"name": name})
+        if resp.get("code") == "not_found":
+            raise NotFound(f"keyspace {name} not found")
+
+    def list_keyspaces(self) -> set:
+        resp = self._misc_op("list_keyspaces", {})
+        return set(resp.get("keyspaces", ()))
+
     # -- views / sequences --------------------------------------------------
     def _misc_op(self, action: str, payload: dict) -> dict:
         resp = self.client.master_rpc("master.misc_op",

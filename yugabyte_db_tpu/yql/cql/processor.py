@@ -93,6 +93,9 @@ class LocalCluster:
         # seam replicates them through the master catalog.
         self.views: dict[str, str] = {}
         self.sequences: dict[str, int] = {}
+        # CQL keyspaces — cluster-wide (shared by every session; the
+        # distributed seam replicates them through the master catalog).
+        self.user_keyspaces: set[str] = set()
         self._seq_lock = __import__("threading").Lock()
         from yugabyte_db_tpu.auth import RoleStore
 
@@ -105,6 +108,20 @@ class LocalCluster:
 
     def auth_op(self, op: dict) -> None:
         self._auth.apply(op)
+
+    # -- keyspaces (shared across sessions) ---------------------------------
+    def create_keyspace(self, name: str) -> None:
+        from yugabyte_db_tpu.utils.status import AlreadyPresent
+
+        if name in self.user_keyspaces:
+            raise AlreadyPresent(f"keyspace {name} exists")
+        self.user_keyspaces.add(name)
+
+    def drop_keyspace(self, name: str) -> None:
+        self.user_keyspaces.discard(name)
+
+    def list_keyspaces(self) -> set:
+        return set(self.user_keyspaces)
 
     def create_table(self, name: str, schema: Schema,
                      num_tablets: int | None = None) -> TableHandle:
@@ -249,6 +266,21 @@ class Unauthorized(Exception):
     reference: UnauthorizedException from the CQL analyzer)."""
 
 
+@dataclass
+class _SelectPlan:
+    """Planned SELECT routing: one tablet (hash fully bound) or fanout,
+    plus the pushdown payload."""
+
+    single: bool
+    hash_code: int | None
+    lower: bytes
+    upper: bytes
+    predicates: list
+    projection: list | None
+    aggregates: list
+    group_by: list
+
+
 class QLProcessor:
     """One CQL session: keyspace state + statement execution.
 
@@ -259,11 +291,19 @@ class QLProcessor:
     the cluster's replicated role store (fails closed; reference:
     enforcement in the CQL analyzer against the auth vtables)."""
 
+    _BUILTIN_KEYSPACES = frozenset({"default", "system"})
+
     def __init__(self, cluster: LocalCluster, login_role: str | None = None):
         self.cluster = cluster
         self.keyspace = "default"
-        self.keyspaces = {"default", "system"}
         self.login_role = login_role
+
+    @property
+    def keyspaces(self) -> set:
+        """All known keyspaces: the built-ins plus the cluster-wide
+        registry (shared across connections — the reference keeps
+        namespaces in the master sys catalog)."""
+        return set(self._BUILTIN_KEYSPACES) | self.cluster.list_keyspaces()
 
     # -- entry points ------------------------------------------------------
     def execute(self, sql, params: list | None = None,
@@ -445,10 +485,18 @@ class QLProcessor:
             if not stmt.if_not_exists:
                 raise AlreadyPresent(f"keyspace {stmt.name} exists")
             return None
-        self.keyspaces.add(stmt.name)
+        try:
+            self.cluster.create_keyspace(stmt.name)
+        except AlreadyPresent:
+            # Lost a create race: same end state.
+            if not stmt.if_not_exists:
+                raise
         return None
 
     def _exec_drop_keyspace(self, stmt: ast.DropKeyspace):
+        if stmt.name in self._BUILTIN_KEYSPACES:
+            raise InvalidArgument(
+                f"keyspace {stmt.name} cannot be dropped")
         if stmt.name not in self.keyspaces:
             if not stmt.if_exists:
                 raise NotFound(f"keyspace {stmt.name} not found")
@@ -457,7 +505,12 @@ class QLProcessor:
                   if t.startswith(stmt.name + ".")]
         if in_use:
             raise InvalidArgument(f"keyspace {stmt.name} is not empty")
-        self.keyspaces.discard(stmt.name)
+        try:
+            self.cluster.drop_keyspace(stmt.name)
+        except NotFound:
+            # Lost a drop race: same end state.
+            if not stmt.if_exists:
+                raise
         return None
 
     def _exec_use(self, stmt: ast.UseKeyspace):
@@ -1135,19 +1188,8 @@ class QLProcessor:
                     raise InvalidArgument(f"unknown column {it.column}")
             projection = [it.column for it in stmt.items]
 
-        @dataclass
-        class Plan:
-            single: bool
-            hash_code: int | None
-            lower: bytes
-            upper: bytes
-            predicates: list
-            projection: list | None
-            aggregates: list
-            group_by: list
-
-        return Plan(bool(single), hash_code, lower, upper, predicates,
-                    projection, aggregates, group_by)
+        return _SelectPlan(bool(single), hash_code, lower, upper,
+                           predicates, projection, aggregates, group_by)
 
     def _target_tablets(self, handle: TableHandle, plan):
         if plan.single and handle.schema.num_hash:

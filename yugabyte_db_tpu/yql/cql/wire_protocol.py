@@ -216,14 +216,31 @@ def decode_value(dt: DataType, b: bytes | None):
     """CQL serialized bytes -> Python value (None stays None)."""
     if b is None:
         return None
+    from yugabyte_db_tpu.utils.status import InvalidArgument
+
     tid = cql_type_id(dt)
     if tid in _INT_WIDTH:
+        # Fixed-width cells must be exactly their width (§6): reject a
+        # mis-typed bind instead of reinterpreting its bytes.
+        if len(b) != _INT_WIDTH[tid]:
+            raise InvalidArgument(
+                f"expected {_INT_WIDTH[tid]} bytes for type {dt.name}, "
+                f"got {len(b)}")
         return int.from_bytes(b, "big", signed=True)
     if tid == T_BOOLEAN:
+        if len(b) != 1:
+            raise InvalidArgument(
+                f"expected 1 byte for BOOLEAN, got {len(b)}")
         return b != b"\x00"
     if tid == T_DOUBLE:
+        if len(b) != 8:
+            raise InvalidArgument(
+                f"expected 8 bytes for DOUBLE, got {len(b)}")
         return struct.unpack(">d", b)[0]
     if tid == T_FLOAT:
+        if len(b) != 4:
+            raise InvalidArgument(
+                f"expected 4 bytes for FLOAT, got {len(b)}")
         return struct.unpack(">f", b)[0]
     if tid == T_VARCHAR:
         return b.decode("utf-8")

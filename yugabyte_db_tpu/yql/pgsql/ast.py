@@ -231,6 +231,21 @@ class HavingRel:
 
 
 @dataclass
+class Union:
+    """a UNION [ALL] b [UNION [ALL] c ...] — left-associative set
+    union over same-arity SELECTs; the trailing ORDER BY / LIMIT /
+    OFFSET applies to the whole union (PG semantics; reference
+    capability: nodeSetOp.c / nodeAppend.c above the FDW)."""
+
+    branches: list                   # [Select, ...]
+    alls: list                       # [bool] per UNION, len-1 of branches
+    order_by: list = field(default_factory=list)
+    limit: object | None = None
+    offset: object | None = None
+    ctes: list = field(default_factory=list)
+
+
+@dataclass
 class Select:
     items: list[SelectItem]
     table: str

@@ -108,7 +108,8 @@ class PgProcessor:
             ast.Insert: self._exec_insert,
             ast.Update: self._exec_update,
             ast.Delete: self._exec_delete,
-            ast.Select: self._exec_select,
+            ast.Select: self._exec_query,
+            ast.Union: self._exec_query,
             ast.CreateView: self._exec_create_view,
             ast.DropView: self._exec_drop_view,
             ast.CreateSequence: self._exec_create_sequence,
@@ -683,7 +684,7 @@ class PgProcessor:
             if self._view_depth > 8:
                 raise InvalidArgument(
                     "view nesting too deep (cyclic definition?)")
-            inner = self._exec_select(parse_statement(view_sql))
+            inner = self._exec_query(parse_statement(view_sql))
         finally:
             self._view_depth -= 1
         return self._select_over_rows(stmt, inner.columns, inner.rows)
@@ -948,22 +949,57 @@ class PgProcessor:
             return max(vals)
         raise InvalidArgument(f"unknown window aggregate {fn}")
 
-    def _exec_select(self, stmt: ast.Select):
+    def _exec_query(self, stmt):
+        """Dispatch a query statement (SELECT or UNION chain), handling
+        a WITH clause once for both kinds: evaluate each CTE in order
+        (PG materializes CTEs; later CTEs and the body see earlier
+        names), scoped to this statement and restored after."""
         if getattr(stmt, "ctes", None):
-            # WITH: evaluate each CTE once (PG materializes CTEs); later
-            # CTEs and the body see earlier names. Bindings are scoped
-            # to this statement and restored after (nested statements
-            # keep their own view of the stack).
             saved = dict(getattr(self, "_cte_results", {}) or {})
             self._cte_results = dict(saved)
             try:
                 for name, sel in stmt.ctes:
-                    self._cte_results[name] = self._exec_select(sel)
+                    self._cte_results[name] = self._exec_query(sel)
                 import dataclasses as _dc
 
-                return self._exec_select(_dc.replace(stmt, ctes=[]))
+                return self._exec_query(_dc.replace(stmt, ctes=[]))
             finally:
                 self._cte_results = saved
+        if isinstance(stmt, ast.Union):
+            return self._exec_union(stmt)
+        return self._exec_select(stmt)
+
+    def _exec_union(self, u: ast.Union) -> PgResult:
+        """Left-associative UNION [ALL]: evaluate each branch, require
+        equal arity, dedup across the accumulated set for plain UNION,
+        then apply the union-level ORDER BY/LIMIT/OFFSET (the work
+        stock PG's Append/SetOp nodes do above the FDW; reference
+        capability: src/postgres/src/backend/executor/nodeSetOp.c)."""
+        results = [self._exec_query(b) for b in u.branches]
+        n = len(results[0].columns)
+        for r in results[1:]:
+            if len(r.columns) != n:
+                raise InvalidArgument(
+                    "each UNION query must have the same number of "
+                    "columns")
+        acc = list(results[0].rows)
+        for r, is_all in zip(results[1:], u.alls):
+            if is_all:
+                acc.extend(r.rows)
+            else:
+                acc = list(dict.fromkeys([*acc, *r.rows]))
+        names = list(results[0].columns)
+        shim = ast.Select(items=[], table=None, order_by=u.order_by,
+                          limit=u.limit, offset=u.offset)
+        rows = self._order_and_limit(shim, names, acc,
+                                     self._limit(shim))
+        return PgResult(columns=names, rows=rows)
+
+    def _exec_select(self, stmt: ast.Select):
+        if getattr(stmt, "ctes", None):
+            # WITH rides the shared query dispatcher (CTE handling for
+            # SELECT and UNION lives in one place).
+            return self._exec_query(stmt)
         if any(isinstance(it.expr, ast.WindowFunc) for it in stmt.items):
             return self._select_window(stmt)
         cte = (getattr(self, "_cte_results", None) or {}).get(stmt.table)

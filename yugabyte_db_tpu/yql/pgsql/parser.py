@@ -249,10 +249,33 @@ class Parser:
         if head == "DELETE":
             return self._delete()
         if head == "SELECT":
-            return self._select()
+            return self._select_entry()
         if head == "WITH":
             return self._with_select()
         raise InvalidArgument(f"unsupported statement {head}")
+
+    def _select_entry(self):
+        """SELECT possibly followed by UNION [ALL] chains; the trailing
+        ORDER BY/LIMIT/OFFSET binds to the whole union (PG)."""
+        first = self._select()
+        if not self.at_kw("UNION"):
+            return first
+        branches, alls = [first], []
+        while self.take_kw("UNION"):
+            alls.append(bool(self.take_kw("ALL")))
+            branches.append(self._select())
+        for b in branches[:-1]:
+            if b.order_by or b.limit is not None or b.offset is not None:
+                raise InvalidArgument(
+                    "ORDER BY/LIMIT in a UNION branch requires "
+                    "parentheses")
+        import dataclasses as _dc
+
+        last = branches[-1]
+        order_by, limit, offset = last.order_by, last.limit, last.offset
+        branches[-1] = _dc.replace(last, order_by=[], limit=None,
+                                   offset=None)
+        return ast.Union(branches, alls, order_by, limit, offset)
 
     def _with_select(self):
         """WITH name AS (select) [, name AS (select)]* SELECT ... — CTEs
@@ -266,12 +289,12 @@ class Parser:
             name = self.ident()
             self.expect_kw("AS")
             self.expect_sym("(")
-            sel = self._select()
+            sel = self._select_entry()
             self.expect_sym(")")
             ctes.append((name, sel))
             if not self.take_sym(","):
                 break
-        body = self._select()
+        body = self._select_entry()
         body.ctes = ctes
         return body
 
@@ -449,7 +472,8 @@ class Parser:
     # -- SELECT ------------------------------------------------------------
     _CLAUSE_KWS = ("FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "OFFSET",
                    "AS", "JOIN", "INNER", "LEFT", "RIGHT", "FULL",
-                   "CROSS", "ON", "HAVING", "AND", "OR", "DESC", "ASC")
+                   "CROSS", "ON", "HAVING", "AND", "OR", "DESC", "ASC",
+                   "UNION")
 
     SCALAR_FNS = ("abs", "upper", "lower", "length", "coalesce", "round",
                   "floor", "ceil", "ceiling", "concat", "mod",
@@ -462,7 +486,7 @@ class Parser:
         if t is None:
             raise InvalidArgument("CREATE VIEW needs a query")
         query_sql = self.raw[t.pos:].rstrip().rstrip(";")
-        select = self._select()  # validated now, re-parsed at use
+        select = self._select_entry()  # validated now, re-parsed at use
         return ast.CreateView(name, query_sql, select, replace)
 
     def _select(self) -> ast.Select:
