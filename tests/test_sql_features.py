@@ -281,3 +281,129 @@ def test_union_with_aggregates_per_branch(pg):
     r = pg.execute("SELECT count(*) FROM items WHERE cat = 'a' "
                    "UNION ALL SELECT count(*) FROM items WHERE cat = 'b'")
     assert sorted(r.rows) == [(2,), (3,)]
+
+
+# -- EXISTS / NOT EXISTS -----------------------------------------------------
+
+def seed_orders(pg):
+    pg.execute("CREATE TABLE orders (oid bigint PRIMARY KEY, item bigint, "
+               "n int)")
+    for oid, item, n in [(1, 1, 2), (2, 1, 1), (3, 3, 5)]:
+        pg.execute(f"INSERT INTO orders (oid, item, n) VALUES "
+                   f"({oid}, {item}, {n})")
+
+
+def test_exists_correlated(pg):
+    seed(pg)
+    seed_orders(pg)
+    r = pg.execute("SELECT id FROM items i WHERE EXISTS "
+                   "(SELECT 1 FROM orders o WHERE o.item = i.id) "
+                   "ORDER BY id")
+    assert r.rows == [(1,), (3,)]
+    r = pg.execute("SELECT id FROM items i WHERE NOT EXISTS "
+                   "(SELECT 1 FROM orders o WHERE o.item = i.id) "
+                   "ORDER BY id")
+    assert r.rows == [(2,), (4,), (5,), (6,)]
+
+
+def test_exists_uncorrelated(pg):
+    seed(pg)
+    seed_orders(pg)
+    r = pg.execute("SELECT count(*) FROM items WHERE EXISTS "
+                   "(SELECT 1 FROM orders WHERE n > 4)")
+    assert r.rows == [(6,)]
+    r = pg.execute("SELECT count(*) FROM items WHERE EXISTS "
+                   "(SELECT 1 FROM orders WHERE n > 99)")
+    assert r.rows == [(0,)]
+    r = pg.execute("SELECT id FROM items WHERE NOT EXISTS "
+                   "(SELECT 1 FROM orders WHERE n > 99) AND cat = 'c'")
+    assert r.rows == [(6,)]
+
+
+def test_exists_combined_with_predicates(pg):
+    seed(pg)
+    seed_orders(pg)
+    r = pg.execute("SELECT id FROM items i WHERE price >= 100 AND "
+                   "EXISTS (SELECT 1 FROM orders o WHERE o.item = i.id)"
+                   " ORDER BY id")
+    assert r.rows == [(1,)]
+
+
+def test_exists_in_update_delete(pg):
+    seed(pg)
+    seed_orders(pg)
+    pg.execute("UPDATE items SET qty = 0 WHERE id = 1 AND EXISTS "
+               "(SELECT 1 FROM orders WHERE n > 4)")
+    assert pg.execute("SELECT qty FROM items WHERE id = 1").rows == [(0,)]
+    pg.execute("DELETE FROM items WHERE id = 6 AND EXISTS "
+               "(SELECT 1 FROM orders WHERE n > 99)")
+    assert pg.execute("SELECT count(*) FROM items").rows == [(6,)]
+    pg.execute("DELETE FROM items WHERE id = 6 AND NOT EXISTS "
+               "(SELECT 1 FROM orders WHERE n > 99)")
+    assert pg.execute("SELECT count(*) FROM items").rows == [(5,)]
+
+
+def test_exists_over_cte(pg):
+    seed(pg)
+    seed_orders(pg)
+    r = pg.execute("WITH c AS (SELECT id, cat FROM items) "
+                   "SELECT count(*) FROM c WHERE EXISTS "
+                   "(SELECT 1 FROM orders WHERE n = 5)")
+    assert r.rows == [(6,)]
+
+
+# -- INTERSECT / EXCEPT ------------------------------------------------------
+
+def test_except_and_intersect(pg):
+    seed(pg)
+    r = pg.execute("SELECT cat FROM items EXCEPT SELECT cat FROM items "
+                   "WHERE cat = 'b' ORDER BY cat")
+    assert r.rows == [("a",), ("c",)]
+    r = pg.execute("SELECT cat FROM items WHERE price < 200 INTERSECT "
+                   "SELECT cat FROM items WHERE qty >= 5 ORDER BY cat")
+    assert r.rows == [("b",), ("c",)]
+
+
+def test_intersect_binds_tighter_than_union(pg):
+    seed(pg)
+    # a UNION b INTERSECT c == a UNION (b INTERSECT c)
+    r = pg.execute("SELECT cat FROM items WHERE cat = 'a' "
+                   "UNION SELECT cat FROM items "
+                   "INTERSECT SELECT cat FROM items WHERE qty > 8 "
+                   "ORDER BY cat")
+    assert r.rows == [("a",), ("c",)]
+
+
+def test_except_all_per_occurrence(pg):
+    seed(pg)
+    # cats: a,a,b,b,b,c ; EXCEPT ALL one 'b' leaves b,b
+    r = pg.execute("SELECT cat FROM items EXCEPT ALL "
+                   "SELECT cat FROM items WHERE id = 3 ORDER BY cat")
+    assert r.rows == [("a",), ("a",), ("b",), ("b",), ("c",)]
+
+
+def test_intersect_all_multiset(pg):
+    seed(pg)
+    # lhs b,b,b ; rhs b,b -> min counts = 2
+    r = pg.execute("SELECT cat FROM items WHERE cat = 'b' INTERSECT ALL "
+                   "SELECT cat FROM items WHERE id >= 4 AND cat = 'b'")
+    assert r.rows == [("b",), ("b",)]
+
+
+def test_union_jsonb_rows(pg):
+    pg.execute("CREATE TABLE j (id bigint PRIMARY KEY, data jsonb)")
+    pg.execute("INSERT INTO j (id, data) VALUES (1, '{\"a\": 1}')")
+    pg.execute("INSERT INTO j (id, data) VALUES (2, '{\"a\": 1}')")
+    r = pg.execute("SELECT data FROM j UNION SELECT data FROM j")
+    assert r.rows == [({"a": 1},)]
+    r = pg.execute("SELECT data FROM j INTERSECT SELECT data FROM j "
+                   "WHERE id = 2")
+    assert r.rows == [({"a": 1},)]
+
+
+def test_correlated_exists_clear_error_in_delete(pg):
+    seed(pg)
+    with pytest.raises(InvalidArgument) as ei:
+        pg.execute("DELETE FROM items WHERE EXISTS "
+                   "(SELECT 1 FROM items i2 WHERE i2.id = items.id)")
+    assert "EXISTS" in str(ei.value)

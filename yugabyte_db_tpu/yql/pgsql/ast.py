@@ -124,10 +124,11 @@ class Insert:
 
 @dataclass
 class Rel:
-    """One WHERE conjunct: column op value (IN carries a tuple)."""
+    """One WHERE conjunct: column op value (IN carries a tuple).
+    [NOT] EXISTS conjuncts carry column=None and a SubQuery value."""
 
-    column: str
-    op: str                    # = != < <= > >= IN
+    column: str | None
+    op: str                    # = != < <= > >= IN | EXISTS | NOT EXISTS
     value: object
 
 
@@ -232,17 +233,21 @@ class HavingRel:
 
 @dataclass
 class Union:
-    """a UNION [ALL] b [UNION [ALL] c ...] — left-associative set
-    union over same-arity SELECTs; the trailing ORDER BY / LIMIT /
-    OFFSET applies to the whole union (PG semantics; reference
-    capability: nodeSetOp.c / nodeAppend.c above the FDW)."""
+    """Set operations over same-arity queries: UNION / EXCEPT /
+    INTERSECT, each optionally ALL, left-associative with INTERSECT
+    binding tighter (parser builds the precedence nesting); the
+    trailing ORDER BY / LIMIT / OFFSET applies to the whole chain
+    (PG semantics; reference capability: nodeSetOp.c / nodeAppend.c
+    above the FDW)."""
 
-    branches: list                   # [Select, ...]
-    alls: list                       # [bool] per UNION, len-1 of branches
+    branches: list                   # [Select | Union, ...]
+    alls: list                       # [bool] per joint, len-1 of branches
     order_by: list = field(default_factory=list)
     limit: object | None = None
     offset: object | None = None
     ctes: list = field(default_factory=list)
+    kinds: list = field(default_factory=list)  # per joint: "union" |
+                                               # "except" | "intersect"
 
 
 @dataclass

@@ -413,6 +413,9 @@ class PgProcessor:
         short-circuits to a point read; anything else scans with
         predicate pushdown."""
         schema = handle.schema
+        where, ok = self._fold_exists(where)
+        if not ok:
+            return []
         key_names = [c.name for c in schema.key_columns]
         eq = {r.column: r.value for r in where if r.op == "="}
         if set(key_names) <= set(eq) and len(where) == len(key_names):
@@ -519,11 +522,37 @@ class PgProcessor:
 
     def _resolved_where(self, where: list[ast.Rel]) -> list[ast.Rel]:
         return [self._resolve_subquery(r)
-                if isinstance(r.value, ast.SubQuery) else r for r in where]
+                if isinstance(r.value, ast.SubQuery)
+                and r.op not in ("EXISTS", "NOT EXISTS") else r
+                for r in where]
+
+    def _fold_exists(self, where: list[ast.Rel]):
+        """Evaluate uncorrelated [NOT] EXISTS conjuncts once; returns
+        (remaining_rels, ok) — ok False means no row can match. Used by
+        paths without per-row subplan support (aggregates, UPDATE /
+        DELETE); the row-select path runs EXISTS per row instead."""
+        out, ok = [], True
+        for rel in where:
+            if rel.op in ("EXISTS", "NOT EXISTS"):
+                try:
+                    res = self._exec_query(rel.value.select)
+                except InvalidArgument as e:
+                    raise InvalidArgument(
+                        "correlated [NOT] EXISTS is supported only in "
+                        f"a single-table SELECT WHERE clause ({e})"
+                    ) from e
+                if bool(res.rows) != (rel.op == "EXISTS"):
+                    ok = False
+                continue
+            out.append(rel)
+        return out, ok
 
     def _predicates(self, schema: Schema, where: list[ast.Rel]):
         preds = []
         for rel in where:
+            if rel.op in ("EXISTS", "NOT EXISTS"):
+                raise InvalidArgument(
+                    "EXISTS is not supported in this clause")
             if isinstance(rel.value, ast.SubQuery):
                 rel = self._resolve_subquery(rel)
             if isinstance(rel.value, X.Col):
@@ -707,6 +736,13 @@ class PgProcessor:
         known = set(columns) | ({prefix + c for c in columns}
                                 if prefix else set())
         for rel in self._resolved_where(stmt.where):
+            if rel.op in ("EXISTS", "NOT EXISTS"):
+                # Uncorrelated over an in-memory relation: one execution
+                # decides the whole conjunct.
+                res = self._exec_query(rel.value.select)
+                if bool(res.rows) != (rel.op == "EXISTS"):
+                    dicts = []
+                continue
             if rel.column not in known:
                 raise InvalidArgument(
                     f"column {rel.column} is not in the relation")
@@ -970,24 +1006,80 @@ class PgProcessor:
         return self._exec_select(stmt)
 
     def _exec_union(self, u: ast.Union) -> PgResult:
-        """Left-associative UNION [ALL]: evaluate each branch, require
-        equal arity, dedup across the accumulated set for plain UNION,
-        then apply the union-level ORDER BY/LIMIT/OFFSET (the work
-        stock PG's Append/SetOp nodes do above the FDW; reference
-        capability: src/postgres/src/backend/executor/nodeSetOp.c)."""
+        """Set operations: evaluate each branch, require equal arity,
+        combine per joint — UNION (dedup unless ALL), EXCEPT (dedup lhs
+        minus rhs; ALL subtracts per-occurrence), INTERSECT (dedup
+        both-sides; ALL keeps multiset minimum counts) — then apply the
+        chain-level ORDER BY/LIMIT/OFFSET (the work stock PG's
+        Append/SetOp nodes do above the FDW; reference capability:
+        src/postgres/src/backend/executor/nodeSetOp.c)."""
+        from collections import Counter
+
         results = [self._exec_query(b) for b in u.branches]
         n = len(results[0].columns)
         for r in results[1:]:
             if len(r.columns) != n:
                 raise InvalidArgument(
-                    "each UNION query must have the same number of "
-                    "columns")
+                    "each query in a set operation must have the same "
+                    "number of columns")
+        kinds = u.kinds or ["union"] * len(u.alls)
+
+        def hkey(v):
+            # Canonical hashable view of a cell (jsonb rows carry
+            # dicts/lists; PG supports them in set operations).
+            if isinstance(v, dict):
+                return ("\x00d", tuple(sorted(
+                    (k, hkey(x)) for k, x in v.items())))
+            if isinstance(v, (list, tuple)):
+                return ("\x00l", tuple(hkey(x) for x in v))
+            if isinstance(v, set):
+                return ("\x00s", tuple(sorted(map(hkey, v),
+                                              key=repr)))
+            return v
+
+        def rkey(row):
+            return tuple(hkey(v) for v in row)
+
+        def dedup(rows):
+            seen = {}
+            for t in rows:
+                seen.setdefault(rkey(t), t)
+            return list(seen.values())
+
         acc = list(results[0].rows)
-        for r, is_all in zip(results[1:], u.alls):
-            if is_all:
-                acc.extend(r.rows)
-            else:
-                acc = list(dict.fromkeys([*acc, *r.rows]))
+        for r, is_all, kind in zip(results[1:], u.alls, kinds):
+            rows = list(r.rows)
+            if kind == "union":
+                acc = ([*acc, *rows] if is_all
+                       else dedup([*acc, *rows]))
+            elif kind == "except":
+                if is_all:
+                    remove = Counter(map(rkey, rows))
+                    out = []
+                    for t in acc:
+                        k = rkey(t)
+                        if remove[k] > 0:
+                            remove[k] -= 1
+                        else:
+                            out.append(t)
+                    acc = out
+                else:
+                    right = set(map(rkey, rows))
+                    acc = [t for t in dedup(acc)
+                           if rkey(t) not in right]
+            else:  # intersect
+                if is_all:
+                    counts = Counter(map(rkey, rows))
+                    out = []
+                    for t in acc:
+                        k = rkey(t)
+                        if counts[k] > 0:
+                            counts[k] -= 1
+                            out.append(t)
+                    acc = out
+                else:
+                    right = set(map(rkey, rows))
+                    acc = [t for t in dedup(acc) if rkey(t) in right]
         names = list(results[0].columns)
         shim = ast.Select(items=[], table=None, order_by=u.order_by,
                           limit=u.limit, offset=u.offset)
@@ -1078,7 +1170,7 @@ class PgProcessor:
                     offset=e.offset, default=e.default)
             return e
 
-        needs = (any("." in r.column for r in stmt.where)
+        needs = (any(r.column and "." in r.column for r in stmt.where)
                  or any(isinstance(r.value, X.Col) and "." in r.value.name
                         for r in stmt.where)
                  or any("." in g for g in stmt.group_by)
@@ -1113,6 +1205,11 @@ class PgProcessor:
         handles, qualify, owners) for _finish_select / window
         evaluation; owners maps bare column name -> owning aliases (the
         single source of the bare-name-resolution rule)."""
+        where_rels, exists_ok = self._fold_exists(stmt.where)
+        if len(where_rels) != len(stmt.where):
+            import dataclasses as _dc
+
+            stmt = _dc.replace(stmt, where=where_rels)
         base_alias = stmt.alias or stmt.table
         tables = [(base_alias, stmt.table)]
         tables += [(j.alias or j.table, j.table) for j in stmt.joins]
@@ -1234,6 +1331,8 @@ class PgProcessor:
             joined = [d for d in joined
                       if all(p.matches(d.get(p.column)) for p in post)]
 
+        if not exists_ok:
+            joined = []
         return joined, tables, handles, qualify, owners
 
     @classmethod
@@ -1432,10 +1531,13 @@ class PgProcessor:
                 else:
                     new_where.append(r)
             res = self._exec_select(_dc.replace(sub, where=new_where))
-            if len(res.columns) != 1:
+            if rel.op not in ("EXISTS", "NOT EXISTS") \
+                    and len(res.columns) != 1:
                 raise InvalidArgument(
                     "subquery must return a single column")
-            hit = cache[key] = [r[0] for r in res.rows]
+            hit = cache[key] = [r[0] if r else None for r in res.rows]
+        if rel.op in ("EXISTS", "NOT EXISTS"):
+            return bool(hit) == (rel.op == "EXISTS")
         if rel.op == "IN":
             left = d.get(rel.column)
             return left is not None and any(
@@ -1452,6 +1554,14 @@ class PgProcessor:
         outer_alias = stmt.alias or stmt.table
         plain, correlated, colcol = [], [], []
         for rel in stmt.where:
+            if rel.op in ("EXISTS", "NOT EXISTS"):
+                # Correlated or not, [NOT] EXISTS rides the per-row
+                # subplan path (uncorrelated = one memoized execution
+                # under the empty binding tuple).
+                refs = self._outer_refs(rel.value.select, schema,
+                                        outer_alias)
+                correlated.append((rel, refs or {}, {}))
+                continue
             if isinstance(rel.value, X.Col):
                 for name in (rel.column, rel.value.name):
                     if not schema.has_column(name):
@@ -1796,6 +1906,14 @@ class PgProcessor:
 
     def _select_aggregate(self, handle, stmt: ast.Select):
         schema = handle.schema
+        where, ok = self._fold_exists(stmt.where)
+        if not ok and schema.key_columns:
+            # An EXISTS conjunct failed: aggregate over no rows.
+            where = [ast.Rel(schema.key_columns[0].name, "IN", ())]
+        if where is not stmt.where:
+            import dataclasses as _dc
+
+            stmt = _dc.replace(stmt, where=where)
         preds = self._predicates(schema, stmt.where)
         group_by = list(stmt.group_by)
         for g in group_by:

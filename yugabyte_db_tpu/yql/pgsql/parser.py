@@ -255,27 +255,55 @@ class Parser:
         raise InvalidArgument(f"unsupported statement {head}")
 
     def _select_entry(self):
-        """SELECT possibly followed by UNION [ALL] chains; the trailing
-        ORDER BY/LIMIT/OFFSET binds to the whole union (PG)."""
-        first = self._select()
-        if not self.at_kw("UNION"):
-            return first
-        branches, alls = [first], []
-        while self.take_kw("UNION"):
-            alls.append(bool(self.take_kw("ALL")))
+        """SELECT possibly followed by UNION / EXCEPT / INTERSECT
+        [ALL] chains; INTERSECT binds tighter (PG precedence) and the
+        trailing ORDER BY/LIMIT/OFFSET binds to the whole chain."""
+        branches = [self._select()]
+        seps: list[tuple] = []
+        while True:
+            if self.take_kw("UNION"):
+                kind = "union"
+            elif self.take_kw("EXCEPT"):
+                kind = "except"
+            elif self.take_kw("INTERSECT"):
+                kind = "intersect"
+            else:
+                break
+            seps.append((kind, bool(self.take_kw("ALL"))))
             branches.append(self._select())
+        if not seps:
+            return branches[0]
         for b in branches[:-1]:
             if b.order_by or b.limit is not None or b.offset is not None:
                 raise InvalidArgument(
-                    "ORDER BY/LIMIT in a UNION branch requires "
-                    "parentheses")
+                    "ORDER BY/LIMIT is only supported after the last "
+                    "branch of a set operation (it applies to the "
+                    "whole result)")
         import dataclasses as _dc
 
         last = branches[-1]
         order_by, limit, offset = last.order_by, last.limit, last.offset
         branches[-1] = _dc.replace(last, order_by=[], limit=None,
                                    offset=None)
-        return ast.Union(branches, alls, order_by, limit, offset)
+
+        def joint(a, kind, alln, b):
+            return ast.Union([a, b], [alln], kinds=[kind])
+
+        # Precedence pass 1: fold INTERSECT joints into their left
+        # neighbor; pass 2: left-fold the remaining UNION/EXCEPT.
+        vals = [branches[0]]
+        ops: list[tuple] = []
+        for (kind, alln), b in zip(seps, branches[1:]):
+            if kind == "intersect":
+                vals[-1] = joint(vals[-1], kind, alln, b)
+            else:
+                ops.append((kind, alln))
+                vals.append(b)
+        acc = vals[0]
+        for (kind, alln), b in zip(ops, vals[1:]):
+            acc = joint(acc, kind, alln, b)
+        return _dc.replace(acc, order_by=order_by, limit=limit,
+                           offset=offset)
 
     def _with_select(self):
         """WITH name AS (select) [, name AS (select)]* SELECT ... — CTEs
@@ -473,7 +501,7 @@ class Parser:
     _CLAUSE_KWS = ("FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "OFFSET",
                    "AS", "JOIN", "INNER", "LEFT", "RIGHT", "FULL",
                    "CROSS", "ON", "HAVING", "AND", "OR", "DESC", "ASC",
-                   "UNION")
+                   "UNION", "EXCEPT", "INTERSECT")
 
     SCALAR_FNS = ("abs", "upper", "lower", "length", "coalesce", "round",
                   "floor", "ceil", "ceiling", "concat", "mod",
@@ -786,6 +814,21 @@ class Parser:
         if not self.take_kw("WHERE"):
             return rels
         while True:
+            neg = False
+            if self.at_kw("NOT") and self._kw_ahead(1, "EXISTS"):
+                self.next()
+                neg = True
+            if self.at_kw("EXISTS"):
+                self.expect_kw("EXISTS")
+                if not self._at_subquery():
+                    raise InvalidArgument(
+                        "EXISTS requires a parenthesized subquery")
+                rels.append(ast.Rel(None,
+                                    "NOT EXISTS" if neg else "EXISTS",
+                                    self._subquery()))
+                if not self.take_kw("AND"):
+                    break
+                continue
             col = self._colref()
             if self.take_kw("BETWEEN"):
                 lo = self.literal()
