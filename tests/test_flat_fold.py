@@ -86,7 +86,9 @@ def test_flat_fold_route_taken():
     load_flat(schema, [tpu])
     spec = ScanSpec(read_ht=MAX_HT, aggregates=list(AGGS))
     plan = tpu._plan_scan(spec)
-    assert plan[0] == "issued"
+    assert plan[0] == "agg_deferred"  # device aggregate (batched sink)
+    route = tpu._device_agg_prep(tpu.runs[0], spec, [])[1]
+    assert route == "flat"
     assert tpu.runs[0].crun.max_group_versions <= 1
     # eligibility holds for this shape
     assert flat_fold.MAX_B >= tpu.runs[0].dev.B

@@ -102,8 +102,9 @@ def test_seg_route_taken():
     schema, cpu, tpu, ht = setup()
     assert tpu.runs[0].crun.max_group_versions > 1  # genuinely segmented
     spec = ScanSpec(read_ht=MAX_HT, aggregates=list(AGGS))
-    assert tpu._plan_scan(spec)[0] == "issued"
-    assert seg_fold.supports.__wrapped__ if False else True
+    assert tpu._plan_scan(spec)[0] == "agg_deferred"
+    route = tpu._device_agg_prep(tpu.runs[0], spec, [])[1]
+    assert route in ("lookback", "seg")  # multi-version resolve route
 
 
 def test_seg_matches_oracle_many_read_points():
