@@ -622,14 +622,25 @@ def bench_tpch(make_engine):
         while q:
             q.popleft().finish()
         pdt = time.perf_counter() - t0
+        # Two metrics, honestly named: the pipelined number measures a
+        # different quantity (8 concurrent queries, depth-4 pipeline)
+        # than the single-query scan rate, so it must not ship under
+        # the plain rows_per_sec name history already tracks.
         out.append({
-            "metric": f"{name}_rows_per_sec",
+            "metric": f"{name}_pipelined_rows_per_sec",
             "value": round(n * 80 / pdt, 1),
             "unit": "rows/s (8 concurrent queries, depth-4 pipeline)",
             "vs_baseline": None,  # no TPC-H numbers exist in-reference
             "vs_cpu_engine": round(cdt * 80 / pdt, 2),
             "single_query_latency_ms": round(tdt * 1000, 1),
-            "single_query_rows_per_sec": round(n / tdt, 1),
+        })
+        out.append({
+            "metric": f"{name}_rows_per_sec",
+            "value": round(n / tdt, 1),
+            "unit": "rows/s (single query, synchronous)",
+            "vs_baseline": None,
+            "vs_cpu_engine": round(cdt / tdt, 2),
+            "single_query_latency_ms": round(tdt * 1000, 1),
         })
     return out
 

@@ -83,6 +83,31 @@ def test_cql_prepared_statements(cql):
     assert res.rows == [("row7",)]
 
 
+def test_cql_prepared_binds_use_column_wire_types(cql):
+    """Bind serialization must follow the PREPARED metadata: an `int`
+    column takes 4 bytes on the wire and `float` a 4-byte IEEE single —
+    not the 8-byte guess made from the Python value's type."""
+    cql.execute("CREATE KEYSPACE wks")
+    cql.execute("USE wks")
+    cql.execute("CREATE TABLE t (k int PRIMARY KEY, s smallint, "
+                "y tinyint, f float, d double)")
+    ins = cql.prepare("INSERT INTO t (k, s, y, f, d) "
+                      "VALUES (?, ?, ?, ?, ?)")
+    from yugabyte_db_tpu.drivers.minicql import (T_DOUBLE, T_FLOAT,
+                                                 T_INT, T_SMALLINT,
+                                                 T_TINYINT)
+    assert [s[0] for s in ins.bind_specs] == [
+        T_INT, T_SMALLINT, T_TINYINT, T_FLOAT, T_DOUBLE]
+    cql.execute_prepared(ins, [7, -300, 5, 1.5, -2.25])
+    # Int binds into a float column are coerced by the typed encoder.
+    cql.execute_prepared(ins, [-40000, 12, -3, 2, 3])
+    sel = cql.prepare("SELECT k, s, y, f, d FROM t WHERE k = ?")
+    assert cql.execute_prepared(sel, [7]).rows == [(7, -300, 5, 1.5,
+                                                   -2.25)]
+    assert cql.execute_prepared(sel, [-40000]).rows == [
+        (-40000, 12, -3, 2.0, 3.0)]
+
+
 def test_cql_paging_loop(cql):
     cql.execute("CREATE KEYSPACE pg2")
     cql.execute("USE pg2")

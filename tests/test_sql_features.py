@@ -407,3 +407,35 @@ def test_correlated_exists_clear_error_in_delete(pg):
         pg.execute("DELETE FROM items WHERE EXISTS "
                    "(SELECT 1 FROM items i2 WHERE i2.id = items.id)")
     assert "EXISTS" in str(ei.value)
+
+
+def test_exists_subquery_typo_not_masked_as_correlated(pg):
+    """A typo'd column inside an EXISTS subquery is the subquery's own
+    error — it must NOT be rewrapped as 'correlated EXISTS
+    unsupported' (only unresolvable outer-column references mean
+    correlation)."""
+    seed(pg)
+    seed_orders(pg)
+    with pytest.raises(InvalidArgument) as ei:
+        pg.execute("SELECT count(*) FROM items WHERE EXISTS "
+                   "(SELECT 1 FROM orders WHERE nosuch_col > 1)")
+    assert "unknown column nosuch_col" in str(ei.value)
+    assert "correlated" not in str(ei.value)
+    # The genuinely-correlated case still gets the clear wrapper.
+    with pytest.raises(InvalidArgument) as ei:
+        pg.execute("SELECT count(*) FROM items WHERE EXISTS "
+                   "(SELECT 1 FROM orders o WHERE o.item = items.id)")
+    assert "correlated" in str(ei.value)
+
+
+def test_false_exists_aggregate_is_empty_aggregate(pg):
+    """A false folded EXISTS means 'aggregate over no rows': one row of
+    count 0 / NULL sums without GROUP BY, zero rows with it."""
+    seed(pg)
+    seed_orders(pg)
+    r = pg.execute("SELECT sum(price), count(*) FROM items WHERE "
+                   "EXISTS (SELECT 1 FROM orders WHERE n > 99)")
+    assert r.rows == [(None, 0)]
+    r = pg.execute("SELECT cat, count(*) FROM items WHERE NOT EXISTS "
+                   "(SELECT 1 FROM orders WHERE n > 0) GROUP BY cat")
+    assert r.rows == []

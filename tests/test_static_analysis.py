@@ -1,0 +1,486 @@
+"""yb-lint: the tier-1 gate plus per-rule unit coverage.
+
+The gate runs the full analysis over the committed tree and fails on
+any violation that is neither suppressed inline nor grandfathered in
+``yugabyte_db_tpu/analysis/baseline.json`` — new code must come in
+lint-clean. The unit tests feed each rule a known-bad fragment and
+assert it fires (and that ``# yb-lint: disable=`` is honored).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from yugabyte_db_tpu.analysis import (
+    all_rules,
+    load_baseline,
+    run_analysis,
+)
+from yugabyte_db_tpu.analysis.core import apply_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "yugabyte_db_tpu")
+
+
+def lint(tmp_path, files):
+    """Write {rel: code} fixtures and lint the fixture package."""
+    for rel, code in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+    return run_analysis([str(tmp_path / "yugabyte_db_tpu")],
+                        repo_root=str(tmp_path))
+
+
+def fired(result, rule):
+    return [v for v in result.violations if v.rule == rule]
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+def test_tree_is_lint_clean():
+    """Zero non-baselined violations over the whole package. On failure:
+    fix the code, suppress with a justified `# yb-lint: disable=`, or
+    (for deliberate grandfathering only) regenerate the baseline."""
+    result = run_analysis([PKG], repo_root=REPO_ROOT,
+                          baseline=load_baseline())
+    assert result.ok, "new yb-lint violations:\n" + "\n".join(
+        v.render() for v in result.violations)
+    assert result.files_checked > 100
+
+
+def test_cli_json_clean_on_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis",
+         "--format=json", PKG],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["ok"] and data["violations"] == []
+
+
+def test_cli_nonzero_on_violations(tmp_path):
+    """The acceptance fixtures: a layering violation, a host sync in an
+    ops kernel, an unlocked write to a guarded attribute, and a bare
+    except-pass — each reported with file, line, and rule id, and the
+    CLI exits non-zero."""
+    fixtures = {
+        "yugabyte_db_tpu/storage/bad_layer.py": """\
+            from yugabyte_db_tpu.yql.pgsql import executor
+        """,
+        "yugabyte_db_tpu/ops/bad_kernel.py": """\
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x.item()
+        """,
+        "yugabyte_db_tpu/tablet/bad_locks.py": """\
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def incr(self):
+                    with self._lock:
+                        self._n += 1
+
+                def reset(self):
+                    self._n = 0
+        """,
+        "yugabyte_db_tpu/util/bad_errors.py": """\
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """,
+    }
+    for rel, code in fixtures.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis",
+         "--format=json", str(tmp_path / "yugabyte_db_tpu")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    by_rule = {v["rule"]: v for v in data["violations"]}
+    expect = {
+        "layering/upward-import": "bad_layer.py",
+        "jax/host-sync-item": "bad_kernel.py",
+        "locks/unguarded-write": "bad_locks.py",
+        "errors/swallowed-exception": "bad_errors.py",
+    }
+    for rule, fname in expect.items():
+        assert rule in by_rule, (rule, data["violations"])
+        v = by_rule[rule]
+        assert v["file"].endswith(fname)
+        assert isinstance(v["line"], int) and v["line"] > 0
+
+
+def test_list_rules_names_all_families():
+    names = set(all_rules())
+    for family in ("layering/", "jax/", "locks/", "errors/"):
+        assert any(n.startswith(family) for n in names), names
+
+
+# -- layering ----------------------------------------------------------------
+
+def test_layering_upward_import_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/bad.py": """\
+        from yugabyte_db_tpu.consensus.raft import RaftConsensus
+    """})
+    (v,) = fired(res, "layering/upward-import")
+    assert v.line == 1 and "rpc -> consensus" in v.message
+
+
+def test_layering_forbidden_edge_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/yql/bad.py": """\
+        import yugabyte_db_tpu.ops.scan
+    """})
+    assert fired(res, "layering/forbidden-import")
+
+
+def test_layering_relative_and_lazy_imports_resolve(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/deep/bad.py": """\
+        def f():
+            from ...yql import pgsql  # lazy does not launder the edge
+            return pgsql
+    """})
+    assert fired(res, "layering/upward-import")
+
+
+def test_layering_downward_and_type_checking_ok(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/yql/good.py": """\
+        from typing import TYPE_CHECKING
+
+        from yugabyte_db_tpu.storage import engine
+
+        if TYPE_CHECKING:
+            from yugabyte_db_tpu.ops import scan  # type-only: no edge
+    """})
+    assert not fired(res, "layering/upward-import")
+    assert not fired(res, "layering/forbidden-import")
+
+
+def test_layering_suppression_respected(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/bad.py": """\
+        from yugabyte_db_tpu.consensus import raft  # yb-lint: disable=layering/upward-import
+    """})
+    assert not res.violations and res.suppressed == 1
+
+
+# -- jax hygiene -------------------------------------------------------------
+
+def test_jax_item_in_jitted_function(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax
+
+        @jax.jit
+        def k(x):
+            return x.item()
+    """})
+    (v,) = fired(res, "jax/host-sync-item")
+    assert v.line == 5
+
+
+def test_jax_item_via_named_tracing_call(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax
+
+        def body(x):
+            return x.sum().item()
+
+        run = jax.jit(body)
+    """})
+    assert fired(res, "jax/host-sync-item")
+
+
+def test_jax_cast_on_tracer(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax
+
+        @jax.jit
+        def k(x):
+            return float(x)
+    """})
+    assert fired(res, "jax/host-sync-cast")
+
+
+def test_jax_shape_math_is_not_a_sync(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/good.py": """\
+        import jax
+
+        @jax.jit
+        def k(x):
+            return int(x.shape[0]) + float(len(x.shape))
+    """})
+    assert not fired(res, "jax/host-sync-cast")
+
+
+def test_jax_host_transfer_in_trace(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def k(x):
+            return np.asarray(x)
+    """})
+    assert fired(res, "jax/host-transfer")
+
+
+def test_jax_module_scope_jnp(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax.numpy as jnp
+
+        ZERO = jnp.int32(0)
+    """})
+    assert fired(res, "jax/module-scope-jnp")
+
+
+def test_jax_block_until_ready_outside_bench(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/bad.py": """\
+        def fetch(x):
+            return x.block_until_ready()
+    """})
+    assert fired(res, "jax/block-until-ready")
+
+
+def test_jax_mutable_static_arg_default(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax
+
+        def k(x, opts=[1, 2]):
+            return x
+
+        run = jax.jit(k, static_argnums=(1,))
+    """})
+    assert fired(res, "jax/unhashable-static-arg")
+
+
+def test_jax_suppression_respected(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/ok.py": """\
+        import jax
+
+        @jax.jit
+        def k(x):
+            # yb-lint: disable=jax/host-sync-item
+            return x.item()
+    """})
+    assert not fired(res, "jax/host-sync-item") and res.suppressed == 1
+
+
+# -- lock discipline ---------------------------------------------------------
+
+LOCKED_CLASS = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def incr(self):
+            with self._lock:
+                self._n += 1
+
+        def reset(self):
+            self._n = 0{suffix}
+"""
+
+
+def test_unguarded_write_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/bad.py":
+                          LOCKED_CLASS.format(suffix="")})
+    (v,) = fired(res, "locks/unguarded-write")
+    assert "C.reset writes self._n" in v.message and v.line == 13
+
+
+def test_unguarded_write_suppression(tmp_path):
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/tablet/ok.py": LOCKED_CLASS.format(
+            suffix="  # yb-lint: disable=locks/unguarded-write")})
+    assert not fired(res, "locks/unguarded-write")
+    assert res.suppressed == 1
+
+
+def test_locked_suffix_convention_counts_as_held(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/ok.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def incr(self):
+                with self._lock:
+                    self._reset_locked()
+
+            def _reset_locked(self):
+                self._n = 0
+    """})
+    assert not fired(res, "locks/unguarded-write")
+
+
+def test_condition_aliases_its_lock(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/ok.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._n = 0
+
+            def incr(self):
+                with self._lock:
+                    self._n += 1
+
+            def wake(self):
+                with self._cv:
+                    self._n = 0
+    """})
+    assert not fired(res, "locks/unguarded-write")
+
+
+def test_abba_lock_order(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/bad.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """})
+    (v,) = fired(res, "locks/inconsistent-order")
+    assert "ABBA" in v.message
+
+
+# -- error discipline --------------------------------------------------------
+
+def test_swallowed_exception_fires_and_suppresses(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/bad.py": """\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+
+        def h():
+            try:
+                g()
+            except Exception:  # yb-lint: disable=errors
+                pass
+    """})
+    (v,) = fired(res, "errors/swallowed-exception")
+    assert v.line == 4
+    assert res.suppressed == 1
+
+
+def test_narrow_except_pass_is_fine(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/ok.py": """\
+        def f():
+            try:
+                g()
+            except (OSError, ValueError):
+                pass
+    """})
+    assert not fired(res, "errors/swallowed-exception")
+
+
+def test_handler_bare_return_and_fall_off_end(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/bad.py": """\
+        class Svc:
+            def _h_ping(self, body):
+                if body:
+                    return {"ok": True}
+                return
+
+            def _h_pong(self, body):
+                if body:
+                    return {"ok": True}
+
+            def _h_good(self, body):
+                return {"ok": True}
+    """})
+    vs = fired(res, "errors/handler-returns-none")
+    assert {v.fingerprint for v in vs} == {"Svc._h_ping", "Svc._h_pong"}
+
+
+def test_unguarded_daemon_thread(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/server/bad.py": """\
+        import threading
+
+        class S:
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+                threading.Thread(target=self._safe, daemon=True).start()
+
+            def _loop(self):
+                while True:
+                    step()
+
+            def _safe(self):
+                try:
+                    while True:
+                        step()
+                except Exception:
+                    log()
+    """})
+    (v,) = fired(res, "errors/unguarded-daemon-thread")
+    assert "_loop" in v.message
+
+
+# -- suppression + baseline machinery ----------------------------------------
+
+def test_standalone_suppression_covers_next_line(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/ok.py": """\
+        # yb-lint: disable=all
+        from yugabyte_db_tpu.consensus import raft
+    """})
+    assert not res.violations and res.suppressed >= 1
+
+
+def test_baseline_budget_absorbs_only_grandfathered_count(tmp_path):
+    files = {"yugabyte_db_tpu/util/two.py": """\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+            try:
+                g()
+            except Exception:
+                pass
+    """}
+    res = lint(tmp_path, files)
+    raw = fired(res, "errors/swallowed-exception")
+    assert len(raw) == 2
+    # Both share one baseline key (same file/rule/fingerprint). A budget
+    # of 1 absorbs only the first in line order: the file grew a fresh
+    # violation past its grandfathered count.
+    assert raw[0].baseline_key() == raw[1].baseline_key()
+    budget = {raw[0].baseline_key(): 1}
+    fresh, absorbed = apply_baseline(raw, budget)
+    assert absorbed == 1
+    assert [v.line for v in fresh] == [max(v.line for v in raw)]

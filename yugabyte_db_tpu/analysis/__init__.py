@@ -1,0 +1,31 @@
+"""yb-lint: repo-native static analysis for the eight-layer map.
+
+The paper's structural claim — util -> rpc -> storage -> docdb ->
+tablet/consensus -> daemons -> client -> YQL, with one sanctioned seam
+between query execution and storage — is enforced here mechanically,
+along with the JAX-hygiene, lock-discipline, and error-discipline
+invariants the test suite cannot see (they only bite under real
+concurrency or on a real TPU).
+
+Reference analog: the reference tree pins the same invariants with
+clang-tidy plugins and iwyu mappings (src/yb/tools/); here the checks
+are AST visitors over the Python tree so they run anywhere in <30s.
+
+Usage:
+    python -m yugabyte_db_tpu.analysis [--format=json] [paths...]
+
+Suppression: append ``# yb-lint: disable=<rule-id>[,<rule-id>...]`` to
+the offending line (or the line directly above it). Grandfathered
+violations live in ``baseline.json`` next to this file; regenerate with
+``--write-baseline`` after deliberate changes, and burn entries down
+over time (ROADMAP "Open items").
+"""
+
+from yugabyte_db_tpu.analysis.core import (  # noqa: F401
+    AnalysisResult,
+    Violation,
+    all_rules,
+    default_baseline_path,
+    load_baseline,
+    run_analysis,
+)

@@ -1,0 +1,64 @@
+"""CLI: ``python -m yugabyte_db_tpu.analysis [options] [paths...]``.
+
+Exit status: 0 when no non-baselined, non-suppressed violations; 2 when
+violations remain; 1 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from yugabyte_db_tpu.analysis import core, reporting
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m yugabyte_db_tpu.analysis",
+        description="yb-lint: layer-map, JAX-hygiene, lock- and "
+                    "error-discipline static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the "
+                         "yugabyte_db_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered violations too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current tree "
+                         "instead of reporting")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = core.all_rules()
+    if args.list_rules:
+        for name in sorted(rules):
+            print(name)
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline = core.load_baseline(args.baseline)
+
+    result = core.run_analysis(paths, baseline=baseline, rules=rules)
+
+    if args.write_baseline:
+        path = core.write_baseline(result.violations, args.baseline)
+        print(f"yb-lint: wrote {len(result.violations)} grandfathered "
+              f"violation(s) to {path}")
+        return 0
+
+    out = (reporting.render_json(result) if args.format == "json"
+           else reporting.render_text(result))
+    print(out)
+    return 0 if result.ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

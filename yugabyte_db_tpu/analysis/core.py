@@ -1,0 +1,270 @@
+"""Framework: file model, rule registry, suppression, baseline, runner.
+
+A rule is a callable ``check(src: SourceFile) -> Iterable[Violation]``
+registered under a ``family/rule-id`` name. The runner parses each file
+once, hands the same ``SourceFile`` to every rule, then filters the
+stream through inline suppressions and the committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+_SUPPRESS_RE = re.compile(r"#\s*yb-lint:\s*disable=([\w/,\- ]+)")
+
+PACKAGE_ROOT = "yugabyte_db_tpu"
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str          # e.g. "layering/upward-import"
+    file: str          # repo-relative posix path
+    line: int
+    message: str
+    # Line-number-free key used for baseline matching so grandfathered
+    # entries survive unrelated edits to the same file.
+    fingerprint: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.file}::{self.rule}::{self.fingerprint}"
+
+
+class SourceFile:
+    """One parsed Python file plus the comment-level suppression map."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        # Dotted module name when the file belongs to the package
+        # (yugabyte_db_tpu/storage/engine.py -> yugabyte_db_tpu.storage.engine),
+        # else None (tests, bench, fixtures).
+        self.module: str | None = None
+        parts = rel[:-3].split("/") if rel.endswith(".py") else []
+        if PACKAGE_ROOT in parts:
+            parts = parts[parts.index(PACKAGE_ROOT):]
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            self.module = ".".join(parts)
+        self._suppressions: dict[int, set[str]] | None = None
+
+    # -- suppressions --------------------------------------------------------
+    def _parse_suppressions(self) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                # A standalone suppression comment covers the next line.
+                out.setdefault(i + 1, set()).update(rules)
+        return out
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if self._suppressions is None:
+            self._suppressions = self._parse_suppressions()
+        rules = self._suppressions.get(line)
+        if not rules:
+            return False
+        family = rule.split("/", 1)[0]
+        return rule in rules or family in rules or "all" in rules
+
+
+# -- registry ---------------------------------------------------------------
+_RULES: dict[str, object] = {}
+
+
+def rule(name: str):
+    """Register ``check(src) -> Iterable[Violation]`` under ``name``."""
+
+    def deco(fn):
+        _RULES[name] = fn
+        fn.rule_name = name
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, object]:
+    _load_rule_modules()
+    return dict(_RULES)
+
+
+_LOADED = False
+
+
+def _load_rule_modules() -> None:
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    from yugabyte_db_tpu.analysis import (  # noqa: F401
+        error_discipline,
+        jax_hygiene,
+        layering,
+        locks,
+    )
+
+
+# -- baseline ---------------------------------------------------------------
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> dict[str, int]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("violations", {}).items()}
+
+
+def write_baseline(violations: list[Violation], path: str | None = None) -> str:
+    path = path or default_baseline_path()
+    counts: dict[str, int] = {}
+    for v in violations:
+        counts[v.baseline_key()] = counts.get(v.baseline_key(), 0) + 1
+    payload = {
+        "comment": "Grandfathered yb-lint violations. Burn down; never add. "
+                   "Regenerate with python -m yugabyte_db_tpu.analysis "
+                   "--write-baseline only after deliberate review.",
+        "violations": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def apply_baseline(violations: list[Violation],
+                   baseline: dict[str, int]) -> tuple[list[Violation], int]:
+    """Split into (fresh, n_baselined). Within one baseline key the
+    grandfather budget absorbs the first N occurrences in line order;
+    anything beyond the budget is fresh (the file grew new ones)."""
+    groups: dict[str, list[Violation]] = {}
+    for v in violations:
+        groups.setdefault(v.baseline_key(), []).append(v)
+    fresh: list[Violation] = []
+    n_baselined = 0
+    for key, group in groups.items():
+        budget = baseline.get(key, 0)
+        group.sort(key=lambda v: v.line)
+        n_baselined += min(budget, len(group))
+        fresh.extend(group[budget:])
+    fresh.sort(key=lambda v: (v.file, v.line, v.rule))
+    return fresh, n_baselined
+
+
+# -- runner -----------------------------------------------------------------
+@dataclass
+class AnalysisResult:
+    violations: list[Violation] = field(default_factory=list)  # actionable
+    baselined: int = 0
+    suppressed: int = 0
+    files_checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv"}
+
+
+def iter_python_files(paths: list[str], repo_root: str) -> list[tuple[str, str]]:
+    """Expand paths to (abs, repo-relative) .py files, sorted."""
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in sorted(dirnames) if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    uniq = sorted(set(out))
+    return [(p, os.path.relpath(p, repo_root).replace(os.sep, "/"))
+            for p in uniq]
+
+
+def run_analysis(paths: list[str], repo_root: str | None = None,
+                 baseline: dict[str, int] | None = None,
+                 rules: dict[str, object] | None = None) -> AnalysisResult:
+    repo_root = repo_root or _find_repo_root(paths)
+    rules = rules if rules is not None else all_rules()
+    result = AnalysisResult()
+    raw: list[Violation] = []
+    for path, rel in iter_python_files(paths, repo_root):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            src = SourceFile(path, rel, text)
+        except (OSError, SyntaxError, ValueError) as e:
+            raw.append(Violation("parse/error", rel,
+                                 getattr(e, "lineno", 0) or 0,
+                                 f"cannot analyze: {e}", "parse"))
+            continue
+        result.files_checked += 1
+        for name, check in rules.items():
+            for v in check(src):
+                if src.is_suppressed(v.rule, v.line):
+                    result.suppressed += 1
+                else:
+                    raw.append(v)
+    if baseline:
+        result.violations, result.baselined = apply_baseline(raw, baseline)
+    else:
+        raw.sort(key=lambda v: (v.file, v.line, v.rule))
+        result.violations = raw
+    return result
+
+
+def _find_repo_root(paths: list[str]) -> str:
+    """Nearest ancestor of the first path that contains the package (so
+    relative file names in reports match the repo layout)."""
+    p = os.path.abspath(paths[0] if paths else os.getcwd())
+    if os.path.isfile(p):
+        p = os.path.dirname(p)
+    while True:
+        if os.path.isdir(os.path.join(p, PACKAGE_ROOT)):
+            return p
+        parent = os.path.dirname(p)
+        if parent == p:
+            return os.path.abspath(paths[0] if paths else os.getcwd())
+        p = parent
+
+
+# -- shared AST helpers ------------------------------------------------------
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('jax.jit', 'self._lock.acquire')."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        inner = dotted_name(node.func)
+        parts.append(f"{inner}()" if inner else "()")
+    else:
+        return ""
+    return ".".join(reversed(parts))

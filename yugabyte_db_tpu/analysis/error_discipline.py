@@ -1,0 +1,225 @@
+"""Rule family 4 — error discipline.
+
+Three shapes of silently-lost failure:
+- ``except [Exception]: pass`` — the error vanishes with no trace;
+- RPC/service handlers (``_h_*`` methods and ``handle`` dispatchers)
+  with a code path that falls off the end — the peer gets ``None``
+  where the wire contract promises a response/Status dict;
+- daemon-thread targets whose body has no top-level exception guard —
+  the thread dies silently and the subsystem it drove just stops.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from yugabyte_db_tpu.analysis.core import SourceFile, Violation, call_name, rule
+
+RULE_SWALLOW = "errors/swallowed-exception"
+RULE_HANDLER = "errors/handler-returns-none"
+RULE_THREAD = "errors/unguarded-daemon-thread"
+
+_BROAD = {None, "Exception", "BaseException"}
+
+
+def _handler_types(handler: ast.ExceptHandler) -> set[str | None]:
+    t = handler.type
+    if t is None:
+        return {None}
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    out: set[str | None] = set()
+    for n in nodes:
+        name = ""
+        if isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Attribute):
+            name = n.attr
+        out.add(name)
+    return out
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    return bool(_handler_types(handler) & _BROAD)
+
+
+def _enclosing_functions(tree: ast.AST):
+    """Yield (func_node, qualname-ish) for every function."""
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                yield child, name
+                yield from walk(child, name)
+            elif isinstance(child, ast.ClassDef):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                yield from walk(child, name)
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+@rule(RULE_SWALLOW)
+def check_swallowed(src: SourceFile):
+    if not src.module:
+        return
+    funcs = list(_enclosing_functions(src.tree))
+
+    def owner(line: int) -> str:
+        best = "<module>"
+        for fn, name in funcs:
+            if fn.lineno <= line <= max(fn.lineno,
+                                        getattr(fn, "end_lineno", fn.lineno)):
+                best = name
+        return best
+
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        body_real = [s for s in node.body
+                     if not (isinstance(s, ast.Expr)
+                             and isinstance(s.value, ast.Constant))]
+        only_pass = all(isinstance(s, (ast.Pass, ast.Continue))
+                        for s in body_real)
+        if only_pass and _is_broad(node):
+            yield Violation(
+                RULE_SWALLOW, src.rel, node.lineno,
+                "blanket `except Exception: pass` swallows the error with "
+                "no trace — log it, narrow the type, or count it in "
+                "metrics", f"swallow:{owner(node.lineno)}")
+
+
+# -- handler return analysis -------------------------------------------------
+def _always_exits(stmts: list[ast.stmt]) -> bool:
+    """Conservative: True if this statement list can never fall through
+    to the next statement without returning a value or raising."""
+    for i, stmt in enumerate(stmts):
+        if isinstance(stmt, ast.Return):
+            return True  # bare `return` is reported separately
+        if isinstance(stmt, ast.Raise):
+            return True
+        if isinstance(stmt, ast.If):
+            if stmt.orelse and _always_exits(stmt.body) \
+                    and _always_exits(stmt.orelse):
+                return True
+        elif isinstance(stmt, ast.Try):
+            handlers_exit = all(_always_exits(h.body) for h in stmt.handlers)
+            body_exit = _always_exits(stmt.body + (stmt.orelse or []))
+            if stmt.finalbody and _always_exits(stmt.finalbody):
+                return True
+            if body_exit and (handlers_exit or not stmt.handlers):
+                return True
+        elif isinstance(stmt, ast.With):
+            if _always_exits(stmt.body):
+                return True
+        elif isinstance(stmt, ast.While):
+            # `while True:` with no break never falls through.
+            if isinstance(stmt.test, ast.Constant) and stmt.test.value:
+                if not any(isinstance(n, ast.Break) for n in ast.walk(stmt)):
+                    return True
+        elif isinstance(stmt, ast.Match):
+            cases = stmt.cases
+            exhaustive = any(
+                isinstance(c.pattern, ast.MatchAs) and c.pattern.pattern
+                is None for c in cases)
+            if exhaustive and all(_always_exits(c.body) for c in cases):
+                return True
+    return False
+
+
+def _bare_returns(fn: ast.AST):
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue  # nested defs return on their own behalf
+            if isinstance(child, ast.Return) and child.value is None:
+                yield child
+            yield from walk(child)
+
+    yield from walk(fn)
+
+
+@rule(RULE_HANDLER)
+def check_handler_returns(src: SourceFile):
+    if not src.module:
+        return
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, ast.FunctionDef):
+                continue
+            if not meth.name.startswith("_h_"):
+                continue
+            fingerprint = f"{cls.name}.{meth.name}"
+            for node in _bare_returns(meth):
+                yield Violation(
+                    RULE_HANDLER, src.rel, node.lineno,
+                    f"service handler {fingerprint} has a bare `return` — "
+                    f"the RPC peer receives None instead of a response "
+                    f"dict/Status", fingerprint)
+            if not _always_exits(meth.body):
+                yield Violation(
+                    RULE_HANDLER, src.rel, meth.lineno,
+                    f"service handler {fingerprint} can fall off the end — "
+                    f"the RPC peer receives None instead of a response "
+                    f"dict/Status", fingerprint)
+
+
+# -- daemon thread guards ----------------------------------------------------
+def _thread_guarded(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    """A broad try/except at the top level of the body, or at the top
+    level of a top-level loop/with, counts as a guard."""
+
+    def tops(stmts, depth):
+        for stmt in stmts:
+            yield stmt
+            if depth > 0 and isinstance(stmt, (ast.While, ast.For, ast.With)):
+                yield from tops(stmt.body, depth - 1)
+            if depth > 0 and isinstance(stmt, ast.Try) and stmt.finalbody:
+                yield from tops(stmt.body, depth - 1)
+
+    for stmt in tops(fn.body, 2):
+        if isinstance(stmt, ast.Try) and any(_is_broad(h)
+                                             for h in stmt.handlers):
+            return True
+    return False
+
+
+@rule(RULE_THREAD)
+def check_daemon_threads(src: SourceFile):
+    if not src.module:
+        return
+    # Local + method function defs, keyed by simple name.
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node).rsplit(".", 1)[-1] != "Thread":
+            continue
+        target = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None:
+            continue
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            name = target.attr
+        fn = defs.get(name) if name else None
+        if fn is None:
+            continue  # unresolvable target: out of scope for this pass
+        if not _thread_guarded(fn):
+            yield Violation(
+                RULE_THREAD, src.rel, node.lineno,
+                f"thread target `{name}` has no top-level exception guard "
+                f"— an unexpected error kills the thread silently and its "
+                f"subsystem stalls", f"thread:{name}")

@@ -1,0 +1,278 @@
+"""Rule family 2 — JAX hygiene: host-sync and retrace hazards.
+
+Traced context = a function that jax will trace: decorated with
+``@jax.jit`` (directly or via ``partial``), passed by name into
+``jax.jit(...)`` / ``jax.vmap(...)`` / ``pl.pallas_call(...)``, or
+defined inside such a function. Host syncs inside a traced context
+either fail at trace time (``.item()`` on a tracer) or, worse, silently
+force a device round-trip per call; retrace hazards (unhashable /
+mutable-default static args) recompile on every invocation.
+
+Module-scope ``jnp`` calls are flagged everywhere in the package: they
+allocate on the default backend at import time, which breaks
+``JAX_PLATFORMS=cpu`` test runs and multi-process device pinning.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from yugabyte_db_tpu.analysis.core import (
+    SourceFile,
+    Violation,
+    call_name,
+    dotted_name,
+    rule,
+)
+
+RULE_ITEM = "jax/host-sync-item"
+RULE_CAST = "jax/host-sync-cast"
+RULE_TRANSFER = "jax/host-transfer"
+RULE_BLOCK = "jax/block-until-ready"
+RULE_MODULE_JNP = "jax/module-scope-jnp"
+RULE_STATIC = "jax/unhashable-static-arg"
+
+_TRACING_CALLS = ("jit", "vmap", "pmap", "pallas_call", "shard_map", "scan",
+                  "while_loop", "fori_loop", "cond", "checkpoint", "remat",
+                  "custom_vjp", "custom_jvp", "grad", "value_and_grad")
+_HOST_ARRAY_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array", "np.ascontiguousarray"}
+
+
+def _is_tracing_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    last = name.rsplit(".", 1)[-1]
+    return last in _TRACING_CALLS
+
+
+def _jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        name = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+        last = name.rsplit(".", 1)[-1]
+        if last in ("jit", "pjit"):
+            return True
+        if last == "partial" and isinstance(dec, ast.Call):
+            for arg in dec.args:
+                inner = dotted_name(arg)
+                if inner.rsplit(".", 1)[-1] in ("jit", "pjit"):
+                    return True
+    return False
+
+
+def _collect_traced_names(tree: ast.AST) -> set[str]:
+    """Function names passed (possibly through partial/vmap nesting) to a
+    tracing entry point anywhere in the module."""
+    traced: set[str] = set()
+
+    def harvest(node: ast.AST) -> None:
+        # Bare names and names nested in partial(...)/vmap(...) wrappers.
+        if isinstance(node, ast.Name):
+            traced.add(node.id)
+        elif isinstance(node, ast.Call):
+            for a in list(node.args) + [kw.value for kw in node.keywords
+                                        if kw.arg in (None, "fun", "f",
+                                                      "kernel", "target")]:
+                harvest(a)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_tracing_call(node):
+            for a in node.args:
+                harvest(a)
+            for kw in node.keywords:
+                if kw.arg in ("fun", "f", "kernel", "body_fun", "cond_fun"):
+                    harvest(kw.value)
+    return traced
+
+
+def _iter_traced_functions(src: SourceFile):
+    """Yield every FunctionDef considered a traced context (including
+    functions nested inside one)."""
+    traced_names = _collect_traced_names(src.tree)
+
+    def walk(node: ast.AST, inside_traced: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                is_traced = (inside_traced or _jit_decorated(child)
+                             or child.name in traced_names)
+                if is_traced:
+                    yield child
+                yield from walk(child, is_traced)
+            else:
+                yield from walk(child, inside_traced)
+
+    yield from walk(src.tree, False)
+
+
+def _mentions_static_shape(node: ast.AST) -> bool:
+    """True if the expression reads static metadata (shape/dtype math is
+    host math even inside a trace — not a sync)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype", "itemsize"):
+            return True
+        if isinstance(sub, ast.Call) and call_name(sub) in ("len", "range"):
+            return True
+    return False
+
+
+def _is_bench_file(rel: str) -> bool:
+    return (rel.startswith("tests/") or "/tests/" in rel
+            or rel.split("/")[-1].startswith(("bench", "test_"))
+            or "/tools/" in rel)
+
+
+@rule("jax/traced-context")
+def check_traced_contexts(src: SourceFile):
+    if not src.module:
+        return
+    seen: set[int] = set()
+    for fn in _iter_traced_functions(src):
+        for node in ast.walk(fn):
+            if id(node) in seen or isinstance(node, (ast.FunctionDef,
+                                                     ast.AsyncFunctionDef)):
+                continue
+            seen.add(id(node))
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name.endswith(".item") or name.endswith(".tolist"):
+                yield Violation(
+                    RULE_ITEM, src.rel, node.lineno,
+                    f"host sync `{name.rsplit('.', 1)[-1]}()` inside traced "
+                    f"function `{fn.name}` — fails on tracers / forces a "
+                    f"device round-trip", f"item:{fn.name}")
+            elif name in ("float", "int", "bool") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) or _mentions_static_shape(arg):
+                    continue
+                yield Violation(
+                    RULE_CAST, src.rel, node.lineno,
+                    f"`{name}(...)` on a traced value inside `{fn.name}` "
+                    f"concretizes the tracer (host sync); keep it as an "
+                    f"array or hoist to the host side", f"cast:{fn.name}")
+            elif name in _HOST_ARRAY_CALLS:
+                yield Violation(
+                    RULE_TRANSFER, src.rel, node.lineno,
+                    f"`{name}(...)` inside traced function `{fn.name}` "
+                    f"copies device values to host; use jnp inside traces",
+                    f"transfer:{fn.name}")
+
+
+@rule(RULE_BLOCK)
+def check_block_until_ready(src: SourceFile):
+    if not src.module or _is_bench_file(src.rel):
+        return
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) \
+                and call_name(node).endswith("block_until_ready"):
+            yield Violation(
+                RULE_BLOCK, src.rel, node.lineno,
+                "block_until_ready outside bench/test code serializes the "
+                "dispatch pipeline; rely on the blocking fetch at the "
+                "result boundary instead", "block")
+
+
+@rule(RULE_MODULE_JNP)
+def check_module_scope_jnp(src: SourceFile):
+    if not src.module:
+        return
+
+    def scan(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.If, ast.Try, ast.With, ast.For,
+                                 ast.While)):
+                for field in ("body", "orelse", "finalbody"):
+                    yield from scan(getattr(stmt, field, []) or [])
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    if name.startswith(("jnp.", "jax.numpy.")) \
+                            or name.startswith("jax.device_put"):
+                        yield node
+
+    for node in scan(src.tree.body):
+        yield Violation(
+            RULE_MODULE_JNP, src.rel, node.lineno,
+            f"`{call_name(node)}(...)` at module import scope allocates on "
+            f"the default backend at import time; build constants lazily "
+            f"inside the kernel factory", "module-jnp")
+
+
+_MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+
+def _static_param_names(fn: ast.FunctionDef, static_argnums, static_argnames):
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    names: set[str] = set()
+    for n in static_argnums:
+        if isinstance(n, int) and 0 <= n < len(params):
+            names.add(params[n])
+    names.update(static_argnames)
+    return names
+
+
+def _literal_elems(node: ast.AST):
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [getattr(e, "value", getattr(e, "id", None))
+                for e in node.elts if isinstance(e, (ast.Constant, ast.Name))]
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    return []
+
+
+@rule(RULE_STATIC)
+def check_static_args(src: SourceFile):
+    if not src.module:
+        return
+    # Local function defs by name, for jax.jit(fn, static_...) resolution.
+    defs: dict[str, ast.FunctionDef] = {
+        n.name: n for n in ast.walk(src.tree)
+        if isinstance(n, ast.FunctionDef)}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node).rsplit(".", 1)[-1] not in ("jit", "pjit"):
+            continue
+        argnums, argnames = [], []
+        for kw in node.keywords:
+            if kw.arg == "static_argnums":
+                argnums = [v for v in _literal_elems(kw.value)
+                           if isinstance(v, int)]
+            elif kw.arg == "static_argnames":
+                argnames = [v for v in _literal_elems(kw.value)
+                            if isinstance(v, str)]
+        if not argnums and not argnames:
+            continue
+        target = None
+        if node.args and isinstance(node.args[0], ast.Name):
+            target = defs.get(node.args[0].id)
+        if target is None:
+            continue
+        static_names = _static_param_names(target, argnums, argnames)
+        pos = target.args.posonlyargs + target.args.args
+        defaults = target.args.defaults
+        with_default = pos[len(pos) - len(defaults):]
+        for param, default in zip(with_default, defaults):
+            if param.arg in static_names \
+                    and isinstance(default, _MUTABLE_DEFAULTS):
+                yield Violation(
+                    RULE_STATIC, src.rel, default.lineno,
+                    f"static arg `{param.arg}` of `{target.name}` has a "
+                    f"mutable (unhashable) default — jit raises on it and "
+                    f"every fresh object retraces; use a tuple/frozen value",
+                    f"static:{target.name}.{param.arg}")
+        for param, default in zip(target.args.kwonlyargs,
+                                  target.args.kw_defaults):
+            if default is not None and param.arg in static_names \
+                    and isinstance(default, _MUTABLE_DEFAULTS):
+                yield Violation(
+                    RULE_STATIC, src.rel, default.lineno,
+                    f"static arg `{param.arg}` of `{target.name}` has a "
+                    f"mutable (unhashable) default — jit raises on it and "
+                    f"every fresh object retraces; use a tuple/frozen value",
+                    f"static:{target.name}.{param.arg}")

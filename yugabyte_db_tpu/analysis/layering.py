@@ -1,0 +1,115 @@
+"""Rule family 1 — layering: enforce the eight-layer import order.
+
+Walks every ``import yugabyte_db_tpu...`` / ``from yugabyte_db_tpu...``
+(including relative imports resolved against the module) and checks the
+(importer package -> imported package) edge against the table in
+``layers.py``. Lazy in-function imports are treated exactly like
+top-level ones: a cycle hidden behind laziness is still a layering bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from yugabyte_db_tpu.analysis import layers
+from yugabyte_db_tpu.analysis.core import PACKAGE_ROOT, SourceFile, Violation, rule
+
+RULE_UPWARD = "layering/upward-import"
+RULE_FORBIDDEN = "layering/forbidden-import"
+
+
+def _self_package(src: SourceFile) -> str | None:
+    if not src.module:
+        return None
+    parts = src.module.split(".")
+    return parts[1] if len(parts) > 1 else None
+
+
+def _resolve_relative(src: SourceFile, node: ast.ImportFrom) -> str | None:
+    """Absolute dotted target of a relative import, or None."""
+    if not src.module:
+        return None
+    base = src.module.split(".")
+    # A module's level-1 base is its package; __init__ modules already
+    # dropped their trailing component in SourceFile.module.
+    if not src.rel.endswith("__init__.py"):
+        base = base[:-1]
+    if node.level > 1:
+        if node.level - 1 >= len(base):
+            return None
+        base = base[:-(node.level - 1)]
+    return ".".join(base + ([node.module] if node.module else []))
+
+
+def _is_type_checking_block(node: ast.AST) -> bool:
+    if not isinstance(node, ast.If):
+        return False
+    test = node.test
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or \
+        (isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING")
+
+
+def _walk_runtime(tree: ast.AST):
+    """ast.walk, pruning `if TYPE_CHECKING:` bodies — those imports never
+    execute, so they create no runtime layering edge."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if _is_type_checking_block(node):
+            stack.extend(node.orelse)
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _imported_packages(src: SourceFile):
+    """Yield (top-level package imported, line)."""
+    for node in _walk_runtime(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                parts = alias.name.split(".")
+                if parts[0] == PACKAGE_ROOT and len(parts) > 1:
+                    yield parts[1], node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                target = _resolve_relative(src, node)
+                if target is None:
+                    continue
+                parts = target.split(".")
+                if parts[0] != PACKAGE_ROOT:
+                    continue
+                if len(parts) > 1:
+                    yield parts[1], node.lineno
+                else:
+                    # `from . import X` at the package root: each name is
+                    # a top-level package.
+                    for alias in node.names:
+                        yield alias.name, node.lineno
+            elif node.module:
+                parts = node.module.split(".")
+                if parts[0] != PACKAGE_ROOT:
+                    continue
+                if len(parts) > 1:
+                    yield parts[1], node.lineno
+                else:
+                    # `from yugabyte_db_tpu import X`
+                    for alias in node.names:
+                        yield alias.name, node.lineno
+
+
+@rule(RULE_UPWARD)
+def check_layering(src: SourceFile):
+    src_pkg = _self_package(src)
+    if src_pkg is None:
+        return
+    for dst_pkg, line in _imported_packages(src):
+        if dst_pkg == src_pkg:
+            continue
+        reason = layers.check_edge(src_pkg, dst_pkg)
+        if reason is None:
+            continue
+        rule_id = (RULE_FORBIDDEN
+                   if (src_pkg, dst_pkg) in layers.FORBIDDEN else RULE_UPWARD)
+        yield Violation(rule_id, src.rel, line,
+                        f"{src_pkg} -> {dst_pkg}: {reason}",
+                        f"{src_pkg}->{dst_pkg}")

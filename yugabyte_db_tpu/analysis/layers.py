@@ -1,0 +1,79 @@
+"""The eight-layer map, as data.
+
+This file is the single authority the layering rule reads; re-layering
+the tree is a one-line diff here. Order follows the paper's dependency
+spine: util -> rpc -> storage -> docdb -> tablet/consensus -> daemons ->
+client -> YQL (reference: src/yb/{util,rpc,rocksdb,docdb,tablet,
+consensus,master,tserver,client,yql}). A package may import its own
+layer or any layer below it; everything else is a violation unless the
+edge appears in ALLOWED_EXTRA.
+"""
+
+from __future__ import annotations
+
+# (layer name, top-level packages / modules of yugabyte_db_tpu.* in it),
+# bottom (most foundational) first.
+LAYERS: list[tuple[str, list[str]]] = [
+    # util: leaf primitives + device kernels. ops/ and utils/ import
+    # nothing above this line — kernels must stay hoistable to any engine.
+    ("util", ["utils", "models", "native", "ops", "fs", "auth"]),
+    ("rpc", ["rpc"]),
+    ("storage", ["storage"]),
+    # docdb: document-level services composed over the storage engine.
+    ("docdb", ["index", "parallel"]),
+    ("tablet_consensus", ["tablet", "consensus", "txn"]),
+    ("daemons", ["master", "tserver", "server"]),
+    ("client", ["client", "drivers", "tools"]),
+    ("yql", ["yql"]),
+    # harness: test/tooling surfaces allowed to see everything.
+    ("harness", ["integration", "analysis"]),
+]
+
+# Edges forbidden even though they point downward: the paper's one
+# sanctioned seam between query execution and storage is the engine
+# interface (storage.engine / YQLStorageIf analog) — YQL never reaches
+# around it to the device kernels.
+FORBIDDEN: dict[tuple[str, str], str] = {
+    ("yql", "ops"): "yql reaches storage only via the engine seam "
+                    "(storage.engine), never the device kernels",
+    ("client", "ops"): "client code never touches device kernels",
+    ("drivers", "ops"): "wire drivers never touch device kernels",
+}
+
+# Sanctioned upward edges (each one documented; add sparingly).
+ALLOWED_EXTRA: dict[tuple[str, str], str] = {}
+
+_RANK: dict[str, int] = {}
+_LAYER_OF: dict[str, str] = {}
+for _i, (_name, _pkgs) in enumerate(LAYERS):
+    for _p in _pkgs:
+        _RANK[_p] = _i
+        _LAYER_OF[_p] = _name
+
+
+def rank(pkg: str) -> int | None:
+    return _RANK.get(pkg)
+
+
+def layer_of(pkg: str) -> str | None:
+    return _LAYER_OF.get(pkg)
+
+
+def check_edge(src_pkg: str, dst_pkg: str) -> str | None:
+    """None if the import is legal, else a human-readable reason."""
+    if (src_pkg, dst_pkg) in FORBIDDEN:
+        return FORBIDDEN[(src_pkg, dst_pkg)]
+    if (src_pkg, dst_pkg) in ALLOWED_EXTRA:
+        return None
+    rs, rd = _RANK.get(src_pkg), _RANK.get(dst_pkg)
+    if rs is None:
+        return (f"package '{src_pkg}' is not in the layer map "
+                f"(analysis/layers.py) — add it to a layer")
+    if rd is None:
+        return (f"imported package '{dst_pkg}' is not in the layer map "
+                f"(analysis/layers.py) — add it to a layer")
+    if rd > rs:
+        return (f"layer '{_LAYER_OF[src_pkg]}' may not import layer "
+                f"'{_LAYER_OF[dst_pkg]}' ({src_pkg} -> {dst_pkg} points "
+                f"up the stack)")
+    return None

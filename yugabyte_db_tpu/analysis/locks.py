@@ -1,0 +1,182 @@
+"""Rule family 3 — lock discipline.
+
+For every class that creates a ``threading.Lock``/``RLock`` attribute,
+infer the set of instance attributes ever written under a ``with
+self.<lock>:`` block; any write to one of those attributes outside every
+lock (``__init__`` excepted — the object is not shared yet) is a data
+race the test suite only catches probabilistically. Also reports lock
+pairs acquired in opposite nesting orders in different methods (ABBA
+deadlock shape).
+
+Two conventions are honored (both mirror the reference tree):
+- ``Condition(self._lock)`` attributes are lock-aliases — ``with
+  self._cv:`` holds the underlying lock;
+- a method named ``*_locked`` asserts "caller holds the lock" (the
+  REQUIRES() annotation of src/yb/util/thread_annotations.h), so its
+  writes count as guarded.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from yugabyte_db_tpu.analysis.core import SourceFile, Violation, call_name, rule
+
+RULE_UNGUARDED = "locks/unguarded-write"
+RULE_ORDER = "locks/inconsistent-order"
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__getstate__", "__setstate__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value,
+                                                              ast.Call):
+            continue
+        name = call_name(node.value)
+        if name.rsplit(".", 1)[-1] not in ("Lock", "RLock", "Condition"):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                out.add(tgt.attr)
+    return out
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _written_attr(target: ast.AST) -> str | None:
+    """Attribute name for `self.X = ..` / `self.X[k] = ..` targets."""
+    attr = _self_attr(target)
+    if attr is not None:
+        return attr
+    if isinstance(target, ast.Subscript):
+        return _self_attr(target.value)
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect (attr, line, frozenset(held locks)) writes and the nested
+    lock-acquisition order pairs for one method."""
+
+    def __init__(self, lock_attrs: set[str]):
+        self.lock_attrs = lock_attrs
+        self.held: list[str] = []
+        self.writes: list[tuple[str, int, frozenset]] = []
+        self.order_pairs: list[tuple[str, str, int]] = []
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.lock_attrs:
+                for outer in self.held:
+                    self.order_pairs.append((outer, attr, node.lineno))
+                self.held.append(attr)
+                acquired.append(attr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for attr in reversed(acquired):
+            self.held.pop()
+
+    def _record(self, target: ast.AST, line: int) -> None:
+        attr = _written_attr(target)
+        if attr is not None and attr not in self.lock_attrs:
+            self.writes.append((attr, line, frozenset(self.held)))
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Tuple):
+                for el in tgt.elts:
+                    self._record(el, node.lineno)
+            else:
+                self._record(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            self._record(tgt, node.lineno)
+        self.generic_visit(node)
+
+    # Nested defs run on other stacks (thread targets, callbacks): their
+    # writes are analyzed with an empty held-set only if they acquire no
+    # lock themselves — keep it simple and scan them with the current
+    # (almost always empty) stack, which matches the common closure case.
+
+
+@rule(RULE_UNGUARDED)
+def check_lock_discipline(src: SourceFile):
+    if not src.module:
+        return
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        scans: list[tuple[str, _MethodScan]] = []
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            scan = _MethodScan(locks)
+            if meth.name.endswith("_locked"):
+                # REQUIRES(lock) convention: the caller holds the lock
+                # for the whole body.
+                scan.held.append("<caller-held>")
+            for stmt in meth.body:
+                scan.visit(stmt)
+            scans.append((meth.name, scan))
+
+        # Attributes considered lock-guarded: written at least once with a
+        # lock held, outside __init__.
+        guarded: dict[str, set[str]] = {}
+        for name, scan in scans:
+            if name in _EXEMPT_METHODS:
+                continue
+            for attr, _line, held in scan.writes:
+                if held:
+                    guarded.setdefault(attr, set()).update(held)
+
+        for name, scan in scans:
+            if name in _EXEMPT_METHODS:
+                continue
+            for attr, line, held in scan.writes:
+                if attr in guarded and not held:
+                    yield Violation(
+                        RULE_UNGUARDED, src.rel, line,
+                        f"{cls.name}.{name} writes self.{attr} without a "
+                        f"lock, but it is elsewhere written under "
+                        f"{sorted(guarded[attr])}",
+                        f"{cls.name}.{attr}")
+
+        # ABBA: both (A before B) and (B before A) nesting observed.
+        orders: dict[tuple[str, str], int] = {}
+        for _name, scan in scans:
+            for a, b, line in scan.order_pairs:
+                orders.setdefault((a, b), line)
+        reported: set[frozenset] = set()
+        for (a, b), line in orders.items():
+            pair = frozenset((a, b))
+            if (b, a) in orders and pair not in reported:
+                reported.add(pair)
+                yield Violation(
+                    RULE_ORDER, src.rel, line,
+                    f"{cls.name} acquires {a} and {b} in both orders "
+                    f"(lines {line} and {orders[(b, a)]}) — ABBA deadlock",
+                    f"{cls.name}.{min(a, b)}-{max(a, b)}")

@@ -1,0 +1,38 @@
+"""Human and JSON reporters for analysis results."""
+
+from __future__ import annotations
+
+import json
+
+from yugabyte_db_tpu.analysis.core import AnalysisResult
+
+
+def render_text(result: AnalysisResult) -> str:
+    lines = [v.render() for v in result.violations]
+    by_rule: dict[str, int] = {}
+    for v in result.violations:
+        by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+    if by_rule:
+        lines.append("")
+        for r, n in sorted(by_rule.items()):
+            lines.append(f"  {r}: {n}")
+    verdict = "ok" if result.ok else f"{len(result.violations)} violation(s)"
+    lines.append(
+        f"yb-lint: {verdict} "
+        f"({result.files_checked} files, {result.baselined} baselined, "
+        f"{result.suppressed} suppressed)")
+    return "\n".join(lines)
+
+
+def render_json(result: AnalysisResult) -> str:
+    return json.dumps({
+        "ok": result.ok,
+        "files_checked": result.files_checked,
+        "baselined": result.baselined,
+        "suppressed": result.suppressed,
+        "violations": [
+            {"rule": v.rule, "file": v.file, "line": v.line,
+             "message": v.message, "fingerprint": v.fingerprint}
+            for v in result.violations
+        ],
+    }, indent=2)

@@ -33,6 +33,7 @@ Simplifications vs the reference, called out honestly:
 from __future__ import annotations
 
 import enum
+import logging
 import random
 import threading
 import time
@@ -165,9 +166,9 @@ class RaftConsensus:
     def start(self) -> None:
         with self._lock:
             self._running = True
-        t = threading.Thread(target=self._timer_loop,
+        t = threading.Thread(target=self._run_timer,
                              name=f"raft-timer-{self.uuid}", daemon=True)
-        a = threading.Thread(target=self._apply_loop,
+        a = threading.Thread(target=self._run_apply,
                              name=f"raft-apply-{self.uuid}", daemon=True)
         self._threads += [t, a]
         t.start()
@@ -299,7 +300,7 @@ class RaftConsensus:
         # _ensure_durable OUTSIDE it, and the entry only counts toward the
         # majority (self's match = _durable_index) once synced. Concurrent
         # appends share one fsync — the WAL's group-commit design.
-        self._append_local(entry, sync=False)
+        self._append_local_locked(entry, sync=False)
         self._signal_peers_locked()
         return entry
 
@@ -374,7 +375,7 @@ class RaftConsensus:
                         since < self.opts.election_timeout_s:
                     return {"term": term, "granted": False}
             if req["term"] > term:
-                self._step_down(req["term"])
+                self._step_down_locked(req["term"])
             granted = False
             up_to_date = ((req["last_log_term"], req["last_log_index"])
                           >= self._last_log_key())
@@ -399,9 +400,9 @@ class RaftConsensus:
                 return {"term": term, "success": False,
                         "last_index": self._last_index}
             if req["term"] > term:
-                self._step_down(req["term"])
+                self._step_down_locked(req["term"])
             elif self._role != Role.FOLLOWER:
-                self._become_follower()
+                self._become_follower_locked()
             self._leader_uuid = req["leader"]
             self._last_heartbeat_recv = time.monotonic()
             self._election_timeout = self._next_timeout()
@@ -427,8 +428,8 @@ class RaftConsensus:
                 if existing is not None:
                     if existing.op_id.term == e.op_id.term:
                         continue  # already have it
-                    self._truncate_suffix(e.op_id.index - 1)
-                self._append_local(e, sync=False)
+                    self._truncate_suffix_locked(e.op_id.index - 1)
+                self._append_local_locked(e, sync=False)
                 appended = True
             if appended or self._durable_index < self._last_index:
                 # ALSO when nothing new appended: a retried request whose
@@ -445,7 +446,7 @@ class RaftConsensus:
                     "last_index": self._last_index,
                     "lease_s_granted": granted}
 
-    def _append_local(self, e: LogEntry, sync: bool = True) -> None:
+    def _append_local_locked(self, e: LogEntry, sync: bool = True) -> None:
         self.log.append(e)
         if sync:
             self.log.sync()
@@ -460,7 +461,7 @@ class RaftConsensus:
             if self._role == Role.LEADER:
                 self._sync_peer_threads_locked()
 
-    def _truncate_suffix(self, last_kept: int) -> None:
+    def _truncate_suffix_locked(self, last_kept: int) -> None:
         """Erase a conflicting log suffix (follower divergence)."""
         self.log.truncate_after(last_kept)
         self._durable_index = min(self._durable_index, last_kept)
@@ -560,7 +561,7 @@ class RaftConsensus:
                         self.cmeta.current_term != term:
                     return
                 if resp["term"] > term:
-                    self._step_down(resp["term"])
+                    self._step_down_locked(resp["term"])
                     return
                 if resp["success"]:
                     peer.last_ack_monotonic = send_time
@@ -618,7 +619,7 @@ class RaftConsensus:
             if self._role == Role.LEADER:
                 self._sync_peer_threads_locked()
                 if not self.cmeta.committed_config.has_peer(self.uuid):
-                    self._become_follower()  # we were removed
+                    self._become_follower_locked()  # we were removed
         self._apply_cond.notify_all()
         self._commit_cond.notify_all()
 
@@ -664,6 +665,13 @@ class RaftConsensus:
             }
 
     # -- apply ---------------------------------------------------------------
+    def _run_apply(self) -> None:
+        try:
+            self._apply_loop()
+        except Exception:  # a silently-dead applier halts the state machine
+            logging.getLogger(__name__).exception(
+                "raft %s: apply thread died", self.uuid)
+
     def _apply_loop(self) -> None:
         while True:
             with self._lock:
@@ -768,6 +776,13 @@ class RaftConsensus:
     def _next_timeout(self) -> float:
         return self.opts.election_timeout_s * (1.0 + self._rng.random())
 
+    def _run_timer(self) -> None:
+        try:
+            self._timer_loop()
+        except Exception:  # a silently-dead timer wedges heartbeats/elections
+            logging.getLogger(__name__).exception(
+                "raft %s: timer thread died", self.uuid)
+
     def _timer_loop(self) -> None:
         # Deadline-based, not fixed-tick: sleep until the next event
         # (heartbeat due / election timeout) and recompute on wake. A
@@ -849,7 +864,7 @@ class RaftConsensus:
                 return
             with self._lock:
                 if resp["term"] > self.cmeta.current_term:
-                    self._step_down(resp["term"])
+                    self._step_down_locked(resp["term"])
                     return
                 if not (self._role == Role.CANDIDATE and
                         self.cmeta.current_term == term and resp["granted"]):
@@ -909,11 +924,11 @@ class RaftConsensus:
                     name=f"raft-peer-{self.uuid}->{uuid}", daemon=True)
                 p.thread.start()
 
-    def _step_down(self, new_term: int) -> None:
+    def _step_down_locked(self, new_term: int) -> None:
         self.cmeta.set_term(new_term)
-        self._become_follower()
+        self._become_follower_locked()
 
-    def _become_follower(self) -> None:
+    def _become_follower_locked(self) -> None:
         if self._role == Role.LEADER:
             self._peers.clear()
         self._role = Role.FOLLOWER

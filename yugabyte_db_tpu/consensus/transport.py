@@ -14,29 +14,16 @@ transport lives in yugabyte_db_tpu.rpc and plugs in behind the same seam.
 
 from __future__ import annotations
 
-import abc
 import random
 import threading
 import time
 
+# The seam itself lives in the rpc layer; re-exported here because
+# consensus is where most callers historically imported it from.
+from yugabyte_db_tpu.rpc.interface import Transport, TransportError
 
-class TransportError(Exception):
-    """Delivery failure (unreachable, partitioned, dropped, timed out)."""
-
-
-class Transport(abc.ABC):
-    @abc.abstractmethod
-    def send(self, dst: str, method: str, payload: dict, timeout: float = 5.0) -> dict:
-        """Deliver a request to node ``dst``; return its response.
-        Raises TransportError if the node is unreachable."""
-
-    @abc.abstractmethod
-    def register(self, uuid: str, handler) -> None:
-        """Register ``handler(method, payload) -> response`` for a node."""
-
-    @abc.abstractmethod
-    def unregister(self, uuid: str) -> None:
-        ...
+__all__ = ["Transport", "TransportError", "LocalTransport",
+           "BoundTransport"]
 
 
 class LocalTransport(Transport):

@@ -162,6 +162,39 @@ def encode_cql(value) -> bytes | None:
     raise CqlError(0x2200, f"cannot serialize {type(value).__name__}")
 
 
+def encode_cql_typed(value, spec) -> bytes | None:
+    """Bind serialization keyed off the server's bind metadata: a
+    prepared INT column takes 4 bytes on the wire, SMALLINT 2, FLOAT a
+    4-byte IEEE single — not the 8-byte guess the untyped path makes
+    from the Python type. Falls back to encode_cql for types whose
+    wire form does not depend on the column (text, blob, uuid, ...)."""
+    if value is None:
+        return None
+    is_int = isinstance(value, int) and not isinstance(value, bool)
+    is_num = is_int or isinstance(value, float)
+    tid, _params = spec
+    if tid in _INT_WIDTHS and is_int:
+        width = _INT_WIDTHS[tid]
+        try:
+            return value.to_bytes(width, "big", signed=True)
+        except OverflowError:
+            raise CqlError(
+                0x2200, f"value {value!r} out of range for "
+                f"{width}-byte integer column") from None
+    if tid == T_FLOAT and is_num:
+        return struct.pack(">f", float(value))
+    if tid == T_DOUBLE and is_num:
+        return struct.pack(">d", float(value))
+    if tid == T_VARINT and is_int:
+        n = max(1, (value.bit_length() + 8) // 8)
+        return value.to_bytes(n, "big", signed=True)
+    if tid == T_BOOLEAN and isinstance(value, bool):
+        return b"\x01" if value else b"\x00"
+    # Type mismatch or column-independent wire form: the untyped
+    # encoder's bytes go out and the server reports any mismatch.
+    return encode_cql(value)
+
+
 def decode_cql(spec, raw: bytes | None):
     """Wire bytes -> Python value from the RESULT metadata type spec."""
     import datetime
@@ -330,14 +363,17 @@ class CqlConnection:
     # -- queries -------------------------------------------------------------
     @staticmethod
     def _query_params(values=None, page_size=None,
-                      paging_state=None) -> bytes:
+                      paging_state=None, bind_specs=None) -> bytes:
         flags = (0x01 if values else 0) | (0x04 if page_size else 0) \
             | (0x08 if paging_state else 0)
         out = struct.pack(">HB", 0x0001, flags)  # consistency ONE
         if values:
             out += struct.pack(">H", len(values))
-            for v in values:
-                out += _pbytes(encode_cql(v))
+            for i, v in enumerate(values):
+                if bind_specs is not None and i < len(bind_specs):
+                    out += _pbytes(encode_cql_typed(v, bind_specs[i]))
+                else:
+                    out += _pbytes(encode_cql(v))
         if page_size:
             out += struct.pack(">i", page_size)
         if paging_state:
@@ -381,7 +417,8 @@ class CqlConnection:
     def execute_prepared(self, prep: Prepared, values=None,
                          page_size=None, paging_state=None) -> CqlResult:
         body = struct.pack(">H", len(prep.stmt_id)) + prep.stmt_id \
-            + self._query_params(values, page_size, paging_state)
+            + self._query_params(values, page_size, paging_state,
+                                 bind_specs=prep.bind_specs)
         op, payload = self._call(_OP_EXECUTE, body)
         return self._parse_result(op, payload)
 
@@ -401,7 +438,9 @@ class CqlConnection:
                 while i < len(values_list) and len(pending) < window:
                     body = (struct.pack(">H", len(prep.stmt_id))
                             + prep.stmt_id
-                            + self._query_params(values_list[i]))
+                            + self._query_params(
+                                values_list[i],
+                                bind_specs=prep.bind_specs))
                     pending[self._send(_OP_EXECUTE, body)] = i
                     i += 1
                 stream, op, payload = self._recv_frame()

@@ -9,12 +9,16 @@ and its (small) result.
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from yugabyte_db_tpu.models.datatypes import DataType
-from yugabyte_db_tpu.storage.columnar import ColumnarRun
+
+if TYPE_CHECKING:  # type-only: ops never depends on storage at runtime
+    from yugabyte_db_tpu.storage.columnar import ColumnarRun
 
 
 def dtype_kind(dt: DataType) -> str:

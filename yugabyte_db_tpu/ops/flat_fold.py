@@ -26,15 +26,17 @@ from __future__ import annotations
 import functools
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from yugabyte_db_tpu.ops import agg_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import le2
 
-I32_MIN = jnp.int32(-(1 << 31))
-I32_MAX = jnp.int32((1 << 31) - 1)
-_BIAS = jnp.int32(-(1 << 31))  # bit pattern 0x80000000
+# np scalars, not jnp: module import must not touch the backend.
+I32_MIN = np.int32(-(1 << 31))
+I32_MAX = np.int32((1 << 31) - 1)
+_BIAS = np.int32(-(1 << 31))  # bit pattern 0x80000000
 
 MAX_R = (1 << 15) - 1   # block limb sums stay < 2^31
 MAX_B = 1 << 14         # second-stage limb sums stay < 2^31

@@ -41,9 +41,7 @@ import jax.numpy as jnp
 
 from yugabyte_db_tpu.ops import flat_fold
 from yugabyte_db_tpu.ops import scan as dscan
-from yugabyte_db_tpu.ops.scan import le2
-
-I32_MIN = jnp.int32(-(1 << 31))
+from yugabyte_db_tpu.ops.scan import I32_MIN, le2
 
 # Largest per-group version count the unrolled lookback compiles for.
 # Beyond it the engine falls back to seg_fold's associative scans.

@@ -34,9 +34,7 @@ from jax import lax
 
 from yugabyte_db_tpu.ops import flat_fold
 from yugabyte_db_tpu.ops import scan as dscan
-from yugabyte_db_tpu.ops.scan import le2
-
-I32_MIN = jnp.int32(-(1 << 31))
+from yugabyte_db_tpu.ops.scan import I32_MIN, le2
 
 
 def supports(sig: dscan.ScanSig) -> bool:

@@ -9,6 +9,7 @@ into calls and serializes responses, so CQL/RESP servers reuse this loop.
 
 from __future__ import annotations
 
+import logging
 import selectors
 import socket
 import struct
@@ -138,19 +139,23 @@ class Messenger:
 
     def _reactor_loop(self) -> None:
         while self._running:
-            events = self._sel.select(timeout=0.2)
-            for key, mask in events:
-                kind, data = key.data
-                if kind == "wake":
-                    try:
-                        self._wake_r.recv(4096)
-                    except BlockingIOError:
-                        pass
-                    self._flush_writable()
-                elif kind == "accept":
-                    self._accept(key.fileobj, *data)
-                elif kind == "conn":
-                    self._on_conn_event(key.fileobj, data, mask)
+            try:
+                events = self._sel.select(timeout=0.2)
+                for key, mask in events:
+                    kind, data = key.data
+                    if kind == "wake":
+                        try:
+                            self._wake_r.recv(4096)
+                        except BlockingIOError:
+                            pass
+                        self._flush_writable()
+                    elif kind == "accept":
+                        self._accept(key.fileobj, *data)
+                    elif kind == "conn":
+                        self._on_conn_event(key.fileobj, data, mask)
+            except Exception:  # a dead reactor silently stops ALL rpc
+                logging.getLogger(__name__).exception(
+                    "reactor %s: event dispatch failed", self.name)
         # shutdown: close everything
         for srv in self._listeners:
             try:

@@ -100,7 +100,12 @@ class Proxy:
                         pc.status, pc.body = status, body
                         pc.event.set()
         except (OSError, ValueError):
-            pass
+            pass  # link-level loss: close() fails pending calls over
+        except Exception:  # decode/dispatch bug — never die silently
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "proxy read loop to %s failed", self.addr)
         finally:
             self.close()
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 
-from yugabyte_db_tpu.consensus.transport import Transport, TransportError
+from yugabyte_db_tpu.rpc.interface import Transport, TransportError
 from yugabyte_db_tpu.rpc.proxy import Proxy
 
 

@@ -131,29 +131,34 @@ class Tablet:
         watermark) are applied — the uncommitted tail is left for Raft to
         commit or truncate (tablet_bootstrap.cc hands those back as
         pending)."""
-        all_entries = list(self.log.read_all(0))
-        if self.consensus_managed:
-            committed_frontier = max((e.committed for e in all_entries),
-                                     default=0)
-            # Consensus reuses this single decode pass for its entry cache
-            # (avoids a second full-log read at startup).
-            self.bootstrap_entries = all_entries
-        else:
-            committed_frontier = None  # local-consensus: everything durable
-        replayed = 0
-        for entry in all_entries:
-            self._last_index = max(self._last_index, entry.op_id.index)
-            self.clock.update(HybridTime(entry.ht))
-            if entry.op_id.index <= self.meta.flushed_op_index:
-                continue  # already durable in the engine's flushed runs
-            if committed_frontier is not None and \
-                    entry.op_id.index > committed_frontier:
-                continue
-            self._apply_entry_body(entry)
-            if entry.op_type == "write":
-                replayed += 1
-            self._applied_index = max(self._applied_index, entry.op_id.index)
-        self._replayed_on_bootstrap = replayed
+        # Replay happens before the peer serves, but holding the write
+        # lock keeps the _last_index/_applied_index invariant uniform
+        # (and a re-bootstrap racing a stray write is then safe too).
+        with self._write_lock:
+            all_entries = list(self.log.read_all(0))
+            if self.consensus_managed:
+                committed_frontier = max((e.committed for e in all_entries),
+                                         default=0)
+                # Consensus reuses this single decode pass for its entry
+                # cache (avoids a second full-log read at startup).
+                self.bootstrap_entries = all_entries
+            else:
+                committed_frontier = None  # local-consensus: all durable
+            replayed = 0
+            for entry in all_entries:
+                self._last_index = max(self._last_index, entry.op_id.index)
+                self.clock.update(HybridTime(entry.ht))
+                if entry.op_id.index <= self.meta.flushed_op_index:
+                    continue  # already durable in the flushed runs
+                if committed_frontier is not None and \
+                        entry.op_id.index > committed_frontier:
+                    continue
+                self._apply_entry_body(entry)
+                if entry.op_type == "write":
+                    replayed += 1
+                self._applied_index = max(self._applied_index,
+                                          entry.op_id.index)
+            self._replayed_on_bootstrap = replayed
 
     def _apply_write_body(self, entry) -> None:
         """Apply a "write" entry. Bodies are one of: an encoded row BLOCK

@@ -95,14 +95,14 @@ class Log:
                        if n.startswith("wal-") and n.endswith(".seg"))
         return [os.path.join(self.wal_dir, n) for n in names]
 
-    def _open_segment(self, first_index: int) -> None:
-        self._close_file()
+    def _open_segment_locked(self, first_index: int) -> None:
+        self._close_file_locked()
         name = f"wal-{first_index:020d}.seg"
         self._file_path = os.path.join(self.wal_dir, name)
         self._file = open(self._file_path, "ab")
         self._file_size = self._file.tell()
 
-    def _close_file(self) -> None:
+    def _close_file_locked(self) -> None:
         # A closed segment must be durable before sync() reports the group
         # durable: roll-over flushes buffered records into the OLD segment,
         # and the subsequent sync() only fsyncs the NEW file — without this
@@ -131,13 +131,13 @@ class Log:
                 self._file_size + self._buffer_bytes >= self.segment_bytes:
             # Roll BEFORE buffering this record so the new segment's name
             # (its first index) truthfully covers it — GC relies on that.
-            self._flush_buffer()
-            self._open_segment(entry.op_id.index)
+            self._flush_buffer_locked()
+            self._open_segment_locked(entry.op_id.index)
         self._buffer.append(rec)
         self._buffer_bytes += len(rec)
         self.last_appended = entry.op_id
 
-    def _flush_buffer(self) -> None:
+    def _flush_buffer_locked(self) -> None:
         if not self._buffer or self._file is None:
             return
         data = b"".join(self._buffer)
@@ -160,8 +160,8 @@ class Log:
         with watchdog().watch("wal.sync", threshold_s=2.0):
             with self._lock:
                 if self._file is None and self._buffer:
-                    self._open_segment(max(1, self.last_appended.index))
-                self._flush_buffer()
+                    self._open_segment_locked(max(1, self.last_appended.index))
+                self._flush_buffer_locked()
                 if self._file is not None:
                     self._file.flush()
                     if self.fsync:
@@ -211,7 +211,7 @@ class Log:
 
     def _truncate_after_locked(self, last_kept_index: int) -> int:
         self.sync()
-        self._close_file()
+        self._close_file_locked()
         dropped = 0
         # Newest-first so a crash mid-truncation always leaves a CONTIGUOUS
         # prefix (a tail segment is fully gone before an earlier one is
@@ -266,4 +266,5 @@ class Log:
 
     def close(self) -> None:
         self.sync()
-        self._close_file()
+        with self._lock:
+            self._close_file_locked()

@@ -89,11 +89,14 @@ class MeshScanService:
                 try:
                     st = ShardedTablets(schema, runs, mesh)
                 except ValueError:
-                    self.fallbacks += 1
-                    return None
-                if len(self._stacks) >= self._max_cached:
-                    self._stacks.pop(next(iter(self._stacks)))
-                self._stacks[key] = st
+                    st = None  # counted outside the lock
+                else:
+                    if len(self._stacks) >= self._max_cached:
+                        self._stacks.pop(next(iter(self._stacks)))
+                    self._stacks[key] = st
+        if st is None:
+            self.fallbacks += 1
+            return None
         try:
             res = sharded_aggregate(st, spec)
         except ValueError:

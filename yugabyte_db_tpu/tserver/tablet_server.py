@@ -1023,17 +1023,26 @@ class TabletServer:
             import threading as _threading
 
             def _resolve():
-                while True:
-                    try:
-                        peer.raft.wait_applied(entry.op_id, 10.0)
-                        break
-                    except NotLeader:
-                        break
-                    except TimeoutError:
-                        if not peer.raft._running:
+                try:
+                    while True:
+                        try:
+                            peer.raft.wait_applied(entry.op_id, 10.0)
                             break
-                        continue
-                coord.finish_commit_attempt(p["txn_id"])
+                        except NotLeader:
+                            break
+                        except TimeoutError:
+                            if not peer.raft._running:
+                                break
+                            continue
+                except Exception:  # never die silently
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "commit resolution for txn %s failed", p["txn_id"])
+                finally:
+                    # The in-flight marker must not leak on any path —
+                    # a stuck marker wedges every later status query.
+                    coord.finish_commit_attempt(p["txn_id"])
 
             _threading.Thread(target=_resolve, daemon=True).start()
             return {"code": "timed_out"}

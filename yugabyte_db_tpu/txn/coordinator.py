@@ -69,7 +69,9 @@ class TransactionCoordinator:
     def load(self) -> None:
         if os.path.exists(self.path):
             with open(self.path) as f:
-                self.txns = json.load(f)
+                loaded = json.load(f)
+            with self._lock:
+                self.txns = loaded
 
     def dump(self) -> dict:
         with self._lock:

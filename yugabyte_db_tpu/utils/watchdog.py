@@ -81,15 +81,18 @@ class StallWatchdog:
     def _loop(self) -> None:
         while True:
             time.sleep(self._interval)
-            now = time.monotonic()
-            with self._lock:
-                for token, (label, start, thr, tname) in \
-                        list(self._active.items()):
-                    if token in self._flagged or now - start <= thr:
-                        continue
-                    self._flagged.add(token)
-                    self._record_locked(label, now - start, tname,
-                                        done=False)
+            try:
+                now = time.monotonic()
+                with self._lock:
+                    for token, (label, start, thr, tname) in \
+                            list(self._active.items()):
+                        if token in self._flagged or now - start <= thr:
+                            continue
+                        self._flagged.add(token)
+                        self._record_locked(label, now - start, tname,
+                                            done=False)
+            except Exception:  # the watchdog itself must never die
+                LOG.exception("stall-watchdog sampler failed")
 
     def _record_locked(self, label: str, dur: float, tname: str,
                        done: bool, count: bool = True) -> None:

@@ -214,14 +214,16 @@ class LocalCluster:
         return self.views.get(name)
 
     def create_sequence(self, name: str) -> None:
-        if name in self.sequences:
-            raise AlreadyPresent(f"sequence {name} exists")
-        self.sequences[name] = 1
+        with self._seq_lock:
+            if name in self.sequences:
+                raise AlreadyPresent(f"sequence {name} exists")
+            self.sequences[name] = 1
 
     def drop_sequence(self, name: str) -> None:
-        if name not in self.sequences:
-            raise NotFound(f"sequence {name} not found")
-        del self.sequences[name]
+        with self._seq_lock:
+            if name not in self.sequences:
+                raise NotFound(f"sequence {name} not found")
+            del self.sequences[name]
 
     def sequence_next(self, name: str, n: int = 1) -> int:
         """Allocate ``n`` values; returns the first (PG nextval blocks

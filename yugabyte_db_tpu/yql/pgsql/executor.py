@@ -537,10 +537,17 @@ class PgProcessor:
                 try:
                     res = self._exec_query(rel.value.select)
                 except InvalidArgument as e:
-                    raise InvalidArgument(
-                        "correlated [NOT] EXISTS is supported only in "
-                        f"a single-table SELECT WHERE clause ({e})"
-                    ) from e
+                    # Only an unresolvable outer-column reference means
+                    # the subquery is correlated; a typo'd table or
+                    # column inside the subquery must surface as-is.
+                    msg = str(e)
+                    if ("cannot be used as a comparison value" in msg
+                            or "unknown table alias" in msg):
+                        raise InvalidArgument(
+                            "correlated [NOT] EXISTS is supported only "
+                            "in a single-table SELECT WHERE clause "
+                            f"({e})") from e
+                    raise
                 if bool(res.rows) != (rel.op == "EXISTS"):
                     ok = False
                 continue
@@ -1907,9 +1914,14 @@ class PgProcessor:
     def _select_aggregate(self, handle, stmt: ast.Select):
         schema = handle.schema
         where, ok = self._fold_exists(stmt.where)
-        if not ok and schema.key_columns:
-            # An EXISTS conjunct failed: aggregate over no rows.
-            where = [ast.Rel(schema.key_columns[0].name, "IN", ())]
+        if not ok:
+            # An EXISTS conjunct failed: aggregate over no rows — PG
+            # still yields one row (count 0 / NULL sums) when there is
+            # no GROUP BY. An impossible IN () predicate on any column
+            # produces exactly the zero-row aggregate; keyless schemas
+            # (virtual tables) use their first column.
+            cols = schema.key_columns or schema.columns
+            where = [ast.Rel(cols[0].name, "IN", ())]
         if where is not stmt.where:
             import dataclasses as _dc
 
