@@ -454,7 +454,7 @@ def test_sql_transactions_end_to_end():
             # write-write conflict: first committer wins — exactly one
             # side fails, with a transaction-conflict error
             from yugabyte_db_tpu.client.client import TabletOpFailed
-            from yugabyte_db_tpu.txn.client import (TransactionAborted,
+            from yugabyte_db_tpu.txn.errors import (TransactionAborted,
                                                     TransactionConflict)
 
             conflict_errs = (SerializationFailure, TransactionConflict,

@@ -14,6 +14,7 @@ import sys
 import textwrap
 
 from yugabyte_db_tpu.analysis import (
+    all_project_rules,
     all_rules,
     load_baseline,
     run_analysis,
@@ -130,6 +131,16 @@ def test_list_rules_names_all_families():
     names = set(all_rules())
     for family in ("layering/", "jax/", "locks/", "errors/"):
         assert any(n.startswith(family) for n in names), names
+    inames = set(all_project_rules())
+    for family in ("ilocks/", "ierrors/", "irpc/", "ijax/"):
+        assert any(n.startswith(family) for n in inames), inames
+
+
+def test_baseline_is_empty():
+    """Policy: the grandfather list is burned down to nothing — CI fails
+    on ANY new entry. Suppress inline (with justification) or fix; do
+    not regenerate the baseline with content."""
+    assert load_baseline() == {}
 
 
 # -- layering ----------------------------------------------------------------
@@ -449,6 +460,341 @@ def test_unguarded_daemon_thread(tmp_path):
     """})
     (v,) = fired(res, "errors/unguarded-daemon-thread")
     assert "_loop" in v.message
+
+
+# -- interprocedural: ilocks -------------------------------------------------
+
+def test_ilocks_cross_function_abba_fires(tmp_path):
+    """Thread 1 runs one() (A, then B via the helper), thread 2 runs
+    two() (B then A) — neither method nests inconsistently on its own,
+    so only the call-graph pass can see the deadlock."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/bad.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b:
+                    pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """})
+    (v,) = fired(res, "ilocks/abba-cycle")
+    assert "ABBA" in v.message and "C._a" in v.message
+    assert not fired(res, "locks/inconsistent-order")  # intra can't see it
+
+
+def test_ilocks_consistent_order_through_calls_is_clean(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/ok.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b:
+                    pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """})
+    assert not fired(res, "ilocks/abba-cycle")
+
+
+def test_ilocks_recursive_acquire_through_call(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/bad.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                with self._lock:
+                    self._n += 1
+    """})
+    (v,) = fired(res, "ilocks/recursive-lock")
+    assert "C.outer" in v.message and "self-deadlock" in v.message
+
+
+def test_ilocks_rlock_reentry_is_legal(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/ok.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                with self._lock:
+                    self._n += 1
+    """})
+    assert not fired(res, "ilocks/recursive-lock")
+
+
+# -- interprocedural: ierrors ------------------------------------------------
+
+IERRORS_CLASS = """\
+    class Sender:
+        def __init__(self, transport):
+            self.transport = transport
+
+        def send_op(self, peer):
+            return self.transport.send(peer, "m", {{}}, timeout=1.0)
+
+        def caller(self, peer):
+            {body}
+"""
+
+
+def test_ierrors_dropped_chain_fires(tmp_path):
+    """send_op returns the raw RPC response (the error channel); the
+    caller discards it, so a not_leader/not_found answer vanishes."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/client/bad.py":
+                          IERRORS_CLASS.format(body="self.send_op(peer)")})
+    (v,) = fired(res, "ierrors/dropped-error-result")
+    assert "Sender.caller" in v.message and "send_op" in v.message
+
+
+def test_ierrors_checked_result_is_clean(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/client/ok.py":
+                          IERRORS_CLASS.format(body="""\
+resp = self.send_op(peer)
+            if resp.get("code") != "ok":
+                raise RuntimeError(resp)""")})
+    assert not fired(res, "ierrors/dropped-error-result")
+
+
+def test_ierrors_direct_transport_discard_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/client/bad.py": """\
+        class Fan:
+            def __init__(self, transport):
+                self.transport = transport
+
+            def blast(self, peer):
+                self.transport.send(peer, "m", {}, timeout=1.0)
+    """})
+    (v,) = fired(res, "ierrors/dropped-error-result")
+    assert "transport.send" in v.message
+
+
+def test_ierrors_code_checking_wrapper_is_not_error_channel(tmp_path):
+    """A tablet_rpc-style wrapper that inspects the code and raises
+    converts the error channel to exceptions — discarding ITS result
+    is safe."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/client/ok.py": """\
+        class Sender:
+            def __init__(self, transport):
+                self.transport = transport
+
+            def checked_rpc(self, peer):
+                resp = self.transport.send(peer, "m", {}, timeout=1.0)
+                if resp.get("code") != "ok":
+                    raise RuntimeError(resp["code"])
+                return resp
+
+            def caller(self, peer):
+                self.checked_rpc(peer)
+    """})
+    assert not fired(res, "ierrors/dropped-error-result")
+
+
+# -- interprocedural: irpc ---------------------------------------------------
+
+IRPC_SVC = """\
+    class Svc:
+        def __init__(self, transport):
+            self.transport = transport
+
+        def _h_ping(self, body):
+            self._fan_out()
+            return {{"code": "ok"}}
+
+        def _fan_out(self):
+            resp = self.transport.send("peer", "m", {{}}{timeout})
+            if resp.get("code") != "ok":
+                raise RuntimeError(resp)
+"""
+
+
+def test_irpc_handler_reaches_deadline_less_send(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/bad.py":
+                          IRPC_SVC.format(timeout="")})
+    (v,) = fired(res, "irpc/handler-no-deadline")
+    assert "Svc._h_ping" in v.message and "_fan_out" in v.message
+
+
+def test_irpc_deadline_propagated_is_clean(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/ok.py":
+                          IRPC_SVC.format(timeout=", timeout=2.0")})
+    assert not fired(res, "irpc/handler-no-deadline")
+
+
+# -- interprocedural: ijax ---------------------------------------------------
+
+def test_ijax_jit_reachable_item_helper_fires(tmp_path):
+    """The helper is textually innocent — no decorator, plain body — but
+    it is called from inside a jit trace, where .item() fails."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax
+
+        def helper(x):
+            return x.item()
+
+        @jax.jit
+        def kernel(x):
+            return helper(x)
+    """})
+    (v,) = fired(res, "ijax/reachable-host-sync")
+    assert "helper" in v.message and "kernel" in v.message
+    assert not fired(res, "jax/host-sync-item")  # intra rule can't see it
+
+
+def test_ijax_clean_helper_passes(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/ok.py": """\
+        import jax
+
+        def helper(x):
+            return x * 2
+
+        @jax.jit
+        def kernel(x):
+            return helper(x)
+    """})
+    assert not fired(res, "ijax/reachable-host-sync")
+
+
+def test_ijax_traced_callee_is_the_intra_rules_problem(tmp_path):
+    """A jitted callee starts its own trace; host syncs inside it are
+    the intra rule's finding, not a second interprocedural report."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/bad.py": """\
+        import jax
+
+        @jax.jit
+        def inner(x):
+            return x.item()
+
+        @jax.jit
+        def outer(x):
+            return inner(x)
+    """})
+    assert fired(res, "jax/host-sync-item")
+    assert not fired(res, "ijax/reachable-host-sync")
+
+
+# -- SARIF -------------------------------------------------------------------
+
+def test_sarif_output_on_violations(tmp_path):
+    p = tmp_path / "yugabyte_db_tpu" / "util" / "bad.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent("""\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis",
+         "--format=sarif", str(tmp_path / "yugabyte_db_tpu")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "yb-lint"
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    (res,) = [r for r in run["results"]
+              if r["ruleId"] == "errors/swallowed-exception"]
+    assert res["ruleId"] in ids
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("util/bad.py")
+    assert loc["region"]["startLine"] == 4
+    assert "ybLintBaselineKey/v1" in res["partialFingerprints"]
+
+
+def test_sarif_clean_tree_has_no_results():
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis",
+         "--format=sarif", PKG],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sarif = json.loads(proc.stdout)
+    assert sarif["runs"][0]["results"] == []
+
+
+# -- --changed-only ----------------------------------------------------------
+
+def test_changed_only_filters_to_dirty_files(tmp_path):
+    """A violation in a committed file is mute under --changed-only; the
+    same violation in a dirty file is reported. The whole tree is still
+    analyzed (files_checked covers both)."""
+    pkg = tmp_path / "yugabyte_db_tpu"
+    (pkg / "util").mkdir(parents=True)
+    bad = textwrap.dedent("""\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    (pkg / "util" / "old.py").write_text(bad)
+    git_env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "JAX_PLATFORMS": "cpu"}
+    for cmd in (["git", "init", "-q"], ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=tmp_path, check=True, env=git_env,
+                       capture_output=True)
+    (pkg / "util" / "new.py").write_text(bad)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis", "--no-baseline",
+         "--changed-only", "--format=json", str(pkg)],
+        cwd=REPO_ROOT, capture_output=True, text=True, env=git_env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    files = {v["file"] for v in data["violations"]}
+    assert files == {"yugabyte_db_tpu/util/new.py"}
+    assert data["files_checked"] == 2
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis", "--no-baseline",
+         "--format=json", str(pkg)],
+        cwd=REPO_ROOT, capture_output=True, text=True, env=git_env)
+    data = json.loads(proc.stdout)
+    assert {v["file"] for v in data["violations"]} == {
+        "yugabyte_db_tpu/util/new.py", "yugabyte_db_tpu/util/old.py"}
 
 
 # -- suppression + baseline machinery ----------------------------------------

@@ -24,6 +24,7 @@ over time (ROADMAP "Open items").
 from yugabyte_db_tpu.analysis.core import (  # noqa: F401
     AnalysisResult,
     Violation,
+    all_project_rules,
     all_rules,
     default_baseline_path,
     load_baseline,

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from yugabyte_db_tpu.analysis import core, reporting
@@ -21,7 +22,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the "
                          "yugabyte_db_tpu package)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only in files changed vs. git "
+                         "HEAD (staged, unstaged, and untracked); the "
+                         "whole tree is still analyzed so interprocedural "
+                         "summaries stay whole-program")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: analysis/baseline.json)")
     ap.add_argument("--no-baseline", action="store_true",
@@ -34,7 +41,7 @@ def main(argv: list[str] | None = None) -> int:
 
     rules = core.all_rules()
     if args.list_rules:
-        for name in sorted(rules):
+        for name in sorted(set(rules) | set(core.all_project_rules())):
             print(name)
         return 0
 
@@ -46,7 +53,16 @@ def main(argv: list[str] | None = None) -> int:
     if not args.no_baseline and not args.write_baseline:
         baseline = core.load_baseline(args.baseline)
 
-    result = core.run_analysis(paths, baseline=baseline, rules=rules)
+    report_only = None
+    if args.changed_only:
+        report_only = _changed_files(core._find_repo_root(paths))
+        if report_only is None:
+            print("yb-lint: --changed-only requires a git checkout",
+                  file=sys.stderr)
+            return 1
+
+    result = core.run_analysis(paths, baseline=baseline, rules=rules,
+                               report_only=report_only)
 
     if args.write_baseline:
         path = core.write_baseline(result.violations, args.baseline)
@@ -54,10 +70,34 @@ def main(argv: list[str] | None = None) -> int:
               f"violation(s) to {path}")
         return 0
 
-    out = (reporting.render_json(result) if args.format == "json"
-           else reporting.render_text(result))
-    print(out)
+    render = {"json": reporting.render_json,
+              "sarif": reporting.render_sarif,
+              "text": reporting.render_text}[args.format]
+    print(render(result))
     return 0 if result.ok else 2
+
+
+def _changed_files(repo_root: str) -> set[str] | None:
+    """Repo-relative paths changed vs. HEAD (staged + unstaged +
+    untracked), or None when git is unavailable."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo_root, "status", "--porcelain", "-z",
+             "--untracked-files=all"],
+            capture_output=True, text=True, timeout=30, check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    changed: set[str] = set()
+    for entry in out.split("\0"):
+        if len(entry) < 4:
+            continue
+        # "XY path" (a rename adds a second NUL-separated entry that is
+        # just the old path — shorter than 4 chars won't catch those, so
+        # only keep entries that carry a status prefix).
+        if entry[2] != " ":
+            continue
+        changed.add(entry[3:])
+    return changed
 
 
 if __name__ == "__main__":

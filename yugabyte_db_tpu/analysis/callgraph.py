@@ -1,0 +1,731 @@
+"""Interprocedural engine: project-wide call graph + per-function summaries.
+
+One ``ProjectIndex`` is built per analysis run from every parsed
+``SourceFile``. It models the whole package at function granularity:
+
+- a **function table** (module functions, methods, nested defs) with the
+  direct facts each ``i*`` rule family needs — locks acquired, exception
+  types raised, blocking-RPC sites, host-sync sites, JAX-traced status,
+  whether the return value carries an error channel;
+- **call sites** resolved to project functions through a tiered scheme
+  (self-methods, typed attributes, local/imported names, constructor
+  types, and a unique-method-name fallback), each annotated with the
+  locks held at the call, whether the result is discarded, whether a
+  timeout is passed, and which exception types the surrounding ``try``
+  catches;
+- memoized **transitive summaries** (locks acquired downstream, exception
+  types that can escape, error-channel returns) so rules ask questions
+  like "does anything this call reaches acquire a conflicting lock?"
+  without re-walking the tree.
+
+Resolution is deliberately conservative: an ambiguous call resolves to
+nothing rather than to every candidate, so interprocedural findings stay
+actionable. The unique-name fallback is suppressed for method names
+common enough to collide across unrelated classes.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from yugabyte_db_tpu.analysis.core import (
+    PACKAGE_ROOT,
+    SourceFile,
+    call_name,
+    dotted_name,
+)
+
+# Names too generic for the unique-method-name fallback: resolving
+# `x.get()` to some random class's `get` would poison every summary.
+_COMMON_METHOD_NAMES = frozenset({
+    "get", "set", "put", "add", "remove", "pop", "close", "open", "start",
+    "stop", "run", "send", "recv", "call", "handle", "apply", "append",
+    "extend", "clear", "update", "items", "keys", "values", "join", "wait",
+    "notify", "read", "write", "flush", "reset", "copy", "encode", "decode",
+    "submit", "shutdown", "acquire", "release", "connect", "register",
+    "unregister", "begin", "commit", "abort", "insert", "delete", "scan",
+    "next", "load", "save", "sleep",
+})
+
+# Blocking RPC primitives, matched on the raw dotted call text: every
+# outbound call in the tree goes through a `*.transport.send(...)` seam
+# or a Proxy. (`sock.send` never matches — the chain must name the seam.)
+_BLOCKING_RAW_SUFFIXES = ("transport.send",)
+_BLOCKING_QUALNAME_TAILS = ("Proxy.call", "Transport.send",
+                            "SocketTransport.send", "LocalTransport.send",
+                            "BoundTransport.send")
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_TIMEOUT_WORDS = ("timeout", "deadline")
+_STATUS_HELPERS = {"Status", "ok", "not_found", "invalid_argument",
+                   "illegal_state", "ql_error"}
+_HOST_SYNC_TAILS = (".item", ".tolist")
+_HOST_TRANSFER = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "np.ascontiguousarray"}
+
+
+@dataclass
+class CallSite:
+    raw: str                       # dotted call text as written
+    line: int
+    callees: tuple[str, ...] = ()  # resolved project qualnames (0 or 1, usually)
+    held: frozenset = frozenset()  # lock tokens held at this call
+    discards: bool = False         # bare expression statement — result dropped
+    timeout_arg: bool = False      # a timeout/deadline argument is passed
+    caught: frozenset = frozenset()  # exception names the enclosing try catches
+    caught_broad: bool = False     # enclosing try has except [Base]Exception
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    module: str
+    cls: str | None
+    name: str
+    rel: str
+    lineno: int
+    node: object = field(repr=False, default=None)
+    requires_lock: bool = False        # *_locked convention
+    locks: set = field(default_factory=set)         # tokens acquired directly
+    order_pairs: list = field(default_factory=list)  # (outer_tok, inner_tok, line)
+    calls: list = field(default_factory=list)        # [CallSite]
+    direct_raises: set = field(default_factory=set)
+    host_syncs: list = field(default_factory=list)   # (line, description)
+    traced: bool = False               # a JAX-traced context (intra rules own it)
+    has_timeout_param: bool = False
+    checks_code: bool = False          # reads resp.get("code") / resp["code"]
+    returns_value: bool = False
+    returns_rpc_resp: bool = False     # returns a blocking-primitive result
+    returns_status: bool = False       # returns a utils.status Status
+    return_calls: list = field(default_factory=list)  # raw names returned
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    module: str
+    name: str
+    bases: list = field(default_factory=list)        # raw base names
+    methods: dict = field(default_factory=dict)      # simple name -> qualname
+    attr_types: dict = field(default_factory=dict)   # attr -> raw class name
+    lock_attrs: dict = field(default_factory=dict)   # attr -> "Lock"|"RLock"
+    lock_aliases: dict = field(default_factory=dict)  # cv attr -> lock attr
+
+
+def _is_handler_name(name: str) -> bool:
+    return name.startswith("_h_") or name == "handle" \
+        or name.startswith("handle_")
+
+
+def _timeout_in_call(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg and any(w in kw.arg for w in _TIMEOUT_WORDS):
+            return True
+    for arg in node.args:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) \
+                    and any(w in sub.id for w in _TIMEOUT_WORDS):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and any(w in sub.attr for w in _TIMEOUT_WORDS):
+                return True
+    return False
+
+
+def is_blocking_raw(raw: str) -> bool:
+    return any(raw.endswith(s) for s in _BLOCKING_RAW_SUFFIXES)
+
+
+def _mentions_static_shape(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype", "itemsize"):
+            return True
+        if isinstance(sub, ast.Call) and call_name(sub) in ("len", "range"):
+            return True
+    return False
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Single pass over one function body collecting the direct facts.
+
+    Tracks a held-locks stack (``with self.<lock>:`` / class-level locks)
+    and a caught-exceptions stack (``try`` bodies) so each call site is
+    annotated with its context. Nested function defs are skipped — they
+    get their own FunctionInfo and scanner.
+    """
+
+    def __init__(self, info: FunctionInfo, cls: ClassInfo | None,
+                 class_names: set):
+        self.info = info
+        self.cls = cls
+        self.class_names = class_names  # locally visible class names (locks)
+        self.held: list[str] = []
+        self.caught: list[tuple[frozenset, bool]] = []
+        self._expr_calls: set[int] = set()  # Call node ids that are bare stmts
+
+    # -- lock tokens ---------------------------------------------------------
+    def _lock_token(self, expr: ast.AST) -> str | None:
+        """Token for a with-item that names a known lock, else None."""
+        raw = dotted_name(expr)
+        if not raw:
+            return None
+        parts = raw.split(".")
+        if parts[0] == "self" and len(parts) == 2 and self.cls is not None:
+            attr = self.cls.lock_aliases.get(parts[1], parts[1])
+            if attr in self.cls.lock_attrs:
+                return f"{self.cls.qualname}.{attr}"
+        if len(parts) == 2 and parts[0] in self.class_names:
+            # ClassName._class_level_lock (shared across instances)
+            return f"{self.info.module}.{parts[0]}.{parts[1]}"
+        return None
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            tok = self._lock_token(item.context_expr)
+            if tok is not None:
+                for outer in self.held:
+                    self.info.order_pairs.append((outer, tok, node.lineno))
+                self.info.locks.add(tok)
+                self.held.append(tok)
+                acquired.append(tok)
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    # -- try context ---------------------------------------------------------
+    def visit_Try(self, node: ast.Try):
+        types: set[str] = set()
+        broad = False
+        for h in node.handlers:
+            t = h.type
+            if t is None:
+                broad = True
+                continue
+            for n in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                nm = dotted_name(n).rsplit(".", 1)[-1]
+                if nm in ("Exception", "BaseException"):
+                    broad = True
+                elif nm:
+                    types.add(nm)
+        self.caught.append((frozenset(types), broad))
+        for stmt in node.body:
+            self.visit(stmt)
+        self.caught.pop()
+        for h in node.handlers:
+            for stmt in h.body:
+                self.visit(stmt)
+        for stmt in node.orelse + node.finalbody:
+            self.visit(stmt)
+
+    # -- statements feeding summaries ---------------------------------------
+    def visit_Expr(self, node: ast.Expr):
+        if isinstance(node.value, ast.Call):
+            self._expr_calls.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_Raise(self, node: ast.Raise):
+        exc = node.exc
+        if exc is None:
+            self.info.direct_raises.add("<reraise>")
+        else:
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            nm = dotted_name(exc).rsplit(".", 1)[-1]
+            self.info.direct_raises.add(nm or "<unknown>")
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return):
+        if node.value is not None:
+            self.info.returns_value = True
+            if isinstance(node.value, ast.Call):
+                raw = call_name(node.value)
+                self.info.return_calls.append(raw)
+                if is_blocking_raw(raw):
+                    self.info.returns_rpc_resp = True
+                if raw.rsplit(".", 1)[-1] in _STATUS_HELPERS:
+                    self.info.returns_status = True
+            elif isinstance(node.value, ast.Name):
+                # `resp = self.transport.send(...); return resp` — treat a
+                # returned name that was bound to a blocking call as an
+                # rpc-response return (single pass: bindings seen earlier).
+                if node.value.id in getattr(self, "_rpc_bound", ()):
+                    self.info.returns_rpc_resp = True
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call) \
+                and is_blocking_raw(call_name(node.value)):
+            bound = getattr(self, "_rpc_bound", None)
+            if bound is None:
+                bound = self._rpc_bound = set()
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    bound.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        raw = call_name(node)
+        if raw:
+            if raw.endswith(_HOST_SYNC_TAILS):
+                self.info.host_syncs.append(
+                    (node.lineno,
+                     f"`{raw.rsplit('.', 1)[-1]}()` host sync"))
+            elif raw in _HOST_TRANSFER:
+                self.info.host_syncs.append(
+                    (node.lineno, f"`{raw}(...)` host transfer"))
+            elif raw in ("float", "int", "bool") and node.args \
+                    and not isinstance(node.args[0], ast.Constant) \
+                    and not _mentions_static_shape(node.args[0]):
+                self.info.host_syncs.append(
+                    (node.lineno, f"`{raw}(...)` concretizing cast"))
+            if raw.endswith('.get') and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == "code":
+                self.info.checks_code = True
+            caught: set[str] = set()
+            broad = False
+            for types, b in self.caught:
+                caught |= types
+                broad = broad or b
+            self.info.calls.append(CallSite(
+                raw=raw, line=node.lineno,
+                held=frozenset(self.held),
+                discards=id(node) in self._expr_calls,
+                timeout_arg=_timeout_in_call(node),
+                caught=frozenset(caught), caught_broad=broad))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.slice, ast.Constant) and node.slice.value == "code":
+            self.info.checks_code = True
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        pass  # nested defs are scanned as their own functions
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        pass
+
+
+class _ModuleModel:
+    """Per-module symbol tables used during call resolution."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.module = src.module
+        self.imports: dict[str, str] = {}       # alias -> dotted target
+        self.classes: dict[str, ClassInfo] = {}  # simple name -> ClassInfo
+        self.functions: dict[str, str] = {}      # simple name -> qualname
+
+
+class ProjectIndex:
+    """The whole-program model. Build once; query from project rules."""
+
+    def __init__(self, srcs: list[SourceFile]):
+        self.modules: dict[str, _ModuleModel] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.lock_kinds: dict[str, str] = {}     # token -> "Lock"|"RLock"
+        self._method_name_index: dict[str, list[str]] = {}
+        self._trans_locks: dict[str, frozenset] = {}
+        self._trans_raises: dict[str, frozenset] = {}
+        self._error_channel: dict[str, bool] = {}
+        for src in srcs:
+            if src.module:
+                self._index_module(src)
+        self._resolve_attr_types()
+        for src in srcs:
+            if src.module:
+                self._resolve_calls(src)
+        self._mark_traced(srcs)
+
+    # -- pass A: symbol tables + raw function facts --------------------------
+    def _index_module(self, src: SourceFile) -> None:
+        mod = _ModuleModel(src)
+        self.modules[src.module] = mod
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.startswith(PACKAGE_ROOT):
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+        def index_scope(body, prefix, cls: ClassInfo | None):
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    ci = ClassInfo(qualname=f"{src.module}.{stmt.name}",
+                                   module=src.module, name=stmt.name,
+                                   bases=[dotted_name(b) for b in stmt.bases])
+                    mod.classes[stmt.name] = ci
+                    self.classes[ci.qualname] = ci
+                    self._collect_class_attrs(stmt, ci)
+                    index_scope(stmt.body, f"{prefix}.{stmt.name}"
+                                if prefix else stmt.name, ci)
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{src.module}.{prefix}.{stmt.name}" if prefix \
+                        else f"{src.module}.{stmt.name}"
+                    if qual in self.functions:
+                        qual = f"{qual}@{stmt.lineno}"
+                    info = FunctionInfo(
+                        qualname=qual, module=src.module,
+                        cls=cls.name if cls else None, name=stmt.name,
+                        rel=src.rel, lineno=stmt.lineno, node=stmt,
+                        requires_lock=stmt.name.endswith("_locked"),
+                        has_timeout_param=any(
+                            any(w in a.arg for w in _TIMEOUT_WORDS)
+                            for a in stmt.args.posonlyargs + stmt.args.args
+                            + stmt.args.kwonlyargs))
+                    self.functions[qual] = info
+                    if cls is not None and stmt.name not in cls.methods:
+                        cls.methods[stmt.name] = qual
+                        if stmt.name not in _COMMON_METHOD_NAMES:
+                            self._method_name_index.setdefault(
+                                stmt.name, []).append(qual)
+                    elif cls is None and stmt.name not in mod.functions:
+                        mod.functions[stmt.name] = qual
+                    scanner = _FunctionScanner(info, cls, set(mod.classes))
+                    for s in stmt.body:
+                        scanner.visit(s)
+                    index_scope(stmt.body, f"{prefix}.{stmt.name}"
+                                if prefix else stmt.name, cls)
+
+        index_scope(src.tree.body, "", None)
+
+    def _collect_class_attrs(self, cls_node: ast.ClassDef,
+                             ci: ClassInfo) -> None:
+        """Lock attrs, Condition aliases, and attr -> type-name hints from
+        class-body and ``self.x = ...`` assignments."""
+        # Class-scope locks (shared across instances).
+        for stmt in cls_node.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                kind = call_name(stmt.value).rsplit(".", 1)[-1]
+                if kind in _LOCK_FACTORIES:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            ci.lock_attrs[tgt.id] = kind
+                            self.lock_kinds[
+                                f"{ci.module}.{ci.name}.{tgt.id}"] = kind
+        # Param annotations feed attr typing: `def __init__(self, c: YBClient)`
+        # plus `self.client = c` types self.client.
+        for meth in cls_node.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ann: dict[str, str] = {}
+            for a in meth.args.posonlyargs + meth.args.args \
+                    + meth.args.kwonlyargs:
+                if a.annotation is not None:
+                    t = dotted_name(a.annotation)
+                    if not t and isinstance(a.annotation, ast.Constant) \
+                            and isinstance(a.annotation.value, str):
+                        t = a.annotation.value.strip('"')
+                    if t:
+                        ann[a.arg] = t
+            for node in ast.walk(meth):
+                if isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Attribute) \
+                        and isinstance(node.target.value, ast.Name) \
+                        and node.target.value.id == "self":
+                    t = dotted_name(node.annotation)
+                    if t:
+                        ci.attr_types.setdefault(node.target.attr, t)
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    if isinstance(node.value, ast.Call):
+                        raw = call_name(node.value)
+                        kind = raw.rsplit(".", 1)[-1]
+                        if kind in _LOCK_FACTORIES:
+                            ci.lock_attrs[tgt.attr] = kind
+                            self.lock_kinds[
+                                f"{ci.module}.{ci.name}.{tgt.attr}"] = kind
+                            if kind == "Condition" and node.value.args:
+                                inner = dotted_name(node.value.args[0])
+                                if inner.startswith("self."):
+                                    ci.lock_aliases[tgt.attr] = \
+                                        inner.split(".", 1)[1]
+                        else:
+                            ci.attr_types.setdefault(tgt.attr, raw)
+                    elif isinstance(node.value, ast.Name) \
+                            and node.value.id in ann:
+                        ci.attr_types.setdefault(tgt.attr, ann[node.value.id])
+                    elif isinstance(node.value, ast.Attribute):
+                        # self.client = manager.client: type via the source
+                        # object's class if resolvable later (keep raw path).
+                        ci.attr_types.setdefault(
+                            tgt.attr, dotted_name(node.value))
+
+    # -- pass B: type + call resolution --------------------------------------
+    def _resolve_class_name(self, raw: str, mod: _ModuleModel) -> str | None:
+        """Project ClassInfo qualname for a raw type name, or None."""
+        if not raw:
+            return None
+        raw = raw.strip("\"'")
+        # Optional[...] / "YBClient | None" style annotations: first token.
+        raw = raw.split("|")[0].strip().split("[")[0].strip()
+        head, _, tail = raw.partition(".")
+        if head in mod.classes and not tail:
+            return mod.classes[head].qualname
+        target = mod.imports.get(head)
+        if target is None:
+            return None
+        if not tail and target in self.classes:
+            return target
+        if tail and f"{target}.{tail}" in self.classes:
+            return f"{target}.{tail}"
+        candidate = f"{target}.{tail}" if tail else target
+        # Imported module alias: mod.Class
+        if candidate in self.classes:
+            return candidate
+        return None
+
+    def _resolve_attr_types(self) -> None:
+        for ci in self.classes.values():
+            mod = self.modules[ci.module]
+            resolved = {}
+            for attr, raw in ci.attr_types.items():
+                # `manager.client` chains: follow one hop through an
+                # already-typed attribute of a project class.
+                qn = self._resolve_class_name(raw, mod)
+                if qn is None and "." in raw:
+                    base, _, rest = raw.partition(".")
+                    base_t = ci.attr_types.get(base) if base != "self" \
+                        else None
+                    if base_t:
+                        base_qn = self._resolve_class_name(base_t, mod)
+                        if base_qn and "." not in rest:
+                            inner = self.classes[base_qn].attr_types.get(rest)
+                            if inner:
+                                qn = self._resolve_class_name(
+                                    inner, self.modules[base_qn.rsplit(
+                                        ".", 1)[0]])
+                if qn:
+                    resolved[attr] = qn
+            ci.attr_types = {**ci.attr_types, **resolved}
+
+    def _class_for(self, info: FunctionInfo) -> ClassInfo | None:
+        if info.cls is None:
+            return None
+        return self.classes.get(f"{info.module}.{info.cls}")
+
+    def _method_on(self, class_qn: str, name: str,
+                   depth: int = 0) -> str | None:
+        ci = self.classes.get(class_qn)
+        if ci is None or depth > 3:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        mod = self.modules.get(ci.module)
+        for base_raw in ci.bases:
+            base_qn = self._resolve_class_name(base_raw, mod) if mod else None
+            if base_qn:
+                found = self._method_on(base_qn, name, depth + 1)
+                if found:
+                    return found
+        return None
+
+    def _resolve_calls(self, src: SourceFile) -> None:
+        mod = self.modules[src.module]
+        for info in self.functions.values():
+            if info.module != src.module:
+                continue
+            local_types = self._local_var_types(info, mod)
+            for cs in info.calls:
+                cs.callees = tuple(self._resolve_one(
+                    cs.raw, info, mod, local_types))
+
+    def _local_var_types(self, info: FunctionInfo,
+                         mod: _ModuleModel) -> dict[str, str]:
+        """var -> class qualname from annotations and constructor calls."""
+        out: dict[str, str] = {}
+        fn = info.node
+        if fn is None:
+            return out
+        for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+            if a.annotation is not None:
+                qn = self._resolve_class_name(dotted_name(a.annotation), mod)
+                if qn:
+                    out[a.arg] = qn
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                qn = self._resolve_class_name(call_name(node.value), mod)
+                if qn:
+                    out[node.targets[0].id] = qn
+        return out
+
+    def _resolve_one(self, raw: str, info: FunctionInfo, mod: _ModuleModel,
+                     local_types: dict[str, str]):
+        parts = raw.split(".")
+        cls = self._class_for(info)
+        # self.method() / self.attr.method()
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2:
+                found = self._method_on(cls.qualname, parts[1])
+                return [found] if found else []
+            if len(parts) == 3:
+                attr_qn = cls.attr_types.get(parts[1])
+                if attr_qn in self.classes:
+                    found = self._method_on(attr_qn, parts[2])
+                    if found:
+                        return [found]
+            return self._fallback(parts[-1])
+        # bare name: nested defs of enclosing scope, module fn, imported fn
+        if len(parts) == 1:
+            name = parts[0]
+            scope_prefix = info.qualname.rsplit(".", 1)[0]
+            nested = f"{scope_prefix}.{info.name}.{name}"
+            if nested in self.functions:
+                return [nested]
+            if cls is not None and name in cls.methods:
+                return []  # bare ref to a method name is not a self-call
+            if name in mod.functions:
+                return [mod.functions[name]]
+            if name in mod.classes:  # constructor
+                found = self._method_on(mod.classes[name].qualname,
+                                        "__init__")
+                return [found] if found else []
+            target = mod.imports.get(name)
+            if target and target in self.functions:
+                return [target]
+            if target and target in self.classes:
+                found = self._method_on(target, "__init__")
+                return [found] if found else []
+            return []
+        # alias.fn() / alias.Class(), var.method()
+        head, rest = parts[0], parts[1:]
+        if head in local_types and len(rest) == 1:
+            found = self._method_on(local_types[head], rest[0])
+            if found:
+                return [found]
+            return self._fallback(rest[0])
+        target = mod.imports.get(head)
+        if target is not None and len(rest) == 1:
+            cand = f"{target}.{rest[0]}"
+            if cand in self.functions:
+                return [cand]
+            if cand in self.classes:
+                found = self._method_on(cand, "__init__")
+                return [found] if found else []
+        if head in mod.classes and len(rest) == 1:
+            found = self._method_on(mod.classes[head].qualname, rest[0])
+            return [found] if found else []
+        return self._fallback(parts[-1])
+
+    def _fallback(self, name: str):
+        """Unique-method-name resolution: safe only when one project class
+        defines the method and the name is not generic."""
+        cands = self._method_name_index.get(name, ())
+        return [cands[0]] if len(cands) == 1 else []
+
+    def _mark_traced(self, srcs: list[SourceFile]) -> None:
+        from yugabyte_db_tpu.analysis import jax_hygiene
+        by_key = {(f.rel, f.lineno): f for f in self.functions.values()}
+        for src in srcs:
+            if not src.module:
+                continue
+            for fn in jax_hygiene._iter_traced_functions(src):
+                info = by_key.get((src.rel, fn.lineno))
+                if info is not None:
+                    info.traced = True
+
+    # -- transitive summaries ------------------------------------------------
+    def trans_locks(self, qualname: str) -> frozenset:
+        """Lock tokens acquired by the function or anything it calls."""
+        memo = self._trans_locks
+        if qualname in memo:
+            return memo[qualname]
+        memo[qualname] = frozenset()  # cycle guard
+        info = self.functions.get(qualname)
+        if info is None:
+            return frozenset()
+        out = set(info.locks)
+        for cs in info.calls:
+            for callee in cs.callees:
+                out |= self.trans_locks(callee)
+        for a, b, _line in info.order_pairs:
+            out.add(a)
+            out.add(b)
+        result = frozenset(out)
+        memo[qualname] = result
+        return result
+
+    def trans_raises(self, qualname: str) -> frozenset:
+        """Exception type names that can escape the function: direct raises
+        plus callee raises not caught at the call site."""
+        memo = self._trans_raises
+        if qualname in memo:
+            return memo[qualname]
+        memo[qualname] = frozenset()  # cycle guard
+        info = self.functions.get(qualname)
+        if info is None:
+            return frozenset()
+        out = {r for r in info.direct_raises if r != "<reraise>"}
+        for cs in info.calls:
+            if cs.caught_broad:
+                continue
+            for callee in cs.callees:
+                out |= self.trans_raises(callee) - cs.caught
+        result = frozenset(out)
+        memo[qualname] = result
+        return result
+
+    def error_channel(self, qualname: str) -> bool:
+        """True when the function's RETURN VALUE is the error channel: it
+        hands back an RPC response or Status whose failure code the caller
+        must inspect (the function neither checks the code itself nor
+        converts failures to raises)."""
+        memo = self._error_channel
+        if qualname in memo:
+            return memo[qualname]
+        memo[qualname] = False  # cycle guard
+        info = self.functions.get(qualname)
+        if info is None:
+            return False
+        result = False
+        if info.returns_status:
+            result = True
+        elif info.returns_rpc_resp and not info.checks_code:
+            result = True
+        elif not info.checks_code:
+            # Propagate through thin wrappers: `return inner(...)` where
+            # inner's return is an error channel.
+            for raw in info.return_calls:
+                mod = self.modules.get(info.module)
+                if mod is None:
+                    continue
+                for callee in self._resolve_one(raw, info, mod, {}):
+                    if self.error_channel(callee):
+                        result = True
+        memo[qualname] = result
+        return result
+
+    # -- misc queries --------------------------------------------------------
+    def handlers(self):
+        """Service-handler entry points (`_h_*` / `handle*` methods)."""
+        return [f for f in self.functions.values()
+                if f.cls is not None and _is_handler_name(f.name)]
+
+    def lock_kind(self, token: str) -> str:
+        return self.lock_kinds.get(token, "Lock")
+
+
+def build_index(srcs: list[SourceFile]) -> ProjectIndex:
+    return ProjectIndex(srcs)

@@ -83,6 +83,7 @@ class SourceFile:
 
 # -- registry ---------------------------------------------------------------
 _RULES: dict[str, object] = {}
+_PROJECT_RULES: dict[str, object] = {}
 
 
 def rule(name: str):
@@ -96,9 +97,26 @@ def rule(name: str):
     return deco
 
 
+def project_rule(name: str):
+    """Register an interprocedural ``check(index) -> Iterable[Violation]``
+    that runs once per analysis over the whole-program ProjectIndex."""
+
+    def deco(fn):
+        _PROJECT_RULES[name] = fn
+        fn.rule_name = name
+        return fn
+
+    return deco
+
+
 def all_rules() -> dict[str, object]:
     _load_rule_modules()
     return dict(_RULES)
+
+
+def all_project_rules() -> dict[str, object]:
+    _load_rule_modules()
+    return dict(_PROJECT_RULES)
 
 
 _LOADED = False
@@ -111,6 +129,10 @@ def _load_rule_modules() -> None:
     _LOADED = True
     from yugabyte_db_tpu.analysis import (  # noqa: F401
         error_discipline,
+        ierrors,
+        ijax,
+        ilocks,
+        irpc,
         jax_hygiene,
         layering,
         locks,
@@ -204,11 +226,20 @@ def iter_python_files(paths: list[str], repo_root: str) -> list[tuple[str, str]]
 
 def run_analysis(paths: list[str], repo_root: str | None = None,
                  baseline: dict[str, int] | None = None,
-                 rules: dict[str, object] | None = None) -> AnalysisResult:
+                 rules: dict[str, object] | None = None,
+                 project_rules: dict[str, object] | None = None,
+                 report_only: set[str] | None = None) -> AnalysisResult:
+    """Parse every file once, run per-file rules, then build the
+    whole-program index and run the interprocedural rules. ``report_only``
+    (repo-relative paths) filters REPORTED violations without narrowing
+    the files analyzed — summaries always see the whole program."""
     repo_root = repo_root or _find_repo_root(paths)
     rules = rules if rules is not None else all_rules()
+    project_rules = (project_rules if project_rules is not None
+                     else all_project_rules())
     result = AnalysisResult()
     raw: list[Violation] = []
+    srcs: list[SourceFile] = []
     for path, rel in iter_python_files(paths, repo_root):
         try:
             with open(path, "r", encoding="utf-8") as f:
@@ -219,6 +250,7 @@ def run_analysis(paths: list[str], repo_root: str | None = None,
                                  getattr(e, "lineno", 0) or 0,
                                  f"cannot analyze: {e}", "parse"))
             continue
+        srcs.append(src)
         result.files_checked += 1
         for name, check in rules.items():
             for v in check(src):
@@ -226,6 +258,19 @@ def run_analysis(paths: list[str], repo_root: str | None = None,
                     result.suppressed += 1
                 else:
                     raw.append(v)
+    if project_rules:
+        from yugabyte_db_tpu.analysis.callgraph import build_index
+        index = build_index(srcs)
+        by_rel = {s.rel: s for s in srcs}
+        for name, check in project_rules.items():
+            for v in check(index):
+                src = by_rel.get(v.file)
+                if src is not None and src.is_suppressed(v.rule, v.line):
+                    result.suppressed += 1
+                else:
+                    raw.append(v)
+    if report_only is not None:
+        raw = [v for v in raw if v.file in report_only]
     if baseline:
         result.violations, result.baselined = apply_baseline(raw, baseline)
     else:

@@ -1,0 +1,54 @@
+"""Rule family 6 — interprocedural error propagation.
+
+The per-file rule catches ``except: pass``; this one catches the quieter
+failure mode the RocksDB "always check your Status" discipline targets:
+a caller that DISCARDS the return value of a function whose summary says
+the return value IS the error channel.
+
+A function has an error-channel return when it hands back an RPC
+response dict (the ``{"code": ...}`` wire contract) or a ``Status``
+without inspecting the code itself — its callers must look at the code
+or the failure vanishes. ``tablet_rpc``-style helpers that check the
+code and convert failures to raises are NOT error-channel: discarding
+their return is safe, the exception path carries the error.
+
+``ierrors/dropped-error-result`` fires on a bare expression-statement
+call to such a function (direct ``*.transport.send(...)`` included), so
+``self.transport.send(replica, "ts.delete_tablet", ...)`` with no look
+at the response is a finding — the replica may have answered
+``{"code": "not_found"}`` forever and nobody will ever know.
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.analysis.core import Violation, project_rule
+from yugabyte_db_tpu.analysis.callgraph import is_blocking_raw
+
+RULE_DROPPED = "ierrors/dropped-error-result"
+
+
+@project_rule(RULE_DROPPED)
+def check_dropped_error_results(index):
+    for fn in sorted(index.functions.values(), key=lambda f: f.qualname):
+        for cs in fn.calls:
+            if not cs.discards:
+                continue
+            if is_blocking_raw(cs.raw):
+                yield Violation(
+                    RULE_DROPPED, fn.rel, cs.line,
+                    f"{fn.qualname} discards the response of {cs.raw} — "
+                    f"the peer's status code (not_leader/not_found/error) "
+                    f"is the only failure signal and it is dropped; check "
+                    f"resp.get('code') or log/count the failure",
+                    f"dropped:{fn.name}:{cs.raw.rsplit('.', 1)[-1]}")
+                continue
+            for callee in cs.callees:
+                if index.error_channel(callee):
+                    yield Violation(
+                        RULE_DROPPED, fn.rel, cs.line,
+                        f"{fn.qualname} discards the result of {cs.raw}, "
+                        f"but {callee} returns an error-channel value "
+                        f"(RPC response / Status) that nothing now "
+                        f"inspects — the failure is silently lost",
+                        f"dropped:{fn.name}:{cs.raw.rsplit('.', 1)[-1]}")
+                    break

@@ -1,0 +1,59 @@
+"""Rule family 8 — interprocedural JAX hygiene.
+
+``jax_hygiene`` flags host syncs INSIDE a traced function; it cannot see
+a hazard hiding one call away — a jitted kernel calling a module-level
+helper whose body does ``x.item()`` fails at trace time just the same,
+but the helper's body is, textually, an innocent plain function.
+
+``ijax/reachable-host-sync`` walks the call graph from every traced
+entry point (``@jax.jit``-style decorations, functions passed into
+``jit``/``vmap``/``pallas_call``/``lax`` control flow — the same
+detection the intra rule uses) and reports host-sync sites
+(``.item()``/``.tolist()``, concretizing ``float/int/bool`` casts,
+``np.asarray``-family transfers) in any reachable helper that is not
+itself a traced context (those are already the intra rule's findings).
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.analysis.core import Violation, project_rule
+
+RULE_REACHABLE = "ijax/reachable-host-sync"
+
+_MAX_DEPTH = 8
+
+
+@project_rule(RULE_REACHABLE)
+def check_reachable_host_sync(index):
+    entries = [f for f in index.functions.values() if f.traced]
+    reported: set[tuple[str, int]] = set()
+    for entry in sorted(entries, key=lambda f: f.qualname):
+        queue: list[tuple[str, tuple[str, ...]]] = [
+            (entry.qualname, (entry.qualname,))]
+        seen = {entry.qualname}
+        while queue:
+            qualname, chain = queue.pop(0)
+            fn = index.functions.get(qualname)
+            if fn is None or len(chain) > _MAX_DEPTH:
+                continue
+            if fn.qualname != entry.qualname and not fn.traced:
+                for line, desc in fn.host_syncs:
+                    key = (fn.rel, line)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    via = " -> ".join(c.rsplit(".", 1)[-1] for c in chain)
+                    yield Violation(
+                        RULE_REACHABLE, fn.rel, line,
+                        f"{desc} in {fn.qualname}, which is transitively "
+                        f"reachable from traced entry point "
+                        f"{entry.qualname} (via {via}) — fails on tracers "
+                        f"or forces a device round-trip at trace time",
+                        f"ijax:{fn.name}")
+            if fn.traced and fn.qualname != entry.qualname:
+                continue  # a traced callee starts its own walk
+            for cs in fn.calls:
+                for callee in cs.callees:
+                    if callee not in seen:
+                        seen.add(callee)
+                        queue.append((callee, chain + (callee,)))

@@ -1,0 +1,106 @@
+"""Rule family 5 — interprocedural lock discipline.
+
+The per-class rule in ``locks.py`` sees one method at a time; real
+deadlocks hide in call chains. From the project index we build a GLOBAL
+lock-order graph: an edge A -> B whenever B is acquired while A is held,
+either by direct nesting (``with self._a: with self._b:``) or through a
+call — a function called with A held whose transitive summary acquires
+B. Two rules consume it:
+
+- ``ilocks/abba-cycle`` — a cycle in the global order graph where at
+  least one edge is call-mediated (pure same-class cycles are already
+  ``locks/inconsistent-order``). Thread 1 runs one chain, thread 2 the
+  other, and both block forever.
+- ``ilocks/recursive-lock`` — a call made while holding a
+  non-reentrant ``Lock`` into code whose summary re-acquires the same
+  lock: self-deadlock on the spot (the ``*_locked`` convention exists
+  precisely so helpers called under the lock do not re-acquire it).
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.analysis.core import Violation, project_rule
+
+RULE_ABBA = "ilocks/abba-cycle"
+RULE_RECURSIVE = "ilocks/recursive-lock"
+
+
+def _short(token: str) -> str:
+    """Class.attr tail of a lock token, for messages."""
+    return ".".join(token.rsplit(".", 2)[-2:])
+
+
+def _order_edges(index):
+    """(A, B) -> (rel, line, description, call_mediated) for the global
+    lock-order graph; first site seen wins."""
+    edges: dict[tuple[str, str], tuple] = {}
+    for fn in index.functions.values():
+        for a, b, line in fn.order_pairs:
+            if a != b:
+                edges.setdefault((a, b), (fn.rel, line,
+                                          f"{fn.qualname} nests "
+                                          f"{_short(b)} under {_short(a)}",
+                                          False))
+        for cs in fn.calls:
+            if not cs.held or not cs.callees:
+                continue
+            for callee in cs.callees:
+                for tok in index.trans_locks(callee):
+                    for held in cs.held:
+                        if held != tok:
+                            edges.setdefault(
+                                (held, tok),
+                                (fn.rel, cs.line,
+                                 f"{fn.qualname} holds {_short(held)} while "
+                                 f"calling {cs.raw} which acquires "
+                                 f"{_short(tok)}", True))
+    return edges
+
+
+@project_rule(RULE_ABBA)
+def check_abba_cycles(index):
+    edges = _order_edges(index)
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    # Two-lock cycles carry the report (longer cycles always contain one
+    # in practice here; SCC machinery would over-engineer 4 rules).
+    reported: set[frozenset] = set()
+    for (a, b), (rel, line, desc, mediated) in sorted(edges.items()):
+        if (b, a) not in edges:
+            continue
+        pair = frozenset((a, b))
+        if pair in reported:
+            continue
+        reported.add(pair)
+        back_rel, back_line, back_desc, back_mediated = edges[(b, a)]
+        if not (mediated or back_mediated):
+            continue  # same-function nesting both ways: locks/* owns it
+        yield Violation(
+            RULE_ABBA, rel, line,
+            f"cross-function ABBA deadlock: {desc}; but "
+            f"{back_desc} ({back_rel}:{back_line}) — two threads running "
+            f"these chains concurrently deadlock",
+            f"abba:{'-'.join(sorted(_short(t) for t in pair))}")
+
+
+@project_rule(RULE_RECURSIVE)
+def check_recursive_acquire(index):
+    for fn in sorted(index.functions.values(), key=lambda f: f.qualname):
+        for cs in fn.calls:
+            if not cs.held or not cs.callees:
+                continue
+            for callee in cs.callees:
+                again = cs.held & index.trans_locks(callee)
+                for tok in sorted(again):
+                    if index.lock_kind(tok) != "Lock":
+                        continue  # RLock re-entry is legal
+                    yield Violation(
+                        RULE_RECURSIVE, fn.rel, cs.line,
+                        f"{fn.qualname} calls {cs.raw} while holding "
+                        f"{_short(tok)}, and that call path re-acquires the "
+                        f"same non-reentrant Lock — self-deadlock (use the "
+                        f"*_locked convention for helpers called under the "
+                        f"lock)",
+                        f"recursive:{fn.name}:{_short(tok)}")

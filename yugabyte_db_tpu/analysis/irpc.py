@@ -1,0 +1,58 @@
+"""Rule family 7 — RPC deadline propagation.
+
+Reference discipline: every YugaByte RPC carries a deadline derived from
+the inbound call's; a handler that fans out with NO deadline inherits
+whatever default the transport picked, which can exceed the caller's
+budget and pin a service-pool worker long after the client gave up
+(worker-pool starvation is how one slow tablet takes out a tserver).
+
+``irpc/handler-no-deadline`` walks every service handler (``_h_*`` /
+``handle*`` methods) through the call graph to each blocking
+``transport.send``/``Proxy.call`` site it can reach, and fires when the
+blocking call passes no timeout/deadline argument — neither an explicit
+value nor a forwarded ``timeout_s``-style parameter.
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.analysis.core import Violation, project_rule
+from yugabyte_db_tpu.analysis.callgraph import is_blocking_raw
+
+RULE_NO_DEADLINE = "irpc/handler-no-deadline"
+
+_MAX_DEPTH = 8
+
+
+@project_rule(RULE_NO_DEADLINE)
+def check_handler_deadlines(index):
+    reported: set[tuple[str, int]] = set()
+    for handler in sorted(index.handlers(), key=lambda f: f.qualname):
+        # BFS from the handler; remember one arrival chain per function
+        # for the message.
+        queue: list[tuple[str, tuple[str, ...]]] = [
+            (handler.qualname, (handler.qualname,))]
+        seen = {handler.qualname}
+        while queue:
+            qualname, chain = queue.pop(0)
+            fn = index.functions.get(qualname)
+            if fn is None or len(chain) > _MAX_DEPTH:
+                continue
+            for cs in fn.calls:
+                if is_blocking_raw(cs.raw) and not cs.timeout_arg:
+                    key = (fn.rel, cs.line)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    via = " -> ".join(c.rsplit(".", 2)[-1] for c in chain)
+                    yield Violation(
+                        RULE_NO_DEADLINE, fn.rel, cs.line,
+                        f"blocking {cs.raw} reachable from service handler "
+                        f"{handler.qualname} (via {via}) passes no "
+                        f"timeout/deadline — the transport default can "
+                        f"outlive the caller's budget and pin a service "
+                        f"worker; propagate a deadline",
+                        f"nodeadline:{fn.name}")
+                for callee in cs.callees:
+                    if callee not in seen:
+                        seen.add(callee)
+                        queue.append((callee, chain + (callee,)))

@@ -1,4 +1,4 @@
-"""Human and JSON reporters for analysis results."""
+"""Human, JSON, and SARIF reporters for analysis results."""
 
 from __future__ import annotations
 
@@ -35,4 +35,49 @@ def render_json(result: AnalysisResult) -> str:
              "message": v.message, "fingerprint": v.fingerprint}
             for v in result.violations
         ],
+    }, indent=2)
+
+
+def render_sarif(result: AnalysisResult) -> str:
+    """SARIF 2.1.0, the interchange format CI annotators ingest (GitHub
+    code scanning et al.). One run, one result per violation; the
+    baseline fingerprint rides along as a partialFingerprint so SARIF
+    consumers can track a finding across line-number churn the same way
+    our own baseline does."""
+    rule_ids = sorted({v.rule for v in result.violations})
+    rule_index = {r: i for i, r in enumerate(rule_ids)}
+    return json.dumps({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "yb-lint",
+                    "informationUri":
+                        "https://github.com/yugabyte/yugabyte-db",
+                    "rules": [{"id": r} for r in rule_ids],
+                },
+            },
+            "results": [
+                {
+                    "ruleId": v.rule,
+                    "ruleIndex": rule_index[v.rule],
+                    "level": "error",
+                    "message": {"text": v.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": v.file,
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {"startLine": max(v.line, 1)},
+                        },
+                    }],
+                    "partialFingerprints": {
+                        "ybLintBaselineKey/v1": v.baseline_key(),
+                    },
+                }
+                for v in result.violations
+            ],
+        }],
     }, indent=2)

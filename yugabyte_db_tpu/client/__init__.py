@@ -10,5 +10,8 @@ frontends sit on the C++ client.
 
 from yugabyte_db_tpu.client.client import YBClient, YBTable
 from yugabyte_db_tpu.client.session import YBSession
+from yugabyte_db_tpu.client.transaction import (TransactionManager,
+                                                YBTransaction)
 
-__all__ = ["YBClient", "YBTable", "YBSession"]
+__all__ = ["TransactionManager", "YBClient", "YBTable", "YBSession",
+           "YBTransaction"]

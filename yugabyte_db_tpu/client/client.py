@@ -14,6 +14,7 @@ from yugabyte_db_tpu.client.meta_cache import MetaCache, TabletLocation
 from yugabyte_db_tpu.consensus.transport import TransportError
 from yugabyte_db_tpu.models.partition import compute_hash_code
 from yugabyte_db_tpu.models.schema import ColumnSchema, Schema
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 
 
 class MasterUnavailable(Exception):
@@ -269,8 +270,8 @@ class YBClient:
                 tried_refresh = True
                 try:
                     self.refresh_tserver_addresses()
-                except Exception:  # noqa: BLE001 — best effort
-                    pass
+                except Exception as e:  # noqa: BLE001 — best effort
+                    count_swallowed("client.refresh_tserver_addresses", e)
                 try:
                     locs = self.meta_cache.locations(table_name, refresh=True)
                     for t in locs.tablets:

@@ -18,6 +18,7 @@ from yugabyte_db_tpu.storage import rowblock, wire
 from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
                                                ScanSpec)
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 
 # Key-column dtype codes for the native batch encoder (writeplane.cc).
 _KEY_DTYPE_CODE = {DataType.BOOL: 0, DataType.FLOAT: 2, DataType.DOUBLE: 2,
@@ -465,7 +466,8 @@ class YBSession:
                         leader, "ts.multi_agg_scan",
                         {"tablet_ids": [g.tablet_id for g in group],
                          "spec": wire.encode_spec(sub)}, timeout=5.0)
-                except Exception:  # noqa: BLE001 — per-tablet fallback
+                except Exception as e:  # noqa: BLE001 — per-tablet fallback
+                    count_swallowed("session.multi_agg_scan", e)
                     continue
                 if resp.get("code") != "ok":
                     continue

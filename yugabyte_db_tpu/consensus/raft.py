@@ -43,6 +43,7 @@ from yugabyte_db_tpu.consensus.metadata import ConsensusMetadata, RaftConfig
 from yugabyte_db_tpu.consensus.transport import Transport, TransportError
 from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
 from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 
 
 class Role(enum.Enum):
@@ -340,9 +341,13 @@ class RaftConsensus:
     def transfer_leadership(self, target: str) -> None:
         """Ask ``target`` to start an immediate election (leader stepdown;
         reference: RunLeaderElection RPC, consensus.proto:592)."""
-        self.transport.send(target, "raft.run_election",
-                            {"tablet_id": self.tablet_id},
-                            timeout=self.opts.rpc_timeout_s)
+        resp = self.transport.send(target, "raft.run_election",
+                                   {"tablet_id": self.tablet_id},
+                                   timeout=self.opts.rpc_timeout_s)
+        if resp.get("code") != "ok":
+            # Best effort — the target may simply lose the election — but
+            # an outright refusal should not vanish.
+            count_swallowed("raft.transfer_leadership", resp.get("code"))
 
     # -- rpc dispatch --------------------------------------------------------
     def handle(self, method: str, payload: dict) -> dict:
@@ -539,10 +544,11 @@ class RaftConsensus:
             try:
                 resp = self.transport.send(peer.uuid, "raft.update_consensus",
                                            req, timeout=self.opts.rpc_timeout_s)
-            except Exception:
+            except Exception as e:
                 # ANY send/remote failure (not just TransportError — e.g. a
                 # remote handler error surfacing as RpcCallError) must leave
                 # this replication thread alive; retry on the next tick.
+                count_swallowed("raft.update_consensus", e)
                 continue
             if batch and self._durable_index < batch[-1][1]:
                 # Deferred leader durability (append_leader): sync once
@@ -553,8 +559,8 @@ class RaftConsensus:
                 # majority (the two followers carry it).
                 try:
                     self._ensure_durable(batch[-1][1])
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    count_swallowed("raft.leader_sync", e)
             need_apply = False
             with self._lock:
                 if not self._running or self._role != Role.LEADER or \
@@ -827,8 +833,8 @@ class RaftConsensus:
             if retry_sync:
                 try:
                     self._ensure_durable(retry_sync)
-                except Exception:  # noqa: BLE001 — retried next beat
-                    pass
+                except Exception as e:  # noqa: BLE001 — retried next beat
+                    count_swallowed("raft.follower_sync_retry", e)
             if start_election:
                 self._start_election()
             time.sleep(max(min_sleep, min(sleep_s, 0.5)))

@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from yugabyte_db_tpu.utils.metrics import count_swallowed
+
 
 @dataclass
 class TabletInfo:
@@ -73,8 +75,8 @@ class CatalogState:
             # (the leader pre-validates; this guards races + replays).
             try:
                 self.auth.apply(op)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                count_swallowed("catalog.auth_apply", e)
             return
         with self._lock:
             if kind == "create_view":

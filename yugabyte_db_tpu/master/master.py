@@ -25,6 +25,7 @@ from yugabyte_db_tpu.models.partition import PartitionSchema
 from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.tablet.wal import Log
 from yugabyte_db_tpu.utils.hybrid_time import HybridClock
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 from yugabyte_db_tpu.utils.trace import RpczStore, trace_request
 
 SYS_CATALOG_ID = "sys.catalog"
@@ -314,8 +315,12 @@ class Master:
                     td["partition_start"], td["partition_end"],
                     op.get("engine", "cpu"), td["replicas"])
                 try:
-                    self.transport.send(replica, "ts.create_tablet", req,
-                                        timeout=5.0)
+                    resp = self.transport.send(replica, "ts.create_tablet",
+                                               req, timeout=5.0)
+                    if resp.get("code") != "ok":
+                        self._failed_creates.add((td["tablet_id"], replica))
+                        errors.append(f"{td['tablet_id']}@{replica}: "
+                                      f"{resp.get('code')}")
                 except Exception as e:  # noqa: BLE001 — balancer retries
                     self._failed_creates.add((td["tablet_id"], replica))
                     errors.append(f"{td['tablet_id']}@{replica}: {e}")
@@ -422,13 +427,19 @@ class Master:
             return
         for info in self.catalog.tablets_of(table_id):
             for replica in info.replicas:
+                # Best effort: replicas recover the index set from
+                # ts.create_tablet on restart, but a refused push should
+                # still be visible somewhere.
                 try:
-                    self.transport.send(replica, "ts.set_indexes", {
+                    resp = self.transport.send(replica, "ts.set_indexes", {
                         "tablet_id": info.tablet_id,
                         "indexes": list(t.indexes),
                     }, timeout=5.0)
-                except Exception:  # noqa: BLE001 — replicas recover the
-                    pass           # set from ts.create_tablet on restart
+                    if resp.get("code") != "ok":
+                        count_swallowed("master.push_index_sets",
+                                        resp.get("code"))
+                except Exception as e:  # noqa: BLE001
+                    count_swallowed("master.push_index_sets", e)
 
     def _h_master_drop_index(self, p: dict):
         if not self.raft.is_leader():
@@ -465,11 +476,14 @@ class Master:
         for info in tablets:
             for replica in info.replicas:
                 try:
-                    self.transport.send(replica, "ts.delete_tablet",
-                                        {"tablet_id": info.tablet_id},
-                                        timeout=5.0)
-                except Exception:  # noqa: BLE001 — heartbeat GC retries
-                    pass
+                    resp = self.transport.send(replica, "ts.delete_tablet",
+                                               {"tablet_id": info.tablet_id},
+                                               timeout=5.0)
+                    if resp.get("code") not in ("ok", "not_found"):
+                        count_swallowed("master.delete_tablet",
+                                        resp.get("code"))
+                except Exception as e:  # noqa: BLE001 — heartbeat GC retries
+                    count_swallowed("master.delete_tablet", e)
         return {"code": "ok"}
 
     # -- lookups ------------------------------------------------------------
@@ -803,13 +817,16 @@ class Master:
                         want = sorted(i["name"] for i in table.indexes)
                         if want != t["index_names"]:
                             try:
-                                self.transport.send(
+                                r = self.transport.send(
                                     p["ts_uuid"], "ts.set_indexes", {
                                         "tablet_id": tid,
                                         "indexes": list(table.indexes),
                                     }, timeout=2.0)
-                            except Exception:  # noqa: BLE001 — next beat
-                                pass
+                                if r.get("code") != "ok":
+                                    count_swallowed("master.hb_set_indexes",
+                                                    r.get("code"))
+                            except Exception as e:  # noqa: BLE001 — next beat
+                                count_swallowed("master.hb_set_indexes", e)
             resp["tablets_to_delete"] = sorted(to_delete)
         return resp
 
@@ -828,12 +845,12 @@ class Master:
                 continue
             try:
                 self._rereplicate_once()
-            except Exception:  # noqa: BLE001 — next tick retries
-                pass
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                count_swallowed("master.rereplicate_tick", e)
             try:
                 self._retry_pending_alters()
-            except Exception:  # noqa: BLE001 — next tick retries
-                pass
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                count_swallowed("master.retry_alters_tick", e)
 
     def _deliver_schema(self, info, schema_dict: dict) -> bool:
         """Push a schema version to one tablet's leader (whichever
@@ -846,7 +863,8 @@ class Master:
                     timeout=5.0)
                 if resp.get("code") == "ok":
                     return True
-            except Exception:  # noqa: BLE001 — try other replicas
+            except Exception as e:  # noqa: BLE001 — try other replicas
+                count_swallowed("master.alter_schema", e)
                 continue
         return False
 
@@ -1036,13 +1054,18 @@ class Master:
                 continue
             self._fixing[tablet_id] = now
             try:
-                self.transport.send(replica, "ts.create_tablet",
-                                    self._create_tablet_req(
-                                        tablet_id, t.name, t.schema,
-                                        info.partition_start,
-                                        info.partition_end, t.engine,
-                                        info.replicas, indexes=t.indexes),
-                                    timeout=5.0)
-                self._failed_creates.discard((tablet_id, replica))
-            except Exception:  # noqa: BLE001 — next tick retries
-                pass
+                resp = self.transport.send(replica, "ts.create_tablet",
+                                           self._create_tablet_req(
+                                               tablet_id, t.name, t.schema,
+                                               info.partition_start,
+                                               info.partition_end, t.engine,
+                                               info.replicas,
+                                               indexes=t.indexes),
+                                           timeout=5.0)
+                if resp.get("code") == "ok":
+                    self._failed_creates.discard((tablet_id, replica))
+                else:
+                    count_swallowed("master.recreate_replica",
+                                    resp.get("code"))
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                count_swallowed("master.recreate_replica", e)

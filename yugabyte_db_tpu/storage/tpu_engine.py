@@ -50,6 +50,7 @@ from yugabyte_db_tpu.storage.merge import merge_versions
 from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.utils import planes as P
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 
 WINDOW_BLOCKS = 8          # blocks per device dispatch on the row path
 PAD_BLOCKS = 64            # run block-axis padding (multiple of every window)
@@ -241,8 +242,8 @@ class TpuStorageEngine(StorageEngine):
                 try:
                     idx = jnp.full((b,), valid.size, dtype=jnp.int32)
                     TpuStorageEngine._scatter_invalid(valid, idx)
-                except Exception:  # noqa: BLE001 — warmup is best-effort
-                    pass
+                except Exception as e:  # noqa: BLE001 — warmup best-effort
+                    count_swallowed("tpu_engine.scatter_warmup", e)
 
         import threading
 

@@ -23,6 +23,8 @@ import subprocess
 import sys
 import time
 
+from yugabyte_db_tpu.utils.metrics import count_swallowed
+
 STATE_FILE = "cluster.json"
 
 
@@ -192,8 +194,8 @@ class ClusterCtl:
             try:
                 if len(admin.list_tservers()) >= want:
                     return
-            except Exception:  # noqa: BLE001 — master still electing
-                pass
+            except Exception as e:  # noqa: BLE001 — master still electing
+                count_swallowed("yb_ctl.wait_tservers", e)
             time.sleep(0.2)
         raise SystemExit(f"tservers did not register within {timeout_s}s")
 

@@ -20,6 +20,7 @@ from yugabyte_db_tpu.tablet.tablet import TabletMetadata
 from yugabyte_db_tpu.tserver.heartbeater import Heartbeater
 from yugabyte_db_tpu.tserver.tablet_manager import (TabletNotFound,
                                                     TSTabletManager)
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 from yugabyte_db_tpu.utils.trace import TRACE, RpczStore, trace_request
 
 
@@ -102,8 +103,8 @@ class TabletServer:
         for tablet_id in resp.get("tablets_to_delete", []):
             try:
                 self.tablet_manager.delete_tablet(tablet_id)
-            except Exception:  # noqa: BLE001 — deletion retried next beat
-                pass
+            except Exception as e:  # noqa: BLE001 — retried next beat
+                count_swallowed("tserver.delete_tablet", e)
 
     def start_webserver(self, host: str = "127.0.0.1", port: int = 0):
         """Expose /metrics, /varz, /healthz, /tablets over HTTP
@@ -226,11 +227,13 @@ class TabletServer:
         (reference: the StartRemoteBootstrap RPC the leader's consensus
         queue fires, consensus_queue.cc -> remote_bootstrap_service.cc)."""
         try:
-            self.transport.send(peer_uuid, "ts.start_remote_bootstrap", {
-                "tablet_id": tablet_id, "source": self.uuid,
-            }, timeout=5.0)
-        except Exception:  # noqa: BLE001 — retried by the next trigger
-            pass
+            resp = self.transport.send(peer_uuid, "ts.start_remote_bootstrap",
+                                       {"tablet_id": tablet_id,
+                                        "source": self.uuid}, timeout=5.0)
+            if resp.get("code") != "ok":
+                count_swallowed("tserver.remote_bootstrap", resp.get("code"))
+        except Exception as e:  # noqa: BLE001 — retried by the next trigger
+            count_swallowed("tserver.remote_bootstrap", e)
 
     def _h_ts_start_remote_bootstrap(self, p: dict):
         import threading as _threading
@@ -450,7 +453,8 @@ class TabletServer:
                     resp = self.transport.send(
                         target, "master.get_table_locations",
                         {"name": table_name}, timeout=2.0)
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001 — try next master
+                    count_swallowed("tserver.get_table_locations", e)
                     continue
                 if resp.get("code") == "not_leader":
                     hint = resp.get("leader_hint")

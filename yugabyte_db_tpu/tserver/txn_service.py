@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 import time
 
+from yugabyte_db_tpu.utils.metrics import count_swallowed
+
 
 class TxnRpcRouter:
     """Leader-following RPC helper for per-tablet transaction RPCs.
@@ -36,7 +38,8 @@ class TxnRpcRouter:
                 resp = self.transport.send(
                     target, "master.locate_tablet",
                     {"tablet_id": tablet_id}, timeout=2.0)
-            except Exception:  # noqa: BLE001 — try next master
+            except Exception as e:  # noqa: BLE001 — try next master
+                count_swallowed("txn_router.locate", e)
                 continue
             if resp.get("code") == "not_leader":
                 hint = resp.get("leader_hint")
@@ -88,7 +91,8 @@ class TxnRpcRouter:
             try:
                 resp = self.transport.send(target, method, payload,
                                            timeout=timeout)
-            except Exception:  # noqa: BLE001 — next candidate
+            except Exception as e:  # noqa: BLE001 — next candidate
+                count_swallowed("txn_router.call", e)
                 continue
             if resp.get("code") == "not_leader":
                 nxt = resp.get("leader_hint")
@@ -142,8 +146,8 @@ class TxnNotifier:
                 return
             try:
                 self._tick()
-            except Exception:  # noqa: BLE001 — next tick retries
-                pass
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                count_swallowed("txn_service.tick", e)
 
     def _tick(self) -> None:
         for peer in self.server.tablet_manager.peers():
@@ -156,8 +160,8 @@ class TxnNotifier:
                         "action": "abort", "txn_id": txn_id,
                         "participants": [],
                     })
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 — next tick retries
+                    count_swallowed("txn_service.expire_abort", e)
             for txn_id, action, commit_ht, unacked in \
                     coord.pending_notifications():
                 for tablet_id, hint in unacked:
@@ -173,11 +177,11 @@ class TxnNotifier:
                                 "action": "ack", "txn_id": txn_id,
                                 "tablet_id": tablet_id,
                             })
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as e:  # noqa: BLE001 — re-notified
+                            count_swallowed("txn_service.ack", e)
             for txn_id in coord.gc_candidates():
                 try:
                     peer.replicate_txn_op("txn_status", {
                         "action": "gc", "txn_id": txn_id})
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 — next tick retries
+                    count_swallowed("txn_service.gc", e)

@@ -12,15 +12,28 @@ becomes a read-side gate + status resolution instead of a merge, because
 applies are local Raft ops that land promptly).
 """
 
-from yugabyte_db_tpu.txn.client import (TransactionConflict,
-                                        TransactionManager, YBTransaction)
 from yugabyte_db_tpu.txn.coordinator import (TXN_STATUS_TABLE,
                                              TransactionCoordinator)
+from yugabyte_db_tpu.txn.errors import (TransactionAborted,
+                                        TransactionConflict)
 from yugabyte_db_tpu.txn.participant import (IntentConflict,
                                              TransactionParticipant)
 
+
+def __getattr__(name):
+    # Lazy re-export of the client-side session API, which moved to
+    # yugabyte_db_tpu.client.transaction. Loading it eagerly here would
+    # recurse: client.transaction imports txn.coordinator, which runs
+    # this package __init__ first.
+    if name in ("TransactionManager", "YBTransaction"):
+        # yb-lint: disable=layering/upward-import
+        from yugabyte_db_tpu.client import transaction
+        return getattr(transaction, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "IntentConflict",
+    "TransactionAborted",
     "TransactionConflict",
     "TransactionCoordinator",
     "TransactionManager",

@@ -1,0 +1,17 @@
+"""Transaction error types.
+
+These live at the bottom of the txn package so both sides of the stack
+can raise/catch them without layering violations: the coordinator and
+participant (txn layer) raise them upward, and the client-side session
+API (yugabyte_db_tpu.client.transaction) imports them downward.
+"""
+
+from __future__ import annotations
+
+
+class TransactionConflict(Exception):
+    """The transaction lost a conflict and must be retried by the app."""
+
+
+class TransactionAborted(Exception):
+    """The transaction was aborted (expiry, wound, or explicit)."""

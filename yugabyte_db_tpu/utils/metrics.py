@@ -19,6 +19,7 @@ everything in one pass, grouping series by metric name.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -166,8 +167,8 @@ class MetricRegistry:
         for fn in collectors:
             try:
                 fn()
-            except Exception:  # noqa: BLE001 — scrape must not die
-                pass
+            except Exception as e:  # noqa: BLE001 — scrape must not die
+                count_swallowed("metrics.collector", e)
         with self._lock:
             entities = list(self._entities)
         by_name: dict[str, list] = {}
@@ -209,3 +210,37 @@ def _labels(labels: dict, **extra) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
     return "{" + inner + "}"
+
+
+# -- process-wide registry + swallowed-error accounting ----------------------
+# Daemons construct their own registries for per-server metrics; this one
+# exists so cross-cutting health counters (swallowed errors, scrape
+# failures) have a home regardless of which daemon — or no daemon — is
+# running in the process.
+_PROCESS_REGISTRY = MetricRegistry()
+_SWALLOW_LOG = logging.getLogger("yugabyte_db_tpu.swallowed")
+_SWALLOW_ENTITIES: dict[str, MetricEntity] = {}
+_SWALLOW_LOCK = threading.Lock()
+
+
+def process_registry() -> MetricRegistry:
+    return _PROCESS_REGISTRY
+
+
+def count_swallowed(site: str, exc: object = None) -> None:
+    """Record a deliberately-swallowed exception: bump
+    ``yb_swallowed_errors{site=...}`` on the process registry and leave a
+    debug-level trace. For best-effort paths (retry loops, shutdown,
+    scrapes) where the except block would otherwise discard the error
+    invisibly — the counter makes a noisy failure site show up on a
+    dashboard even when nobody has debug logging on. Never raises."""
+    try:
+        with _SWALLOW_LOCK:
+            ent = _SWALLOW_ENTITIES.get(site)
+            if ent is None:
+                ent = _PROCESS_REGISTRY.entity(site=site)
+                _SWALLOW_ENTITIES[site] = ent
+        ent.counter("yb_swallowed_errors").increment()
+        _SWALLOW_LOG.debug("swallowed at %s: %r", site, exc)
+    except Exception:  # noqa: BLE001 — error accounting must not throw
+        _SWALLOW_LOG.debug("count_swallowed failed at site %s", site)

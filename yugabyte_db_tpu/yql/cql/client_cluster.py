@@ -323,7 +323,7 @@ class ClientCluster:
         (reference: the TransactionManager the SQL layer's PgTxnManager
         drives, pg_txn_manager.cc) — distributed seam only."""
         if getattr(self, "_txn_manager", None) is None:
-            from yugabyte_db_tpu.txn.client import TransactionManager
+            from yugabyte_db_tpu.client.transaction import TransactionManager
 
             self._txn_manager = TransactionManager(self.client)
             self._txn_manager.ensure_status_table()

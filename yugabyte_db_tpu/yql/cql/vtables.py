@@ -19,6 +19,7 @@ import uuid
 
 from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.models.schema import ColumnKind
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 from yugabyte_db_tpu.utils.status import InvalidArgument
 
 # A stable fake host id per process (reference: the tserver's uuid).
@@ -117,7 +118,8 @@ def _user_tables(processor):
         ks, table = name.split(".", 1)
         try:
             schema = processor.cluster.table(name).schema
-        except Exception:  # noqa: BLE001 — dropped concurrently
+        except Exception as e:  # noqa: BLE001 — dropped concurrently
+            count_swallowed("cql_vtables.table_schema", e)
             continue
         out.append((ks, table, schema))
     return out

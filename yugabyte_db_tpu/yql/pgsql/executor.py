@@ -128,7 +128,7 @@ class PgProcessor:
 
     # -- transactions ------------------------------------------------------
     def _exec_txn_control(self, stmt: ast.TxnControl):
-        from yugabyte_db_tpu.txn.client import (TransactionAborted,
+        from yugabyte_db_tpu.txn.errors import (TransactionAborted,
                                                 TransactionConflict)
 
         if stmt.kind == "begin":

@@ -17,6 +17,7 @@ from __future__ import annotations
 import uuid
 
 from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 
 _PG_TYPE_NAMES = {
     DataType.INT8: "smallint", DataType.INT16: "smallint",
@@ -52,7 +53,8 @@ def _user_tables(processor):
     for name in sorted(processor.cluster.tables):
         try:
             schema = processor.cluster.table(name).schema
-        except Exception:  # noqa: BLE001 — dropped concurrently
+        except Exception as e:  # noqa: BLE001 — dropped concurrently
+            count_swallowed("pg_vtables.table_schema", e)
             continue
         out.append((name, schema))
     return out
