@@ -517,6 +517,186 @@ def bench_redis(n_keys=20_000, pipeline=256):
     }]
 
 
+def bench_serving_path(n_keys=20_000, pipeline=256, cql_rows=2_000,
+                       cql_ops=10_000, window=128):
+    """The native request-batch serving path (docs/serving-path.md)
+    against its own Python per-op fallback, same sockets, same data:
+    pipelined RESP GETs and pipelined prepared CQL point SELECTs, each
+    timed with the native batch executors enabled and then force-
+    disabled. NEW metric keys — the pre-existing redis_pipelined_* keys
+    keep measuring whatever path the server picks by default."""
+    import socket
+    import tempfile
+
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+    from yugabyte_db_tpu.yql.cql import wire_protocol as W
+    from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+    from yugabyte_db_tpu.yql.cql.processor import QLProcessor
+    from yugabyte_db_tpu.yql.cql.server import CQLServer
+    from yugabyte_db_tpu.yql.redis import RedisServer
+    from yugabyte_db_tpu.yql.redis.server import RedisServiceImpl
+
+    out = []
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            # -- redis: pipelined GET sweep, native vs forced-Python ----
+            server = RedisServer(mc.client("redis-bench-native"))
+            host, port = server.listen("127.0.0.1", 0)
+            sock = socket.create_connection((host, port))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            f = sock.makefile("rwb")
+
+            def run(cmds):
+                for c0 in range(0, len(cmds), pipeline):
+                    chunk = cmds[c0:c0 + pipeline]
+                    f.write(b"".join(chunk))
+                    f.flush()
+                    for _ in chunk:
+                        line = f.readline()
+                        if line[:1] == b"$":
+                            ln = int(line[1:])
+                            if ln >= 0:
+                                f.read(ln + 2)
+
+            def resp(*args):
+                parts = [b"*%d\r\n" % len(args)]
+                for a in args:
+                    b = a if isinstance(a, bytes) else str(a).encode()
+                    parts.append(b"$%d\r\n%s\r\n" % (len(b), b))
+                return b"".join(parts)
+
+            run([resp("SET", f"nk{i:07d}", f"val{i}")
+                 for i in range(n_keys)])
+            rng = random.Random(7)
+            gets = [resp("GET", f"nk{rng.randrange(n_keys):07d}")
+                    for _ in range(n_keys)]
+            run(gets[:pipeline])  # warm both paths' caches
+            t0 = time.perf_counter()
+            run(gets)
+            native_dt = time.perf_counter() - t0
+            native_get = RedisServiceImpl._native_get_values
+            RedisServiceImpl._native_get_values = \
+                lambda self, rkeys: None
+            try:
+                t0 = time.perf_counter()
+                run(gets)
+                py_dt = time.perf_counter() - t0
+            finally:
+                RedisServiceImpl._native_get_values = native_get
+            sock.close()
+            server.shutdown()
+            out.append({
+                "metric": "redis_native_batch_get_ops_per_sec",
+                "value": round(n_keys / native_dt, 1),
+                "unit": f"GET ops/s (native batch path, pipeline "
+                        f"{pipeline}, RF=3)",
+                "vs_baseline": round(n_keys / native_dt / (538_000 / 3),
+                                     2),
+                "python_per_op_ops_per_sec": round(n_keys / py_dt, 1),
+                "speedup_vs_python": round(py_dt / native_dt, 2),
+            })
+
+            # -- CQL: pipelined prepared point SELECTs ------------------
+            cql = CQLServer(ClientCluster(mc.client()))
+            host, port = cql.listen("127.0.0.1", 0)
+            sock = socket.create_connection((host, port))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+            def send(stream, opcode, body):
+                sock.sendall(W.HEADER.pack(W.VERSION_REQ, 0, stream,
+                                           opcode, len(body)) + body)
+
+            def recvn(n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = sock.recv(n - len(buf))
+                    assert chunk
+                    buf += chunk
+                return buf
+
+            def recv_frame():
+                hdr = recvn(W.HEADER.size)
+                _v, _fl, _s, op, ln = W.HEADER.unpack(hdr)
+                return op, recvn(ln)
+
+            def query(q):
+                w = W.Writer().long_string(q).short(1).byte(0)
+                send(1, W.OP_QUERY, w.getvalue())
+                op, body = recv_frame()
+                assert op == W.OP_RESULT, body
+
+            w = W.Writer()
+            w.short(1)
+            w.string("CQL_VERSION").string("3.4.4")
+            send(0, W.OP_STARTUP, w.getvalue())
+            assert recv_frame()[0] == W.OP_READY
+            query("CREATE KEYSPACE IF NOT EXISTS bench_sp")
+            query("USE bench_sp")
+            query("CREATE TABLE t (k bigint PRIMARY KEY, v text)")
+            for i in range(cql_rows):
+                query(f"INSERT INTO t (k, v) VALUES ({i}, 'val{i}')")
+            send(1, W.OP_PREPARE,
+                 W.Writer().long_string(
+                     "SELECT k, v FROM t WHERE k = ?").getvalue())
+            op, body = recv_frame()
+            assert op == W.OP_RESULT, body
+            r = W.Reader(body)
+            assert r.int32() == W.RESULT_PREPARED
+            stmt_id = r.short_bytes()
+
+            def exec_frames(keys):
+                frames = []
+                for s, k in enumerate(keys):
+                    w = W.Writer().short_bytes(stmt_id)
+                    w.short(1).byte(0x01).short(1)
+                    w.bytes_(k.to_bytes(8, "big", signed=True))
+                    b = w.getvalue()
+                    frames.append(W.HEADER.pack(
+                        W.VERSION_REQ, 0, s + 2, W.OP_EXECUTE, len(b))
+                        + b)
+                return b"".join(frames)
+
+            keys = [rng.randrange(cql_rows) for _ in range(cql_ops)]
+            bufs = [exec_frames(keys[c0:c0 + window])
+                    for c0 in range(0, len(keys), window)]
+
+            def sweep():
+                for buf, c0 in zip(bufs, range(0, len(keys), window)):
+                    sock.sendall(buf)
+                    for _ in range(len(keys[c0:c0 + window])):
+                        recv_frame()
+
+            sweep()  # warm
+            t0 = time.perf_counter()
+            sweep()
+            native_dt = time.perf_counter() - t0
+            batch = QLProcessor.execute_wire_point_batch
+            QLProcessor.execute_wire_point_batch = \
+                lambda self, items: [None] * len(items)
+            try:
+                t0 = time.perf_counter()
+                sweep()
+                py_dt = time.perf_counter() - t0
+            finally:
+                QLProcessor.execute_wire_point_batch = batch
+            sock.close()
+            cql.shutdown()
+            out.append({
+                "metric": "ycql_native_point_select_ops_per_sec",
+                "value": round(cql_ops / native_dt, 1),
+                "unit": f"prepared point SELECT ops/s (native batch "
+                        f"path, window {window}, RF=3)",
+                "vs_baseline": None,
+                "python_per_op_ops_per_sec": round(cql_ops / py_dt, 1),
+                "speedup_vs_python": round(py_dt / native_dt, 2),
+            })
+        finally:
+            mc.shutdown()
+    return out
+
+
 def bench_multisource(schema, tpu, cpu, max_ht, S, waves=4):
     """Post-write scans: after heavy update traffic the engine holds a
     live memtable + overlapping runs (the VERDICT-flagged shape real
@@ -959,6 +1139,7 @@ def main():
         *bench_ycsb_mix(make_engine, S),
         *bench_index(),
         *bench_redis(),
+        *bench_serving_path(),
         bench_multisource(schema, tpu, cpu, max_ht, S),
         *bench_kernel_scan(),
         *bench_tpch(make_engine),

@@ -1,0 +1,206 @@
+// Shared DocKey-component encoding + partition hashing for the native
+// extensions. Factored out of writeplane.cc so the request-batch serving
+// module (servebatch.cc) routes point ops with exactly the bytes the
+// write plane produces — one implementation, two hot paths.
+//
+// Parity contracts (hold byte-for-byte, enforced by the engine-diff
+// tests):
+//   encode_key_component  <->  models/encoding.py encode_key_component
+//   crc32 + fold          <->  models/partition.py compute_hash_code
+//   upper_bound(starts)   <->  models/partition.py partition_index
+//
+// Reference analog: src/yb/docdb/doc_key.cc (DocKey::EncodeFrom) and
+// src/yb/common/partition.cc (PartitionSchema::EncodeKey) — the
+// reference likewise shares one key codec between its write path and its
+// redis/cql serving paths.
+
+#ifndef YB_NATIVE_KEYCODEC_H
+#define YB_NATIVE_KEYCODEC_H
+
+#include "tagcodec.h"
+
+namespace ybkey {
+
+using ybtag::Buf;
+
+// Key-encoding tags (yugabyte_db_tpu/models/encoding.py).
+enum KeyTag : unsigned char {
+  K_GROUP_END = 0x01,
+  K_NULL = 0x04,
+  K_HASH = 0x08,
+  K_FALSE = 0x10,
+  K_TRUE = 0x11,
+  K_INT = 0x20,
+  K_DOUBLE = 0x28,
+  K_STRING = 0x30,
+  K_BINARY = 0x32,
+};
+
+// dtype codes passed from Python (models/datatypes.py key kinds).
+enum DtypeCode { DT_BOOL = 0, DT_INT = 1, DT_DOUBLE = 2, DT_STR = 3,
+                 DT_BIN = 4 };
+
+// -- little-endian scalar writes/reads ---------------------------------------
+
+inline bool put_u16(Buf* b, uint16_t v) { return ybtag::buf_put(b, &v, 2); }
+inline bool put_u32(Buf* b, uint32_t v) { return ybtag::buf_put(b, &v, 4); }
+inline bool put_u64(Buf* b, uint64_t v) { return ybtag::buf_put(b, &v, 8); }
+inline bool put_i64(Buf* b, int64_t v) { return ybtag::buf_put(b, &v, 8); }
+
+inline uint16_t get_u16(const unsigned char* p) {
+  uint16_t v; memcpy(&v, p, 2); return v;
+}
+inline uint32_t get_u32(const unsigned char* p) {
+  uint32_t v; memcpy(&v, p, 4); return v;
+}
+inline uint64_t get_u64(const unsigned char* p) {
+  uint64_t v; memcpy(&v, p, 8); return v;
+}
+inline int64_t get_i64(const unsigned char* p) {
+  int64_t v; memcpy(&v, p, 8); return v;
+}
+
+// -- crc32 (zlib-compatible) -------------------------------------------------
+
+inline const uint32_t* crc_table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) {
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+inline uint32_t crc32(const unsigned char* p, size_t n) {
+  const uint32_t* t = crc_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) {
+    c = t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  }
+  return c ^ 0xFFFFFFFFu;
+}
+
+// 16-bit partition hash over the concatenated encoded hash components
+// (models/partition.py compute_hash_code).
+inline uint16_t hash_code_of(const Buf& hashbuf) {
+  uint32_t crc = crc32((const unsigned char*)hashbuf.data, hashbuf.len);
+  return (uint16_t)(((crc >> 16) ^ (crc & 0xFFFF)) & 0xFFFF);
+}
+
+// -- key-component encoding (parity with models/encoding.py) -----------------
+
+inline bool key_put_int(Buf* b, long long x) {
+  // Sign-flip maps signed order onto unsigned byte order; big-endian.
+  uint64_t biased = static_cast<uint64_t>(x) + (1ULL << 63);
+  unsigned char be[8];
+  for (int i = 7; i >= 0; i--) { be[i] = biased & 0xFF; biased >>= 8; }
+  return ybtag::buf_putc(b, K_INT) && ybtag::buf_put(b, be, 8);
+}
+
+inline bool key_put_double(Buf* b, double d) {
+  if (d == 0.0) d = 0.0;  // canonicalize -0.0
+  uint64_t bits;
+  memcpy(&bits, &d, 8);
+  if (bits & (1ULL << 63)) {
+    bits = ~bits;                 // negative: flip all bits
+  } else {
+    bits |= 1ULL << 63;           // positive: flip sign bit
+  }
+  unsigned char be[8];
+  for (int i = 7; i >= 0; i--) { be[i] = bits & 0xFF; bits >>= 8; }
+  return ybtag::buf_putc(b, K_DOUBLE) && ybtag::buf_put(b, be, 8);
+}
+
+inline bool key_put_escaped(Buf* b, const unsigned char* p, size_t n) {
+  // 0x00 -> 0x00 0x01, terminated 0x00 0x00 (ZeroEncodeAndAppendStrToKey).
+  for (size_t i = 0; i < n; i++) {
+    if (!ybtag::buf_putc(b, p[i])) return false;
+    if (p[i] == 0 && !ybtag::buf_putc(b, 0x01)) return false;
+  }
+  return ybtag::buf_putc(b, 0x00) && ybtag::buf_putc(b, 0x00);
+}
+
+// Encode one key column value as [tag][payload]. Returns false with a
+// Python error set on unsupported value.
+inline bool encode_key_component(Buf* b, PyObject* v, int dtype) {
+  if (v == Py_None) return ybtag::buf_putc(b, K_NULL);
+  switch (dtype) {
+    case DT_BOOL: {
+      int truth = PyObject_IsTrue(v);
+      if (truth < 0) return false;
+      return ybtag::buf_putc(b, truth ? K_TRUE : K_FALSE);
+    }
+    case DT_INT: {
+      long long x;
+      if (PyLong_Check(v)) {
+        int overflow = 0;
+        x = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (overflow != 0) {
+          PyErr_SetString(PyExc_ValueError,
+                          "integer key value out of int64 range");
+          return false;
+        }
+        if (x == -1 && PyErr_Occurred()) return false;
+      } else {
+        PyObject* as_int = PyNumber_Long(v);
+        if (as_int == nullptr) return false;
+        x = PyLong_AsLongLong(as_int);
+        Py_DECREF(as_int);
+        if (x == -1 && PyErr_Occurred()) return false;
+      }
+      return key_put_int(b, x);
+    }
+    case DT_DOUBLE: {
+      double d = PyFloat_AsDouble(v);
+      if (d == -1.0 && PyErr_Occurred()) return false;
+      return key_put_double(b, d);
+    }
+    case DT_STR: {
+      if (!PyUnicode_Check(v)) {
+        PyErr_Format(PyExc_TypeError, "string key value must be str, not %s",
+                     Py_TYPE(v)->tp_name);
+        return false;
+      }
+      PyObject* raw = PyUnicode_AsEncodedString(v, "utf-8", "surrogateescape");
+      if (raw == nullptr) return false;
+      char* p;
+      Py_ssize_t n;
+      if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
+        Py_DECREF(raw);
+        return false;
+      }
+      bool ok = ybtag::buf_putc(b, K_STRING) &&
+                key_put_escaped(b, (const unsigned char*)p, (size_t)n);
+      Py_DECREF(raw);
+      return ok;
+    }
+    case DT_BIN: {
+      PyObject* raw = PyBytes_FromObject(v);
+      if (raw == nullptr) return false;
+      char* p;
+      Py_ssize_t n;
+      if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
+        Py_DECREF(raw);
+        return false;
+      }
+      bool ok = ybtag::buf_putc(b, K_BINARY) &&
+                key_put_escaped(b, (const unsigned char*)p, (size_t)n);
+      Py_DECREF(raw);
+      return ok;
+    }
+    default:
+      PyErr_Format(PyExc_ValueError, "bad key dtype code %d", dtype);
+      return false;
+  }
+}
+
+}  // namespace ybkey
+
+#endif  // YB_NATIVE_KEYCODEC_H

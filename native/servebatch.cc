@@ -1,0 +1,286 @@
+// Native request-batch serving path: RESP batch parsing and batched
+// point-key routing for the per-op CQL/Redis hot loop.
+//
+// The reference batch-executes redis ops inside its C++ reactor
+// (src/yb/yql/redis/redisserver/redis_service.cc BatchContext +
+// src/yb/rpc/reactor.cc): one drained socket buffer becomes one batch
+// of parsed commands, routed to tablets by partition hash, served, and
+// answered without per-op allocation. This module is that shape for the
+// TPU-native framework's Python frontends: Python keeps sockets, auth,
+// consensus, and transactions; the per-op inner loop — frame parse,
+// DocKey encode, partition route — runs here over whole batches, and
+// point reads are served by yb_wp.Memtable.point_lookup against the
+// native memtable. Anything unusual falls back to the Python path with
+// byte-identical results (yql/redis/resp.py and models/encoding.py are
+// the specs).
+//
+// Exposed as the CPython extension module `yb_rb`.
+
+#include "keycodec.h"
+#include "tagcodec.h"
+
+#include <algorithm>
+#include <string>
+#include <vector>
+
+namespace {
+
+using ybtag::Buf;
+using namespace ybkey;
+
+// -- parse_resp --------------------------------------------------------------
+//
+// parse_resp(data) -> (commands, consumed) | None
+//
+// Strict RESP2 array-of-bulk-strings parser (the form every pipelined
+// client emits). Consumes complete commands; incomplete trailing data is
+// left unconsumed (commands parsed so far are returned). Returns None —
+// having consumed NOTHING — on anything the strict grammar doesn't
+// cover (inline commands, malformed lengths): the caller re-parses the
+// whole buffer with yql.redis.resp.parse_commands so error behavior and
+// consumption stay byte-identical to the Python path.
+
+// index of "\r\n" at/after `from`, or -1.
+static Py_ssize_t find_crlf(const unsigned char* p, Py_ssize_t n,
+                            Py_ssize_t from) {
+  for (Py_ssize_t i = from; i + 1 < n; i++) {
+    if (p[i] == '\r' && p[i + 1] == '\n') return i;
+  }
+  return -1;
+}
+
+// Parse "-?[0-9]+" in [a, b). Returns false on any other shape.
+static bool parse_strict_int(const unsigned char* p, Py_ssize_t a,
+                             Py_ssize_t b, long long* out) {
+  if (a >= b) return false;
+  bool neg = false;
+  if (p[a] == '-') { neg = true; a++; }
+  if (a >= b || b - a > 18) return false;  // 18 digits caps < 2^63
+  long long v = 0;
+  for (Py_ssize_t i = a; i < b; i++) {
+    if (p[i] < '0' || p[i] > '9') return false;
+    v = v * 10 + (p[i] - '0');
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
+PyObject* py_parse_resp(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  const unsigned char* p = (const unsigned char*)view.buf;
+  Py_ssize_t n = view.len;
+
+  PyObject* cmds = PyList_New(0);
+  if (cmds == nullptr) { PyBuffer_Release(&view); return nullptr; }
+  Py_ssize_t consumed = 0;
+  bool fallback = false;
+
+  while (consumed < n) {
+    if (p[consumed] != '*') { fallback = true; break; }  // inline form
+    Py_ssize_t end = find_crlf(p, n, consumed);
+    if (end < 0) break;  // incomplete header
+    long long nargs;
+    if (!parse_strict_int(p, consumed + 1, end, &nargs)) {
+      fallback = true;  // parse_commands raises ProtocolError here
+      break;
+    }
+    Py_ssize_t pos = end + 2;
+    PyObject* args = PyList_New(0);
+    if (args == nullptr) { Py_DECREF(cmds); PyBuffer_Release(&view);
+                           return nullptr; }
+    bool complete = true;
+    for (long long a = 0; a < nargs; a++) {
+      if (pos >= n) { complete = false; break; }
+      if (p[pos] != '$') { fallback = true; break; }
+      Py_ssize_t lend = find_crlf(p, n, pos);
+      if (lend < 0) { complete = false; break; }
+      long long ln;
+      if (!parse_strict_int(p, pos + 1, lend, &ln) || ln < 0) {
+        fallback = true;  // bad / negative bulk length
+        break;
+      }
+      Py_ssize_t start = lend + 2;
+      if (n < start + ln + 2) { complete = false; break; }
+      PyObject* item = PyBytes_FromStringAndSize((const char*)p + start,
+                                                 (Py_ssize_t)ln);
+      if (item == nullptr || PyList_Append(args, item) < 0) {
+        Py_XDECREF(item);
+        Py_DECREF(args);
+        Py_DECREF(cmds);
+        PyBuffer_Release(&view);
+        return nullptr;
+      }
+      Py_DECREF(item);
+      pos = start + ln + 2;
+    }
+    if (fallback || !complete) { Py_DECREF(args); break; }
+    consumed = pos;
+    if (PyList_GET_SIZE(args) > 0) {
+      if (PyList_Append(cmds, args) < 0) {
+        Py_DECREF(args);
+        Py_DECREF(cmds);
+        PyBuffer_Release(&view);
+        return nullptr;
+      }
+    }
+    Py_DECREF(args);
+  }
+  PyBuffer_Release(&view);
+  if (fallback) {
+    Py_DECREF(cmds);
+    Py_RETURN_NONE;
+  }
+  return Py_BuildValue("(Nn)", cmds, consumed);
+}
+
+// -- encode_point_keys -------------------------------------------------------
+//
+// encode_point_keys(hash_dtypes, range_dtypes, rows, starts, full)
+//   -> [(partition_index, key_bytes)]
+//
+// Batch DocKey encoder + partition router for point ops: each row is a
+// flat sequence of key column values (hash components then range
+// components); dtypes are models/datatypes.py key-kind codes. full=1
+// appends the trailing group terminator (schema.encode_primary_key
+// parity — redis point rows); full=0 stops after the range components
+// (models/encoding.py encode_doc_key_prefix parity — CQL point-SELECT
+// bounds, paired with prefix_successor upper bounds).
+
+static bool parse_dtypes(PyObject* seq, std::vector<int>* out,
+                         const char* what) {
+  PyObject* fast = PySequence_Fast(seq, what);
+  if (fast == nullptr) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(fast, i));
+    if (v == -1 && PyErr_Occurred()) { Py_DECREF(fast); return false; }
+    out->push_back((int)v);
+  }
+  Py_DECREF(fast);
+  return true;
+}
+
+PyObject* py_encode_point_keys(PyObject*, PyObject* args) {
+  PyObject *hash_o, *range_o, *rows, *starts_obj;
+  int full;
+  if (!PyArg_ParseTuple(args, "OOOOi", &hash_o, &range_o, &rows,
+                        &starts_obj, &full)) {
+    return nullptr;
+  }
+  std::vector<int> hash_dt, range_dt;
+  if (!parse_dtypes(hash_o, &hash_dt, "encode_point_keys: hash dtypes") ||
+      !parse_dtypes(range_o, &range_dt, "encode_point_keys: range dtypes")) {
+    return nullptr;
+  }
+  if (hash_dt.empty()) {
+    PyErr_SetString(PyExc_ValueError,
+                    "encode_point_keys: need at least one hash column");
+    return nullptr;
+  }
+  std::vector<uint32_t> starts;
+  {
+    PyObject* fast = PySequence_Fast(starts_obj,
+                                     "encode_point_keys: starts");
+    if (fast == nullptr) return nullptr;
+    Py_ssize_t sn = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < sn; i++) {
+      long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(fast, i));
+      if (v == -1 && PyErr_Occurred()) { Py_DECREF(fast); return nullptr; }
+      starts.push_back((uint32_t)v);
+    }
+    Py_DECREF(fast);
+  }
+  if (starts.empty() || starts[0] != 0) {
+    PyErr_SetString(PyExc_ValueError,
+                    "encode_point_keys: partition starts must begin at 0");
+    return nullptr;
+  }
+
+  PyObject* rows_fast = PySequence_Fast(rows, "encode_point_keys: rows");
+  if (rows_fast == nullptr) return nullptr;
+  Py_ssize_t nrows = PySequence_Fast_GET_SIZE(rows_fast);
+  PyObject* out = PyList_New(nrows);
+  if (out == nullptr) { Py_DECREF(rows_fast); return nullptr; }
+
+  Buf key, hashbuf;  // reused per row
+  size_t ncomp = hash_dt.size() + range_dt.size();
+  for (Py_ssize_t i = 0; i < nrows; i++) {
+    PyObject* row_fast = PySequence_Fast(
+        PySequence_Fast_GET_ITEM(rows_fast, i), "encode_point_keys: row");
+    if (row_fast == nullptr) goto fail;
+    if ((size_t)PySequence_Fast_GET_SIZE(row_fast) != ncomp) {
+      PyErr_SetString(PyExc_ValueError,
+                      "encode_point_keys: row arity mismatch");
+      Py_DECREF(row_fast);
+      goto fail;
+    }
+    {
+      key.len = 0;
+      hashbuf.len = 0;
+      bool ok = true;
+      for (size_t c = 0; ok && c < hash_dt.size(); c++) {
+        ok = encode_key_component(
+            &hashbuf, PySequence_Fast_GET_ITEM(row_fast, (Py_ssize_t)c),
+            hash_dt[c]);
+      }
+      uint16_t h = 0;
+      size_t part = 0;
+      if (ok) {
+        h = hash_code_of(hashbuf);
+        part = std::upper_bound(starts.begin(), starts.end(),
+                                (uint32_t)h) - starts.begin() - 1;
+        ok = ybtag::buf_putc(&key, K_HASH) &&
+             ybtag::buf_putc(&key, (unsigned char)(h >> 8)) &&
+             ybtag::buf_putc(&key, (unsigned char)(h & 0xFF)) &&
+             ybtag::buf_put(&key, hashbuf.data, hashbuf.len) &&
+             ybtag::buf_putc(&key, K_GROUP_END);
+      }
+      for (size_t c = 0; ok && c < range_dt.size(); c++) {
+        ok = encode_key_component(
+            &key,
+            PySequence_Fast_GET_ITEM(row_fast,
+                                     (Py_ssize_t)(hash_dt.size() + c)),
+            range_dt[c]);
+      }
+      if (ok && full) ok = ybtag::buf_putc(&key, K_GROUP_END);
+      Py_DECREF(row_fast);
+      if (!ok) goto fail;
+      PyObject* entry = Py_BuildValue(
+          "(ny#)", (Py_ssize_t)part, key.data, (Py_ssize_t)key.len);
+      if (entry == nullptr) goto fail;
+      PyList_SET_ITEM(out, i, entry);
+    }
+  }
+  Py_DECREF(rows_fast);
+  return out;
+
+fail:
+  Py_DECREF(rows_fast);
+  Py_DECREF(out);
+  return nullptr;
+}
+
+// -- module ------------------------------------------------------------------
+
+PyMethodDef kMethods[] = {
+    {"parse_resp", py_parse_resp, METH_O,
+     "parse_resp(data) -> (commands, consumed) | None "
+     "(None = fall back to yql.redis.resp.parse_commands)"},
+    {"encode_point_keys", py_encode_point_keys, METH_VARARGS,
+     "encode_point_keys(hash_dtypes, range_dtypes, rows, starts, full) "
+     "-> [(partition_index, key_bytes)]"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "yb_rb",
+    "native request-batch serving: RESP batch parse + point-key routing",
+    -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_yb_rb() {
+  return PyModule_Create(&kModule);
+}

@@ -28,6 +28,7 @@
 //
 // Exposed as the CPython extension module `yb_wp`.
 
+#include "keycodec.h"
 #include "tagcodec.h"
 
 #include <algorithm>
@@ -40,171 +41,9 @@ namespace {
 
 using ybtag::Buf;
 using ybtag::Reader;
+using namespace ybkey;  // key tags, dtype codes, crc32, LE scalar helpers
 
 constexpr uint64_t kMaxHT = (1ULL << 63) - 1;
-
-// Key-encoding tags (yugabyte_db_tpu/models/encoding.py).
-enum KeyTag : unsigned char {
-  K_GROUP_END = 0x01,
-  K_NULL = 0x04,
-  K_HASH = 0x08,
-  K_FALSE = 0x10,
-  K_TRUE = 0x11,
-  K_INT = 0x20,
-  K_DOUBLE = 0x28,
-  K_STRING = 0x30,
-  K_BINARY = 0x32,
-};
-
-// dtype codes passed from Python (models/datatypes.py key kinds).
-enum DtypeCode { DT_BOOL = 0, DT_INT = 1, DT_DOUBLE = 2, DT_STR = 3,
-                 DT_BIN = 4 };
-
-// -- crc32 (zlib-compatible) -------------------------------------------------
-
-const uint32_t* crc_table() {
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
-    for (uint32_t i = 0; i < 256; i++) {
-      uint32_t c = i;
-      for (int k = 0; k < 8; k++) {
-        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      }
-      table[i] = c;
-    }
-    init = true;
-  }
-  return table;
-}
-
-uint32_t crc32(const unsigned char* p, size_t n) {
-  const uint32_t* t = crc_table();
-  uint32_t c = 0xFFFFFFFFu;
-  for (size_t i = 0; i < n; i++) {
-    c = t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-  }
-  return c ^ 0xFFFFFFFFu;
-}
-
-// -- little-endian scalar writes --------------------------------------------
-
-bool put_u16(Buf* b, uint16_t v) { return ybtag::buf_put(b, &v, 2); }
-bool put_u32(Buf* b, uint32_t v) { return ybtag::buf_put(b, &v, 4); }
-bool put_u64(Buf* b, uint64_t v) { return ybtag::buf_put(b, &v, 8); }
-bool put_i64(Buf* b, int64_t v) { return ybtag::buf_put(b, &v, 8); }
-
-uint16_t get_u16(const unsigned char* p) { uint16_t v; memcpy(&v, p, 2); return v; }
-uint32_t get_u32(const unsigned char* p) { uint32_t v; memcpy(&v, p, 4); return v; }
-uint64_t get_u64(const unsigned char* p) { uint64_t v; memcpy(&v, p, 8); return v; }
-int64_t get_i64(const unsigned char* p) { int64_t v; memcpy(&v, p, 8); return v; }
-
-// -- key-component encoding (parity with models/encoding.py) -----------------
-
-bool key_put_int(Buf* b, long long x) {
-  // Sign-flip maps signed order onto unsigned byte order; big-endian.
-  uint64_t biased = static_cast<uint64_t>(x) + (1ULL << 63);
-  unsigned char be[8];
-  for (int i = 7; i >= 0; i--) { be[i] = biased & 0xFF; biased >>= 8; }
-  return ybtag::buf_putc(b, K_INT) && ybtag::buf_put(b, be, 8);
-}
-
-bool key_put_double(Buf* b, double d) {
-  if (d == 0.0) d = 0.0;  // canonicalize -0.0
-  uint64_t bits;
-  memcpy(&bits, &d, 8);
-  if (bits & (1ULL << 63)) {
-    bits = ~bits;                 // negative: flip all bits
-  } else {
-    bits |= 1ULL << 63;           // positive: flip sign bit
-  }
-  unsigned char be[8];
-  for (int i = 7; i >= 0; i--) { be[i] = bits & 0xFF; bits >>= 8; }
-  return ybtag::buf_putc(b, K_DOUBLE) && ybtag::buf_put(b, be, 8);
-}
-
-bool key_put_escaped(Buf* b, const unsigned char* p, size_t n) {
-  // 0x00 -> 0x00 0x01, terminated 0x00 0x00 (ZeroEncodeAndAppendStrToKey).
-  for (size_t i = 0; i < n; i++) {
-    if (!ybtag::buf_putc(b, p[i])) return false;
-    if (p[i] == 0 && !ybtag::buf_putc(b, 0x01)) return false;
-  }
-  return ybtag::buf_putc(b, 0x00) && ybtag::buf_putc(b, 0x00);
-}
-
-// Encode one key column value as [tag][payload]. Returns false with a
-// Python error set on unsupported value.
-bool encode_key_component(Buf* b, PyObject* v, int dtype) {
-  if (v == Py_None) return ybtag::buf_putc(b, K_NULL);
-  switch (dtype) {
-    case DT_BOOL: {
-      int truth = PyObject_IsTrue(v);
-      if (truth < 0) return false;
-      return ybtag::buf_putc(b, truth ? K_TRUE : K_FALSE);
-    }
-    case DT_INT: {
-      long long x;
-      if (PyLong_Check(v)) {
-        int overflow = 0;
-        x = PyLong_AsLongLongAndOverflow(v, &overflow);
-        if (overflow != 0) {
-          PyErr_SetString(PyExc_ValueError,
-                          "integer key value out of int64 range");
-          return false;
-        }
-        if (x == -1 && PyErr_Occurred()) return false;
-      } else {
-        PyObject* as_int = PyNumber_Long(v);
-        if (as_int == nullptr) return false;
-        x = PyLong_AsLongLong(as_int);
-        Py_DECREF(as_int);
-        if (x == -1 && PyErr_Occurred()) return false;
-      }
-      return key_put_int(b, x);
-    }
-    case DT_DOUBLE: {
-      double d = PyFloat_AsDouble(v);
-      if (d == -1.0 && PyErr_Occurred()) return false;
-      return key_put_double(b, d);
-    }
-    case DT_STR: {
-      if (!PyUnicode_Check(v)) {
-        PyErr_Format(PyExc_TypeError, "string key value must be str, not %s",
-                     Py_TYPE(v)->tp_name);
-        return false;
-      }
-      PyObject* raw = PyUnicode_AsEncodedString(v, "utf-8", "surrogateescape");
-      if (raw == nullptr) return false;
-      char* p;
-      Py_ssize_t n;
-      if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
-        Py_DECREF(raw);
-        return false;
-      }
-      bool ok = ybtag::buf_putc(b, K_STRING) &&
-                key_put_escaped(b, (const unsigned char*)p, (size_t)n);
-      Py_DECREF(raw);
-      return ok;
-    }
-    case DT_BIN: {
-      PyObject* raw = PyBytes_FromObject(v);
-      if (raw == nullptr) return false;
-      char* p;
-      Py_ssize_t n;
-      if (PyBytes_AsStringAndSize(raw, &p, &n) < 0) {
-        Py_DECREF(raw);
-        return false;
-      }
-      bool ok = ybtag::buf_putc(b, K_BINARY) &&
-                key_put_escaped(b, (const unsigned char*)p, (size_t)n);
-      Py_DECREF(raw);
-      return ok;
-    }
-    default:
-      PyErr_Format(PyExc_ValueError, "bad key dtype code %d", dtype);
-      return false;
-  }
-}
 
 // -- record writer -----------------------------------------------------------
 
@@ -1966,6 +1805,120 @@ PyObject* mt_drain_run(MemtableObject* self, PyObject* args) {
       "n", (Py_ssize_t)n);
 }
 
+// point_lookup(keys, read_ht, col_id) -> list (one entry per key)
+//
+// The request-batch read executor: replicate storage/merge.py
+// merge_versions for ONE projected column over a batch of encoded
+// DocKeys, returning the winning value's raw tagged payload so the
+// serving layer can emit reply bytes without building a Python value
+// per row. Entries:
+//   bytes — payload of the winning T_STR/T_BYTES value (exactly
+//           str.encode('utf-8','surrogateescape') for strings, so RESP
+//           bulk replies slice it verbatim)
+//   None  — key absent, row shadowed/tombstoned, column unset, explicit
+//           NULL, or TTL-expired (expiry reads NULL but still shadows)
+//   False — winning value is not a string/bytes: not definitive here,
+//           the caller must fall back to the Python path for this key.
+PyObject* mt_point_lookup(MemtableObject* self, PyObject* args) {
+  PyObject* keys;
+  long long read_ht_s;
+  unsigned long col_id;
+  if (!PyArg_ParseTuple(args, "OLk", &keys, &read_ht_s, &col_id)) {
+    return nullptr;
+  }
+  uint64_t read_ht = (uint64_t)read_ht_s;
+  PyObject* fast = PySequence_Fast(keys, "point_lookup: keys");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject* out = PyList_New(n);
+  if (out == nullptr) { Py_DECREF(fast); return nullptr; }
+  std::vector<const Ver*> vis;  // reused per key
+  std::string key;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    char* kp;
+    Py_ssize_t klen;
+    if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i), &kp,
+                                &klen) < 0) {
+      Py_DECREF(out);
+      Py_DECREF(fast);
+      return nullptr;
+    }
+    key.assign(kp, (size_t)klen);
+    auto it = self->data->map.find(key);
+    PyObject* entry = nullptr;
+    if (it == self->data->map.end()) {
+      entry = Py_NewRef(Py_None);
+    } else {
+      const std::vector<Ver>& vers = it->second;
+      uint64_t tomb_ht = 0;
+      for (const Ver& v : vers) {
+        if (v.ht <= read_ht && (v.flags & 1) && v.ht > tomb_ht) {
+          tomb_ht = v.ht;
+        }
+      }
+      vis.clear();
+      for (const Ver& v : vers) {
+        if (v.ht > read_ht || v.ht <= tomb_ht || (v.flags & 1)) continue;
+        vis.push_back(&v);
+      }
+      std::stable_sort(vis.begin(), vis.end(),
+                       [](const Ver* a, const Ver* b) {
+                         if (a->ht != b->ht) return a->ht > b->ht;
+                         return a->write_id > b->write_id;
+                       });
+      for (const Ver* v : vis) {
+        Reader r{(const unsigned char*)v->cols.data(), v->cols.size()};
+        bool found = false, bad = false;
+        for (uint16_t ci = 0; ci < v->ncols; ci++) {
+          if (r.len - r.pos < 4) { bad = true; break; }
+          uint32_t cid = get_u32(r.data + r.pos);
+          r.pos += 4;
+          if (cid != (uint32_t)col_id) {
+            if (!ybtag::skip_obj(&r, 0)) { bad = true; PyErr_Clear(); }
+            if (bad) break;
+            continue;
+          }
+          found = true;
+          bool expired = v->expire_ht != kMaxHT && read_ht >= v->expire_ht;
+          if (expired || r.pos >= r.len) {
+            entry = expired ? Py_NewRef(Py_None) : nullptr;
+            if (entry == nullptr) bad = true;
+            break;
+          }
+          unsigned char tag = r.data[r.pos++];
+          if (tag == ybtag::T_NONE) {
+            entry = Py_NewRef(Py_None);
+          } else if (tag == ybtag::T_STR || tag == ybtag::T_BYTES) {
+            uint64_t plen;
+            if (!ybtag::read_varint(&r, &plen) ||
+                r.len - r.pos < plen) {
+              PyErr_Clear();
+              bad = true;
+            } else {
+              entry = PyBytes_FromStringAndSize(
+                  (const char*)(r.data + r.pos), (Py_ssize_t)plen);
+              if (entry == nullptr) {
+                Py_DECREF(out);
+                Py_DECREF(fast);
+                return nullptr;
+              }
+            }
+          } else {
+            entry = Py_NewRef(Py_False);  // non-string value: fall back
+          }
+          break;
+        }
+        if (bad) { entry = Py_NewRef(Py_False); }
+        if (found || bad) break;  // newest setter wins (even as NULL)
+      }
+      if (entry == nullptr) entry = Py_NewRef(Py_None);  // no setter
+    }
+    PyList_SET_ITEM(out, i, entry);
+  }
+  Py_DECREF(fast);
+  return out;
+}
+
 PyObject* mt_stats(MemtableObject* self, PyObject*) {
   return Py_BuildValue(
       "{s:n,s:n,s:N,s:N}",
@@ -2007,6 +1960,9 @@ PyMethodDef kMemtableMethods[] = {
      "has_keys(lower, upper) -> any key in [lower, upper)"},
     {"drain_sorted", (PyCFunction)mt_drain_sorted, METH_NOARGS,
      "drain_sorted() -> [(key, [row tuples ht-desc])] in key order"},
+    {"point_lookup", (PyCFunction)mt_point_lookup, METH_VARARGS,
+     "point_lookup(keys, read_ht, col_id) -> [payload bytes | None | "
+     "False] (False = not definitive, fall back to the Python path)"},
     {"drain_run", (PyCFunction)mt_drain_run, METH_VARARGS,
      "drain_run(R, key_words, coldesc) -> flat packed run buffers "
      "(the native flush path; see storage/columnar.py)"},

@@ -25,6 +25,9 @@ import pytest
 
 from yugabyte_db_tpu.tools.yb_ctl import ClusterCtl, _pid_alive
 
+# Excluded from tier-1 (-m 'not slow'): multi-minute rig, full runs keep it.
+pytestmark = pytest.mark.slow
+
 KILL_CYCLES = 20
 
 

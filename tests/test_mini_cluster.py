@@ -15,6 +15,9 @@ from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
 from yugabyte_db_tpu.storage.scan_spec import AggSpec, Predicate, ScanSpec
 
+# Excluded from tier-1 (-m 'not slow'): multi-minute rig, full runs keep it.
+pytestmark = pytest.mark.slow
+
 COLUMNS = [
     ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
     ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),

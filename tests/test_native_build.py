@@ -160,6 +160,39 @@ def test_native_build_rich_types_fall_back():
         assert_runs_equal(generic, native)
 
 
+def test_native_servebatch_builds_and_parses():
+    """The request-batch module (native/servebatch.cc -> yb_rb) rides the
+    same build-on-first-import as yb_codec/yb_wp; its strict RESP parser
+    must agree with the pure-Python one on commands AND bytes consumed,
+    and return None (nothing consumed) for the inline form so error
+    behavior stays with the canonical Python path."""
+    from yugabyte_db_tpu import native as native_pkg
+    from yugabyte_db_tpu.yql.redis import resp
+    yb_rb = native_pkg.yb_rb
+    if yb_rb is None:
+        if native_pkg.yb_wp is not None:
+            pytest.fail("toolchain built yb_wp but not yb_rb")
+        pytest.skip("native toolchain unavailable")
+    cmds = ([["SET", f"k{i:04d}", "v" * (i % 9)] for i in range(40)]
+            + [["GET", f"k{i:04d}"] for i in range(40)]
+            + [["MGET", "k0001", "\x00bin\r\n$", ""]])
+    buf = bytearray()
+    for args in cmds:
+        buf += b"*%d\r\n" % len(args)
+        for a in args:
+            ab = a.encode("utf-8", "surrogateescape")
+            buf += b"$%d\r\n" % len(ab) + ab + b"\r\n"
+    buf += b"*0\r\n"                             # empty array: skipped
+    buf += b"*2\r\n$3\r\nGET\r\n$7\r\nk000"      # incomplete tail: left
+    got = yb_rb.parse_resp(buf)
+    assert got is not None
+    native_cmds, consumed = got
+    pybuf = bytearray(buf)
+    assert native_cmds == resp.parse_commands(pybuf)
+    assert consumed == len(buf) - len(pybuf)
+    assert yb_rb.parse_resp(bytearray(b"PING\r\n")) is None
+
+
 def test_flush_uses_native_and_engine_diff_holds():
     schema = make_schema()
     rows = make_rows(schema, n=500, seed=4)

@@ -1,0 +1,304 @@
+"""Engine-diff interop for the native request-batch serving path
+(docs/serving-path.md): pipelined RESP GET/SET/MGET and prepared CQL
+point SELECTs must produce BYTE-IDENTICAL replies whether a batch is
+served by the native C++ executors or by the per-op Python path they
+shortcut — including when the native module is not built at all.
+
+Reference analog: the reference proves proxy fidelity with stock
+drivers (java/yb-jedis-tests, java/yb-cql); here the two server-side
+execution paths are diffed against each other at the socket byte level.
+"""
+
+import socket
+
+import pytest
+
+from yugabyte_db_tpu.integration import MiniCluster
+from yugabyte_db_tpu.yql.cql import wire_protocol as W
+from yugabyte_db_tpu.yql.cql import processor as procmod
+from yugabyte_db_tpu.yql.cql.client_cluster import ClientCluster
+from yugabyte_db_tpu.yql.cql.server import CQLServer
+from yugabyte_db_tpu.yql.redis import RedisServer
+from yugabyte_db_tpu.yql.redis import resp as respmod
+from yugabyte_db_tpu.yql.redis import server as redismod
+
+try:
+    from yugabyte_db_tpu.native import yb_rb as _yb_rb
+except ImportError:  # pragma: no cover - native module not built
+    _yb_rb = None
+
+needs_native = pytest.mark.skipif(
+    _yb_rb is None, reason="native yb_rb module not built")
+
+
+# -- redis -------------------------------------------------------------------
+
+def _resp_encode(cmds):
+    out = []
+    for args in cmds:
+        out.append(f"*{len(args)}\r\n".encode())
+        for a in args:
+            b = a.encode() if isinstance(a, str) else a
+            out.append(f"${len(b)}\r\n".encode() + b + b"\r\n")
+    return b"".join(out)
+
+
+def _read_replies(sock, n):
+    """Raw bytes of exactly n RESP replies (nested arrays counted as
+    one), so byte-level diffs cover framing, not just values."""
+    buf = bytearray()
+
+    def need(k):
+        while len(buf) < k:
+            chunk = sock.recv(65536)
+            assert chunk, "connection closed"
+            buf.extend(chunk)
+
+    pos = 0
+
+    def line():
+        nonlocal pos
+        while True:
+            i = buf.find(b"\r\n", pos)
+            if i >= 0:
+                break
+            need(len(buf) + 1)
+        s = bytes(buf[pos:i])
+        pos = i + 2
+        return s
+
+    def one():
+        nonlocal pos
+        ln = line()
+        t = ln[:1]
+        if t in (b"+", b"-", b":"):
+            return
+        if t == b"$":
+            k = int(ln[1:])
+            if k >= 0:
+                need(pos + k + 2)
+                pos += k + 2
+            return
+        assert t == b"*", ln
+        cnt = int(ln[1:])
+        for _ in range(max(cnt, 0)):
+            one()
+
+    for _ in range(n):
+        one()
+    assert pos == len(buf), "unexpected trailing bytes"
+    return bytes(buf)
+
+
+@pytest.fixture
+def redis_rig(tmp_path):
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3).start()
+    c.wait_tservers_registered()
+    server = RedisServer(c.client("redis-proxy"))
+    host, port = server.listen("127.0.0.1", 0)
+
+    def run(cmds):
+        s = socket.create_connection((host, port), timeout=10)
+        try:
+            s.sendall(_resp_encode(cmds))
+            return _read_replies(s, len(cmds))
+        finally:
+            s.close()
+
+    yield run
+    server.shutdown()
+    c.shutdown()
+
+
+PIPELINE = ([("SET", f"k{i}", f"v{i}") for i in range(40)]
+            + [("GET", f"k{i}") for i in range(40)]
+            + [("GET", "missing"), ("GET", "k7"),
+               ("MGET", "k1", "missing", "k2"),
+               ("SET", "k1", "v1b"), ("GET", "k1"),
+               ("MSET", "a", "1", "b", "2"), ("MGET", "a", "b", "c")])
+
+
+@needs_native
+def test_redis_pipeline_native_vs_python_byte_identical(redis_rig,
+                                                        monkeypatch):
+    native = redis_rig(PIPELINE)
+    served = []
+    orig = redismod.RedisServiceImpl._native_get_values
+
+    def spy(self, rkeys):
+        v = orig(self, rkeys)
+        served.append(v is not None)
+        return v
+
+    monkeypatch.setattr(redismod.RedisServiceImpl, "_native_get_values",
+                        spy)
+    again = redis_rig(PIPELINE)
+    assert served and all(served), "native batch path never served"
+    assert again == native
+    # identical pipeline with the native read path disabled entirely
+    monkeypatch.setattr(redismod.RedisServiceImpl, "_native_get_values",
+                        lambda self, rkeys: None)
+    fallback = redis_rig(PIPELINE)
+    assert fallback == native
+
+
+def test_redis_pipeline_without_native_module(redis_rig, monkeypatch):
+    """The whole pipeline (parse included) must behave identically when
+    the native module is absent — the not-built deployment shape."""
+    expected = redis_rig(PIPELINE)
+    monkeypatch.setattr(respmod, "_yb_rb", None)
+    monkeypatch.setattr(redismod, "_yb_rb", None)
+    assert redis_rig(PIPELINE) == expected
+
+
+# -- CQL ---------------------------------------------------------------------
+
+class _CqlWire:
+    """Minimal CQL v4 raw-frame client that can pipeline many EXECUTE
+    frames in one socket write and hand back each reply frame verbatim."""
+
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        w = W.Writer()
+        w.short(1)
+        w.string("CQL_VERSION").string("3.4.4")
+        self._send(0, W.OP_STARTUP, w.getvalue())
+        _s, opcode, _b = self.recv_frame()
+        assert opcode == W.OP_READY
+
+    def close(self):
+        self.sock.close()
+
+    def _send(self, stream, opcode, body):
+        self.sock.sendall(
+            W.HEADER.pack(W.VERSION_REQ, 0, stream, opcode, len(body))
+            + body)
+
+    def _recvn(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            assert chunk, "connection closed"
+            buf += chunk
+        return buf
+
+    def recv_frame(self):
+        hdr = self._recvn(W.HEADER.size)
+        _v, _f, stream, opcode, length = W.HEADER.unpack(hdr)
+        return stream, opcode, self._recvn(length)
+
+    def query(self, cql):
+        self._send(1, W.OP_QUERY,
+                   W.Writer().long_string(cql).short(1).byte(0).getvalue())
+        _s, opcode, body = self.recv_frame()
+        assert opcode == W.OP_RESULT, body
+        return body
+
+    def prepare(self, cql):
+        self._send(1, W.OP_PREPARE,
+                   W.Writer().long_string(cql).getvalue())
+        _s, opcode, body = self.recv_frame()
+        assert opcode == W.OP_RESULT, body
+        r = W.Reader(body)
+        assert r.int32() == W.RESULT_PREPARED
+        return r.short_bytes()
+
+    def execute_many(self, frames):
+        """frames: [(stream, stmt_id, [raw_value_bytes])]. All sent in
+        ONE write (the pipelined shape the batch path coalesces);
+        returns {stream: (opcode, body)} for byte-level comparison."""
+        out = []
+        for stream, stmt_id, values in frames:
+            w = W.Writer().short_bytes(stmt_id)
+            w.short(1).byte(0x01 if values else 0)
+            if values:
+                w.short(len(values))
+                for v in values:
+                    w.bytes_(v)
+            out.append(W.HEADER.pack(W.VERSION_REQ, 0, stream,
+                                     W.OP_EXECUTE, len(w.getvalue()))
+                       + w.getvalue())
+        self.sock.sendall(b"".join(out))
+        replies = {}
+        for _ in range(len(frames)):
+            stream, opcode, body = self.recv_frame()
+            assert stream not in replies
+            replies[stream] = (opcode, body)
+        assert set(replies) == {f[0] for f in frames}
+        return replies
+
+
+@pytest.fixture
+def cql_rig(tmp_path):
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3).start()
+    c.wait_tservers_registered()
+    server = CQLServer(ClientCluster(c.client()))
+    host, port = server.listen("127.0.0.1", 0)
+    cli = _CqlWire(host, port)
+    cli.query("CREATE KEYSPACE sp")
+    cli.query("USE sp")
+    cli.query("CREATE TABLE t (k bigint PRIMARY KEY, v text, d double)")
+    for i in range(30):
+        cli.query(f"INSERT INTO t (k, v, d) VALUES ({i}, 'val{i}', "
+                  f"{i * 0.5})")
+    yield cli
+    cli.close()
+    server.shutdown()
+    c.shutdown()
+
+
+def _i64(v):
+    return v.to_bytes(8, "big", signed=True)
+
+
+def test_cql_prepared_point_select_batch_byte_identical(cql_rig,
+                                                        monkeypatch):
+    sel = cql_rig.prepare("SELECT k, v, d FROM t WHERE k = ?")
+    frames = [(100 + i, sel, [_i64(i)]) for i in range(20)]
+    frames += [(200, sel, [_i64(999)]),             # miss -> empty rows
+               (201, b"\x00" * 16, [_i64(1)])]      # unknown stmt -> error
+    served = []
+    orig = procmod.QLProcessor.execute_wire_point_batch
+
+    def spy(self, items):
+        out = orig(self, items)
+        served.extend(r is not None for r in out)
+        return out
+
+    monkeypatch.setattr(procmod.QLProcessor, "execute_wire_point_batch",
+                        spy)
+    batched = cql_rig.execute_many(frames)
+    assert served and any(served), "batch path never served a frame"
+    assert batched[201][0] == W.OP_ERROR
+    # Same frames with the batch executor refusing everything: each
+    # frame runs the canonical per-op handle_call path.
+    monkeypatch.setattr(procmod.QLProcessor, "execute_wire_point_batch",
+                        lambda self, items: [None] * len(items))
+    fallback = cql_rig.execute_many(frames)
+    assert fallback == batched
+
+
+def test_cql_batch_mixed_with_nonpoint_select(cql_rig):
+    """A pipelined window mixing point SELECTs with a full-table scan:
+    the scan falls back per-op inside the SAME batch and every reply
+    stays stream-paired."""
+    sel = cql_rig.prepare("SELECT v FROM t WHERE k = ?")
+    scan = cql_rig.prepare("SELECT k FROM t")
+    frames = [(1, sel, [_i64(3)]), (2, scan, []), (3, sel, [_i64(4)])]
+    replies = cql_rig.execute_many(frames)
+    for stream in (1, 2, 3):
+        opcode, body = replies[stream]
+        assert opcode == W.OP_RESULT
+        assert W.Reader(body).int32() == W.RESULT_ROWS
+    # the scan really returned the whole table
+    r = W.Reader(replies[2][1])
+    assert r.int32() == W.RESULT_ROWS
+    flags = r.int32()
+    ncols = r.int32()
+    if flags & 0x0002:
+        r.bytes_()
+    if flags & 0x0001:
+        r.string(); r.string()
+    for _ in range(ncols):
+        r.string(); r.short()
+    assert r.int32() == 30

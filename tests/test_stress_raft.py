@@ -21,6 +21,9 @@ from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
 from yugabyte_db_tpu.storage.scan_spec import ScanSpec
 from yugabyte_db_tpu.utils.fault_injection import arm_fault_once, clear_faults
 
+# Excluded from tier-1 (-m 'not slow'): multi-minute rig, full runs keep it.
+pytestmark = pytest.mark.slow
+
 COLUMNS = [ColumnSchema("k", DataType.INT64, ColumnKind.HASH),
            ColumnSchema("v", DataType.INT64)]
 

@@ -9,6 +9,9 @@ extension modules next to this package:
 - ``yb_wp``   (native/writeplane.cc) — the write plane: row-block batch
   encoding (doc keys + partition hash + per-tablet split), leader-side
   hybrid-time stamping, and the C++ memtable.
+- ``yb_rb``   (native/servebatch.cc) — the request-batch serving path:
+  RESP batch parsing and redis doc-key encoding for whole pipelined
+  windows (docs/serving-path.md).
 
 If an extension is missing, we try ONE quiet `make -C native` (the
 toolchain is a build requirement, not a runtime one — pure-Python
@@ -22,7 +25,7 @@ import os
 import subprocess
 import sys
 
-_MODS = ("yb_codec", "yb_wp")
+_MODS = ("yb_codec", "yb_wp", "yb_rb")
 
 
 def _import_each():
@@ -53,7 +56,8 @@ def _load():
     # per process (a doomed `make` at import time would tax every CLI run).
     stamp = os.path.join(src, ".build_failed")
     sources = [os.path.join(src, n)
-               for n in ("codec.cc", "writeplane.cc", "tagcodec.h")]
+               for n in ("codec.cc", "writeplane.cc", "servebatch.cc",
+                         "tagcodec.h", "keycodec.h")]
     try:
         if os.path.exists(stamp) and all(
                 os.path.getmtime(stamp) >= os.path.getmtime(s)
@@ -83,3 +87,4 @@ def _load():
 _loaded = _load()
 yb_codec = _loaded.get("yb_codec")
 yb_wp = _loaded.get("yb_wp")
+yb_rb = _loaded.get("yb_rb")

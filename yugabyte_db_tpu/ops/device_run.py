@@ -84,3 +84,18 @@ class DeviceRun:
     @property
     def num_windows(self) -> int:
         return self.B // self.K
+
+    @property
+    def nbytes(self) -> int:
+        """Device-resident bytes of this run's planes — the HBM
+        footprint the engine accounts under the root->device MemTracker
+        subtree (/memz)."""
+        total = 0
+        stack = [self.arrays]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, dict):
+                stack.extend(node.values())
+            else:
+                total += int(node.size) * node.dtype.itemsize
+        return total

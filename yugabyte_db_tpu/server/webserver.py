@@ -123,6 +123,15 @@ class Webserver:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     body = ws.registry.prometheus_text()
+                    # Cross-cutting process-wide series (swallowed
+                    # errors, serving-path batch histograms) render on
+                    # every daemon's scrape — they have no daemon
+                    # registry of their own.
+                    from yugabyte_db_tpu.utils.metrics import \
+                        process_registry
+
+                    if process_registry() is not ws.registry:
+                        body += process_registry().prometheus_text()
                     ctype = "text/plain; version=0.0.4"
                 elif path in ws._handlers:
                     fn, ctype = ws._handlers[path]

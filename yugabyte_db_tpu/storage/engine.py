@@ -105,6 +105,23 @@ class StorageEngine(abc.ABC):
         return [wire_from_result(self, r, fmt)
                 for r in self.scan_batch(specs)]
 
+    def point_serve(self, keys: list[bytes], read_ht: int, col_id: int):
+        """Batch point-value lookup for the native request-batch serving
+        path: one value column of each full-doc-key row, straight from
+        the native memtable. Returns ``None`` when this engine cannot
+        answer the batch definitively (sorted runs on disk, non-native
+        memtable, spilled rows) — the caller falls back to the general
+        read path. Otherwise a list aligned with ``keys`` whose entries
+        are payload ``bytes``, ``None`` (absent row / NULL column), or
+        ``False`` (value not natively servable: fall back per key)."""
+        if getattr(self, "runs", None):
+            return None
+        lookup = getattr(getattr(self, "memtable", None),
+                         "point_lookup", None)
+        if lookup is None:
+            return None
+        return lookup(keys, read_ht, col_id)
+
     # -- lifecycle ---------------------------------------------------------
     @abc.abstractmethod
     def flush(self) -> None:

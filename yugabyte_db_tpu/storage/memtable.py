@@ -199,6 +199,14 @@ class NativeMemTable:
             return None
         return merge_versions(key, versions, read_ht)
 
+    def point_lookup(self, keys: list[bytes], read_ht: int, col_id: int):
+        """Batch point-column lookup served entirely in C++ (the native
+        request-batch path). Returns None when spilled rows exist — the
+        spill may shadow any key, so no answer is definitive."""
+        if self._spill:
+            return None
+        return self._mt.point_lookup(keys, read_ht, col_id)
+
     def drain_sorted(self) -> list[tuple[bytes, list[RowVersion]]]:
         native = [(k, [RowVersion(*t) for t in vers])
                   for k, vers in self._mt.drain_sorted()]

@@ -50,7 +50,8 @@ from yugabyte_db_tpu.storage.merge import merge_versions
 from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.utils import planes as P
-from yugabyte_db_tpu.utils.metrics import count_swallowed
+from yugabyte_db_tpu.utils.metrics import (count_host_verify_rows,
+                                           count_swallowed)
 
 WINDOW_BLOCKS = 8          # blocks per device dispatch on the row path
 PAD_BLOCKS = 64            # run block-axis padding (multiple of every window)
@@ -124,6 +125,14 @@ class TpuStorageEngine(StorageEngine):
             crun = ColumnarRun.build(self.schema, entries, self.rows_per_block)
             self.runs.append(TpuRun(crun))
             self.flushed_frontier_ht = max(self.flushed_frontier_ht, crun.max_ht)
+        # Device-plane accounting: the runs' HBM-resident plane bytes,
+        # a sibling subtree of memstore so /memz shows both residencies.
+        from yugabyte_db_tpu.utils.memtracker import root_tracker
+
+        self.device_tracker = root_tracker().child("device").child(
+            self.mem_tracker.name)
+        self._tracked_device_bytes = 0
+        self._track_device()
 
     # -- writes ------------------------------------------------------------
     def apply(self, rows: list[RowVersion]) -> None:
@@ -186,6 +195,7 @@ class TpuStorageEngine(StorageEngine):
             trun.host_index = None  # column planes changed shape/set
             if changed:
                 trun.dev = DeviceRun(crun, PAD_BLOCKS)
+        self._track_device()
 
     def flush(self) -> None:
         from yugabyte_db_tpu.utils.sync_point import sync_point
@@ -211,6 +221,7 @@ class TpuStorageEngine(StorageEngine):
         self.memtable = make_memtable()
         self._plan_cache.clear()
         self._track_memstore()
+        self._track_device()
         if len(self.runs) > 1:
             self._warm_overlay_scatter()
         sync_point("tpu_engine:flush:done")
@@ -305,6 +316,20 @@ class TpuStorageEngine(StorageEngine):
                                      if self.persist.enabled else [])
         self.runs = [TpuRun(crun)] if crun is not None else []
         self._plan_cache.clear()
+        self._track_device()
+
+    def _track_device(self) -> None:
+        """Sync the device tracker with the current runs' plane bytes.
+        Called whenever the run set changes (flush/compact/restore)."""
+        current = sum(t.dev.nbytes for t in self.runs)
+        delta = current - self._tracked_device_bytes
+        if delta:
+            self.device_tracker.consume(delta)
+            self._tracked_device_bytes = current
+
+    def close(self) -> None:
+        self.device_tracker.detach()
+        super().close()
 
     def _device_compact_entries(self, cutoff: int):
         """Device merge+GC -> (entries, merged ColumnarRun), or None when
@@ -629,6 +654,7 @@ class TpuStorageEngine(StorageEngine):
         else:
             self.runs = []
         self._plan_cache.clear()
+        self._track_device()
 
     def dump_entries(self):
         """All flushed (key, versions ht-desc) pairs, key-merged across
@@ -644,6 +670,7 @@ class TpuStorageEngine(StorageEngine):
             "memtable_versions": self.memtable.num_versions,
             "run_versions": sum(t.crun.num_versions for t in self.runs),
             "flushed_frontier_ht": self.flushed_frontier_ht,
+            "device_bytes": self._tracked_device_bytes,
         }
 
     # -- scan plumbing ------------------------------------------------------
@@ -1578,6 +1605,11 @@ class TpuStorageEngine(StorageEngine):
             if name in _kp:
                 return crun.key_vals_at(int(_s[i]))[_kp[name]]
             return _cv[self._name_to_id[name]][i]
+        if verify_preds and n:
+            # Every fetched row crosses back for host re-verification
+            # when the device mask is a superset (string predicates) —
+            # yb_scan_host_verify_rows makes that cliff measurable.
+            count_host_verify_rows(int(n))
         taken_i = -1
         for i in range(n):
             if verify_preds and not all(

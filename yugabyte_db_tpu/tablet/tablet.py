@@ -423,6 +423,20 @@ class Tablet:
         storage hop — point gets share the bloom/merge machinery)."""
         return self.engine.scan_batch(specs)
 
+    def scan_wire_many(self, specs: list[ScanSpec], fmt: str = "cql"):
+        """One engine batch of wire-serialized scans — the batched read
+        RPC's storage hop for the native request-batch serving path."""
+        return self.engine.scan_batch_wire(specs, fmt)
+
+    def point_serve(self, keys: list[bytes], read_ht: int, col_id: int):
+        """Native batch point-value serve. None unless the whole visible
+        state is servable from the native memtable: pending transaction
+        intents live outside the engine, so any intent on this tablet
+        forces the general read path (which resolves them)."""
+        if self.participant.txns:
+            return None
+        return self.engine.point_serve(keys, read_ht, col_id)
+
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         """Flush memtable to a durable run, advance the replay frontier,

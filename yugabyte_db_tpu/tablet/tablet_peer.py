@@ -364,6 +364,28 @@ class TabletPeer:
                 raise NotLeader(self.node_uuid, None)
         return self.tablet.scan_many(specs)
 
+    def scan_wire_many(self, specs, fmt: str = "cql",
+                       allow_stale: bool = False):
+        """Batched wire-serialized scans under ONE leader-with-lease
+        gate (the native request-batch serving path's read RPC)."""
+        if not allow_stale:
+            if not self.raft.is_leader():
+                raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+            if not self.raft.has_lease():
+                raise NotLeader(self.node_uuid, None)
+        return self.tablet.scan_wire_many(specs, fmt)
+
+    def point_serve(self, keys, read_ht: int, col_id: int,
+                    allow_stale: bool = False):
+        """Batched native point-value serve under one leader-with-lease
+        gate. None when the tablet cannot answer natively."""
+        if not allow_stale:
+            if not self.raft.is_leader():
+                raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+            if not self.raft.has_lease():
+                raise NotLeader(self.node_uuid, None)
+        return self.tablet.point_serve(keys, read_ht, col_id)
+
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         with self._maintenance_lock:

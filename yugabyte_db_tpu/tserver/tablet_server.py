@@ -794,6 +794,59 @@ class TabletServer:
                 "resume": pg.resume, "columns": pg.columns,
                 "read_ht": spec.read_ht}
 
+    def _h_ts_scan_wire_batch(self, p: dict):
+        """Many wire-serialized scans in ONE RPC — the batched read hop
+        of the native request-batch serving path (docs/serving-path.md):
+        one read gate, one engine batch, one serialized result page per
+        spec. Replaces a per-op ts.scan_wire round trip for every
+        eligible prepared point SELECT in a pipelined CQL batch."""
+        peer, specs, err = self._read_gate(
+            p, [wire.decode_spec(s) for s in p["specs"]])
+        if err is not None:
+            return err
+        try:
+            pages = peer.scan_wire_many(
+                specs, p.get("fmt", "cql"),
+                allow_stale=p.get("allow_stale", False))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        return {"code": "ok",
+                "pages": [{"data": pg.data, "nrows": pg.nrows,
+                           "resume": pg.resume, "columns": pg.columns}
+                          for pg in pages],
+                "read_ht": max(s.read_ht for s in specs)}
+
+    def _h_ts_redis_read_batch(self, p: dict):
+        """Batched redis point GETs served straight from the native
+        memtable (yb_wp.Memtable.point_lookup) — no ScanSpec, no
+        RowVersion materialization. Strictly an optimization of the
+        scan-batch path: whenever the tablet cannot answer natively AND
+        definitively (sorted runs, spilled rows, pending txn intents,
+        pure-Python memtable) it replies {"code": "ok", "fallback":
+        True} ("ok" so the client's TabletInvoker retry loop hands the
+        reply straight back) and the frontend re-issues the batch
+        through session.get_many, whose gate also resolves intents.
+        Values are the raw stored payloads; None = absent row or NULL
+        column; False = fall back for that key only."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if p.get("propagated_ht"):
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
+
+            peer.tablet.clock.update(_HT(p["propagated_ht"]))
+        read_ht = peer.read_time().value
+        try:
+            values = peer.point_serve(
+                p["keys"], read_ht, p["col_id"],
+                allow_stale=p.get("allow_stale", False))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        if values is None:
+            return {"code": "ok", "fallback": True, "read_ht": read_ht}
+        return {"code": "ok", "values": values, "read_ht": read_ht}
+
     def _resolve_read_intents(self, peer, spec) -> dict | None:
         """Intent-aware read gate (the IntentAwareIterator contract,
         src/yb/docdb/intent_aware_iterator.h:62-81, as a pre-scan gate):

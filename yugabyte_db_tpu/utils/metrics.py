@@ -123,8 +123,14 @@ class MetricEntity:
                 m._fn = fn
             return m
 
-    def histogram(self, name: str) -> Histogram:
-        return self._get(name, Histogram)
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = (
+                    Histogram(buckets) if buckets is not None
+                    else Histogram())
+            return m
 
     def _get(self, name, cls):
         with self._lock:
@@ -244,3 +250,50 @@ def count_swallowed(site: str, exc: object = None) -> None:
         _SWALLOW_LOG.debug("swallowed at %s: %r", site, exc)
     except Exception:  # noqa: BLE001 — error accounting must not throw
         _SWALLOW_LOG.debug("count_swallowed failed at site %s", site)
+
+
+# -- serving-path observability ----------------------------------------------
+# Batch-size bucket bounds (ops per drained request batch): 1 .. 4096.
+BATCH_SIZE_BUCKETS = tuple(2 ** i for i in range(13))
+
+_SERVE_ENTITIES: dict[str, MetricEntity] = {}
+_SERVE_LOCK = threading.Lock()
+
+
+def observe_serve_batch(proto: str, ops: int) -> None:
+    """Record one request batch entering a serving path: bump the
+    per-protocol batch-size histogram ``yb_serve_batch_ops{proto=...}``
+    on the process registry. The distribution answers the question the
+    native request-batch path (docs/serving-path.md) lives on: are
+    clients actually pipelining, and how much per-batch work does one
+    native call amortize? Never raises."""
+    try:
+        with _SERVE_LOCK:
+            ent = _SERVE_ENTITIES.get(proto)
+            if ent is None:
+                ent = _PROCESS_REGISTRY.entity(proto=proto)
+                _SERVE_ENTITIES[proto] = ent
+        ent.histogram("yb_serve_batch_ops",
+                      buckets=BATCH_SIZE_BUCKETS).observe(ops)
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("observe_serve_batch failed for %s", proto)
+
+
+_HOST_VERIFY_ENTITY: MetricEntity | None = None
+
+
+def count_host_verify_rows(n: int) -> None:
+    """Bump ``yb_scan_host_verify_rows`` by the number of fetched rows
+    the host re-verified after a device scan. The device predicate mask
+    for string columns is a conservative SUPERSET (ops/scan.py: ``!=``
+    on strings stays all-true), so every masked row crosses back for
+    host-side verification — this counter makes that silent cliff
+    measurable. Never raises."""
+    global _HOST_VERIFY_ENTITY
+    try:
+        with _SERVE_LOCK:
+            if _HOST_VERIFY_ENTITY is None:
+                _HOST_VERIFY_ENTITY = _PROCESS_REGISTRY.entity()
+        _HOST_VERIFY_ENTITY.counter("yb_scan_host_verify_rows").increment(n)
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("count_host_verify_rows failed")

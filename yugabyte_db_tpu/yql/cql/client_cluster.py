@@ -74,6 +74,23 @@ class RemoteTablet:
         pg.read_ht = resp.get("read_ht")
         return pg
 
+    def scan_wire_many(self, specs: list[ScanSpec], fmt: str = "cql"):
+        """Batched wire scans in ONE ts.scan_wire_batch RPC — the read
+        hop of the native request-batch serving path. Pages align with
+        specs; the single server-chosen read time rides on each page."""
+        from yugabyte_db_tpu.storage.host_page import WirePage
+
+        resp = self.client.tablet_rpc(
+            self.table_name, self.loc, "ts.scan_wire_batch",
+            {"specs": [wire.encode_spec(s) for s in specs], "fmt": fmt})
+        pages = []
+        for p in resp["pages"]:
+            pg = WirePage(p.get("columns"), p["data"], p["nrows"],
+                          p.get("resume"), 0)
+            pg.read_ht = resp.get("read_ht")
+            pages.append(pg)
+        return pages
+
 
 class RemoteTable:
     def __init__(self, client: YBClient, name: str, schema: Schema,

@@ -30,6 +30,7 @@ from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import AggSpec, Predicate, ScanSpec
 from yugabyte_db_tpu.tablet.tablet import Tablet, TabletMetadata
 from yugabyte_db_tpu.utils.hybrid_time import HybridClock
+from yugabyte_db_tpu.utils.metrics import count_swallowed
 from yugabyte_db_tpu.utils.status import (AlreadyPresent, InvalidArgument,
                                           NotFound)
 from yugabyte_db_tpu.yql.cql import ast
@@ -283,6 +284,32 @@ class _SelectPlan:
     group_by: list
 
 
+@dataclass
+class _PointStmtPlan:
+    """Params-independent half of a prepared point SELECT's plan, cached
+    per statement for the request-batch serving path: the '='-bound key
+    relations (values still carry the bind markers), the projection, and
+    the resolved handle. Per frame only coerce + encode + route remain."""
+
+    stmt: object          # pins the statement so id() can't alias
+    schema: object        # replan sentinel: compared by identity
+    handle: object
+    hash_rels: list       # [(ColumnSchema, ast.Relation)] hash order
+    range_rels: list      # [(ColumnSchema, ast.Relation)] prefix order
+    projection: list
+    names: list
+
+
+@dataclass
+class _PointBounds:
+    """Per-frame output of the cached point plan — the fields the batch
+    serving loop reads (duck-typed subset of _SelectPlan)."""
+
+    lower: bytes
+    upper: bytes
+    predicates: list
+
+
 class QLProcessor:
     """One CQL session: keyspace state + statement execution.
 
@@ -299,6 +326,11 @@ class QLProcessor:
         self.cluster = cluster
         self.keyspace = "default"
         self.login_role = login_role
+        # Structural plan cache for the request-batch serving path:
+        # (id(stmt), keyspace) -> _PointStmtPlan. Statements live in the
+        # server's prepared cache, so ids are stable; each entry pins its
+        # stmt anyway so a collected id can never alias.
+        self._point_stmt_cache: dict = {}
 
     @property
     def keyspaces(self) -> set:
@@ -1286,6 +1318,165 @@ class QLProcessor:
         if b is None:
             return a
         return min(a, b)
+
+    def _point_stmt_plan(self, stmt):
+        """Build (and cache) the params-independent plan of a prepared
+        point SELECT: which columns the '='-bound WHERE covers, the
+        projection, and wire eligibility. Returns None when the
+        statement's shape can never ride the batch path. The per-frame
+        remainder is just coerce + key encode + hash route."""
+        from yugabyte_db_tpu.yql.cql import vtables
+
+        if type(stmt) is not ast.Select or stmt.limit is not None \
+                or getattr(stmt, "order_by", None):
+            return None
+        if vtables.is_virtual(self._qualify(stmt.table)):
+            return None
+        handle = self.cluster.table(self._qualify(stmt.table))
+        schema = handle.schema
+        hash_cols = schema.hash_columns
+        if not hash_cols:
+            return None
+        # Every relation must be '=' on a distinct key column, covering
+        # all hash columns plus a PREFIX of the range columns — exactly
+        # the shape _plan_select turns into [prefix, successor(prefix))
+        # with no residual predicates and no bound tightening.
+        by_col = {}
+        for rel in stmt.where:
+            if rel.op != "=" or rel.column in by_col:
+                return None
+            by_col[rel.column] = rel
+        if any(c.name not in by_col for c in hash_cols):
+            return None
+        range_prefix = []
+        rest = set(by_col) - {c.name for c in hash_cols}
+        for c in schema.range_columns:
+            if c.name not in rest:
+                break
+            rest.discard(c.name)
+            range_prefix.append(c)
+        if rest:
+            return None
+        probe = _SelectPlan(True, 0, b"", b"", [], None, [], [])
+        projection = None
+        if stmt.items:
+            for it in stmt.items:
+                if it.agg_fn or not schema.has_column(it.column):
+                    return None
+            projection = [it.column for it in stmt.items]
+        probe.projection = projection
+        if not self._wire_eligible(handle, stmt, probe):
+            return None
+        projection = projection or [c.name for c in schema.columns]
+        names = ([it.output_name for it in stmt.items] if stmt.items
+                 else list(projection))
+        return _PointStmtPlan(
+            stmt, schema, handle,
+            [(c, by_col[c.name]) for c in hash_cols],
+            [(c, by_col[c.name]) for c in range_prefix],
+            projection, names)
+
+    def execute_wire_point_batch(self, items: list) -> list:
+        """Batched serving of prepared point SELECTs — the CQL side of
+        the native request-batch serving path (docs/serving-path.md).
+
+        Each item is (stmt, params, page_size, paging_state), one per
+        pipelined EXECUTE frame. Frames whose plan is a single-tablet
+        wire-eligible read with no predicates, aggregates, LIMIT, or
+        paging are grouped per tablet and served through ONE
+        scan_wire_many batch per tablet; every other frame gets None in
+        its slot and the caller runs the canonical execute(). Replies
+        are byte-identical to the per-op path: the bounds and specs
+        below are exactly what _plan_select/_run_rows would build for
+        these statements (limit None, page budget None), served by the
+        same page server. The params-independent planning is cached per
+        prepared statement (_point_stmt_plan); a schema change drops the
+        entry and replans.
+        """
+        out: list = [None] * len(items)
+        groups: dict = {}
+        cache = self._point_stmt_cache
+        for i, (stmt, params, page_size, paging_state) in enumerate(items):
+            if page_size is not None or paging_state:
+                continue
+            ckey = (id(stmt), self.keyspace)
+            try:
+                sp = cache.get(ckey, False)
+                if sp is not False and sp is not None and \
+                        sp.schema is not self.cluster.table(
+                            self._qualify(stmt.table)).schema:
+                    sp = False  # schema changed: replan
+                if sp is False:
+                    sp = cache[ckey] = self._point_stmt_plan(stmt)
+                if sp is None:
+                    continue
+                self._params = params or []
+                self._page_size = None
+                self._paging_state = None
+                self._wire_results = True
+                self._enforce(stmt)
+                eq = {c.name: self._coerce(c, rel.value)
+                      for c, rel in sp.hash_rels}
+                hash_code = compute_hash_code(sp.schema, eq)
+                prefix = encode_doc_key_prefix(
+                    hash_code,
+                    [(eq[c.name], c.dtype) for c, _rel in sp.hash_rels],
+                    [(self._coerce(c, rel.value), c.dtype)
+                     for c, rel in sp.range_rels])
+                tablet = self.cluster.tablet_for_hash(sp.handle, hash_code)
+                if not hasattr(tablet, "scan_wire_many"):
+                    continue
+            except Exception as e:  # noqa: BLE001 — the execute()
+                # fallback re-raises this error canonically per frame.
+                count_swallowed("cql.batch_plan", e)
+                continue
+            bounds = _PointBounds(prefix, prefix_successor(prefix), [])
+            # RemoteTablet handles are constructed per lookup: group by
+            # the underlying tablet id so one RPC serves the tablet.
+            key = getattr(getattr(tablet, "loc", None), "tablet_id",
+                          None) or id(tablet)
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = (tablet, [])
+            g[1].append((i, sp.names, sp.projection, bounds))
+
+        for tablet, frames in groups.values():
+            read_ht = tablet.read_time().value
+            specs = [ScanSpec(lower=plan.lower, upper=plan.upper,
+                              read_ht=read_ht,
+                              predicates=plan.predicates,
+                              projection=projection, limit=None)
+                     for _i, _names, projection, plan in frames]
+            try:
+                pages = tablet.scan_wire_many(specs)
+            except Exception as e:  # noqa: BLE001 — whole group falls
+                count_swallowed("cql.batch_serve", e)  # back to execute()
+                continue
+            for (i, names, projection, plan), spec, page in zip(
+                    frames, specs, pages):
+                parts = [page.data]
+                nrows = page.nrows
+                resume = page.resume
+                read_ht = getattr(page, "read_ht", None) or spec.read_ht
+                try:
+                    while resume is not None:
+                        # Continuation pages pin the batch's read time —
+                        # the same snapshot rule as _run_rows paging.
+                        res = tablet.scan_wire(ScanSpec(
+                            lower=resume, upper=plan.upper,
+                            read_ht=read_ht, predicates=plan.predicates,
+                            projection=projection, limit=None))
+                        parts.append(res.data)
+                        nrows += res.nrows
+                        resume = res.resume
+                except Exception as e:  # noqa: BLE001 — rerun this
+                    count_swallowed("cql.batch_continue", e)  # frame
+                    continue
+                rs = ResultSet(columns=names)
+                rs.wire_data = b"".join(parts)
+                rs.wire_rows = nrows
+                out[i] = rs
+        return out
 
     def _run_aggregate(self, handle: TableHandle, stmt: ast.Select, plan):
         """Fan the aggregate out per tablet, combine partials host-side —

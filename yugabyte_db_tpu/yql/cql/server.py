@@ -19,6 +19,8 @@ import threading
 
 from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.rpc.messenger import ConnectionContext, Messenger
+from yugabyte_db_tpu.utils.metrics import (count_swallowed,
+                                           observe_serve_batch)
 from yugabyte_db_tpu.utils.status import (AlreadyPresent, InvalidArgument,
                                           NotFound)
 from yugabyte_db_tpu.yql.cql import ast
@@ -85,6 +87,12 @@ class CQLServiceImpl:
     def __init__(self):
         self._lock = threading.Lock()
         self._prepared: dict[bytes, PreparedStatement] = {}
+        # ROWS metadata-header cache for the batch serving path:
+        # (id(stmt), keyspace, columns) -> (stmt, header bytes). The
+        # header (kind/flags/colspecs) is identical for every frame of a
+        # statement; only nrows + rows_data vary. The stmt ref pins the
+        # id; a rename/projection change shifts the columns key.
+        self._rows_hdr: dict = {}
 
     # -- frame dispatch ------------------------------------------------------
     def handle_call(self, processor: QLProcessor, stream: int, opcode: int,
@@ -196,6 +204,68 @@ class CQLServiceImpl:
             r, ps.bind_cols)
         return self._run(processor, stream, ps.stmt, params, page_size,
                          paging_state)
+
+    def handle_execute_batch(self, processor: QLProcessor,
+                             frames: list) -> bytes:
+        """One pipelined burst of EXECUTE frames as ONE call — the CQL
+        entry of the native request-batch serving path. ``frames`` is
+        [(stream, body), ...] in arrival order; the return value is the
+        reply frames concatenated in that same order (each carries its
+        own stream id, so a single response body preserves pairing).
+        Frames the batched wire path can't serve — unknown statement,
+        non-point SELECT, writes, errors — run through handle_call one
+        by one, which is exactly the pre-batch behavior."""
+        observe_serve_batch("cql", len(frames))
+        decoded: list = [None] * len(frames)  # (stmt, params, ps, pg)
+        for fi, (stream, body) in enumerate(frames):
+            try:
+                r = W.Reader(body)
+                stmt_id = r.short_bytes()
+                with self._lock:
+                    ps = self._prepared.get(stmt_id)
+                if ps is None:
+                    continue
+                params, page_size, paging_state = self._read_query_params(
+                    r, ps.bind_cols)
+                decoded[fi] = (ps.stmt, params, page_size, paging_state)
+            except Exception as e:  # noqa: BLE001 — handle_call below
+                count_swallowed("cql.batch_decode", e)
+        results: list = [None] * len(frames)
+        items = [(fi, d) for fi, d in enumerate(decoded) if d is not None]
+        if items:
+            try:
+                served = processor.execute_wire_point_batch(
+                    [d for _fi, d in items])
+            except Exception as e:  # noqa: BLE001 — per-frame fallback
+                count_swallowed("cql.batch_execute", e)
+                served = [None] * len(items)
+            for (fi, d), rs in zip(items, served):
+                if rs is None:
+                    continue
+                stream = frames[fi][0]
+                hkey = (id(d[0]), processor.keyspace, tuple(rs.columns))
+                hit = self._rows_hdr.get(hkey)
+                if hit is not None and hit[0] is d[0]:
+                    hdr = hit[1]
+                    body_len = len(hdr) + 4 + len(rs.wire_data)
+                    results[fi] = (
+                        W.HEADER.pack(W.VERSION_RESP, 0, stream,
+                                      W.OP_RESULT, body_len)
+                        + hdr + rs.wire_rows.to_bytes(4, "big")
+                        + rs.wire_data)
+                    continue
+                out = self._rows(processor, stream, d[0], rs)
+                # Split the canonical frame around nrows+rows_data: the
+                # leading metadata header is reusable verbatim, which
+                # also guarantees cached replies stay byte-identical.
+                hdr = out[W.HEADER.size:len(out) - 4 - len(rs.wire_data)]
+                self._rows_hdr[hkey] = (d[0], hdr)
+                results[fi] = out
+        for fi, (stream, body) in enumerate(frames):
+            if results[fi] is None:
+                results[fi] = self.handle_call(processor, stream,
+                                               W.OP_EXECUTE, body)
+        return b"".join(results)
 
     # -- execution -----------------------------------------------------------
     def _run(self, processor, stream: int, stmt, params, page_size,
@@ -356,6 +426,8 @@ class CQLServer:
 
         def handler(_method, payload):
             processor, stream, opcode, body = payload
+            if opcode == "execute_batch":
+                return self.service.handle_execute_batch(processor, body)
             return self.service.handle_call(processor, stream, opcode, body)
 
         class _Ctx(CQLConnectionContext):
@@ -364,8 +436,37 @@ class CQLServer:
                 self.processor = QLProcessor(cluster)
 
             def feed(self, data):
-                return [(stream, "cql", (self.processor, stream, op, body))
-                        for stream, _m, (op, body) in super().feed(data)]
+                # Runs of pipelined EXECUTEs collapse into ONE
+                # "execute_batch" call (the native request-batch serving
+                # path). The single reply body carries one frame per
+                # request frame, each tagged with its own stream id, so
+                # response pairing survives the coalescing.
+                calls = []
+                run: list = []
+                for stream, _m, (op, body) in super().feed(data):
+                    if op == W.OP_EXECUTE:
+                        run.append((stream, body))
+                        continue
+                    self._flush_run(calls, run)
+                    calls.append(
+                        (stream, "cql", (self.processor, stream, op, body)))
+                self._flush_run(calls, run)
+                return calls
+
+            def _flush_run(self, calls, run):
+                if not run:
+                    return
+                if len(run) == 1:
+                    stream, body = run[0]
+                    calls.append((stream, "cql",
+                                  (self.processor, stream, W.OP_EXECUTE,
+                                   body)))
+                else:
+                    stream = run[0][0]
+                    calls.append((stream, "cql",
+                                  (self.processor, stream, "execute_batch",
+                                   list(run))))
+                run.clear()
 
         return self.messenger.listen(host, port, handler,
                                      context_factory=_Ctx)

@@ -10,6 +10,11 @@ from __future__ import annotations
 
 from yugabyte_db_tpu.rpc.messenger import ConnectionContext
 
+try:
+    from yugabyte_db_tpu.native import yb_rb as _yb_rb
+except ImportError:  # native batch parser not built: pure-Python parse
+    _yb_rb = None
+
 CRLF = b"\r\n"
 
 
@@ -123,7 +128,21 @@ class RedisConnectionContext(ConnectionContext):
 
     def feed(self, data: bytes) -> list:
         self._buf.extend(data)
-        cmds = parse_commands(self._buf)
+        # Native batch parse first (servebatch.cc): one C++ pass over the
+        # drained buffer for the strict array-of-bulks grammar every
+        # pipelined client speaks. It consumes nothing and returns None
+        # on anything else (inline commands, malformed lengths), so the
+        # Python parser below re-parses the SAME bytes and error
+        # behavior stays identical to a build without the native module.
+        cmds = None
+        if _yb_rb is not None:
+            parsed = _yb_rb.parse_resp(self._buf)
+            if parsed is not None:
+                cmds, consumed = parsed
+                if consumed:
+                    del self._buf[:consumed]
+        if cmds is None:
+            cmds = parse_commands(self._buf)
         if not cmds:
             return []
         # One call carries the whole pipelined burst: the service

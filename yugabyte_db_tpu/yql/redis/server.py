@@ -38,7 +38,14 @@ from yugabyte_db_tpu.models.encoding import prefix_successor
 from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
 from yugabyte_db_tpu.rpc.messenger import Messenger
 from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+from yugabyte_db_tpu.utils.metrics import (count_swallowed,
+                                           observe_serve_batch)
 from yugabyte_db_tpu.yql.redis import resp
+
+try:
+    from yugabyte_db_tpu.native import yb_rb as _yb_rb
+except ImportError:  # native serving module not built: Python path only
+    _yb_rb = None
 
 REDIS_TABLE = "sys.redis"
 
@@ -191,6 +198,7 @@ class RedisServiceImpl:
         reference's RedisPipelinedKeyValue numbers possible (its proxy
         batches ops through the async client; docs/yb-perf-v1.0.7.md:
         18-19). Everything else takes the per-command path."""
+        observe_serve_batch("redis", len(cmds))
         out = []
         i = 0
         n = len(cmds)
@@ -263,11 +271,73 @@ class RedisServiceImpl:
             if self._monitors:
                 for k in keys:
                     self._feed_monitors(conn, "GET", [k])
+            return b"".join(resp.bulk(v) for v in self._get_values(keys))
+
+    def _get_values(self, keys: list[str]) -> list:
+        """Values of plain string keys (field "") in key order — the
+        native batch serving path when every hop is eligible (raw
+        stored payload bytes), session.get_many otherwise (str).
+        resp.bulk encodes bytes and str to IDENTICAL reply bytes: the
+        stored column payload is exactly the value's utf-8
+        surrogateescape encoding (tagcodec T_STR). Callers hold _lock
+        (self._cur.db feeds the storage rkey)."""
+        rkeys = [self._rk(k) for k in keys]
+        values = self._native_get_values(rkeys)
+        if values is None:
+            values = [False] * len(rkeys)
+        # False entries: native couldn't answer definitively (module
+        # absent, tablet fallback, non-string stored value) — serve
+        # those through the canonical Python read path.
+        need = [i for i, v in enumerate(values) if v is False]
+        if need:
             rows = self.session.get_many(
                 self.table,
-                [{"rkey": self._rk(k), "field": ""} for k in keys])
-            return b"".join(
-                resp.bulk(None if r is None else r[2]) for r in rows)
+                [{"rkey": rkeys[i], "field": ""} for i in need])
+            for i, r in zip(need, rows):
+                values[i] = None if r is None else r[2]
+        return values
+
+    def _native_get_values(self, rkeys: list[str]):
+        """One ts.redis_read_batch RPC per tablet for a batch of point
+        keys, served from the native memtable (docs/serving-path.md).
+        None = native path unavailable; per-key False = fall back for
+        that key (a tablet replying "fallback" leaves its whole group
+        False)."""
+        if _yb_rb is None:
+            return None
+        try:
+            locs = self.client.meta_cache.locations(self.table.name)
+            tablets = sorted(locs.tablets,
+                             key=lambda t: t.partition_start)
+            routed = _yb_rb.encode_point_keys(
+                (3,), (3,), [(rk, "") for rk in rkeys],
+                [t.partition_start for t in tablets], 1)
+        except Exception as e:  # noqa: BLE001 — Python path is canonical
+            count_swallowed("redis.native_route", e)
+            return None
+        groups: dict[int, tuple[list, list]] = {}
+        for i, (part, key) in enumerate(routed):
+            g = groups.get(part)
+            if g is None:
+                g = groups[part] = ([], [])
+            g[0].append(i)
+            g[1].append(key)
+        values: list = [False] * len(rkeys)
+        col_id = self.table.col_id["value"]
+        for part, (idxs, keys) in groups.items():
+            try:
+                r = self.client.tablet_rpc(
+                    self.table.name, tablets[part],
+                    "ts.redis_read_batch",
+                    {"keys": keys, "col_id": col_id})
+            except Exception as e:  # noqa: BLE001 — per-group fallback
+                count_swallowed("redis.native_read_batch", e)
+                continue
+            if r.get("fallback"):
+                continue
+            for i, v in zip(idxs, r["values"]):
+                values[i] = v
+        return values
 
     def _batch_set(self, sets: list[tuple[str, str]], conn) -> bytes:
         with self._lock:
@@ -591,7 +661,9 @@ class RedisServiceImpl:
         return resp.integer(len(new))
 
     def cmd_mget(self, a):
-        return resp.array([self._get(k, "") for k in a])
+        # Same batched serving path as pipelined GET runs: one native
+        # multiget (or one ts.scan_batch) instead of a scan per key.
+        return resp.array(self._get_values(list(a)))
 
     def cmd_mset(self, a):
         if not a or len(a) % 2:
