@@ -1,0 +1,344 @@
+"""HBM residency manager: budgeted device caching must never change
+scan results.
+
+Covers the PR's acceptance bar: with a budget far smaller than the
+dataset's plane bytes the TPU engine stays byte-identical to the CPU
+oracle (demand re-upload on miss, mid-scan eviction pressure included),
+one full scan cannot flush the protected point-get pool (scan
+resistance), residency accounting respects the budget and detaches on
+close, and the incremental overlay advances by memtable deltas instead
+of rebuilding.
+"""
+
+import gc
+
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import (
+    AggSpec, Predicate, RowVersion, ScanSpec, make_engine,
+)
+from yugabyte_db_tpu.storage.residency import HbmCache, hbm_cache
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.memtracker import root_tracker
+from yugabyte_db_tpu.utils.sync_point import SYNC_POINT
+import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401  (registers 'tpu')
+
+
+def make_schema():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("a", DataType.INT64),
+        ColumnSchema("b", DataType.STRING),
+        ColumnSchema("c", DataType.DOUBLE),
+    ], table_id="t")
+
+
+def enc(schema, k, r):
+    return schema.encode_primary_key(
+        {"k": k, "r": r}, compute_hash_code(schema, {"k": k}))
+
+
+def ids(schema):
+    return {c.name: c.col_id for c in schema.value_columns}
+
+
+@pytest.fixture
+def budget_flag():
+    """Restore the budget flag (and drain stray residents) around a test."""
+    gc.collect()  # dead engines from earlier tests release via weakrefs
+    hbm_cache().evict_unpinned()
+    old = FLAGS.get("tpu_hbm_budget_bytes")
+    yield lambda v: FLAGS.set("tpu_hbm_budget_bytes", int(v))
+    FLAGS.set("tpu_hbm_budget_bytes", old)
+    hbm_cache().evict_unpinned()
+
+
+def load_engines(n_flushes=3, rows_per_flush=120, tail_writes=40):
+    """CPU + TPU engines with several runs plus live memtable writes."""
+    schema = make_schema()
+    cpu = make_engine("cpu", schema, {})
+    tpu = make_engine("tpu", schema, {"rows_per_block": 32})
+    cids = ids(schema)
+    ht = 0
+    for f in range(n_flushes):
+        rows = []
+        for i in range(rows_per_flush):
+            ht += 1
+            rows.append(RowVersion(
+                enc(schema, ["p", "q", "rr"][i % 3], (f * 7 + i) % 211),
+                ht=ht, liveness=True,
+                columns={cids["a"]: i - 50, cids["b"]: f"v{f}-{i % 9}",
+                         cids["c"]: i * 0.25 - 3.0}))
+        cpu.apply(rows)
+        tpu.apply(rows)
+        cpu.flush()
+        tpu.flush()
+    rows = []
+    for i in range(tail_writes):
+        ht += 1
+        rows.append(RowVersion(
+            enc(schema, "q", i % 211), ht=ht, liveness=True,
+            columns={cids["a"]: 1000 + i}))
+    cpu.apply(rows)
+    tpu.apply(rows)
+    return schema, cpu, tpu, ht
+
+
+def plane_budget(tpu, fraction=0.25):
+    total = sum(t._nbytes_hint() for t in tpu.runs)
+    assert total > 0
+    return max(int(total * fraction), 1)
+
+
+def assert_same(cpu, tpu, **spec_kwargs):
+    a = cpu.scan(ScanSpec(**spec_kwargs))
+    b = tpu.scan(ScanSpec(**spec_kwargs))
+    assert a.columns == b.columns
+    assert a.rows == b.rows, f"spec={spec_kwargs}"
+
+
+SCAN_BATTERY = [
+    dict(read_ht=MAX_HT),
+    dict(read_ht=MAX_HT,
+         aggregates=[AggSpec("count", None), AggSpec("sum", "a"),
+                     AggSpec("min", "a"), AggSpec("max", "a")]),
+    dict(read_ht=MAX_HT, predicates=[Predicate("a", ">", 0)]),
+]
+
+
+def _bounded(schema):
+    return dict(read_ht=MAX_HT, lower=enc(schema, "p", 10),
+                upper=enc(schema, "p", 150))
+
+
+def test_engine_diff_under_tiny_budget(budget_flag):
+    """Dataset ≫ budget: every scan answer must still be byte-identical
+    to the CPU oracle — misses demand re-upload from the authoritative
+    host run — and once pins release, residency settles to the budget."""
+    schema, cpu, tpu, max_ht = load_engines()
+    budget = plane_budget(tpu, 0.25)
+    budget_flag(budget)
+    try:
+        for spec in SCAN_BATTERY:
+            assert_same(cpu, tpu, **spec)
+        assert_same(cpu, tpu, **_bounded(schema))
+        assert_same(cpu, tpu, read_ht=max_ht // 2)
+        # Point-get shape: single-key range with an aggregate.
+        assert_same(cpu, tpu, read_ht=MAX_HT,
+                    lower=enc(schema, "q", 5),
+                    upper=enc(schema, "q", 6),
+                    aggregates=[AggSpec("count", None)])
+        gc.collect()
+        hbm_cache().evict_unpinned()  # drop THIS test's unpinned leftovers
+        pinned = hbm_cache().pinned_bytes()
+        assert hbm_cache().resident_bytes() <= budget + pinned
+        assert hbm_cache().stats()["misses"] > 0
+    finally:
+        cpu.close()
+        tpu.close()
+
+
+def test_engine_diff_mid_scan_eviction(budget_flag):
+    """Eviction pressure injected mid-plan (everything unpinned is
+    dropped right after the memtable snapshot) must not change results:
+    gathers re-acquire and re-upload on demand."""
+    schema, cpu, tpu, _ = load_engines(n_flushes=2)
+    budget_flag(plane_budget(tpu, 0.25))
+    SYNC_POINT.set_callback(
+        "tpu_engine:plan:mem_snapshotted",
+        lambda _arg: hbm_cache().evict_unpinned())
+    SYNC_POINT.enable()
+    try:
+        for spec in SCAN_BATTERY:
+            assert_same(cpu, tpu, **spec)
+        assert_same(cpu, tpu, **_bounded(schema))
+    finally:
+        SYNC_POINT.disable_and_clear()
+        cpu.close()
+        tpu.close()
+
+
+def test_scan_resistance_protects_high_pool():
+    """One full scan's worth of low-pri admissions must not evict the
+    protected point-get entries: the low pool drains first."""
+    cache = HbmCache()
+    tracker = root_tracker().child("device").child("test_scanres")
+
+    class Owner:
+        pass
+
+    owners = []
+
+    def entry(nbytes, priority):
+        o = Owner()
+        owners.append(o)
+        key = cache.register(o, tracker, "unit")
+        cache.acquire(key, lambda: (("payload", nbytes), nbytes),
+                      nbytes_hint=nbytes, priority=priority)
+        return key
+
+    try:
+        FLAGS.set("tpu_hbm_budget_bytes", 1000)
+        hot = [entry(200, "high") for _ in range(3)]  # 600B protected
+        # A "full scan" streaming 20 low-pri entries through the cache.
+        for _ in range(20):
+            entry(300, "low")
+        for key in hot:
+            def must_not_rebuild():
+                raise AssertionError("protected entry was evicted")
+            assert cache.acquire(key, must_not_rebuild,
+                                 priority="high") is not None
+        assert cache.resident_bytes() <= 1000
+    finally:
+        FLAGS.set("tpu_hbm_budget_bytes", 0)
+        for o in owners:
+            del o
+        owners.clear()
+        gc.collect()
+        tracker.detach()
+
+
+def test_accounting_budget_and_detach(budget_flag):
+    """resident_bytes tracks the MemTracker subtree exactly, never
+    exceeds the budget for unpinned traffic, and engine close() releases
+    and detaches its device subtree."""
+    cache = HbmCache()
+    tracker = root_tracker().child("device").child("test_acct")
+
+    class Owner:
+        pass
+
+    keep = []
+    observed = []
+    SYNC_POINT.set_callback(
+        "hbm_cache:admit", lambda _arg: observed.append(
+            cache.resident_bytes()))
+    SYNC_POINT.enable()
+    try:
+        FLAGS.set("tpu_hbm_budget_bytes", 512)
+        for i in range(8):
+            o = Owner()
+            keep.append(o)
+            key = cache.register(o, tracker, f"e{i}")
+            cache.acquire(key, lambda: (object(), 200), nbytes_hint=200)
+        assert observed and max(observed) <= 512
+        assert cache.resident_bytes() == tracker.consumption
+        assert cache.stats()["evictions"] >= 6
+    finally:
+        SYNC_POINT.disable_and_clear()
+        FLAGS.set("tpu_hbm_budget_bytes", 0)
+        keep.clear()
+        gc.collect()
+        tracker.detach()
+
+    # Engine lifecycle: close() must empty and detach the device subtree.
+    _schema, cpu, tpu, _ = load_engines(n_flushes=1, rows_per_flush=40,
+                                        tail_writes=0)
+    tpu.scan(ScanSpec(read_ht=MAX_HT))
+    name = tpu.device_tracker.name
+    parent = tpu.device_tracker.parent
+    cpu.close()
+    tpu.close()
+    assert tpu.device_tracker.consumption == 0
+    assert name not in parent._children
+
+
+def test_overlay_incremental_delta(budget_flag):
+    """A second post-write scan advances the cached overlay by the
+    memtable delta: same masked plane object when only existing keys
+    changed, fresh scatter when new primary rows need clearing — and
+    results match the CPU oracle at every step."""
+    # The overlay needs a dominant primary: one big run, a small delta
+    # run, and a small live memtable (the postwrite_scan shape).
+    schema = make_schema()
+    cpu = make_engine("cpu", schema, {})
+    tpu = make_engine("tpu", schema, {"rows_per_block": 32})
+    cids = ids(schema)
+    rows = [RowVersion(enc(schema, ["p", "q", "rr"][i % 3], i % 211),
+                       ht=1 + i, liveness=True,
+                       columns={cids["a"]: i - 50, cids["b"]: f"v{i % 9}",
+                                cids["c"]: i * 0.25 - 3.0})
+            for i in range(240)]
+    cpu.apply(rows)
+    tpu.apply(rows)
+    cpu.flush()
+    tpu.flush()
+    rows = [RowVersion(enc(schema, "q", i), ht=500 + i, liveness=True,
+                       columns={cids["a"]: 2_000 + i})
+            for i in range(24)]
+    cpu.apply(rows)
+    tpu.apply(rows)
+    cpu.flush()
+    tpu.flush()
+    rows = [RowVersion(enc(schema, "q", i), ht=600 + i, liveness=True,
+                       columns={cids["a"]: 3_000 + i})
+            for i in range(10)]
+    cpu.apply(rows)
+    tpu.apply(rows)
+    # The overlay drives multi-source AGGREGATE scans (row scans merge
+    # on host); this spec is the steady-state shape being accelerated.
+    agg = dict(read_ht=MAX_HT,
+               aggregates=[AggSpec("count", None), AggSpec("sum", "a"),
+                           AggSpec("min", "a"), AggSpec("max", "a")])
+    assert_same(cpu, tpu, **agg)  # builds the overlay
+    state1 = tpu._overlay_cache[3]
+    assert state1 is not None
+
+    def write(key_i, val, part="q"):
+        r = [RowVersion(enc(schema, part, key_i % 211), ht=10_000 + val,
+                        liveness=True, columns={cids["a"]: val})]
+        cpu.apply(r)
+        tpu.apply(r)
+
+    # Delta wave 1: only keys the overlay already tracks.
+    for i in range(5):
+        write(i, 7_000 + i)
+    assert_same(cpu, tpu, **agg)
+    state2 = tpu._overlay_cache[3]
+    assert state2 is not state1
+    assert state2.mem_count > state1.mem_count
+    assert state2.masked is state1.masked  # no re-scatter needed
+    assert len(state2.rows) == len(state1.rows)
+
+    # Delta wave 2: brand-new keys present in the primary run.
+    for i in range(30, 34):
+        write(i, 8_000 + i, part="p")
+    assert_same(cpu, tpu, **agg)
+    state3 = tpu._overlay_cache[3]
+    assert len(state3.rows) > len(state2.rows)
+    assert state3.keys == sorted(state3.keys)
+    assert_same(cpu, tpu, **_bounded(schema))
+
+    # Steady state: an unchanged memtable is a pure cache hit.
+    assert_same(cpu, tpu, **agg)
+    assert tpu._overlay_cache[3] is state3
+    cpu.close()
+    tpu.close()
+
+
+def test_metrics_and_memz_exposure(budget_flag):
+    """The cache series render on the process registry and /memz carries
+    the budget/resident/pinned breakdown."""
+    from yugabyte_db_tpu.server.webserver import _memz
+    from yugabyte_db_tpu.utils.metrics import process_registry
+
+    _schema, cpu, tpu, _ = load_engines(n_flushes=1, rows_per_flush=40,
+                                        tail_writes=0)
+    tpu.scan(ScanSpec(read_ht=MAX_HT))
+    text = process_registry().prometheus_text()
+    for series in ("yb_hbm_cache_hits", "yb_hbm_cache_misses",
+                   "yb_hbm_cache_evictions", "yb_hbm_demand_upload_bytes",
+                   "yb_hbm_resident_bytes", "yb_hbm_pinned_bytes",
+                   "yb_hbm_budget_bytes"):
+        assert series in text
+    memz = _memz()
+    assert "hbm_cache" in memz
+    for k in ("budget_bytes", "resident_bytes", "pinned_bytes", "pools"):
+        assert k in memz["hbm_cache"]
+    cpu.close()
+    tpu.close()

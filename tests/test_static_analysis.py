@@ -710,6 +710,84 @@ def test_ijax_traced_callee_is_the_intra_rules_problem(tmp_path):
     assert not fired(res, "ijax/reachable-host-sync")
 
 
+# -- interprocedural: ijax/unmanaged-device-put ------------------------------
+
+def test_ijax_unmanaged_device_put_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/bad.py": """\
+        import jax
+
+        def upload(planes):
+            return jax.device_put(planes)
+    """})
+    (v,) = fired(res, "ijax/unmanaged-device-put")
+    assert "device_put" in v.message and "residency" in v.message
+
+
+def test_ijax_unmanaged_device_put_in_lambda_fires(tmp_path):
+    """The sharded-mesh shape: the upload hides inside a tree.map
+    lambda, invisible to a scanner that skips lambda bodies."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/parallel/bad.py": """\
+        import jax
+
+        def stack(tree, sharding):
+            return jax.tree.map(
+                lambda a: jax.device_put(a, sharding), tree)
+    """})
+    (v,) = fired(res, "ijax/unmanaged-device-put")
+    assert "stack" in v.message
+
+
+def test_ijax_unmanaged_asarray_of_planes_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/bad.py": """\
+        import jax.numpy as jnp
+
+        def reupload(run):
+            return jnp.asarray(run.cmp_planes)
+    """})
+    (v,) = fired(res, "ijax/unmanaged-device-put")
+    assert "cmp_planes" in v.message
+
+
+def test_ijax_asarray_of_scalars_is_clean(tmp_path):
+    """Index vectors and literals are staging, not plane residency."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/ok.py": """\
+        import jax.numpy as jnp
+
+        def stage(idx, lit):
+            return jnp.asarray(idx), jnp.asarray(lit)
+    """})
+    assert not fired(res, "ijax/unmanaged-device-put")
+
+
+def test_ijax_unmanaged_allowlists_residency_modules(tmp_path):
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/storage/residency.py": """\
+            import jax
+
+            def admit(planes):
+                return jax.device_put(planes)
+        """,
+        "yugabyte_db_tpu/ops/device_run.py": """\
+            import jax
+
+            def up(arr, device):
+                return jax.device_put(arr, device)
+        """})
+    assert not fired(res, "ijax/unmanaged-device-put")
+
+
+def test_ijax_unmanaged_suppression_honored(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/parallel/ok.py": """\
+        import jax
+
+        def stack(tree, sharding):
+            return jax.tree.map(
+                lambda a: jax.device_put(a, sharding),  # yb-lint: disable=ijax/unmanaged-device-put
+                tree)
+    """})
+    assert not fired(res, "ijax/unmanaged-device-put")
+
+
 # -- SARIF -------------------------------------------------------------------
 
 def test_sarif_output_on_violations(tmp_path):

@@ -64,6 +64,34 @@ _HOST_SYNC_TAILS = (".item", ".tolist")
 _HOST_TRANSFER = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
                   "np.ascontiguousarray"}
 
+# Device-upload primitives (ijax/unmanaged-device-put): explicit
+# placement, and the implicit jnp constructors that device_put host data.
+_UPLOAD_ASARRAY = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
+                   "jax.numpy.array"}
+
+
+def _upload_fact(node: ast.Call) -> tuple[int, str, str] | None:
+    """(line, kind, first-arg text) when ``node`` uploads host data to
+    the device, else None.  kind is "device_put" or "asarray"."""
+    raw = call_name(node)
+    if not raw:
+        return None
+    if raw == "device_put" or raw.endswith(".device_put"):
+        kind = "device_put"
+    elif raw in _UPLOAD_ASARRAY:
+        kind = "asarray"
+    else:
+        return None
+    arg = ""
+    if node.args:
+        arg = dotted_name(node.args[0])
+        if not arg:
+            try:
+                arg = ast.unparse(node.args[0])
+            except Exception:  # noqa: BLE001 — best-effort label
+                arg = ""
+    return (node.lineno, kind, arg)
+
 
 @dataclass
 class CallSite:
@@ -99,6 +127,7 @@ class FunctionInfo:
     returns_rpc_resp: bool = False     # returns a blocking-primitive result
     returns_status: bool = False       # returns a utils.status Status
     return_calls: list = field(default_factory=list)  # raw names returned
+    uploads: list = field(default_factory=list)  # (line, kind, arg text)
 
 
 @dataclass
@@ -269,6 +298,9 @@ class _FunctionScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call):
+        fact = _upload_fact(node)
+        if fact is not None:
+            self.info.uploads.append(fact)
         raw = call_name(node)
         if raw:
             if raw.endswith(_HOST_SYNC_TAILS):
@@ -311,7 +343,14 @@ class _FunctionScanner(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node: ast.Lambda):
-        pass
+        # Lambda bodies are otherwise opaque to summaries, but an upload
+        # hidden in `jax.tree.map(lambda a: jax.device_put(a, ...), t)`
+        # is exactly what ijax/unmanaged-device-put exists to catch.
+        for sub in ast.walk(node.body):
+            if isinstance(sub, ast.Call):
+                fact = _upload_fact(sub)
+                if fact is not None:
+                    self.info.uploads.append(fact)
 
 
 class _ModuleModel:

@@ -19,8 +19,20 @@ from __future__ import annotations
 from yugabyte_db_tpu.analysis.core import Violation, project_rule
 
 RULE_REACHABLE = "ijax/reachable-host-sync"
+RULE_UNMANAGED = "ijax/unmanaged-device-put"
 
 _MAX_DEPTH = 8
+
+# The residency manager and the upload primitive it owns: the only
+# modules allowed to move run planes to the device directly.
+_UPLOAD_ALLOWLIST = ("storage/residency.py", "ops/device_run.py")
+
+# Argument-text tokens marking an upload as run-plane data. A bare
+# jnp.asarray of a scalar or an index vector is fine; re-uploading a
+# plane group bypasses the --tpu_hbm_budget_bytes accounting.
+_PLANE_TOKENS = ("valid", "group_start", "tomb", "live", "ht_hi", "ht_lo",
+                 "exp_hi", "exp_lo", "cmp_planes", "key_planes", "arrays",
+                 "set_", "isnull", "arith")
 
 
 @project_rule(RULE_REACHABLE)
@@ -57,3 +69,34 @@ def check_reachable_host_sync(index):
                     if callee not in seen:
                         seen.add(callee)
                         queue.append((callee, chain + (callee,)))
+
+
+@project_rule(RULE_UNMANAGED)
+def check_unmanaged_device_put(index):
+    """Run-plane uploads must go through the residency manager.
+
+    ``jax.device_put`` outside storage/residency.py and ops/device_run.py
+    is always flagged (explicit placement is the residency manager's
+    job); implicit ``jnp.asarray``/``jnp.array`` uploads are flagged only
+    when the argument text names run-plane data (_PLANE_TOKENS), so
+    scalar and index-vector staging stays legal. Suppress deliberate
+    exceptions inline (``# yb-lint: disable=ijax/unmanaged-device-put``)
+    — e.g. the sharded mesh placement, which is accounted separately via
+    ``HbmCache.add_external``."""
+    for fn in sorted(index.functions.values(), key=lambda f: f.qualname):
+        if fn.rel.endswith(_UPLOAD_ALLOWLIST):
+            continue
+        for line, kind, arg in fn.uploads:
+            if kind == "asarray" and not any(
+                    tok in arg for tok in _PLANE_TOKENS):
+                continue
+            what = ("explicit jax.device_put" if kind == "device_put"
+                    else f"implicit jnp.asarray upload of `{arg}`")
+            yield Violation(
+                RULE_UNMANAGED, fn.rel, line,
+                f"{what} in {fn.qualname} bypasses the HBM residency "
+                f"manager (storage/residency.py) — plane uploads must be "
+                f"demand-paged through HbmCache.acquire so "
+                f"--tpu_hbm_budget_bytes and /memz device accounting "
+                f"stay truthful",
+                f"upload:{fn.name}:{kind}")

@@ -1,8 +1,11 @@
-"""DeviceRun: a ColumnarRun's planes resident in device memory (HBM).
+"""DeviceRun: a ColumnarRun's planes uploaded to device memory (HBM).
 
-Reference analog: the block cache holding SSTable blocks in RAM
-(src/yb/rocksdb/util/cache.cc) — except the TPU engine keeps whole runs
-HBM-resident and lets scans window over them with dynamic slices, so a
+Reference analog: the SSTable blocks an LRU block cache holds in RAM
+(src/yb/rocksdb/util/cache.cc).  A DeviceRun is the cached unit, not a
+permanent resident: the TPU engine demand-uploads runs through the
+residency manager (storage/residency.py) under ``--tpu_hbm_budget_bytes``
+and re-uploads from the authoritative host ColumnarRun after eviction.
+While resident, scans window over the planes with dynamic slices, so a
 scan is pure compute with no host↔device data motion besides its scalars
 and its (small) result.
 """
@@ -33,6 +36,37 @@ def dtype_kind(dt: DataType) -> str:
     return "i32"
 
 
+def padded_blocks(B: int, window_blocks: int) -> int:
+    """The padded block count a DeviceRun uses for a run of ``B`` blocks
+    — host-side math shared with residency sizing and warmup, so cache
+    keys and byte hints agree with the actual upload."""
+    b = max(B, 1)
+    return b + (-b) % window_blocks
+
+
+def plane_nbytes(run: ColumnarRun, window_blocks: int) -> int:
+    """Predicted HBM footprint of DeviceRun(run, window_blocks), computed
+    from host plane shapes without uploading — the eviction hint that
+    lets the residency cache make room *before* a demand upload."""
+    pb = padded_blocks(run.B, window_blocks)
+
+    def padded(arr) -> int:
+        per_block = 1
+        for d in arr.shape[1:]:
+            per_block *= int(d)
+        return pb * per_block * arr.dtype.itemsize
+
+    total = sum(padded(a) for a in (
+        run.valid, run.group_start, run.tomb, run.live,
+        run.ht_hi, run.ht_lo, run.exp_hi, run.exp_lo))
+    for col in run.cols.values():
+        total += padded(col.set_) + padded(col.isnull)
+        total += padded(col.cmp_planes)
+        if col.arith is not None:
+            total += padded(col.arith)
+    return total
+
+
 class DeviceRun:
     """Uploads a ColumnarRun, padding the block axis to a multiple of the
     window size so window tiling never clamps (clamped dynamic slices would
@@ -42,7 +76,7 @@ class DeviceRun:
         self.run = run
         self.K = window_blocks
         B = max(run.B, 1)
-        pad = (-B) % window_blocks
+        pad = padded_blocks(run.B, window_blocks) - B
         self.B = B + pad
         self.device = device or jax.devices()[0]
 

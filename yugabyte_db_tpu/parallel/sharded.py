@@ -42,8 +42,10 @@ from yugabyte_db_tpu.ops.agg_fold import (agg_init, check_limb_bound,
                                           pred_literal)
 from yugabyte_db_tpu.ops.scan import I32_MAX, I32_MIN
 from yugabyte_db_tpu.storage.columnar import ColumnarRun
+from yugabyte_db_tpu.storage.residency import device_nbytes, hbm_cache
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.utils import planes as PL
+from yugabyte_db_tpu.utils.memtracker import root_tracker
 
 
 # -- host-side assembly ------------------------------------------------------
@@ -81,9 +83,26 @@ class ShardedTablets:
 
         stacked = self._stack(runs, pad_t)
         spec_tb = P("t", "b")
+        # Mesh placement must shard, not cache: plane-group residency for
+        # sharded arrays is accounted (and pinned) via add_external below.
         self.arrays = jax.tree.map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, spec_tb)), stacked)
+            lambda a: jax.device_put(a, NamedSharding(mesh, spec_tb)),  # yb-lint: disable=ijax/unmanaged-device-put
+            stacked)
         self.padded_T = self.T + pad_t
+        # The stacked mesh arrays live outside the demand-upload path but
+        # inside the same HBM budget: account them as a pinned external
+        # entry so /memz, /metrics and eviction pressure see them.
+        self._res_key = hbm_cache().add_external(
+            self, device_nbytes(self.arrays),
+            root_tracker().child("device").child("sharded"), "sharded_mesh")
+
+    def close(self) -> None:
+        """Release the mesh arrays' residency accounting (the arrays
+        themselves free when the last reference dies)."""
+        if self._res_key is not None:
+            hbm_cache().invalidate(self._res_key)
+            self._res_key = None
+        self.arrays = None
 
     def _stack(self, runs, pad_t):
         B, R = self.B, self.R

@@ -40,6 +40,15 @@ def _memz() -> dict:
         out["trackers"] = root_tracker().dump()
     except ImportError:
         pass
+    try:
+        from yugabyte_db_tpu.storage.residency import hbm_cache
+
+        # budget / resident / pinned / pool breakdown for the HBM
+        # residency cache (the device-subtree numbers above are the
+        # MemTracker view of the same bytes).
+        out["hbm_cache"] = hbm_cache().stats()
+    except ImportError:
+        pass
     return out
 
 

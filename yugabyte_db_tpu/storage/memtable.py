@@ -26,6 +26,10 @@ class MemTable:
     def __init__(self):
         self._data: dict[bytes, list[RowVersion]] = {}
         self._sorted_keys: list[bytes] | None = []
+        # Apply-order log backing versions_since(); entries are the same
+        # RowVersion objects _data holds, so the overhead is one pointer
+        # per version.
+        self._log: list[RowVersion] | None = []
         self.num_versions = 0
         self.approx_bytes = 0
         self.min_ht = None
@@ -46,6 +50,8 @@ class MemTable:
                 self._sorted_keys = None  # new key invalidates the index
             else:
                 versions.append(r)
+            if self._log is not None:
+                self._log.append(r)
             self.num_versions += 1
             self.approx_bytes += len(r.key) + 64 + 16 * len(r.columns)
             if self.min_ht is None or r.ht < self.min_ht:
@@ -75,6 +81,15 @@ class MemTable:
 
     def versions(self, key: bytes) -> list[RowVersion]:
         return self._data.get(key, [])
+
+    def versions_since(self, n: int) -> list[RowVersion] | None:
+        """Row versions applied after global version index ``n`` (i.e.
+        once ``num_versions`` was ``n``), in apply order — the delta
+        source for the incremental scan overlay.  None when the log is
+        unavailable, which tells the caller to rebuild from scratch."""
+        if self._log is None:
+            return None
+        return self._log[n:]
 
     def merged(self, key: bytes, read_ht: int) -> MergedRow | None:
         versions = self._data.get(key)
@@ -112,11 +127,23 @@ class NativeMemTable:
     crash the Raft apply stage.
     """
 
+    # Stop logging for versions_since() past this many logged block
+    # bytes: a memtable this large is about to flush anyway, and the
+    # overlay falls back to a full rebuild when the log is gone.
+    LOG_BYTES_CAP = 64 << 20
+
     def __init__(self):
         from yugabyte_db_tpu.native import yb_wp
 
         self._mt = yb_wp.Memtable()
         self._spill: MemTable | None = None
+        # Apply-order log of ("b", encoded block) / ("r", RowVersion)
+        # entries with a parallel list of version-count offsets, backing
+        # versions_since().  Blocks are kept encoded (zero copies on the
+        # hot path) and decoded lazily on delta reads.
+        self._log: list[tuple[str, object]] | None = []
+        self._log_starts: list[int] = []
+        self._log_bytes = 0
 
     def __len__(self) -> int:
         return self.num_versions
@@ -151,20 +178,56 @@ class NativeMemTable:
     def is_empty(self) -> bool:
         return self.num_versions == 0
 
+    def _log_note(self, start: int, kind: str, payload,
+                  nbytes: int) -> None:
+        if self._log is None:
+            return
+        self._log_bytes += nbytes
+        if self._log_bytes > self.LOG_BYTES_CAP:
+            self._log = None
+            self._log_starts = []
+            return
+        self._log.append((kind, payload))
+        self._log_starts.append(start)
+
     def apply_block(self, block: bytes) -> None:
+        start = self.num_versions
         self._mt.apply_block(block)
+        self._log_note(start, "b", block, len(block))
 
     def apply(self, rows: list[RowVersion]) -> None:
         try:
-            self._mt.apply_block(rowblock.encode_rows(rows))
+            self.apply_block(rowblock.encode_rows(rows))
         except (OverflowError, ValueError, TypeError):
             for r in rows:  # isolate the un-encodable row(s)
                 try:
-                    self._mt.apply_block(rowblock.encode_rows([r]))
+                    self.apply_block(rowblock.encode_rows([r]))
                 except (OverflowError, ValueError, TypeError):
                     if self._spill is None:
                         self._spill = MemTable()
+                    start = self.num_versions
                     self._spill.apply([r])
+                    self._log_note(start, "r", r, len(r.key) + 64)
+
+    def versions_since(self, n: int) -> list[RowVersion] | None:
+        """Row versions applied after global version index ``n``, in
+        apply order (see MemTable.versions_since).  None once the log
+        was capped — callers must fall back to a full rebuild."""
+        if self._log is None:
+            return None
+        out: list[RowVersion] = []
+        i = max(bisect.bisect_right(self._log_starts, n) - 1, 0)
+        for kind, payload in self._log[i:]:
+            start = self._log_starts[i]
+            i += 1
+            if kind == "b":
+                rows = rowblock.rows_from_block(payload)
+            else:
+                rows = [payload]
+            if start + len(rows) <= n:
+                continue
+            out.extend(rows[max(n - start, 0):])
+        return out
 
     def scan_keys(self, lower: bytes, upper: bytes):
         native = self._mt.scan_keys(lower, upper)

@@ -1,0 +1,392 @@
+"""Device/host residency manager: the HBM block-cache analog.
+
+Reference analog: src/yb/rocksdb/util/cache.cc — the LRU block cache
+with a high-pri/low-pri pool split (sized and wired for docdb in
+docdb_rocksdb_util.cc) that lets SSTable working sets exceed RAM.  Here
+the cached unit is a whole columnar run's device plane group: the
+host-side ``ColumnarRun`` stays authoritative, ``TpuRun`` demand-uploads
+its ``DeviceRun`` through this cache on first access, and when the
+process-wide budget (``--tpu_hbm_budget_bytes``) is exceeded the least
+recently used unpinned plane group is dropped, releasing its device
+buffers and debiting the owning engine's ``device`` MemTracker subtree
+so /memz and /metrics show true residency.
+
+Scan resistance mirrors the reference's two-pool policy: point-get and
+bounded-scan traffic is admitted to (or promoted into) the protected
+high-pri pool; full-table-scan traffic is admitted to the low-pri pool,
+so one large scan streams through the low pool and cannot flush the hot
+working set.  A configurable fraction of the budget
+(``HIGH_PRI_POOL_RATIO``) caps the high pool; overflow demotes its LRU
+entries into the low pool, exactly like the reference's high-pri pointer
+walk.
+
+Pins keep a plane group resident across a dispatch window (issue→finish
+in ``scan_batch_async``, compaction's ``resident_gc_mask``, the cached
+delta-overlay primary, the sharded mesh arrays).  Pinned entries are
+never evicted — a pinned set larger than the budget overflows it
+(non-strict capacity, as in the reference's pinned-usage accounting)
+rather than failing the dispatch.
+
+This module deliberately imports no device framework: payloads are built
+by caller-supplied closures, so /memz handlers and tests can import it
+without touching jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+
+from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.memtracker import root_tracker
+from yugabyte_db_tpu.utils.metrics import hbm_cache_entity
+from yugabyte_db_tpu.utils.sync_point import sync_point
+
+# Fraction of the budget reserved for the protected (high-pri) pool.
+HIGH_PRI_POOL_RATIO = 0.8
+
+# Sentinel payload for externally-owned residency (bytes uploaded outside
+# the cache but accounted through it, e.g. the sharded mesh arrays).
+_EXTERNAL = object()
+
+
+class _Entry:
+    __slots__ = ("key", "label", "tracker", "owner_ref", "payload",
+                 "nbytes", "aux", "aux_bytes", "pins", "pool", "external")
+
+    def __init__(self, key: int, label: str, tracker):
+        self.key = key
+        self.label = label
+        self.tracker = tracker
+        self.owner_ref = None
+        self.payload = None
+        self.nbytes = 0
+        self.aux: dict = {}
+        self.aux_bytes = 0
+        self.pins = 0
+        self.pool = "high"
+        self.external = False
+
+    @property
+    def total_bytes(self) -> int:
+        return self.nbytes + self.aux_bytes
+
+
+class HbmCache:
+    """Process-wide capacity-budgeted cache of device plane groups.
+
+    Keys are integer tokens handed out by :meth:`register`; each token is
+    tied to its owner by a weakref, so a dropped run releases its device
+    bytes without the cache pinning the host run alive.  ``acquire`` is
+    the one read path: hit → LRU touch (plus promotion into the
+    protected pool when the access is ``priority="high"``), miss → evict
+    down to budget, build the payload via the caller's closure (the
+    demand re-upload), charge the owner's MemTracker, admit.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: dict[int, _Entry] = {}
+        # Eviction order: oldest first.  "low" drains before "high".
+        self._pools: dict[str, OrderedDict] = {"low": OrderedDict(),
+                                               "high": OrderedDict()}
+        self._next_key = 1
+        self._resident = 0
+        self._peak_resident = 0
+        ent = hbm_cache_entity()
+        self._m_hits = ent.counter("yb_hbm_cache_hits")
+        self._m_misses = ent.counter("yb_hbm_cache_misses")
+        self._m_evictions = ent.counter("yb_hbm_cache_evictions")
+        self._m_upload = ent.counter("yb_hbm_demand_upload_bytes")
+        ent.gauge("yb_hbm_resident_bytes", self.resident_bytes)
+        ent.gauge("yb_hbm_pinned_bytes", self.pinned_bytes)
+        ent.gauge("yb_hbm_budget_bytes", self.budget)
+
+    # -- configuration --------------------------------------------------------
+
+    @staticmethod
+    def budget() -> int:
+        """Current byte budget; 0 means unbounded."""
+        try:
+            return int(FLAGS.get("tpu_hbm_budget_bytes"))
+        except KeyError:
+            return 0
+
+    # -- registration ---------------------------------------------------------
+
+    def register(self, owner, tracker=None, label: str = "") -> int:
+        """A residency key for ``owner`` (a TpuRun or similar).  The
+        entry auto-invalidates when ``owner`` is collected; ``tracker``
+        (the engine's device MemTracker) is charged while resident."""
+        with self._lock:
+            key = self._next_key
+            self._next_key += 1
+            e = _Entry(key, label or type(owner).__name__, tracker)
+            if owner is not None:
+                e.owner_ref = weakref.ref(
+                    owner, lambda _r, k=key: self.invalidate(k))
+            self._entries[key] = e
+            return key
+
+    def add_external(self, owner, nbytes: int, tracker=None,
+                     label: str = "external") -> int:
+        """Account ``nbytes`` of device residency uploaded outside the
+        cache (sharded mesh arrays, the overlay's masked valid plane).
+        External entries are permanently pinned until invalidated (or
+        their owner is collected); they overflow the budget rather than
+        being evictable."""
+        key = self.register(owner, tracker, label)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:  # owner died during registration
+                return key
+            e.external = True
+            e.payload = _EXTERNAL
+            e.nbytes = int(nbytes)
+            e.pins = 1
+            self._pools["high"][key] = e
+            self._charge(e, e.nbytes)
+        return key
+
+    def invalidate(self, key: int) -> None:
+        """Drop the entry entirely: release device bytes and forget the
+        key.  Used on owner teardown; also the weakref callback."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None and e.payload is not None:
+                self._release_entry(e, evicted=False)
+
+    # -- the read path --------------------------------------------------------
+
+    def acquire(self, key: int, build, nbytes_hint: int | None = None,
+                priority: str | None = None, pin: bool = False):
+        """The payload for ``key``, demand-built on miss.
+
+        ``build`` returns ``(payload, nbytes)`` — it runs under the cache
+        lock, serializing uploads (by design: concurrent uploads under
+        memory pressure would overshoot the budget).  ``nbytes_hint``
+        lets the cache evict *before* uploading so residency never
+        transiently exceeds the budget.  ``priority`` is "high", "low",
+        or None; None admits high but never promotes an existing low
+        entry (so follow-up accesses inside a full scan don't defeat
+        scan resistance).  ``pin=True`` takes a pin before returning.
+        """
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                # Owner already unregistered (e.g. a scan finishing after
+                # compaction dropped its run): serve unmanaged so in-flight
+                # reads stay correct; nothing to account.
+                payload, _ = build()
+                return payload
+            if e.payload is not None:
+                pool = self._pools[e.pool]
+                pool.move_to_end(key)
+                if priority == "high" and e.pool == "low":
+                    self._move_pool(e, "high")
+                if pin:
+                    e.pins += 1
+                hit = True
+                payload = e.payload
+            else:
+                payload = self._admit(e, build, nbytes_hint, priority,
+                                      pin)
+                hit = False
+        (self._m_hits if hit else self._m_misses).increment()
+        return payload
+
+    def pin(self, key: int, build, nbytes_hint: int | None = None,
+            priority: str | None = None):
+        """Acquire + pin: the payload stays resident until :meth:`unpin`."""
+        return self.acquire(key, build, nbytes_hint, priority, pin=True)
+
+    def unpin(self, key: int) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            if e.pins > 0:
+                e.pins -= 1
+            # Unpinning may unlock deferred evictions.
+            b = self.budget()
+            if b and self._resident > b:
+                self._evict_until(b)
+
+    # -- derived-tensor side cars (pallas gather tensors) --------------------
+
+    def aux_get(self, key: int, aux_key):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.payload is None:
+                return None
+            return e.aux.get(aux_key)
+
+    def aux_put(self, key: int, aux_key, value, nbytes: int) -> None:
+        """Attach a derived device tensor set to a resident entry; it is
+        charged with — and dropped with — the entry.  A no-op if the
+        entry was evicted meanwhile (the caller still holds ``value``)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.payload is None or aux_key in e.aux:
+                return
+            e.aux[aux_key] = value
+            e.aux_bytes += int(nbytes)
+            self._charge(e, int(nbytes))
+            b = self.budget()
+            if b and self._resident > b:
+                self._evict_until(b)
+
+    # -- internals ------------------------------------------------------------
+
+    def _admit(self, e: _Entry, build, hint, priority, pin: bool):
+        b = self.budget()
+        root_tracker().child("device").set_limit(b or None)
+        if b and hint:
+            self._evict_until(max(b - int(hint), 0))
+        payload, nbytes = build()
+        e.payload = payload
+        e.nbytes = int(nbytes)
+        e.aux = {}
+        e.aux_bytes = 0
+        e.pool = "low" if priority == "low" else "high"
+        self._pools[e.pool][e.key] = e
+        if pin:
+            e.pins += 1
+        self._charge(e, e.nbytes)
+        self._m_upload.increment(e.nbytes)
+        if b:
+            self._rebalance_high(b)
+            self._evict_until(b)
+        sync_point("hbm_cache:admit", e.label)
+        return payload
+
+    def _charge(self, e: _Entry, nbytes: int) -> None:
+        self._resident += nbytes
+        if self._resident > self._peak_resident:
+            self._peak_resident = self._resident
+        if e.tracker is not None:
+            e.tracker.consume(nbytes)
+
+    def _move_pool(self, e: _Entry, pool: str) -> None:
+        self._pools[e.pool].pop(e.key, None)
+        e.pool = pool
+        self._pools[pool][e.key] = e
+
+    def _rebalance_high(self, b: int) -> None:
+        cap = int(b * HIGH_PRI_POOL_RATIO)
+        high = self._pools["high"]
+        hb = sum(en.total_bytes for en in high.values()
+                 if not en.external)
+        for k in list(high.keys()):
+            if hb <= cap:
+                break
+            en = high[k]
+            if en.external:
+                continue
+            self._move_pool(en, "low")
+            hb -= en.total_bytes
+
+    def _evict_until(self, target: int) -> None:
+        while self._resident > target:
+            if not self._evict_one():
+                break  # everything left is pinned: allowed overflow
+
+    def _evict_one(self) -> bool:
+        for pool_name in ("low", "high"):
+            for en in self._pools[pool_name].values():
+                if en.pins == 0 and not en.external:
+                    self._release_entry(en, evicted=True)
+                    return True
+        return False
+
+    def _release_entry(self, e: _Entry, evicted: bool) -> None:
+        total = e.total_bytes
+        self._pools[e.pool].pop(e.key, None)
+        e.payload = None
+        e.aux = {}
+        self._resident -= total
+        if e.tracker is not None:
+            e.tracker.release(total)
+        e.nbytes = 0
+        e.aux_bytes = 0
+        e.pins = 0
+        if evicted:
+            self._m_evictions.increment()
+            sync_point("hbm_cache:evict", e.label)
+
+    # -- introspection --------------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident
+
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return sum(e.total_bytes
+                       for pool in self._pools.values()
+                       for e in pool.values() if e.pins > 0)
+
+    def peak_resident_bytes(self) -> int:
+        with self._lock:
+            return self._peak_resident
+
+    def evict_unpinned(self) -> int:
+        """Drop every unpinned entry (test hook for eviction pressure);
+        returns how many entries were evicted."""
+        n = 0
+        with self._lock:
+            while self._evict_one():
+                n += 1
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            pools = {
+                name: {"entries": len(pool),
+                       "bytes": sum(e.total_bytes for e in pool.values())}
+                for name, pool in self._pools.items()}
+            out = {
+                "budget_bytes": self.budget(),
+                "resident_bytes": self._resident,
+                "peak_resident_bytes": self._peak_resident,
+                "registered": len(self._entries),
+                "pools": pools,
+            }
+        out["pinned_bytes"] = self.pinned_bytes()
+        out["hits"] = self._m_hits.get()
+        out["misses"] = self._m_misses.get()
+        out["evictions"] = self._m_evictions.get()
+        out["demand_upload_bytes"] = self._m_upload.get()
+        return out
+
+
+def device_nbytes(tree) -> int:
+    """Device bytes of a nested dict/list/tuple of arrays (duck-typed:
+    anything with .size and .dtype.itemsize) — the footprint charged for
+    cache payloads and aux tensors."""
+    total = 0
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (list, tuple)):
+            stack.extend(node)
+        elif node is not None:
+            total += int(node.size) * node.dtype.itemsize
+    return total
+
+
+_CACHE: HbmCache | None = None
+_CACHE_LOCK = threading.Lock()
+
+
+def hbm_cache() -> HbmCache:
+    """The process-wide residency cache (one HBM, one budget)."""
+    global _CACHE
+    if _CACHE is None:
+        with _CACHE_LOCK:
+            if _CACHE is None:
+                _CACHE = HbmCache()
+    return _CACHE

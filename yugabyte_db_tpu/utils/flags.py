@@ -86,6 +86,13 @@ FLAGS.define("tpu_engine_use_pallas", False,
              "route eligible flat-run aggregate scans through the "
              "hand-written Pallas fold kernel (ops.pallas_agg) instead "
              "of the XLA scan program", ("evolving", "runtime"))
+FLAGS.define("tpu_hbm_budget_bytes", 0,
+             "capacity budget for device-resident (HBM) columnar run "
+             "planes; 0 = unbounded. When set, run planes are "
+             "demand-uploaded through the storage.residency cache and "
+             "evicted LRU with a scan-resistant two-pool policy "
+             "(reference: rocksdb/util/cache.cc high-pri/low-pri split)",
+             ("evolving", "runtime"))
 FLAGS.define("global_memstore_limit_bytes", 1 << 40,
              "process-wide memtable budget; crossing it flushes the "
              "engine that noticed (reference: the shared memory_monitor "

@@ -62,6 +62,13 @@ class MemTracker:
     def over_limit(self) -> bool:
         return self.limit is not None and self.consumption > self.limit
 
+    def set_limit(self, limit: int | None) -> None:
+        """Update the limit shown next to consumption in /memz dumps —
+        for runtime-settable budgets (e.g. the HBM residency budget
+        mirrored onto the root->device subtree)."""
+        with self._lock:
+            self.limit = limit
+
     def detach(self) -> None:
         """Remove this tracker from its parent (releasing any residual
         consumption up the tree)."""

@@ -279,6 +279,23 @@ def observe_serve_batch(proto: str, ops: int) -> None:
         _SWALLOW_LOG.debug("observe_serve_batch failed for %s", proto)
 
 
+# -- HBM residency-cache observability ----------------------------------------
+_HBM_ENTITY: MetricEntity | None = None
+
+
+def hbm_cache_entity() -> MetricEntity:
+    """The process-registry entity carrying the HBM residency-cache
+    series (``yb_hbm_cache_hits``/``misses``/``evictions``,
+    ``yb_hbm_demand_upload_bytes``, ``yb_hbm_resident_bytes``) — same
+    pattern as ``yb_serve_batch_ops``: the cache is process-wide, so its
+    series render on every daemon's /metrics scrape."""
+    global _HBM_ENTITY
+    with _SERVE_LOCK:
+        if _HBM_ENTITY is None:
+            _HBM_ENTITY = _PROCESS_REGISTRY.entity()
+        return _HBM_ENTITY
+
+
 _HOST_VERIFY_ENTITY: MetricEntity | None = None
 
 
