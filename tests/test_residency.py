@@ -248,6 +248,48 @@ def test_accounting_budget_and_detach(budget_flag):
     assert name not in parent._children
 
 
+def test_alter_keeps_runs_managed(budget_flag):
+    """ALTER invalidates each live run's stale device planes but must
+    keep its residency registration: the post-alter demand re-upload
+    goes through the cache (accounted, budgeted, evictable), never the
+    unmanaged unregistered-owner fallback — which would duplicate
+    planes per access and silently escape the budget."""
+    schema, cpu, tpu, _ = load_engines(n_flushes=2, tail_writes=0)
+    budget = plane_budget(tpu, 0.5)
+    budget_flag(budget)
+    cache = hbm_cache()
+    try:
+        new_schema = schema.with_added_column("d", DataType.INT64)
+        cpu.alter_schema(new_schema)
+        tpu.alter_schema(new_schema)
+        # Registrations survive the invalidation...
+        for t in tpu.runs:
+            assert t._res_key in cache._entries
+        # ...and the evolved planes are gone until the next access.
+        assert all(cache._entries[t._res_key].payload is None
+                   for t in tpu.runs)
+        before = cache.stats()["demand_upload_bytes"]
+        for spec in SCAN_BATTERY:
+            assert_same(cpu, tpu, **spec)
+        stats = cache.stats()
+        # The re-upload was a managed miss, charged to the cache.
+        assert stats["demand_upload_bytes"] > before
+        # A fresh pinned access lands IN the cache (not an unmanaged
+        # copy); pinned so tight-budget eviction can't race the check.
+        tpu.runs[0].pin()
+        try:
+            assert (cache._entries[tpu.runs[0]._res_key].payload
+                    is not None)
+        finally:
+            tpu.runs[0].unpin()
+        gc.collect()
+        cache.evict_unpinned()
+        assert cache.resident_bytes() <= budget + cache.pinned_bytes()
+    finally:
+        cpu.close()
+        tpu.close()
+
+
 def test_overlay_incremental_delta(budget_flag):
     """A second post-write scan advances the cached overlay by the
     memtable delta: same masked plane object when only existing keys

@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import threading
 import weakref
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from yugabyte_db_tpu.utils.flags import FLAGS
 from yugabyte_db_tpu.utils.memtracker import root_tracker
@@ -88,6 +88,13 @@ class HbmCache:
     def __init__(self):
         self._lock = threading.RLock()
         self._entries: dict[int, _Entry] = {}
+        # Keys whose owners were collected.  Weakref death callbacks run
+        # at arbitrary allocation points — including re-entrantly on a
+        # thread already inside the cache (the lock is an RLock) — so
+        # they must not mutate _entries/_pools directly; they append
+        # here (deque.append is atomic) and every public method drains
+        # the queue under the lock before touching shared state.
+        self._dead: deque[int] = deque()
         # Eviction order: oldest first.  "low" drains before "high".
         self._pools: dict[str, OrderedDict] = {"low": OrderedDict(),
                                                "high": OrderedDict()}
@@ -120,12 +127,13 @@ class HbmCache:
         entry auto-invalidates when ``owner`` is collected; ``tracker``
         (the engine's device MemTracker) is charged while resident."""
         with self._lock:
+            self._drain_dead()
             key = self._next_key
             self._next_key += 1
             e = _Entry(key, label or type(owner).__name__, tracker)
             if owner is not None:
                 e.owner_ref = weakref.ref(
-                    owner, lambda _r, k=key: self.invalidate(k))
+                    owner, lambda _r, k=key: self._dead.append(k))
             self._entries[key] = e
             return key
 
@@ -138,6 +146,7 @@ class HbmCache:
         being evictable."""
         key = self.register(owner, tracker, label)
         with self._lock:
+            self._drain_dead()
             e = self._entries.get(key)
             if e is None:  # owner died during registration
                 return key
@@ -151,9 +160,24 @@ class HbmCache:
 
     def invalidate(self, key: int) -> None:
         """Drop the entry entirely: release device bytes and forget the
-        key.  Used on owner teardown; also the weakref callback."""
+        key.  Owner-teardown only — a later acquire() on this key takes
+        the unmanaged fallback.  For owners that stay live (planes
+        rebuilt in place), use :meth:`release` instead."""
         with self._lock:
+            self._drain_dead()
             e = self._entries.pop(key, None)
+            if e is not None and e.payload is not None:
+                self._release_entry(e, evicted=False)
+
+    def release(self, key: int) -> None:
+        """Drop the entry's resident payload but keep the registration:
+        the next acquire() demand-rebuilds through the cache, still
+        budgeted and MemTracker-accounted.  The right call when the
+        owner outlives its current upload (e.g. ALTER grows the host
+        planes and the stale device copy must go)."""
+        with self._lock:
+            self._drain_dead()
+            e = self._entries.get(key)
             if e is not None and e.payload is not None:
                 self._release_entry(e, evicted=False)
 
@@ -173,6 +197,7 @@ class HbmCache:
         scan resistance).  ``pin=True`` takes a pin before returning.
         """
         with self._lock:
+            self._drain_dead()
             e = self._entries.get(key)
             if e is None:
                 # Owner already unregistered (e.g. a scan finishing after
@@ -203,6 +228,7 @@ class HbmCache:
 
     def unpin(self, key: int) -> None:
         with self._lock:
+            self._drain_dead()
             e = self._entries.get(key)
             if e is None:
                 return
@@ -217,6 +243,7 @@ class HbmCache:
 
     def aux_get(self, key: int, aux_key):
         with self._lock:
+            self._drain_dead()
             e = self._entries.get(key)
             if e is None or e.payload is None:
                 return None
@@ -227,6 +254,7 @@ class HbmCache:
         charged with — and dropped with — the entry.  A no-op if the
         entry was evicted meanwhile (the caller still holds ``value``)."""
         with self._lock:
+            self._drain_dead()
             e = self._entries.get(key)
             if e is None or e.payload is None or aux_key in e.aux:
                 return
@@ -238,6 +266,19 @@ class HbmCache:
                 self._evict_until(b)
 
     # -- internals ------------------------------------------------------------
+
+    def _drain_dead(self) -> None:
+        """Reap entries whose owners were collected (lock held).  The
+        weakref callbacks only enqueue; all structural mutation happens
+        here, at a point where no pool iteration is in progress."""
+        while True:
+            try:
+                key = self._dead.popleft()
+            except IndexError:
+                return
+            e = self._entries.pop(key, None)
+            if e is not None and e.payload is not None:
+                self._release_entry(e, evicted=False)
 
     def _admit(self, e: _Entry, build, hint, priority, pin: bool):
         b = self.budget()
@@ -319,10 +360,12 @@ class HbmCache:
 
     def resident_bytes(self) -> int:
         with self._lock:
+            self._drain_dead()
             return self._resident
 
     def pinned_bytes(self) -> int:
         with self._lock:
+            self._drain_dead()
             return sum(e.total_bytes
                        for pool in self._pools.values()
                        for e in pool.values() if e.pins > 0)
@@ -336,12 +379,14 @@ class HbmCache:
         returns how many entries were evicted."""
         n = 0
         with self._lock:
+            self._drain_dead()
             while self._evict_one():
                 n += 1
         return n
 
     def stats(self) -> dict:
         with self._lock:
+            self._drain_dead()
             pools = {
                 name: {"entries": len(pool),
                        "bytes": sum(e.total_bytes for e in pool.values())}

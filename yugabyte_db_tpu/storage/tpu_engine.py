@@ -111,7 +111,16 @@ class TpuRun:
         hbm_cache().unpin(self._res_key)
 
     def invalidate_device(self) -> None:
-        """Drop any resident planes (run retired or planes rebuilt)."""
+        """Drop any resident planes for a run that stays live (host
+        planes rebuilt in place, e.g. ALTER adding columns).  The
+        residency registration survives, so the next access demand
+        re-uploads through the cache — budgeted and tracker-accounted,
+        not the unmanaged unregistered-owner fallback."""
+        hbm_cache().release(self._res_key)
+
+    def retire(self) -> None:
+        """Run leaving the run set for good (compaction, restore,
+        close): drop resident planes and the registration itself."""
         hbm_cache().invalidate(self._res_key)
 
     def pallas_tensors(self, col_order: tuple):
@@ -414,7 +423,7 @@ class TpuStorageEngine(StorageEngine):
         self._plan_cache.clear()
         self._drop_overlay_cache()
         for t in old_runs:
-            t.invalidate_device()
+            t.retire()
 
     def _drop_overlay_cache(self) -> None:
         """Forget the cached delta-overlay state, releasing its pin on
@@ -432,7 +441,7 @@ class TpuStorageEngine(StorageEngine):
     def close(self) -> None:
         self._drop_overlay_cache()
         for t in self.runs:
-            t.invalidate_device()
+            t.retire()
         self.device_tracker.detach()
         super().close()
 
@@ -548,7 +557,7 @@ class TpuStorageEngine(StorageEngine):
 
         c_hi, c_lo = P.scalar_ht_planes(max(cutoff, 0))
         keep_dev = None
-        gc_pinned = False
+        gc_pins: list[TpuRun] = []
         try:
             if N > HOST_GC_MASK_MAX and self._device_gc_fits_budget():
                 # Device retention mask over RESIDENT planes: upload only
@@ -559,7 +568,7 @@ class TpuStorageEngine(StorageEngine):
                 # can't drop planes the mask program still references.
                 for t in self.runs:
                     t.pin("low")
-                gc_pinned = True
+                    gc_pins.append(t)
                 R = self.rows_per_block
                 offsets = np.cumsum(
                     [0] + [t.dev.B * R for t in self.runs])[:-1]
@@ -621,9 +630,8 @@ class TpuStorageEngine(StorageEngine):
             if keep_dev is not None:
                 keep = np.asarray(keep_dev)
         finally:
-            if gc_pinned:
-                for t in self.runs:
-                    t.unpin()
+            for t in gc_pins:
+                t.unpin()
 
         kept_pos = np.nonzero(keep[:].astype(bool) & (perm < N))[0]
         kept_src = perm[kept_pos]
@@ -784,7 +792,7 @@ class TpuStorageEngine(StorageEngine):
         self._plan_cache.clear()
         self._drop_overlay_cache()
         for t in old_runs:
-            t.invalidate_device()
+            t.retire()
 
     def dump_entries(self):
         """All flushed (key, versions ht-desc) pairs, key-merged across
@@ -1026,34 +1034,49 @@ class TpuStorageEngine(StorageEngine):
             want_pin(trun, self._scan_priority(spec))
         for item in grouped_sink:
             want_pin(item[0], self._scan_priority(item[1]))
+        # Until the _AsyncBatch below takes ownership (its finish path
+        # unpins), any failure while pinning or planning must unwind the
+        # pins already taken, or those entries stay unevictable for the
+        # process lifetime.
         pins = []
-        for trun, priority in want_pins.values():
-            trun.pin(priority)
-            pins.append(trun)
-        if deferred:
-            # Single-source device aggregates dispatch together: one
-            # vmapped program per (run, signature) group.
-            items = [(pi, trun, spec, exact) for pi, (trun, spec, exact)
-                     in zip(deferred, agg_sink)]
-            issued_outs.extend(self._plan_device_aggregate_batch(items))
-        if gdeferred:
-            items = [(pi, trun, spec, exact, payload)
-                     for pi, (trun, spec, exact, payload)
-                     in zip(gdeferred, grouped_sink)]
-            issued_outs.extend(self._plan_grouped_batch(items))
-        # Page items defer wholesale to finish() (device work first);
-        # host_page.serve_pages runs them through the native page server.
-        pages = page_items
+        try:
+            for trun, priority in want_pins.values():
+                trun.pin(priority)
+                pins.append(trun)
+            if deferred:
+                # Single-source device aggregates dispatch together: one
+                # vmapped program per (run, signature) group.
+                items = [(pi, trun, spec, exact)
+                         for pi, (trun, spec, exact)
+                         in zip(deferred, agg_sink)]
+                issued_outs.extend(
+                    self._plan_device_aggregate_batch(items))
+            if gdeferred:
+                items = [(pi, trun, spec, exact, payload)
+                         for pi, (trun, spec, exact, payload)
+                         in zip(gdeferred, grouped_sink)]
+                issued_outs.extend(self._plan_grouped_batch(items))
+            # Page items defer wholesale to finish() (device work
+            # first); host_page.serve_pages runs them through the
+            # native page server.
+            pages = page_items
 
-        states = dict(gathers)
-        pending = {pi: st.pending for pi, st in gathers if st.pending}
-        dispatches = self._issue_round(states, pending) if pending else []
-        for leaf in jax.tree.leaves([[d for _c, d in dispatches],
-                                     [o for _pi, o, _f in issued_outs]]):
-            leaf.copy_to_host_async()
-        return _AsyncBatch(self, results, host_plans, issued_outs,
-                           gathers, states, pending, dispatches, pages,
-                           pre_work, pins)
+            states = dict(gathers)
+            pending = {pi: st.pending for pi, st in gathers
+                       if st.pending}
+            dispatches = (self._issue_round(states, pending)
+                          if pending else [])
+            for leaf in jax.tree.leaves([[d for _c, d in dispatches],
+                                         [o for _pi, o, _f
+                                          in issued_outs]]):
+                leaf.copy_to_host_async()
+            return _AsyncBatch(self, results, host_plans, issued_outs,
+                               gathers, states, pending, dispatches,
+                               pages, pre_work, pins)
+        except BaseException:
+            for trun in pins:
+                trun.unpin()
+            raise
 
     def scan_batch_wire(self, specs: list[ScanSpec], fmt: str = "cql"):
         """Wire-serialized pages with the native fast path: LIMIT pages
