@@ -34,3 +34,17 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_injection():
+    """Leak containment for the fault-injection plane: a test that arms
+    a fault flag or a sync point and then fails (or forgets cleanup)
+    must not poison the next test — armed one-shot faults would fire in
+    whatever unrelated code path calls maybe_fault() next."""
+    yield
+    from yugabyte_db_tpu.utils.fault_injection import clear_faults
+    from yugabyte_db_tpu.utils.sync_point import SYNC_POINT
+
+    clear_faults()
+    SYNC_POINT.disable_and_clear()

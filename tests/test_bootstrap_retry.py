@@ -14,7 +14,10 @@ from yugabyte_db_tpu.integration import MiniCluster
 from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
 from yugabyte_db_tpu.storage import wire
+from yugabyte_db_tpu.storage.row_version import RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+from yugabyte_db_tpu.utils.fault_injection import arm_fault_once
+from yugabyte_db_tpu.utils.metrics import faults_fired
 
 COLUMNS = [
     ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
@@ -171,3 +174,74 @@ def _try(fn, *args):
         return True
     except Exception:
         return False
+
+
+def test_crash_recovery_replays_wal_and_dedups_retries(tmp_path):
+    """WAL sync fault mid-workload, then crash-restart the leader: the
+    tablet must come back via bootstrap WAL replay with every acked row,
+    and a re-sent (client_id, request_id) write must dedup to the
+    ORIGINAL hybrid time — RetryableRequests state is rebuilt by replay,
+    so a client retrying across the crash still gets exactly-once."""
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=3).start()
+    try:
+        c.wait_tservers_registered()
+        client = c.client()
+        table = client.create_table("cr", COLUMNS, num_tablets=1)
+        s = YBSession(client)
+        for i in range(30):
+            s.insert(table, {"k": f"a{i}", "v": i})
+        s.flush()
+
+        # Mid-workload: the next WAL sync fails. On the leader raft
+        # swallows it (the majority acks via follower appends), so the
+        # writes below must still be acked and durable cluster-wide.
+        fired0 = faults_fired("fault.wal_sync_failed")
+        arm_fault_once("fault.wal_sync_failed")
+        for i in range(30, 60):
+            s.insert(table, {"k": f"a{i}", "v": i})
+        s.flush()
+        assert faults_fired("fault.wal_sync_failed") == fired0 + 1
+
+        # One write with an explicit request id (the retry-dedup probe).
+        loc = client.meta_cache.locations("cr", refresh=True).tablets[0]
+        enc = wire.encode_rows([RowVersion(
+            table.encode_key({"k": "dup"}), ht=0, liveness=True,
+            columns={table.col_id["v"]: 999})])
+        payload = {"rows": enc, "client_id": client.client_id,
+                   "request_id": 4242}
+        r1 = client.tablet_rpc("cr", loc, "ts.write", dict(payload))
+        assert r1["code"] == "ok"
+
+        # Crash-restart the leader.
+        leader = next(
+            ts.uuid for ts in c.tservers.values()
+            if any(p.tablet_id == loc.tablet_id and p.is_leader()
+                   for p in ts.tablet_manager.peers()))
+        c.stop_tserver(leader)
+        c.start_tserver(leader)
+
+        # The restarted replica replays its WAL: all 61 acked rows back.
+        def replayed():
+            try:
+                peer = c.tservers[leader].tablet_manager.get(loc.tablet_id)
+                res = peer.tablet.engine.scan(ScanSpec(projection=["k"]))
+            except Exception:
+                return False
+            return len(res.rows) == 61
+        wait_for(replayed, timeout=60.0, msg="bootstrap WAL replay")
+
+        # The client's RETRY of the same request (same client_id +
+        # request_id, re-sent because the crash made the first ack
+        # uncertain from its point of view) must be absorbed by dedup.
+        loc = client.meta_cache.locations("cr", refresh=True).tablets[0]
+        r2 = client.tablet_rpc("cr", loc, "ts.write", dict(payload))
+        assert r2["code"] == "ok"
+        assert r2["ht"] == r1["ht"], \
+            "replayed RetryableRequests must return the original ht"
+
+        # And the cluster still serves every acked row exactly once.
+        res = s.scan(table, ScanSpec(projection=["k", "v"]))
+        assert len(res.rows) == 61
+        assert sum(1 for row in res.rows if row[0] == "dup") == 1
+    finally:
+        c.shutdown()

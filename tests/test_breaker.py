@@ -1,0 +1,271 @@
+"""Circuit-breaker fault domain: state machine + engine degrade/recover.
+
+Unit half: the CLOSED -> OPEN -> HALF_OPEN transitions on an injectable
+clock (no sleeping through cooldowns). Integration half: a device
+dispatch fault trips the TPU engine into host-serve mode — scans stay
+byte-identical to the CPU oracle, ``yb_engine_degraded`` goes 1 -> 0
+across the half-open probe, and neither residency pins nor the device
+MemTracker leak across the degrade/recover cycle.
+"""
+
+import random
+import time
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import RowVersion, ScanSpec, make_engine
+from yugabyte_db_tpu.storage.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                             CircuitBreaker, degraded,
+                                             health_report)
+from yugabyte_db_tpu.storage.residency import hbm_cache
+from yugabyte_db_tpu.utils.fault_injection import arm_fault_once
+from yugabyte_db_tpu.utils.metrics import process_registry
+import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401  (registers 'tpu')
+
+
+def degraded_gauge() -> int:
+    """Read yb_engine_degraded off the process registry the way a
+    scraper would (the callback gauge lives on the entity the breaker
+    module wired; the text endpoint is the public surface)."""
+    total = 0
+    for line in process_registry().prometheus_text().splitlines():
+        if line.startswith("yb_engine_degraded"):
+            total += int(float(line.rsplit(" ", 1)[1]))
+    return total
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def make_breaker(threshold=3, cooldown=1.0):
+    clock = FakeClock()
+    b = CircuitBreaker("test", failure_threshold=threshold,
+                       cooldown_s=cooldown, clock=clock)
+    return b, clock
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_breaker_stays_closed_below_threshold():
+    b, _ = make_breaker(threshold=3)
+    for _ in range(2):
+        assert b.allow()
+        b.record_failure(RuntimeError("x"))
+    assert b.state == CLOSED
+    assert b.allow()
+    assert not b.is_degraded
+
+
+def test_breaker_success_resets_failure_streak():
+    b, _ = make_breaker(threshold=3)
+    b.record_failure(RuntimeError("x"))
+    b.record_failure(RuntimeError("x"))
+    b.record_success()
+    b.record_failure(RuntimeError("x"))
+    b.record_failure(RuntimeError("x"))
+    assert b.state == CLOSED  # streak broke; never reached 3 consecutive
+
+
+def test_breaker_trips_open_and_blocks_until_cooldown():
+    b, clock = make_breaker(threshold=2, cooldown=5.0)
+    b.record_failure(RuntimeError("a"))
+    b.record_failure(RuntimeError("b"))
+    assert b.state == OPEN
+    assert b.trips == 1
+    assert not b.allow()
+    clock.advance(4.9)
+    assert not b.allow()
+    clock.advance(0.2)
+    assert b.allow()  # cooldown elapsed: half-open, probe admitted
+    assert b.state == HALF_OPEN
+
+
+def test_breaker_half_open_admits_exactly_one_probe():
+    b, clock = make_breaker(threshold=1, cooldown=1.0)
+    b.record_failure(RuntimeError("x"))
+    clock.advance(1.5)
+    assert b.allow()       # the probe
+    assert not b.allow()   # everyone else stays on the fallback
+    assert not b.allow()
+    b.record_success()
+    assert b.state == CLOSED
+    assert b.allow()
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    b, clock = make_breaker(threshold=1, cooldown=2.0)
+    b.record_failure(RuntimeError("x"))
+    clock.advance(2.5)
+    assert b.allow()
+    b.record_failure(RuntimeError("probe died"))
+    assert b.state == OPEN
+    assert b.trips == 2
+    assert not b.allow()          # fresh cooldown from the probe failure
+    clock.advance(1.9)
+    assert not b.allow()
+    clock.advance(0.2)
+    assert b.allow()
+
+
+def test_breaker_trip_opens_immediately_and_reset_closes():
+    b, _ = make_breaker(threshold=5)
+    exc = RuntimeError("native module gone")
+    b.trip(exc)
+    assert b.state == OPEN
+    assert b.last_error is exc
+    assert b in degraded()
+    report = health_report()
+    assert report["status"] == "degraded"
+    assert any(d["breaker"] == "test" for d in report["degraded"])
+    b.reset()
+    assert b.state == CLOSED
+    assert b not in degraded()
+
+
+def test_degraded_gauge_counts_open_breakers():
+    base = degraded_gauge()
+    b, clock = make_breaker(threshold=1, cooldown=1.0)
+    b.record_failure(RuntimeError("x"))
+    assert degraded_gauge() == base + 1
+    clock.advance(1.5)
+    assert b.allow()
+    # HALF_OPEN still counts as degraded — only a successful probe clears.
+    assert degraded_gauge() == base + 1
+    b.record_success()
+    assert degraded_gauge() == base
+
+
+# ----------------------------------------------------- engine integration
+
+
+def _make_schema():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("a", DataType.INT64),
+        ColumnSchema("b", DataType.STRING),
+    ], table_id="t")
+
+
+def _load(schema, engines, n=120, seed=11):
+    rnd = random.Random(seed)
+    cids = {c.name: c.col_id for c in schema.value_columns}
+    ht = 0
+    for i in range(n):
+        ht += rnd.randrange(1, 3)
+        key = schema.encode_primary_key(
+            {"k": rnd.choice(["p", "q"]), "r": i % 53},
+            compute_hash_code(schema, {"k": rnd.choice(["p", "q"])}))
+        row = RowVersion(key, ht=ht, liveness=True, columns={
+            cids["a"]: rnd.randrange(-100, 100),
+            cids["b"]: f"v{i}"})
+        for eng in engines:
+            eng.apply([row])
+    return ht
+
+
+def _assert_identical(cpu, tpu, spec):
+    a = cpu.scan(spec)
+    b = tpu.scan(spec)
+    assert a.columns == b.columns
+    assert a.rows == b.rows
+    assert a.resume_key == b.resume_key
+    return b
+
+
+def test_engine_degrade_and_recover_byte_identical():
+    """The acceptance scenario: device-dispatch fault -> breaker opens,
+    scans re-serve from host byte-identically, yb_engine_degraded goes
+    1 -> 0 after the half-open probe, and no residency pin or device
+    MemTracker bytes leak."""
+    schema = _make_schema()
+    opts = {"breaker_failure_threshold": 1, "breaker_cooldown_s": 0.05}
+    cpu = make_engine("cpu", schema, dict(opts))
+    tpu = make_engine("tpu", schema, dict(opts, rows_per_block=32))
+    max_ht = _load(schema, [cpu, tpu])
+    cpu.flush()
+    tpu.flush()
+    spec = ScanSpec(read_ht=max_ht + 1, limit=1000)
+
+    def quiesce():
+        tpu._drop_overlay_cache()
+        hbm_cache().evict_unpinned()
+
+    _assert_identical(cpu, tpu, spec)  # healthy baseline
+    quiesce()
+    pins0 = hbm_cache().pinned_bytes()
+    dev0 = tpu.device_tracker.consumption
+    base = degraded_gauge()
+
+    # One armed dispatch fault trips the threshold-1 breaker; the faulted
+    # batch itself must already be re-served from the host, byte-identical.
+    arm_fault_once("fault.tpu_dispatch")
+    _assert_identical(cpu, tpu, spec)
+    assert tpu.breaker.state == OPEN
+    assert degraded_gauge() == base + 1
+    assert tpu.breaker in degraded()
+
+    # While quarantined (cooldown not yet elapsed) every scan serves from
+    # the host path — still byte-identical, still degraded.
+    _assert_identical(cpu, tpu, spec)
+    assert tpu.breaker.state == OPEN
+
+    # Cooldown elapses; the next scan is the half-open probe. It succeeds
+    # (the fault was one-shot) and the breaker closes: recovered.
+    time.sleep(0.06)
+    _assert_identical(cpu, tpu, spec)
+    assert tpu.breaker.state == CLOSED
+    assert degraded_gauge() == base
+
+    # No leaks across the whole degrade/recover cycle.
+    quiesce()
+    assert hbm_cache().pinned_bytes() == pins0
+    assert tpu.device_tracker.consumption == dev0
+
+    cpu.close()
+    tpu.close()
+
+
+def test_engine_open_breaker_serves_writes_made_during_degrade():
+    """Writes applied while the device path is quarantined are visible
+    through the host-serve path and after recovery (the host structures
+    are authoritative; the device is only an accelerator)."""
+    schema = _make_schema()
+    opts = {"breaker_failure_threshold": 1, "breaker_cooldown_s": 0.05}
+    cpu = make_engine("cpu", schema, dict(opts))
+    tpu = make_engine("tpu", schema, dict(opts, rows_per_block=32))
+    max_ht = _load(schema, [cpu, tpu], n=40)
+    cpu.flush()
+    tpu.flush()
+
+    arm_fault_once("fault.tpu_dispatch")
+    tpu.scan(ScanSpec(read_ht=max_ht + 1, limit=10))
+    assert tpu.breaker.state == OPEN
+
+    # New write lands in the memtable while degraded.
+    cids = {c.name: c.col_id for c in schema.value_columns}
+    key = schema.encode_primary_key(
+        {"k": "zz", "r": 1}, compute_hash_code(schema, {"k": "zz"}))
+    row = RowVersion(key, ht=max_ht + 2, liveness=True,
+                     columns={cids["a"]: 777, cids["b"]: "late"})
+    cpu.apply([row])
+    tpu.apply([row])
+    spec = ScanSpec(read_ht=max_ht + 3, limit=1000)
+
+    _assert_identical(cpu, tpu, spec)     # host-serve sees the new row
+    time.sleep(0.06)
+    _assert_identical(cpu, tpu, spec)     # probe succeeds, device path back
+    assert tpu.breaker.state == CLOSED
+
+    cpu.close()
+    tpu.close()

@@ -1,0 +1,213 @@
+"""Unit tests for the unified retry/deadline policy (utils/retry.py).
+
+These pin down the contract every RPC loop in the tree now leans on:
+deadline debiting, jittered-exponential backoff shape, retriable
+classification across the failure representations that actually occur
+(Status, Code, wire-code string, response dict, exception), and the
+attempts()/call() loop drivers.
+"""
+
+import random
+
+import pytest
+
+from yugabyte_db_tpu.utils.retry import (RETRIABLE_WIRE_CODES, Deadline,
+                                         DeadlineExpired, RetryPolicy)
+from yugabyte_db_tpu.utils.status import Code, Status, StatusError
+
+
+def no_sleep_policy(**kw):
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("rng", random.Random(7))
+    return RetryPolicy(**kw)
+
+
+# ------------------------------------------------------------- Deadline
+
+
+def test_deadline_after_and_remaining():
+    d = Deadline.after(10.0)
+    assert 9.0 < d.remaining() <= 10.0
+    assert not d.expired()
+    d.check("op")  # no raise
+
+
+def test_deadline_expired_raises_timed_out():
+    d = Deadline.after(-1.0)
+    assert d.expired()
+    with pytest.raises(DeadlineExpired) as ei:
+        d.check("scan")
+    assert ei.value.status.code == Code.TIMED_OUT
+    assert "scan" in str(ei.value)
+
+
+def test_deadline_timeout_caps_at_remaining():
+    d = Deadline.after(0.5)
+    assert d.timeout(2.0) <= 0.5
+    assert d.timeout(0.1) == pytest.approx(0.1, abs=0.01)
+    expired = Deadline.after(-5.0)
+    assert expired.timeout(2.0) == 0.0  # floored, never negative
+
+
+def test_infinite_deadline_never_expires():
+    d = Deadline.infinite()
+    assert not d.expired()
+    assert d.timeout(3.0) == 3.0
+    assert d.remaining() == float("inf")
+
+
+# ------------------------------------------------------- classification
+
+
+def test_retriable_accepts_every_failure_shape():
+    p = no_sleep_policy(max_attempts=3)
+    assert p.retriable(Code.TIMED_OUT)
+    assert p.retriable(Status(Code.SERVICE_UNAVAILABLE, "x"))
+    assert p.retriable("timed_out")
+    assert p.retriable({"code": "not_leader"})
+    assert p.retriable(StatusError(Status(Code.NETWORK_ERROR, "x")))
+    assert p.retriable(TimeoutError("slow"))
+    assert p.retriable(ConnectionError("refused"))
+
+
+def test_terminal_failures_are_not_retriable():
+    p = no_sleep_policy(max_attempts=3)
+    assert not p.retriable(None)
+    assert not p.retriable(Code.INVALID_ARGUMENT)
+    assert not p.retriable(Code.EXPIRED)  # the budget itself — never retried
+    assert not p.retriable("conflict")
+    assert not p.retriable({"code": "ok"})
+    assert not p.retriable(ValueError("bug"))
+
+
+def test_wire_codes_mirror_the_rpc_payload_convention():
+    assert "timed_out" in RETRIABLE_WIRE_CODES
+    assert "not_leader" in RETRIABLE_WIRE_CODES
+    assert "conflict" not in RETRIABLE_WIRE_CODES
+
+
+# ------------------------------------------------------------- backoff
+
+
+def test_backoff_grows_exponentially_within_jitter_bounds():
+    p = no_sleep_policy(max_attempts=10, initial_backoff_s=0.1,
+                        max_backoff_s=10.0, multiplier=2.0, jitter=0.25)
+    for n, base in [(1, 0.1), (2, 0.2), (3, 0.4), (4, 0.8)]:
+        for _ in range(20):
+            s = p.backoff_s(n)
+            assert base * 0.75 <= s <= base * 1.25
+
+
+def test_backoff_is_capped_at_max():
+    p = no_sleep_policy(max_attempts=10, initial_backoff_s=0.1,
+                        max_backoff_s=0.5, multiplier=2.0, jitter=0.0)
+    assert p.backoff_s(10) == pytest.approx(0.5)
+
+
+def test_unbounded_policy_is_rejected_at_construction():
+    with pytest.raises(ValueError):
+        RetryPolicy()
+
+
+# ------------------------------------------------------------ attempts
+
+
+def test_attempts_stop_at_max_attempts():
+    p = no_sleep_policy(max_attempts=4)
+    numbers = [a.number for a in p.attempts()]
+    assert numbers == [1, 2, 3, 4]
+
+
+def test_attempts_sleep_between_iterations_but_not_after_last():
+    slept = []
+    p = RetryPolicy(max_attempts=3, sleep=slept.append,
+                    rng=random.Random(7))
+    list(p.attempts())
+    assert len(slept) == 2  # n attempts -> n-1 backoffs
+
+
+def test_attempts_stop_when_deadline_expires():
+    p = no_sleep_policy(max_attempts=100)
+    d = Deadline.after(-1.0)
+    # First attempt is always yielded (the caller gets one shot), then
+    # the exhausted deadline stops the loop.
+    assert [a.number for a in p.attempts(deadline=d)] == [1]
+
+
+def test_attempts_never_sleep_past_the_deadline():
+    slept = []
+    p = RetryPolicy(max_attempts=50, initial_backoff_s=5.0,
+                    sleep=slept.append, rng=random.Random(7))
+    d = Deadline.after(0.2)
+    list(p.attempts(deadline=d))
+    assert all(s <= 0.2 for s in slept)
+
+
+def test_attempt_note_carries_the_last_failure():
+    p = no_sleep_policy(max_attempts=2)
+    seen = None
+    for attempt in p.attempts():
+        attempt.note({"code": "timed_out"})
+        seen = attempt.last
+    assert seen == {"code": "timed_out"}
+
+
+def test_attempts_timeout_s_overrides_policy_budget():
+    p = no_sleep_policy(timeout_s=100.0, initial_backoff_s=0.001)
+    count = 0
+    for attempt in p.attempts(timeout_s=-1.0):
+        count += 1
+    assert count == 1  # the explicit (already expired) budget wins
+
+
+# ---------------------------------------------------------------- call
+
+
+def test_call_returns_first_success():
+    p = no_sleep_policy(max_attempts=5)
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt.number)
+        if attempt.number < 3:
+            raise TimeoutError("not yet")
+        return "ok"
+
+    assert p.call(fn) == "ok"
+    assert calls == [1, 2, 3]
+
+
+def test_call_propagates_terminal_errors_immediately():
+    p = no_sleep_policy(max_attempts=5)
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt.number)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        p.call(fn)
+    assert calls == [1]
+
+
+def test_call_reraises_last_retriable_failure_on_exhaustion():
+    p = no_sleep_policy(max_attempts=2)
+
+    def fn(attempt):
+        raise ConnectionError(f"attempt {attempt.number}")
+
+    with pytest.raises(ConnectionError, match="attempt 2"):
+        p.call(fn)
+
+
+def test_call_raises_deadline_expired_when_nothing_ran():
+    p = no_sleep_policy(max_attempts=5)
+    d = Deadline.after(-1.0)
+
+    # One attempt is always yielded; make it fail retriably so the loop
+    # consults the (expired) deadline and gives up.
+    def fn(attempt):
+        raise TimeoutError("x")
+
+    with pytest.raises(TimeoutError):
+        p.call(fn, deadline=d)

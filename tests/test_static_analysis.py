@@ -658,6 +658,78 @@ def test_irpc_deadline_propagated_is_clean(tmp_path):
     assert not fired(res, "irpc/handler-no-deadline")
 
 
+def test_irpc_bare_retry_loop_reaching_rpc_fires(tmp_path):
+    """An except-continue while loop with no deadline/attempt bound,
+    reaching a blocking send through a helper — the interprocedural
+    part: the loop body itself never names the transport."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/bad_loop.py": """\
+        class Pinger:
+            def __init__(self, transport):
+                self.transport = transport
+
+            def ping_until_up(self, peer):
+                while True:
+                    try:
+                        resp = self._send_one(peer)
+                    except ConnectionError:
+                        continue
+                    if resp.get("code") == "ok":
+                        return resp
+
+            def _send_one(self, peer):
+                return self.transport.send(peer, "ping", {}, timeout=1.0)
+    """})
+    (v,) = fired(res, "irpc/bare-retry-loop")
+    assert "transport.send" in v.message
+    assert "ping_until_up" in v.message
+
+
+def test_irpc_budgeted_retry_loops_are_clean(tmp_path):
+    """The two sanctioned shapes: a RetryPolicy.attempts() for-loop and
+    a while loop explicitly bounded by a Deadline."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/rpc/ok_loop.py": """\
+        class Pinger:
+            def __init__(self, transport, policy):
+                self.transport = transport
+                self.policy = policy
+
+            def ping_with_policy(self, peer):
+                for attempt in self.policy.attempts():
+                    try:
+                        return self.transport.send(
+                            peer, "ping", {}, timeout=attempt.timeout(1.0))
+                    except ConnectionError as e:
+                        attempt.note(e)
+                        continue
+
+            def ping_with_deadline(self, peer, deadline):
+                while not deadline.expired():
+                    try:
+                        return self.transport.send(
+                            peer, "ping", {}, timeout=deadline.timeout(1.0))
+                    except ConnectionError:
+                        continue
+    """})
+    assert not fired(res, "irpc/bare-retry-loop")
+
+
+def test_irpc_bare_loop_without_rpc_is_clean(tmp_path):
+    """A budget-less retry loop around pure computation is somebody
+    else's problem — the rule only fires when a blocking RPC is in
+    reach."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/utils/spin.py": """\
+        def stir(items):
+            out = []
+            while items:
+                try:
+                    out.append(items.pop())
+                except IndexError:
+                    continue
+            return out
+    """})
+    assert not fired(res, "irpc/bare-retry-loop")
+
+
 # -- interprocedural: ijax ---------------------------------------------------
 
 def test_ijax_jit_reachable_item_helper_fires(tmp_path):

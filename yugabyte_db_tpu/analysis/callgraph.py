@@ -58,6 +58,17 @@ _BLOCKING_QUALNAME_TAILS = ("Proxy.call", "Transport.send",
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 _TIMEOUT_WORDS = ("timeout", "deadline")
+
+# Tokens whose presence in a while-loop's test or body mark the loop as
+# BOUNDED: either by a retry budget (deadline/attempts — the
+# utils.retry discipline) or by service lifecycle (a daemon's
+# `while self._running` pump retries for as long as the server lives,
+# which is deliberate, not a bug). irpc/bare-retry-loop only fires on
+# loops with none of these.
+_LOOP_BOUND_TOKENS = ("deadline", "remaining", "expired", "attempt",
+                      "retries", "budget", "policy", "monotonic",
+                      "running", "stopped", "alive", "shutdown", "closed",
+                      "done")
 _STATUS_HELPERS = {"Status", "ok", "not_found", "invalid_argument",
                    "illegal_state", "ql_error"}
 _HOST_SYNC_TAILS = (".item", ".tolist")
@@ -103,6 +114,8 @@ class CallSite:
     timeout_arg: bool = False      # a timeout/deadline argument is passed
     caught: frozenset = frozenset()  # exception names the enclosing try catches
     caught_broad: bool = False     # enclosing try has except [Base]Exception
+    retry_loop: int = 0            # line of enclosing BARE while-retry loop
+    #                                (no budget/lifecycle bound), 0 if none
 
 
 @dataclass
@@ -166,6 +179,47 @@ def is_blocking_raw(raw: str) -> bool:
     return any(raw.endswith(s) for s in _BLOCKING_RAW_SUFFIXES)
 
 
+def _mentions_bound_token(nodes) -> bool:
+    """Any name/attribute among ``nodes`` mentioning a budget or
+    lifecycle token (see _LOOP_BOUND_TOKENS)."""
+    for sub in nodes:
+        if isinstance(sub, ast.Name):
+            ident = sub.id.lower()
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr.lower()
+        else:
+            continue
+        if any(tok in ident for tok in _LOOP_BOUND_TOKENS):
+            return True
+    return False
+
+
+def _walk_skip_defs(nodes: list):
+    """ast.walk over statements, not descending into nested defs (they
+    run on their own stack, not in the enclosing loop)."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if not isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.append(c)
+
+
+def _has_retry_except(body: list) -> bool:
+    """True when the loop body contains a ``try`` whose handler
+    ``continue``s — the retry-on-failure shape."""
+    for sub in _walk_skip_defs(body):
+        if not isinstance(sub, ast.Try):
+            continue
+        for handler in sub.handlers:
+            if any(isinstance(n, ast.Continue)
+                   for hs in handler.body for n in ast.walk(hs)):
+                return True
+    return False
+
+
 def _mentions_static_shape(node: ast.AST) -> bool:
     for sub in ast.walk(node):
         if isinstance(sub, ast.Attribute) and sub.attr in (
@@ -193,6 +247,7 @@ class _FunctionScanner(ast.NodeVisitor):
         self.held: list[str] = []
         self.caught: list[tuple[frozenset, bool]] = []
         self._expr_calls: set[int] = set()  # Call node ids that are bare stmts
+        self._bare_loops: list[int] = []    # enclosing bare-retry-loop lines
 
     # -- lock tokens ---------------------------------------------------------
     def _lock_token(self, expr: ast.AST) -> str | None:
@@ -225,6 +280,23 @@ class _FunctionScanner(ast.NodeVisitor):
             self.visit(stmt)
         for _ in acquired:
             self.held.pop()
+
+    # -- retry loops ---------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        """A ``while`` whose test AND body mention no budget or lifecycle
+        bound, but whose body retries via except-continue, is a bare
+        retry loop — every call inside is annotated with its line so
+        irpc/bare-retry-loop can ask whether one reaches a blocking RPC.
+        (``for`` loops are never bare: their iterator is the bound —
+        the clean pattern is ``for attempt in policy.attempts()``.)"""
+        bare = (not _mentions_bound_token(ast.walk(node.test))
+                and not _mentions_bound_token(_walk_skip_defs(node.body))
+                and _has_retry_except(node.body))
+        if bare:
+            self._bare_loops.append(node.lineno)
+        self.generic_visit(node)
+        if bare:
+            self._bare_loops.pop()
 
     # -- try context ---------------------------------------------------------
     def visit_Try(self, node: ast.Try):
@@ -329,7 +401,8 @@ class _FunctionScanner(ast.NodeVisitor):
                 held=frozenset(self.held),
                 discards=id(node) in self._expr_calls,
                 timeout_arg=_timeout_in_call(node),
-                caught=frozenset(caught), caught_broad=broad))
+                caught=frozenset(caught), caught_broad=broad,
+                retry_loop=self._bare_loops[-1] if self._bare_loops else 0))
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript):

@@ -11,6 +11,14 @@ budget and pin a service-pool worker long after the client gave up
 ``transport.send``/``Proxy.call`` site it can reach, and fires when the
 blocking call passes no timeout/deadline argument — neither an explicit
 value nor a forwarded ``timeout_s``-style parameter.
+
+``irpc/bare-retry-loop`` flags the other half of the discipline: a
+``while`` loop that retries on exception (except-continue) with no
+budget in sight — no deadline/attempt bound in the test or body, no
+service-lifecycle flag — when something inside the loop reaches a
+blocking RPC. Such a loop retries forever against a dead peer,
+pinning its thread past any caller's budget; the fix is
+``utils.retry.RetryPolicy.attempts()`` (or an explicit Deadline check).
 """
 
 from __future__ import annotations
@@ -19,6 +27,7 @@ from yugabyte_db_tpu.analysis.core import Violation, project_rule
 from yugabyte_db_tpu.analysis.callgraph import is_blocking_raw
 
 RULE_NO_DEADLINE = "irpc/handler-no-deadline"
+RULE_BARE_RETRY = "irpc/bare-retry-loop"
 
 _MAX_DEPTH = 8
 
@@ -56,3 +65,50 @@ def check_handler_deadlines(index):
                     if callee not in seen:
                         seen.add(callee)
                         queue.append((callee, chain + (callee,)))
+
+
+def _reaches_blocking(index, callees) -> str | None:
+    """BFS through the call graph from ``callees``: the raw text of the
+    first blocking RPC primitive reachable, or None."""
+    queue = [(c, 1) for c in callees]
+    seen = set(callees)
+    while queue:
+        qualname, depth = queue.pop(0)
+        fn = index.functions.get(qualname)
+        if fn is None or depth > _MAX_DEPTH:
+            continue
+        for cs in fn.calls:
+            if is_blocking_raw(cs.raw):
+                return cs.raw
+            for callee in cs.callees:
+                if callee not in seen:
+                    seen.add(callee)
+                    queue.append((callee, depth + 1))
+    return None
+
+
+@project_rule(RULE_BARE_RETRY)
+def check_bare_retry_loops(index):
+    reported: set[tuple[str, int]] = set()
+    for fn in sorted(index.functions.values(), key=lambda f: f.qualname):
+        for cs in fn.calls:
+            if not cs.retry_loop:
+                continue
+            key = (fn.rel, cs.retry_loop)
+            if key in reported:
+                continue
+            if is_blocking_raw(cs.raw):
+                blocking = cs.raw
+            else:
+                blocking = _reaches_blocking(index, cs.callees)
+            if blocking is None:
+                continue
+            reported.add(key)
+            yield Violation(
+                RULE_BARE_RETRY, fn.rel, cs.retry_loop,
+                f"unbudgeted retry loop in {fn.qualname} reaches blocking "
+                f"{blocking} — an except-continue while loop with no "
+                f"deadline or attempt bound retries a dead peer forever; "
+                f"drive it with utils.retry.RetryPolicy.attempts() or an "
+                f"explicit Deadline",
+                f"bareretry:{fn.name}")

@@ -15,6 +15,7 @@ from yugabyte_db_tpu.consensus.transport import TransportError
 from yugabyte_db_tpu.models.partition import compute_hash_code
 from yugabyte_db_tpu.models.schema import ColumnSchema, Schema
 from yugabyte_db_tpu.utils.metrics import count_swallowed
+from yugabyte_db_tpu.utils.retry import RetryPolicy
 
 
 class MasterUnavailable(Exception):
@@ -85,6 +86,13 @@ class YBClient:
         # server's clock ratchets past it — a read after a write (or
         # after a transaction commit) can never miss it.
         self.last_observed_ht = 0
+        # One retry/deadline policy for every blocking loop in this
+        # client (utils.retry): jittered exponential backoff between
+        # failover sweeps, every attempt debiting the call's one
+        # deadline. The reference's RpcRetrier/TabletInvoker shape.
+        self.retry_policy = RetryPolicy(
+            timeout_s=default_rpc_timeout_s,
+            initial_backoff_s=0.05, max_backoff_s=0.5)
 
     @classmethod
     def connect(cls, master_addrs: str) -> "YBClient":
@@ -124,10 +132,11 @@ class YBClient:
     def master_rpc(self, method: str, payload: dict,
                    timeout_s: float | None = None) -> dict:
         """Call the master leader, following NOT_THE_LEADER hints and
-        retrying through the master set until the deadline."""
-        deadline = time.monotonic() + (timeout_s or self.default_rpc_timeout_s)
+        retrying through the master set until the RetryPolicy's deadline
+        budget runs out (each failover sweep debits it; backoff between
+        sweeps is jittered so clients don't re-converge in lockstep)."""
         last = None
-        while time.monotonic() < deadline:
+        for attempt in self.retry_policy.attempts(timeout_s=timeout_s):
             targets = ([self._master_leader_hint]
                        if self._master_leader_hint else []) + \
                 [u for u in self.master_uuids
@@ -135,7 +144,7 @@ class YBClient:
             for target in targets:
                 try:
                     resp = self.transport.send(target, method, payload,
-                                               timeout=2.0)
+                                               timeout=attempt.timeout(2.0))
                 except (TransportError, TimeoutError) as e:
                     last = e
                     continue
@@ -145,7 +154,7 @@ class YBClient:
                     continue
                 self._master_leader_hint = target
                 return resp
-            time.sleep(0.05)
+            attempt.note(last)
         raise MasterUnavailable(f"{method}: no master leader ({last})")
 
     # -- ddl ----------------------------------------------------------------
@@ -215,21 +224,31 @@ class YBClient:
         replica fallback (reference: TabletInvoker::Execute). ``prefer``
         puts one replica first in the try order (stale same-zone reads);
         ``mark_leader=False`` suppresses leader learning for responses a
-        follower may legitimately serve."""
-        deadline = time.monotonic() + (timeout_s or self.default_rpc_timeout_s)
+        follower may legitimately serve.
+
+        Deadline propagation: every attempt debits ONE RetryPolicy
+        budget, and the remaining budget rides in ``payload["timeout"]``
+        so the server's read gate / engine batch give up before the
+        client stops waiting (the clean "timed_out" reply reaches the
+        caller instead of a transport error)."""
         payload = dict(payload, tablet_id=loc.tablet_id)
         payload.setdefault("propagated_ht", self.last_observed_ht)
         tried_refresh = False
         last = None
-        while time.monotonic() < deadline:
+        for attempt in self.retry_policy.attempts(timeout_s=timeout_s):
             targets = ([loc.leader] if loc.leader else []) + \
                 [r for r in loc.replicas if r != loc.leader]
             if prefer is not None and prefer in loc.replicas:
                 targets = [prefer] + [t for t in targets if t != prefer]
             for target in targets:
+                transport_timeout = attempt.timeout(5.0)
+                # Server-side budget: stay below the transport timeout
+                # so the server's own timed_out beats the socket's.
+                payload["timeout"] = max(0.05,
+                                         round(transport_timeout * 0.8, 3))
                 try:
                     resp = self.transport.send(target, method, payload,
-                                               timeout=5.0)
+                                               timeout=transport_timeout)
                 except (TransportError, TimeoutError) as e:
                     last = e
                     continue
@@ -280,6 +299,6 @@ class YBClient:
                             break
                 except Exception as e:  # noqa: BLE001
                     last = e
-            time.sleep(0.05)
+            attempt.note(last)
         raise TabletOpFailed(
             f"{method} on {loc.tablet_id} failed before deadline: {last}")

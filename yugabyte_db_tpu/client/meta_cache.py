@@ -10,6 +10,14 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from yugabyte_db_tpu.utils.retry import RetryPolicy
+
+# A location lookup retries only transient master-side failures; a
+# missing table ("not_found") is terminal here — unlike the tablet-RPC
+# loop, where not_found means a replica is mid-move.
+_LOOKUP_RETRIABLE = frozenset({"timed_out", "service_unavailable",
+                               "try_again"})
+
 
 @dataclass
 class TabletLocation:
@@ -37,6 +45,9 @@ class MetaCache:
         self._client = client
         self._lock = threading.Lock()
         self._tables: dict[str, TableLocations] = {}
+        self.retry_policy = RetryPolicy(
+            timeout_s=5.0, initial_backoff_s=0.05, max_backoff_s=0.5,
+            retriable_wire_codes=_LOOKUP_RETRIABLE)
 
     def locations(self, table_name: str,
                   refresh: bool = False) -> TableLocations:
@@ -44,9 +55,14 @@ class MetaCache:
             locs = self._tables.get(table_name)
         if locs is not None and not refresh:
             return locs
-        resp = self._client.master_rpc("master.get_table_locations",
-                                       {"name": table_name})
-        if resp.get("code") != "ok":
+        resp = None
+        for attempt in self.retry_policy.attempts():
+            resp = self._client.master_rpc("master.get_table_locations",
+                                           {"name": table_name})
+            if not self.retry_policy.retriable(resp):
+                break
+            attempt.note(resp)
+        if resp is None or resp.get("code") != "ok":
             raise KeyError(f"table {table_name!r}: {resp}")
         locs = TableLocations(resp["table_id"], resp["schema"])
         for t in resp["tablets"]:

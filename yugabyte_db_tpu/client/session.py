@@ -461,11 +461,18 @@ class YBSession:
                 sub = ScanSpec(lower=spec.lower, upper=spec.upper,
                                read_ht=read_ht, predicates=spec.predicates,
                                aggregates=partial_aggs)
+                mesh_timeout = min(5.0, timeout_s)
                 try:
+                    # Budget rides server-side (below the transport
+                    # timeout) so a slow pin returns a clean timed_out
+                    # and the per-tablet fallback still has time to run.
                     resp = self.client.transport.send(
                         leader, "ts.multi_agg_scan",
                         {"tablet_ids": [g.tablet_id for g in group],
-                         "spec": wire.encode_spec(sub)}, timeout=5.0)
+                         "spec": wire.encode_spec(sub),
+                         "timeout": max(0.05,
+                                        round(mesh_timeout * 0.8, 3))},
+                        timeout=mesh_timeout)
                 except Exception as e:  # noqa: BLE001 — per-tablet fallback
                     count_swallowed("session.multi_agg_scan", e)
                     continue

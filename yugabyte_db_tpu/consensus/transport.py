@@ -21,9 +21,43 @@ import time
 # The seam itself lives in the rpc layer; re-exported here because
 # consensus is where most callers historically imported it from.
 from yugabyte_db_tpu.rpc.interface import Transport, TransportError
+from yugabyte_db_tpu.utils.retry import Deadline, RetryPolicy
 
 __all__ = ["Transport", "TransportError", "LocalTransport",
-           "BoundTransport"]
+           "BoundTransport", "send_with_retry"]
+
+# Default policy for one-off sends through the seam: a short budget with
+# jittered backoff (server-to-server fire-and-forget helpers; latency-
+# sensitive loops construct their own).
+_SEND_POLICY = RetryPolicy(timeout_s=5.0, initial_backoff_s=0.05,
+                           max_backoff_s=0.5)
+
+
+def send_with_retry(transport: Transport, dst: str, method: str,
+                    payload: dict, *, policy: RetryPolicy | None = None,
+                    deadline: Deadline | None = None,
+                    timeout_s: float | None = None,
+                    attempt_cap: float = 2.0) -> dict:
+    """``transport.send`` under a RetryPolicy: transient transport
+    failures and retriable wire codes back off and retry until the one
+    deadline budget runs out; terminal responses return immediately.
+    Raises TransportError when the policy gives up."""
+    policy = policy or _SEND_POLICY
+    last: object = None
+    for attempt in policy.attempts(deadline=deadline, timeout_s=timeout_s):
+        try:
+            resp = transport.send(dst, method, payload,
+                                  timeout=attempt.timeout(attempt_cap))
+        except (TransportError, TimeoutError, ConnectionError) as e:
+            last = e
+            attempt.note(e)
+            continue
+        if not policy.retriable(resp):
+            return resp
+        last = resp
+        attempt.note(resp)
+    raise TransportError(
+        f"{dst} unreachable before deadline ({method}): {last}")
 
 
 class LocalTransport(Transport):

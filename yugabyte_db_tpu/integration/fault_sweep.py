@@ -1,0 +1,409 @@
+"""Randomized fault-sweep harness: seeded faults against a live
+mini-cluster workload, invariants checked after every round.
+
+Reference analog: the randomized kill-testing loop of
+src/yb/integration-tests (ExternalMiniClusterITest crash-point sweeps)
+crossed with the fault-injection flags of util/fault_injection.h — a
+seeded RNG drives both the workload and the fault schedule, so any
+failing sweep replays byte-for-byte from its seed.
+
+Each round fires one fault from the catalog mid-workload:
+
+==================  =======================================================
+``wal_sync``        ``fault.wal_sync_failed`` armed once: the next WAL
+                    group-commit raises; the write's outcome is ambiguous
+                    (appended-but-unsynced entries may still replicate).
+``respond_dropped`` ``fault.ts_write_respond_failed`` armed once: the
+                    write APPLIES but the response reports failure; the
+                    client retry must dedup (exactly-once).
+``leader_crash``    The tserver hosting the most leaders is stopped and
+                    restarted (bootstrap replay); in-flight ops fail over.
+``device_dispatch`` ``fault.tpu_dispatch`` armed once: the next device
+                    dispatch faults; the circuit breaker must re-serve
+                    from the host byte-identically and later recover.
+``hbm_eviction``    ``hbm_cache().evict_unpinned()`` hammered from a side
+                    thread while scans run (mid-scan eviction pressure).
+==================  =======================================================
+
+Invariants after every round (each returns a list of error strings):
+
+1. **No acked write lost** — every acknowledged write is visible at its
+   exact value; writes whose ack was lost to a fault may hold either the
+   old or the attempted value (never anything else).
+2. **Engine diff** — for every TPU-engine leader, the device scan path
+   and the host (CPU) serve path return byte-identical rows; the
+   breaker must be recovered (``yb_engine_degraded == 0``) first.
+3. **No leaked residency pins** — ``hbm_cache().pinned_bytes() == 0``
+   once no scan is in flight.
+4. **MemTracker baseline** — after evicting every unpinned entry the
+   device subtree's consumption returns to the post-setup baseline
+   (a leaked pin or unaccounted upload shows up here).
+
+The harness also asserts its injection ledger against the
+``yb_faults_fired{name=...}`` process metric — the fault points
+themselves count fires, so a fault that silently failed to arm (or
+fired twice) is caught rather than trusted.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from yugabyte_db_tpu.client.session import YBSession
+from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
+from yugabyte_db_tpu.storage.breaker import degraded
+from yugabyte_db_tpu.storage.residency import hbm_cache
+from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+from yugabyte_db_tpu.utils.fault_injection import (arm_fault_once,
+                                                   clear_faults)
+from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.memtracker import root_tracker
+from yugabyte_db_tpu.utils.metrics import faults_fired
+
+FAULT_CATALOG = ("wal_sync", "respond_dropped", "leader_crash",
+                 "device_dispatch", "hbm_eviction")
+
+# Catalog entries backed by a maybe_fault() point (armed one-shot and
+# asserted against the yb_faults_fired metric).
+ARMED_FLAG = {
+    "wal_sync": "fault.wal_sync_failed",
+    "respond_dropped": "fault.ts_write_respond_failed",
+    "device_dispatch": "fault.tpu_dispatch",
+}
+
+# "the row is absent" in the oracle / acceptable-value sets.
+ABSENT = object()
+
+
+class FaultSweep:
+    """One seeded sweep: a MiniCluster with a TPU-engine table, a
+    keyed write/scan workload, one fault per round, invariants after
+    each. ``run()`` returns a summary dict or raises AssertionError
+    with every violated invariant (prefixed by the seed, so the report
+    alone is enough to replay)."""
+
+    def __init__(self, data_root: str, seed: int, rounds: int = 5,
+                 ops_per_round: int = 16,
+                 faults: tuple = FAULT_CATALOG,
+                 schedule: tuple | None = None,
+                 num_tservers: int = 3, num_tablets: int = 2,
+                 keyspace: int = 48):
+        self.data_root = data_root
+        self.seed = seed
+        self.rounds = len(schedule) if schedule is not None else rounds
+        self.ops_per_round = ops_per_round
+        self.faults = tuple(faults)
+        # Explicit per-round fault names (deterministic coverage: one
+        # round per catalog entry); None = rng-chosen from ``faults``.
+        self.schedule = tuple(schedule) if schedule is not None else None
+        self.num_tservers = num_tservers
+        self.num_tablets = num_tablets
+        self.keys = [f"k{i:04d}" for i in range(keyspace)]
+        self.rng = random.Random(seed)
+        # key -> last acked value (ABSENT = acked delete / never written)
+        self.oracle: dict[str, object] = {}
+        # key -> set of acceptable values while the last write's ack was
+        # lost to a fault (old value or attempted value, until a later
+        # acked write re-fixes it)
+        self.ambiguous: dict[str, set] = {}
+        self._next_value = 0
+        self.fired_ledger: dict[str, int] = {}
+        self.errors: list[str] = []
+        self.mc: MiniCluster | None = None
+        self.client = None
+        self.table = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def setup(self) -> None:
+        FLAGS.set("fault.seed", self.seed, force=True)
+        self._fired_base = {n: faults_fired(f)
+                            for n, f in ARMED_FLAG.items()}
+        self.mc = MiniCluster(
+            self.data_root, num_tservers=self.num_tservers,
+            # A fast breaker so degrade -> half-open probe -> recover
+            # fits inside one round.
+            engine_options={"breaker_cooldown_s": 0.05,
+                            "breaker_failure_threshold": 1}).start()
+        self.mc.wait_tservers_registered()
+        self.client = self.mc.client()
+        self.client.create_table("sweep", [
+            ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+            ColumnSchema("v", DataType.INT64)],
+            num_tablets=self.num_tablets, engine="tpu")
+        self.table = self.client.open_table("sweep")
+        # Pre-fill + flush so the device path has runs to scan.
+        s = YBSession(self.client)
+        for k in self.keys[: len(self.keys) // 2]:
+            v = self._bump_value()
+            s.insert(self.table, {"k": k, "v": v})
+            self.oracle[k] = v
+        s.flush()
+        self._flush_tablets()
+        self._scan_cluster()  # warm the device path
+        self._quiesce_device()
+        self._device_baseline = root_tracker().child("device").consumption
+
+    def teardown(self) -> None:
+        clear_faults()
+        FLAGS.set("fault.seed", 0, force=True)
+        if self.mc is not None:
+            self.mc.shutdown()
+            self.mc = None
+
+    def run(self) -> dict:
+        self.setup()
+        try:
+            for rnd in range(self.rounds):
+                fault = (self.schedule[rnd] if self.schedule is not None
+                         else self.faults[self.rng.randrange(
+                             len(self.faults))])
+                self._run_round(rnd, fault)
+                self.errors.extend(
+                    f"round {rnd} ({fault}, seed {self.seed}): {e}"
+                    for e in self.check_invariants())
+            self.errors.extend(
+                f"final (seed {self.seed}): {e}"
+                for e in self._check_fired_ledger())
+            if self.errors:
+                raise AssertionError(
+                    "fault sweep invariants violated:\n  "
+                    + "\n  ".join(self.errors))
+            return {"seed": self.seed, "rounds": self.rounds,
+                    "faults_fired": dict(self.fired_ledger),
+                    "keys": len(self.oracle)}
+        finally:
+            self.teardown()
+
+    # -- one round -----------------------------------------------------------
+
+    def _run_round(self, rnd: int, fault: str) -> None:
+        fire_at = self.rng.randrange(self.ops_per_round)
+        evictor = None
+        for i in range(self.ops_per_round):
+            if i == fire_at:
+                evictor = self._fire(fault)
+            self._one_op()
+            if i % 5 == 4:
+                self._scan_cluster()
+        # Ensure every armed fault point is actually reached this round:
+        # a write (WAL sync + response path) and a scan (device dispatch)
+        # both run after the arm point.
+        self._one_op(kind="insert")
+        self._scan_cluster()
+        if evictor is not None:
+            evictor.join(timeout=5.0)
+
+    def _fire(self, fault: str) -> threading.Thread | None:
+        flag = ARMED_FLAG.get(fault)
+        if flag is not None:
+            arm_fault_once(flag)
+            self.fired_ledger[fault] = self.fired_ledger.get(fault, 0) + 1
+            return None
+        if fault == "leader_crash":
+            self._crash_and_restart_leader()
+            return None
+        if fault == "hbm_eviction":
+            # Eviction pressure racing the scans the round keeps issuing.
+            def pound():
+                try:
+                    for _ in range(20):
+                        hbm_cache().evict_unpinned()
+                        time.sleep(0.002)
+                except Exception as e:  # noqa: BLE001 — surfaced as a failure
+                    self.errors.append(f"evictor thread died: {e!r}")
+
+            t = threading.Thread(target=pound, name="sweep-evictor",
+                                 daemon=True)
+            t.start()
+            return t
+        raise ValueError(f"unknown fault {fault!r}")
+
+    def _crash_and_restart_leader(self) -> None:
+        counts = {
+            uuid: sum(1 for p in ts.tablet_manager.peers()
+                      if p.is_leader())
+            for uuid, ts in self.mc.tservers.items()}
+        victim = max(counts, key=counts.get)
+        self.mc.stop_tserver(victim)
+        try:
+            self._one_op()          # ops fail over to the new leader
+        finally:
+            self.mc.restart_tserver(victim)
+        self.mc.wait_tservers_registered()
+
+    def _one_op(self, kind: str | None = None) -> None:
+        k = self.keys[self.rng.randrange(len(self.keys))]
+        if kind is None:
+            kind = "delete" if self.rng.random() < 0.15 else "insert"
+        value = ABSENT if kind == "delete" else self._bump_value()
+        s = YBSession(self.client)
+        if kind == "delete":
+            s.delete(self.table, {"k": k})
+        else:
+            s.insert(self.table, {"k": k, "v": value})
+        try:
+            s.flush()
+        except Exception:  # noqa: BLE001 — ack lost; outcome ambiguous
+            self.ambiguous[k] = {self._current(k), value}
+            return
+        self.oracle[k] = value
+        self.ambiguous.pop(k, None)
+
+    def _current(self, k: str):
+        amb = self.ambiguous.get(k)
+        if amb:
+            # Still unresolved from an earlier lost ack: any previously
+            # acceptable value remains acceptable.
+            return next(iter(amb))
+        return self.oracle.get(k, ABSENT)
+
+    def _bump_value(self) -> int:
+        self._next_value += 1
+        return self._next_value
+
+    # -- cluster access ------------------------------------------------------
+
+    def _scan_cluster(self) -> dict:
+        res = YBSession(self.client).scan(
+            self.table, ScanSpec(projection=["k", "v"]))
+        return dict(res.rows)
+
+    def _tpu_leader_engines(self):
+        for ts in self.mc.tservers.values():
+            for peer in ts.tablet_manager.peers():
+                if peer.is_leader() and \
+                        hasattr(peer.tablet.engine, "_serve_host_batch"):
+                    yield peer
+
+    def _flush_tablets(self) -> None:
+        for ts in self.mc.tservers.values():
+            for peer in ts.tablet_manager.peers():
+                peer.flush()
+
+    # -- invariants ----------------------------------------------------------
+
+    def check_invariants(self) -> list[str]:
+        errs = []
+        errs.extend(self.check_acked_writes())
+        errs.extend(self.check_engine_diff())
+        errs.extend(self.check_residency_pins())
+        errs.extend(self.check_memtracker_baseline())
+        return errs
+
+    def check_acked_writes(self) -> list[str]:
+        got = self._scan_cluster()
+        errs = []
+        for k in self.keys:
+            actual = got.get(k, ABSENT)
+            acceptable = self.ambiguous.get(k)
+            if acceptable is None:
+                acceptable = {self.oracle.get(k, ABSENT)}
+            if actual not in acceptable:
+                want = sorted("ABSENT" if v is ABSENT else str(v)
+                              for v in acceptable)
+                errs.append(
+                    f"acked write lost: {k} = "
+                    f"{'ABSENT' if actual is ABSENT else actual}, "
+                    f"acceptable {want}")
+        for k in got:
+            if k not in self.keys:
+                errs.append(f"phantom row {k!r}")
+        return errs
+
+    def check_engine_diff(self) -> list[str]:
+        errs = []
+        for peer in list(self._tpu_leader_engines()):
+            eng = peer.tablet.engine
+            spec = ScanSpec(read_ht=peer.read_time().value,
+                            projection=["k", "v"])
+            self._await_breaker_recovery(eng, spec)
+            device = eng.scan_batch([spec])[0]
+            host = eng._serve_host_batch([spec])[0]
+            if (device.rows, device.resume_key) != (host.rows,
+                                                    host.resume_key):
+                errs.append(
+                    f"engine diff on {peer.tablet_id}: device "
+                    f"{len(device.rows)} rows vs host {len(host.rows)}")
+        if degraded():
+            errs.append(
+                "breaker still degraded after recovery probes: "
+                f"{[b.name for b in degraded()]}")
+        return errs
+
+    def _await_breaker_recovery(self, eng, spec,
+                                timeout_s: float = 5.0) -> None:
+        """Probe the breaker back to closed: after the cooldown, one
+        successful half-open dispatch restores the device path."""
+        deadline = time.monotonic() + timeout_s
+        while eng.breaker.is_degraded and time.monotonic() < deadline:
+            eng.scan_batch([spec])
+            time.sleep(0.02)
+
+    def _quiesce_device(self) -> None:
+        """Release every legitimate pin holder: the cached delta
+        overlays (which pin their primary run while cached) and all
+        unpinned residency. Whatever stays pinned afterward is a leak."""
+        for ts in self.mc.tservers.values():
+            for peer in ts.tablet_manager.peers():
+                eng = peer.tablet.engine
+                if hasattr(eng, "_drop_overlay_cache"):
+                    eng._drop_overlay_cache()
+        hbm_cache().evict_unpinned()
+
+    def check_residency_pins(self) -> list[str]:
+        self._quiesce_device()
+        pinned = hbm_cache().pinned_bytes()
+        external = self._external_bytes()
+        if pinned > external:
+            return [f"leaked residency pins: {pinned} pinned bytes "
+                    f"({external} external)"]
+        return []
+
+    def _external_bytes(self) -> int:
+        cache = hbm_cache()
+        with cache._lock:
+            return sum(e.total_bytes
+                       for pool in cache._pools.values()
+                       for e in pool.values() if e.external)
+
+    def check_memtracker_baseline(self) -> list[str]:
+        self._quiesce_device()
+        dev = root_tracker().child("device").consumption
+        if dev != self._device_baseline:
+            return [f"device MemTracker not back to baseline: {dev} "
+                    f"(baseline {self._device_baseline})"]
+        return []
+
+    def _check_fired_ledger(self) -> list[str]:
+        errs = []
+        for name, count in self.fired_ledger.items():
+            flag = ARMED_FLAG[name]
+            fired = faults_fired(flag) - self._fired_base[name]
+            if fired != count:
+                errs.append(
+                    f"yb_faults_fired{{name={flag}}} = {fired}, "
+                    f"harness armed {count}")
+        return errs
+
+
+def run_sweep(data_root: str, seed: int, rounds: int = 5,
+              ops_per_round: int = 16,
+              faults: tuple = FAULT_CATALOG, **kwargs) -> dict:
+    """Run one seeded sweep; returns its summary dict (see FaultSweep)."""
+    return FaultSweep(data_root, seed, rounds=rounds,
+                      ops_per_round=ops_per_round, faults=faults,
+                      **kwargs).run()
+
+
+if __name__ == "__main__":  # replay a failing seed: python -m ... <seed>
+    import sys
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as root:
+        print(run_sweep(root, int(sys.argv[1]) if len(sys.argv) > 1
+                        else 1234))

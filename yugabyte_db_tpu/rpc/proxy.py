@@ -15,6 +15,7 @@ import threading
 
 from yugabyte_db_tpu.rpc.messenger import MAX_FRAME, RpcCallError
 from yugabyte_db_tpu.utils import codec
+from yugabyte_db_tpu.utils.retry import Deadline
 
 _LEN = struct.Struct("<I")
 
@@ -45,7 +46,14 @@ class Proxy:
                                         daemon=True)
         self._reader.start()
 
-    def call(self, method: str, body, timeout: float = 10.0):
+    def call(self, method: str, body, timeout: float = 10.0,
+             deadline: Deadline | None = None):
+        """Send one call and wait for its response. ``deadline`` (the
+        propagated utils.retry budget) caps ``timeout`` at the caller's
+        remaining budget, so a retry loop's later attempts never wait
+        longer than the one deadline they all debit."""
+        if deadline is not None:
+            timeout = deadline.timeout(timeout)
         with self._lock:
             if self._closed:
                 raise ConnectionError(f"proxy to {self.addr} is closed")

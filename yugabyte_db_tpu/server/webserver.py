@@ -28,6 +28,19 @@ nav a{margin-right:1em}
 </style>"""
 
 
+def _healthz() -> dict:
+    """"ok" when every circuit breaker in the process is closed;
+    otherwise "degraded" plus one entry per quarantined path (e.g. a
+    TPU engine serving from the host while its device path recovers) —
+    the JSON twin of the ``yb_engine_degraded`` gauge."""
+    try:
+        from yugabyte_db_tpu.storage.breaker import health_report
+
+        return health_report()
+    except ImportError:
+        return {"status": "ok"}
+
+
 def _memz() -> dict:
     import resource
 
@@ -60,7 +73,7 @@ class Webserver:
         self._dashboards: list[tuple[str, str]] = []  # (path, title)
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
-        self.add_json_handler("/healthz", lambda: {"status": "ok"})
+        self.add_json_handler("/healthz", _healthz)
         self.add_json_handler("/varz", lambda: {
             f.name: {"value": f.value, "default": f.default,
                      "help": f.help, "tags": sorted(f.tags)}

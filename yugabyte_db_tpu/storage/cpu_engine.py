@@ -308,15 +308,20 @@ class CpuStorageEngine(StorageEngine):
                     versions.extend(src.get(key))
             yield key, merge_versions(key, versions, spec.read_ht)
 
-    def scan_batch(self, specs: list[ScanSpec]) -> list[ScanResult]:
+    def scan_batch(self, specs: list[ScanSpec],
+                   deadline=None) -> list[ScanResult]:
         """Point gets skip the k-way source merge: one map/bisect lookup
         per source (the DocRowwiseIterator point-get shape); everything
         else takes the generic scan. Results are identical to scan() —
-        pinned by tests/test_point_fastpath.py."""
+        pinned by tests/test_point_fastpath.py. ``deadline`` is the RPC
+        edge's propagated budget (utils.retry.Deadline): checked between
+        specs so an expired batch aborts with Code.TIMED_OUT."""
         from yugabyte_db_tpu.storage.scan_spec import point_key_of
 
         out = []
         for s in specs:
+            if deadline is not None:
+                deadline.check("cpu_engine.scan_batch")
             pk = point_key_of(s, self.schema)
             out.append(self.scan(s) if pk is None
                        else self._point_scan(s, pk))

@@ -36,6 +36,11 @@ class StorageEngine(abc.ABC):
         self.mem_tracker = root_tracker().child("memstore").child(
             self.options.get("tracker_name", f"engine-{id(self):x}"))
         self._tracked_bytes = 0
+        # Engines with a device dispatch path install a CircuitBreaker
+        # (storage/breaker.py) here; None = pure-host engine, nothing to
+        # quarantine. /healthz and yb_engine_degraded read the breaker
+        # registry, not this attribute.
+        self.breaker = None
 
     def _track_memstore(self) -> None:
         """Sync this engine's tracker with its memtable size. Crossing
@@ -83,14 +88,24 @@ class StorageEngine(abc.ABC):
     def scan(self, spec: ScanSpec) -> ScanResult:
         """MVCC scan/aggregate at spec.read_ht over [lower, upper)."""
 
-    def scan_batch(self, specs: list[ScanSpec]) -> list[ScanResult]:
+    def scan_batch(self, specs: list[ScanSpec],
+                   deadline=None) -> list[ScanResult]:
         """Execute many scans. Engines with an accelerator data plane
         override this to pipeline device dispatches (one host↔device
         round-trip for the whole batch) — the analog of the reference
-        serving hundreds of concurrent YCSB clients per tserver."""
-        return [self.scan(s) for s in specs]
+        serving hundreds of concurrent YCSB clients per tserver.
+        ``deadline`` (utils.retry.Deadline) is the RPC edge's propagated
+        budget: the batch aborts with Code.TIMED_OUT instead of serving
+        results nobody is waiting for."""
+        out = []
+        for s in specs:
+            if deadline is not None:
+                deadline.check("scan_batch")
+            out.append(self.scan(s))
+        return out
 
-    def scan_batch_wire(self, specs: list[ScanSpec], fmt: str = "cql"):
+    def scan_batch_wire(self, specs: list[ScanSpec], fmt: str = "cql",
+                        deadline=None):
         """Execute many scans and return each result as serialized
         protocol bytes (host_page.WirePage): fmt "cql" = CQL binary
         cells, "pg" = PG text DataRow messages. This base implementation
@@ -103,7 +118,7 @@ class StorageEngine(abc.ABC):
         from yugabyte_db_tpu.storage.host_page import wire_from_result
 
         return [wire_from_result(self, r, fmt)
-                for r in self.scan_batch(specs)]
+                for r in self.scan_batch(specs, deadline=deadline)]
 
     def point_serve(self, keys: list[bytes], read_ht: int, col_id: int):
         """Batch point-value lookup for the native request-batch serving

@@ -46,6 +46,7 @@ from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.device_run import (DeviceRun, dtype_kind,
                                             padded_blocks, plane_nbytes)
 from yugabyte_db_tpu.storage.residency import device_nbytes, hbm_cache
+from yugabyte_db_tpu.storage.breaker import CircuitBreaker
 from yugabyte_db_tpu.storage.columnar import ColumnarRun
 from yugabyte_db_tpu.storage import host_page
 from yugabyte_db_tpu.storage.cpu_engine import Aggregator, RowMaterializer
@@ -55,8 +56,16 @@ from yugabyte_db_tpu.storage.merge import merge_versions
 from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.utils import planes as P
+from yugabyte_db_tpu.utils.fault_injection import FaultInjected, maybe_fault
 from yugabyte_db_tpu.utils.metrics import (count_host_verify_rows,
                                            count_swallowed)
+
+# Failures the circuit breaker attributes to the DEVICE path: injected
+# dispatch faults and runtime errors out of the device framework
+# (compile/dispatch/transfer). Deliberately narrow — Status-carrying
+# errors (e.g. a propagated deadline) and programming errors
+# (Type/Key/Index) are NOT device faults and propagate unchanged.
+DEVICE_FAULT_TYPES = (FaultInjected, RuntimeError)
 
 WINDOW_BLOCKS = 8          # blocks per device dispatch on the row path
 PAD_BLOCKS = 64            # run block-axis padding (multiple of every window)
@@ -218,6 +227,20 @@ class TpuStorageEngine(StorageEngine):
         # residency entry until the cache is dropped.
         self._overlay_pinned: TpuRun | None = None
         self._overlay_ext_key: int | None = None
+        # Fault domain: the breaker quarantines the device dispatch path
+        # after repeated device faults; while open (and for one probe's
+        # worth of half-open) every scan re-serves byte-identically from
+        # the authoritative host structures (_serve_host_batch).
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        self.breaker = CircuitBreaker(
+            f"tpu_engine:{self.mem_tracker.name}",
+            failure_threshold=int(self.options.get(
+                "breaker_failure_threshold",
+                FLAGS.get("tpu_breaker_failure_threshold"))),
+            cooldown_s=float(self.options.get(
+                "breaker_cooldown_s",
+                FLAGS.get("tpu_breaker_cooldown_s"))))
         self.persist = RunPersistence(self.options.get("data_dir"))
         for entries in self.persist.load_all():
             crun = ColumnarRun.build(self.schema, entries, self.rows_per_block)
@@ -930,6 +953,7 @@ class TpuStorageEngine(StorageEngine):
                            pred_sigs, pred_lits, apply_preds: bool):
         """Run the device row-scan over the block windows covering the range;
         yield candidate keys (host-materialized, in key order)."""
+        self._device_fault_point()
         crun = trun.crun
         row_lo = crun.lower_row(spec.lower)
         row_hi = crun.upper_row(spec.upper)
@@ -970,10 +994,18 @@ class TpuStorageEngine(StorageEngine):
     # G buckets for the vmapped page-scan dispatch (one compile per bucket).
     _G_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
-    def scan_batch(self, specs: list[ScanSpec]) -> list[ScanResult]:
-        return self.scan_batch_async(specs).finish()
+    def scan_batch(self, specs: list[ScanSpec],
+                   deadline=None) -> list[ScanResult]:
+        return self.scan_batch_async(specs, deadline=deadline).finish()
 
-    def scan_batch_async(self, specs: list[ScanSpec]) -> "_AsyncBatch":
+    def _device_fault_point(self) -> None:
+        """MAYBE_FAULT marker for the device dispatch path (flag
+        ``fault.tpu_dispatch``): fires as the kind of failure the
+        breaker quarantines."""
+        if maybe_fault("fault.tpu_dispatch"):
+            raise FaultInjected("injected device dispatch fault")
+
+    def scan_batch_async(self, specs: list[ScanSpec], deadline=None):
         """Plan every scan, issue all round-1 device work, and start the
         outputs streaming host-ward (copy_to_host_async) WITHOUT waiting.
         The caller finishes the batch later with .finish().
@@ -981,7 +1013,28 @@ class TpuStorageEngine(StorageEngine):
         This is the server shape for the tunnel link: one synchronous
         fetch cycle costs ~1 link RTT regardless of size, but dispatches
         and async copies pipeline — so overlapping batches (issue N+1
-        before finishing N) amortizes the RTT across whole batches."""
+        before finishing N) amortizes the RTT across whole batches.
+
+        Fault containment: while the breaker quarantines the device path
+        (or a device fault strikes during planning/dispatch) the batch
+        is served from the authoritative host structures instead —
+        byte-identical results, no device traffic. ``deadline``
+        (utils.retry.Deadline) is the propagated RPC budget; an expired
+        deadline aborts with Code.TIMED_OUT before any work is issued
+        (and between finish()-time rounds), unwinding residency pins."""
+        if deadline is not None:
+            deadline.check("tpu_engine.scan_batch")
+        if not self.breaker.allow():
+            return _HostServeBatch(self, specs, deadline)
+        try:
+            return self._scan_batch_async_device(specs, deadline)
+        except DEVICE_FAULT_TYPES as e:
+            self.breaker.record_failure(e)
+            return _HostServeBatch(self, specs, deadline)
+
+    def _scan_batch_async_device(self, specs: list[ScanSpec],
+                                 deadline=None) -> "_AsyncBatch":
+        self._device_fault_point()
         agg_sink: list = []
         grouped_sink: list = []
         plans = [self._plan_scan(s, agg_sink=agg_sink,
@@ -1072,13 +1125,15 @@ class TpuStorageEngine(StorageEngine):
                 leaf.copy_to_host_async()
             return _AsyncBatch(self, results, host_plans, issued_outs,
                                gathers, states, pending, dispatches,
-                               pages, pre_work, pins)
+                               pages, pre_work, pins, specs=specs,
+                               deadline=deadline)
         except BaseException:
             for trun in pins:
                 trun.unpin()
             raise
 
-    def scan_batch_wire(self, specs: list[ScanSpec], fmt: str = "cql"):
+    def scan_batch_wire(self, specs: list[ScanSpec], fmt: str = "cql",
+                        deadline=None):
         """Wire-serialized pages with the native fast path: LIMIT pages
         on a single flat run with host-exact predicates serialize to
         protocol bytes entirely in C (host_page.serve_pages_wire /
@@ -1159,7 +1214,8 @@ class TpuStorageEngine(StorageEngine):
                         out[i] = pg
         if slow_specs:
             for i, pg in zip(slow_idx,
-                             super().scan_batch_wire(slow_specs, fmt)):
+                             super().scan_batch_wire(slow_specs, fmt,
+                                                     deadline=deadline)):
                 out[i] = pg
         return out
 
@@ -1239,6 +1295,7 @@ class TpuStorageEngine(StorageEngine):
     def _issue_round(self, states, pending):
         """Group every active gather's pending param-rows by (signature,
         run) into vmapped dispatches; returns [(chunk, out_array)]."""
+        self._device_fault_point()
         from yugabyte_db_tpu.ops import row_gather
 
         by_sig: dict = {}
@@ -1453,18 +1510,70 @@ class TpuStorageEngine(StorageEngine):
         return ("host", lambda: self._row_scan(
             spec, runs, mem_live, pred_split, aggregate=False, mem=mem))
 
+    def _serve_host_batch(self, specs: list[ScanSpec],
+                          deadline=None) -> list[ScanResult]:
+        """Serve a whole batch WITHOUT touching the device: candidate
+        keys come from the authoritative host ColumnarRuns instead of
+        device scans, and the shared merge/materialize loop applies the
+        full predicate set host-side — so results are byte-identical to
+        the device path (and to the CPU oracle). This is the degraded
+        mode behind the circuit breaker."""
+        out = []
+        for spec in specs:
+            if deadline is not None:
+                deadline.check("tpu_engine.host_serve")
+            out.append(self._host_scan(spec))
+        return out
+
+    def _host_scan(self, spec: ScanSpec) -> ScanResult:
+        mem = self.memtable
+        runs = self._overlapping_runs(spec)
+        mem_live = (not mem.is_empty) and \
+            mem.has_keys(spec.lower, spec.upper)
+        pred_split = self._split_predicates(spec)
+        if not spec.is_aggregate:
+            pk = self._point_key(spec)
+            if pk is not None:
+                projection, rows, resume, scanned = \
+                    self._point_get_row(spec, mem, pk)
+                return ScanResult(list(projection), rows, resume, scanned)
+        return self._row_scan(spec, runs, mem_live, pred_split,
+                              aggregate=spec.is_aggregate, mem=mem,
+                              device_ok=False)
+
+    def _host_candidates(self, trun: TpuRun, spec: ScanSpec):
+        """Candidate keys for one run straight from the host ColumnarRun
+        (every valid key in range, duplicates adjacent — the merge loop
+        dedups and applies predicates). The device-free twin of
+        _device_candidates for breaker-degraded serving. Pad rows past
+        each block's valid prefix hold b"" keys and MUST be skipped:
+        they would both break heapq.merge's sorted-stream contract and
+        defeat the merge loop's adjacency dedup."""
+        crun = trun.crun
+        row_lo = crun.lower_row(spec.lower)
+        row_hi = crun.upper_row(spec.upper)
+        R = crun.R
+        for row in range(row_lo, row_hi):
+            b, r = divmod(row, R)
+            if r >= crun.blocks[b].num_valid:
+                continue
+            yield crun.row_keys[b][r]
+
     def _row_scan(self, spec: ScanSpec, runs, mem_live, pred_split,
-                  aggregate: bool, mem: MemTable | None = None):
+                  aggregate: bool, mem: MemTable | None = None,
+                  device_ok: bool = True):
         exact, superset, host_only = pred_split
         mem = self.memtable if mem is None else mem
         single_source = len(runs) == 1 and not mem_live
-        apply_preds = single_source
+        apply_preds = single_source and device_ok
         pred_sigs, pred_lits = (
             self._pred_sig_and_literals(exact + superset) if apply_preds
             else ((), ()))
 
         key_streams = [
-            self._device_candidates(t, spec, pred_sigs, pred_lits, apply_preds)
+            self._device_candidates(t, spec, pred_sigs, pred_lits,
+                                    apply_preds)
+            if device_ok else self._host_candidates(t, spec)
             for t in runs
         ]
         if mem_live or not mem.is_empty:
@@ -2687,7 +2796,7 @@ class _AsyncBatch:
 
     def __init__(self, eng, results, host_plans, issued_outs, gathers,
                  states, pending, dispatches, pages=(), pre_work=(),
-                 pins=()):
+                 pins=(), specs=(), deadline=None):
         self.eng = eng
         self.results = results
         self.host_plans = host_plans
@@ -2699,6 +2808,8 @@ class _AsyncBatch:
         self.pages = list(pages)
         self.pre_work = list(pre_work)
         self.pins = list(pins)
+        self.specs = list(specs)
+        self.deadline = deadline
         self._done = False
 
     def _release_pins(self) -> None:
@@ -2718,13 +2829,33 @@ class _AsyncBatch:
         if self._done:
             return self.results
         try:
-            return self._finish()
-        finally:
+            out = self._finish()
+        except DEVICE_FAULT_TYPES as e:
+            # Mid-flight device fault: release the pins, report to the
+            # breaker, and re-serve the WHOLE batch from the host — the
+            # specs' pinned read points make the re-serve byte-identical
+            # (MVCC: later writes are invisible at spec.read_ht).
             self._release_pins()
+            self.eng.breaker.record_failure(e)
+            self.results = self.eng._serve_host_batch(self.specs,
+                                                      self.deadline)
+            self._done = True
+            return self.results
+        except BaseException:
+            self._release_pins()
+            raise
+        self._release_pins()
+        self.eng.breaker.record_success()
+        return out
+
+    def _check_deadline(self) -> None:
+        if self.deadline is not None:
+            self.deadline.check("tpu_engine.scan_batch.finish")
 
     def _finish(self) -> list[ScanResult]:
         eng = self.eng
         results = self.results
+        self._check_deadline()
         # Host work that overlaps the in-flight fetch (e.g. the delta
         # overlay's dirty-row fold), then host-path scans.
         for pre in self.pre_work:
@@ -2748,14 +2879,39 @@ class _AsyncBatch:
         pending = eng._feed_round(self.states, self.pending,
                                   self.dispatches, disp_bufs)
         # Continuation rounds (overflow/verification shortfalls): plain
-        # synchronous cycles.
+        # synchronous cycles. Each round re-checks the propagated
+        # deadline: a budget that expired mid-scan aborts here and
+        # finish() unwinds the residency pins on the way out.
         while pending:
+            self._check_deadline()
             dispatches = eng._issue_round(self.states, pending)
             disp_bufs = jax.device_get([d for _c, d in dispatches])
             pending = eng._feed_round(self.states, pending, dispatches,
                                       disp_bufs)
         for pi, st in self.gathers:
             results[pi] = st.result()
+        self._done = True
+        return self.results
+
+
+class _HostServeBatch:
+    """The degraded-mode stand-in for _AsyncBatch: produced while the
+    circuit breaker quarantines the device path (or after a fault struck
+    during planning). Nothing was issued to the device and no residency
+    pins are held; finish() serves the whole batch from the host."""
+
+    def __init__(self, eng, specs, deadline=None):
+        self.eng = eng
+        self.specs = list(specs)
+        self.deadline = deadline
+        self.results: list | None = None
+        self._done = False
+
+    def finish(self) -> list[ScanResult]:
+        if self._done:
+            return self.results
+        self.results = self.eng._serve_host_batch(self.specs,
+                                                  self.deadline)
         self._done = True
         return self.results
 

@@ -409,24 +409,28 @@ class Tablet:
     def read_time(self) -> HybridTime:
         return self.mvcc.safe_time()
 
-    def scan(self, spec: ScanSpec) -> ScanResult:
-        return self.engine.scan(spec)
+    def scan(self, spec: ScanSpec, deadline=None) -> ScanResult:
+        return self.engine.scan_batch([spec], deadline=deadline)[0]
 
-    def scan_wire(self, spec: ScanSpec, fmt: str = "cql"):
+    def scan_wire(self, spec: ScanSpec, fmt: str = "cql", deadline=None):
         """Scan serving serialized protocol bytes (storage page server;
         reference: rows_data serialized once at the tablet,
         src/yb/common/ql_rowblock.h:66)."""
-        return self.engine.scan_batch_wire([spec], fmt)[0]
+        return self.engine.scan_batch_wire([spec], fmt,
+                                           deadline=deadline)[0]
 
-    def scan_many(self, specs: list[ScanSpec]) -> list[ScanResult]:
+    def scan_many(self, specs: list[ScanSpec],
+                  deadline=None) -> list[ScanResult]:
         """One engine batch for many scans (the multi-key read RPC's
-        storage hop — point gets share the bloom/merge machinery)."""
-        return self.engine.scan_batch(specs)
+        storage hop — point gets share the bloom/merge machinery).
+        ``deadline`` is the RPC edge's propagated budget (utils.retry)."""
+        return self.engine.scan_batch(specs, deadline=deadline)
 
-    def scan_wire_many(self, specs: list[ScanSpec], fmt: str = "cql"):
+    def scan_wire_many(self, specs: list[ScanSpec], fmt: str = "cql",
+                       deadline=None):
         """One engine batch of wire-serialized scans — the batched read
         RPC's storage hop for the native request-batch serving path."""
-        return self.engine.scan_batch_wire(specs, fmt)
+        return self.engine.scan_batch_wire(specs, fmt, deadline=deadline)
 
     def point_serve(self, keys: list[bytes], read_ht: int, col_id: int):
         """Native batch point-value serve. None unless the whole visible

@@ -330,31 +330,33 @@ class TabletPeer:
     def read_time(self) -> HybridTime:
         return self.tablet.mvcc.safe_time()
 
-    def scan(self, spec: ScanSpec, allow_stale: bool = False) -> ScanResult:
+    def scan(self, spec: ScanSpec, allow_stale: bool = False,
+             deadline=None) -> ScanResult:
         """Serve a scan. Leader-with-lease only, unless the caller opted
-        into stale follower reads."""
+        into stale follower reads. ``deadline`` is the RPC edge's
+        propagated budget (utils.retry.Deadline)."""
         if not allow_stale:
             if not self.raft.is_leader():
                 raise NotLeader(self.node_uuid, self.raft.leader_uuid())
             if not self.raft.has_lease():
                 raise NotLeader(self.node_uuid, None)
         TRACE("scan: read_ht=%d", spec.read_ht)
-        res = self.tablet.scan(spec)
+        res = self.tablet.scan(spec, deadline=deadline)
         TRACE("scan: %d row(s), %d scanned", len(res.rows),
               res.rows_scanned)
         return res
 
     def scan_wire(self, spec: ScanSpec, fmt: str = "cql",
-                  allow_stale: bool = False):
+                  allow_stale: bool = False, deadline=None):
         """Wire-serialized scan (leader-with-lease gate as scan)."""
         if not allow_stale:
             if not self.raft.is_leader():
                 raise NotLeader(self.node_uuid, self.raft.leader_uuid())
             if not self.raft.has_lease():
                 raise NotLeader(self.node_uuid, None)
-        return self.tablet.scan_wire(spec, fmt)
+        return self.tablet.scan_wire(spec, fmt, deadline=deadline)
 
-    def scan_many(self, specs, allow_stale: bool = False):
+    def scan_many(self, specs, allow_stale: bool = False, deadline=None):
         """Batched scans under ONE leader-with-lease gate (the
         multi-key read RPC)."""
         if not allow_stale:
@@ -362,10 +364,10 @@ class TabletPeer:
                 raise NotLeader(self.node_uuid, self.raft.leader_uuid())
             if not self.raft.has_lease():
                 raise NotLeader(self.node_uuid, None)
-        return self.tablet.scan_many(specs)
+        return self.tablet.scan_many(specs, deadline=deadline)
 
     def scan_wire_many(self, specs, fmt: str = "cql",
-                       allow_stale: bool = False):
+                       allow_stale: bool = False, deadline=None):
         """Batched wire-serialized scans under ONE leader-with-lease
         gate (the native request-batch serving path's read RPC)."""
         if not allow_stale:
@@ -373,7 +375,7 @@ class TabletPeer:
                 raise NotLeader(self.node_uuid, self.raft.leader_uuid())
             if not self.raft.has_lease():
                 raise NotLeader(self.node_uuid, None)
-        return self.tablet.scan_wire_many(specs, fmt)
+        return self.tablet.scan_wire_many(specs, fmt, deadline=deadline)
 
     def point_serve(self, keys, read_ht: int, col_id: int,
                     allow_stale: bool = False):

@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 import time
 
+from yugabyte_db_tpu.utils.retry import RetryPolicy
+
 
 class Heartbeater:
     def __init__(self, server, master_uuids: list[str],
@@ -24,6 +26,12 @@ class Heartbeater:
         self._wake = threading.Event()
         self.last_response: dict | None = None
         self.consecutive_failures = 0
+        # Per-heartbeat budget: a couple of failover sweeps with jittered
+        # backoff, bounded well below the stop() join timeout so a
+        # leaderless master quorum can't wedge shutdown.
+        self.retry_policy = RetryPolicy(
+            timeout_s=max(2.0, interval_s * 4),
+            initial_backoff_s=0.05, max_backoff_s=0.5)
 
     def start(self) -> None:
         self._running = True
@@ -61,25 +69,30 @@ class Heartbeater:
             "tablets": self.server.tablet_manager.tablet_reports(),
             "num_live_tablets": len(self.server.tablet_manager.peers()),
         }
-        targets = ([self._leader_hint] if self._leader_hint else []) + [
-            u for u in self.master_uuids if u != self._leader_hint]
-        last_err: Exception | None = None
-        for target in targets:
-            try:
-                resp = self.server.transport.send(
-                    target, "master.ts_heartbeat", req, timeout=2.0)
-            except Exception as e:  # noqa: BLE001 — try the next master
-                last_err = e
-                continue
-            if resp.get("code") == "not_leader":
-                self._leader_hint = resp.get("leader_hint")
-                if self._leader_hint and self._leader_hint not in targets:
-                    targets.append(self._leader_hint)
-                continue
-            self._leader_hint = target
-            self.last_response = resp
-            self.server.process_heartbeat_response(resp)
-            return
-        if last_err is not None:
-            raise last_err
-        raise ConnectionError("no master leader reachable")
+        last: object = None
+        for attempt in self.retry_policy.attempts():
+            if not self._running:
+                return
+            # A fresh hint learned mid-sweep gets tried first next sweep.
+            targets = ([self._leader_hint] if self._leader_hint else []) + [
+                u for u in self.master_uuids if u != self._leader_hint]
+            for target in targets:
+                try:
+                    resp = self.server.transport.send(
+                        target, "master.ts_heartbeat", req,
+                        timeout=attempt.timeout(2.0))
+                except Exception as e:  # noqa: BLE001 — try the next master
+                    last = e
+                    continue
+                if resp.get("code") == "not_leader":
+                    self._leader_hint = resp.get("leader_hint")
+                    last = resp
+                    continue
+                self._leader_hint = target
+                self.last_response = resp
+                self.server.process_heartbeat_response(resp)
+                return
+            attempt.note(last)
+        if isinstance(last, Exception):
+            raise last
+        raise ConnectionError(f"no master leader reachable ({last})")

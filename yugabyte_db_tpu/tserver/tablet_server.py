@@ -13,6 +13,7 @@ MetaCache uses to re-route (the reference's TabletInvoker contract).
 from __future__ import annotations
 
 from yugabyte_db_tpu.consensus.raft import NotLeader, RaftOptions
+from yugabyte_db_tpu.consensus.transport import send_with_retry
 from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.storage import wire
 from yugabyte_db_tpu.storage.scan_spec import ScanSpec
@@ -21,6 +22,7 @@ from yugabyte_db_tpu.tserver.heartbeater import Heartbeater
 from yugabyte_db_tpu.tserver.tablet_manager import (TabletNotFound,
                                                     TSTabletManager)
 from yugabyte_db_tpu.utils.metrics import count_swallowed
+from yugabyte_db_tpu.utils.retry import Deadline, DeadlineExpired
 from yugabyte_db_tpu.utils.trace import TRACE, RpczStore, trace_request
 
 
@@ -227,9 +229,10 @@ class TabletServer:
         (reference: the StartRemoteBootstrap RPC the leader's consensus
         queue fires, consensus_queue.cc -> remote_bootstrap_service.cc)."""
         try:
-            resp = self.transport.send(peer_uuid, "ts.start_remote_bootstrap",
-                                       {"tablet_id": tablet_id,
-                                        "source": self.uuid}, timeout=5.0)
+            resp = send_with_retry(self.transport, peer_uuid,
+                                   "ts.start_remote_bootstrap",
+                                   {"tablet_id": tablet_id,
+                                    "source": self.uuid}, timeout_s=5.0)
             if resp.get("code") != "ok":
                 count_swallowed("tserver.remote_bootstrap", resp.get("code"))
         except Exception as e:  # noqa: BLE001 — retried by the next trigger
@@ -710,7 +713,15 @@ class TabletServer:
             return {"code": "timed_out"}
         return None
 
-    def _read_gate(self, p: dict, specs: list | None = None):
+    def _rpc_deadline(self, p: dict) -> Deadline:
+        """The propagated deadline of one read RPC: the client debits
+        its retry budget into ``payload["timeout"]`` (client.py
+        tablet_rpc), and every stage below — safe-time wait, engine
+        batch, device dispatch rounds — debits this one Deadline."""
+        return Deadline.after(float(p.get("timeout", 4.0)))
+
+    def _read_gate(self, p: dict, specs: list | None = None,
+                   deadline: Deadline | None = None):
         """The shared read prologue of every scan RPC: tablet lookup,
         HLC causality (ratchet past everything the client observed
         BEFORE choosing the read time, so a fresh read cannot miss its
@@ -730,8 +741,9 @@ class TabletServer:
             specs = [wire.decode_spec(p["spec"])]
         explicit = [s.read_ht for s in specs if s.read_ht != wire.MAX_HT]
         if explicit:
-            err = self._pin_read_point(peer, max(explicit),
-                                       p.get("timeout", 4.0))
+            timeout = (deadline.timeout() if deadline is not None
+                       else p.get("timeout", 4.0))
+            err = self._pin_read_point(peer, max(explicit), timeout)
             if err is not None:
                 return None, None, err
         read_ht = peer.read_time().value
@@ -745,14 +757,18 @@ class TabletServer:
         return peer, specs, None
 
     def _h_ts_scan(self, p: dict):
-        peer, specs, err = self._read_gate(p)
+        deadline = self._rpc_deadline(p)
+        peer, specs, err = self._read_gate(p, deadline=deadline)
         if err is not None:
             return err
         spec = specs[0]
         try:
-            res = peer.scan(spec, allow_stale=p.get("allow_stale", False))
+            res = peer.scan(spec, allow_stale=p.get("allow_stale", False),
+                            deadline=deadline)
         except NotLeader as e:
             return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except DeadlineExpired:
+            return {"code": "timed_out"}
         out = wire.encode_result(res)
         out["code"] = "ok"
         out["read_ht"] = spec.read_ht
@@ -763,15 +779,20 @@ class TabletServer:
         one engine batch — the server hop of the client's multi-key
         reads (reference: the batcher packing many ops into one
         tserver call, src/yb/client/batcher.h:80)."""
+        deadline = self._rpc_deadline(p)
         peer, specs, err = self._read_gate(
-            p, [wire.decode_spec(s) for s in p["specs"]])
+            p, [wire.decode_spec(s) for s in p["specs"]],
+            deadline=deadline)
         if err is not None:
             return err
         try:
             results = peer.scan_many(
-                specs, allow_stale=p.get("allow_stale", False))
+                specs, allow_stale=p.get("allow_stale", False),
+                deadline=deadline)
         except NotLeader as e:
             return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except DeadlineExpired:
+            return {"code": "timed_out"}
         out = [wire.encode_result(r) for r in results]
         return {"code": "ok", "results": out,
                 "read_ht": max(s.read_ht for s in specs)}
@@ -781,15 +802,19 @@ class TabletServer:
         cells, "pg" = PG DataRow messages) — the reference's rows_data
         contract (src/yb/common/ql_rowblock.h:66): rows serialize once
         at the tablet and every layer above forwards bytes."""
-        peer, specs, err = self._read_gate(p)
+        deadline = self._rpc_deadline(p)
+        peer, specs, err = self._read_gate(p, deadline=deadline)
         if err is not None:
             return err
         spec = specs[0]
         try:
             pg = peer.scan_wire(spec, p.get("fmt", "cql"),
-                                allow_stale=p.get("allow_stale", False))
+                                allow_stale=p.get("allow_stale", False),
+                                deadline=deadline)
         except NotLeader as e:
             return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except DeadlineExpired:
+            return {"code": "timed_out"}
         return {"code": "ok", "data": pg.data, "nrows": pg.nrows,
                 "resume": pg.resume, "columns": pg.columns,
                 "read_ht": spec.read_ht}
@@ -800,16 +825,21 @@ class TabletServer:
         one read gate, one engine batch, one serialized result page per
         spec. Replaces a per-op ts.scan_wire round trip for every
         eligible prepared point SELECT in a pipelined CQL batch."""
+        deadline = self._rpc_deadline(p)
         peer, specs, err = self._read_gate(
-            p, [wire.decode_spec(s) for s in p["specs"]])
+            p, [wire.decode_spec(s) for s in p["specs"]],
+            deadline=deadline)
         if err is not None:
             return err
         try:
             pages = peer.scan_wire_many(
                 specs, p.get("fmt", "cql"),
-                allow_stale=p.get("allow_stale", False))
+                allow_stale=p.get("allow_stale", False),
+                deadline=deadline)
         except NotLeader as e:
             return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except DeadlineExpired:
+            return {"code": "timed_out"}
         return {"code": "ok",
                 "pages": [{"data": pg.data, "nrows": pg.nrows,
                            "resume": pg.resume, "columns": pg.columns}
@@ -1157,15 +1187,13 @@ class TabletServer:
             spec.read_ht = min(pr.read_time().value for pr in peers)
         else:
             # One deadline across ALL pins: serial per-peer waits must not
-            # sum past the client's single transport timeout.
-            import time as _time
-
-            deadline = _time.monotonic() + p.get("timeout", 4.0)
+            # sum past the client's propagated budget.
+            deadline = self._rpc_deadline(p)
             for peer in peers:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
+                if deadline.expired():
                     return {"code": "timed_out"}
-                err = self._pin_read_point(peer, spec.read_ht, remaining)
+                err = self._pin_read_point(peer, spec.read_ht,
+                                           deadline.timeout())
                 if err is not None:
                     return err
         for peer in peers:

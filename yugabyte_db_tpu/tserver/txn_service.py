@@ -10,9 +10,9 @@ RPCs, src/yb/tserver/tserver_service.proto:59).
 from __future__ import annotations
 
 import threading
-import time
 
 from yugabyte_db_tpu.utils.metrics import count_swallowed
+from yugabyte_db_tpu.utils.retry import Deadline
 
 
 class TxnRpcRouter:
@@ -60,7 +60,10 @@ class TxnRpcRouter:
         """Send a per-tablet RPC to its leader. Returns the ok response or
         None when no leader answered."""
         payload = dict(payload, tablet_id=tablet_id)
-        deadline = time.monotonic() + timeout * 3
+        # One propagated budget for the whole leader hunt: the hint, the
+        # cached leader, every replica, and (once) a master re-locate all
+        # debit it; per-send waits are capped at the remainder.
+        deadline = Deadline.after(timeout * 3)
         seen = set()
         located = False
         with self._lock:
@@ -70,7 +73,7 @@ class TxnRpcRouter:
         for t in (hint, cached, *replicas):
             if t and t not in targets:
                 targets.append(t)
-        while time.monotonic() < deadline:
+        while not deadline.expired():
             if not targets:
                 if located:
                     return None
@@ -90,7 +93,7 @@ class TxnRpcRouter:
             seen.add(target)
             try:
                 resp = self.transport.send(target, method, payload,
-                                           timeout=timeout)
+                                           timeout=deadline.timeout(timeout))
             except Exception as e:  # noqa: BLE001 — next candidate
                 count_swallowed("txn_router.call", e)
                 continue

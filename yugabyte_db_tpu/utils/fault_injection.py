@@ -8,6 +8,12 @@ production code marks fault points; tests arm them via flags.
     FLAGS.set("fault.ts_write_respond_failed", 1.0)   # always
     FLAGS.set("fault.ts_write_respond_failed", 0.0)   # never (default)
     arm_fault_once("fault.wal_sync")                  # exactly one hit
+
+Reproducibility + observability: ``fault.seed`` (non-zero) seeds the
+probability RNG so a randomized sweep replays byte-for-byte, and every
+fault that fires bumps ``yb_faults_fired{name=...}`` on the process
+registry — the sweep harness asserts its injection count against the
+metric instead of trusting its own bookkeeping.
 """
 
 from __future__ import annotations
@@ -18,6 +24,7 @@ import threading
 _lock = threading.Lock()
 _once: dict[str, int] = {}   # fault name -> remaining forced hits
 _rng = random.Random()
+_applied_seed = 0            # last fault.seed value folded into _rng
 
 
 def arm_fault_once(name: str, times: int = 1) -> None:
@@ -32,14 +39,39 @@ def clear_faults() -> None:
         _once.clear()
 
 
+def _count_fired(name: str) -> None:
+    from yugabyte_db_tpu.utils.metrics import count_fault_fired
+
+    count_fault_fired(name)
+
+
+def _maybe_reseed_locked() -> None:
+    """Fold a changed ``fault.seed`` flag into the RNG (0 = unseeded).
+    Lazy so ``FLAGS.set("fault.seed", s)`` takes effect at the next
+    fault evaluation, matching the runtime-mutable flag contract."""
+    global _applied_seed
+    from yugabyte_db_tpu.utils.flags import FLAGS
+
+    try:
+        seed = int(FLAGS.get("fault.seed"))
+    except (KeyError, TypeError, ValueError):
+        return
+    if seed != _applied_seed:
+        _applied_seed = seed
+        if seed != 0:
+            _rng.seed(seed)
+
+
 def maybe_fault(name: str) -> bool:
     """True when the named fault should fire. Checks armed one-shot
     hits first, then the flag ``name`` as a probability in [0, 1]
-    (unknown flag = 0: disabled)."""
+    (unknown flag = 0: disabled). Every fire counts in
+    ``yb_faults_fired{name=...}``."""
     with _lock:
         n = _once.get(name, 0)
         if n > 0:
             _once[name] = n - 1
+            _count_fired(name)
             return True
     from yugabyte_db_tpu.utils.flags import FLAGS
 
@@ -47,7 +79,14 @@ def maybe_fault(name: str) -> bool:
         p = float(FLAGS.get(name))
     except (KeyError, TypeError, ValueError):
         return False
-    return p > 0 and _rng.random() < p
+    if p <= 0:
+        return False
+    with _lock:
+        _maybe_reseed_locked()
+        fired = _rng.random() < p
+    if fired:
+        _count_fired(name)
+    return fired
 
 
 class FaultInjected(Exception):

@@ -113,3 +113,21 @@ FLAGS.define("fault.ts_write_respond_failed", 0.0,
 FLAGS.define("fault.wal_sync_failed", 0.0,
              "probability a WAL group-commit sync raises IOError",
              ("unsafe", "runtime", "hidden"))
+FLAGS.define("tpu_breaker_failure_threshold", 3,
+             "consecutive device-dispatch faults before the TPU engine's "
+             "circuit breaker opens and scans re-serve from the host path",
+             ("advanced", "runtime"))
+FLAGS.define("tpu_breaker_cooldown_s", 1.0,
+             "seconds an open TPU-engine breaker waits before admitting "
+             "one half-open probe dispatch",
+             ("advanced", "runtime"))
+FLAGS.define("fault.tpu_dispatch", 0.0,
+             "probability a device (TPU) dispatch raises — exercises the "
+             "storage/breaker.py circuit breaker and the host re-serve "
+             "path",
+             ("unsafe", "runtime", "hidden"))
+FLAGS.define("fault.seed", 0,
+             "non-zero: seed the fault-injection RNG so probabilistic "
+             "faults replay deterministically (the sweep harness sets "
+             "this; 0 = unseeded)",
+             ("unsafe", "runtime", "hidden"))

@@ -252,6 +252,35 @@ def count_swallowed(site: str, exc: object = None) -> None:
         _SWALLOW_LOG.debug("count_swallowed failed at site %s", site)
 
 
+# -- fault-injection observability -------------------------------------------
+_FAULT_ENTITIES: dict[str, MetricEntity] = {}
+_FAULT_LOCK = threading.Lock()
+
+
+def count_fault_fired(name: str) -> None:
+    """Bump ``yb_faults_fired{name=...}`` on the process registry: one
+    series per fault point, incremented every time the fault actually
+    fires. The fault-sweep harness asserts its injection schedule
+    against this counter, so a fault point that silently stops being
+    evaluated shows up as a sweep failure. Never raises."""
+    try:
+        with _FAULT_LOCK:
+            ent = _FAULT_ENTITIES.get(name)
+            if ent is None:
+                ent = _PROCESS_REGISTRY.entity(name=name)
+                _FAULT_ENTITIES[name] = ent
+        ent.counter("yb_faults_fired").increment()
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("count_fault_fired failed for %s", name)
+
+
+def faults_fired(name: str) -> int:
+    """Current ``yb_faults_fired{name=...}`` value (0 if never fired)."""
+    with _FAULT_LOCK:
+        ent = _FAULT_ENTITIES.get(name)
+    return ent.counter("yb_faults_fired").get() if ent is not None else 0
+
+
 # -- serving-path observability ----------------------------------------------
 # Batch-size bucket bounds (ops per drained request batch): 1 .. 4096.
 BATCH_SIZE_BUCKETS = tuple(2 ** i for i in range(13))
