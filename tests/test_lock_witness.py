@@ -1,0 +1,241 @@
+"""Runtime lock witness: @guarded_by instrumentation + the
+``--witness-check`` cross-validation against yb-lint's static facts.
+
+Tier 1 runs the witness over one deterministic fault-sweep round plus
+direct breaker/residency exercise and feeds the dump to
+``python -m yugabyte_db_tpu.analysis --witness-check`` (must exit 0:
+runtime behaviour never contradicts a static "guarded" fact).  Full
+randomized witness rounds stay under ``-m slow``.
+"""
+
+import tempfile
+import threading
+
+import pytest
+
+from yugabyte_db_tpu.utils import locking
+from yugabyte_db_tpu.utils.locking import guarded_by
+
+
+@pytest.fixture(autouse=True)
+def _witness_reset():
+    locking.witness().clear()
+    yield
+    locking.disable_lock_witness()
+    locking.witness().clear()
+
+
+def _obs(cls_name, field):
+    for row in locking.witness().observations():
+        if row["class"] == cls_name and row["field"] == field:
+            return row
+    return None
+
+
+@guarded_by("_lock", "_n", "_state")
+class _Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._state = "closed"
+
+    def bump_locked_path(self):
+        with self._lock:
+            self._n += 1
+
+    def bump_racy_path(self):
+        self._n += 1
+
+
+# -- decorator semantics -----------------------------------------------------
+
+def test_declaration_is_recorded_on_class():
+    assert _Guarded.__guarded_by__ == {"_n": "_lock", "_state": "_lock"}
+    assert _Guarded.__guard_locks__ == frozenset({"_lock"})
+
+
+def test_declarations_stack():
+    @guarded_by("_a", "_x")
+    @guarded_by("_b", "_y")
+    class Two:
+        pass
+
+    assert Two.__guarded_by__ == {"_x": "_a", "_y": "_b"}
+    assert Two.__guard_locks__ == frozenset({"_a", "_b"})
+
+
+def test_non_literal_declaration_rejected():
+    with pytest.raises(TypeError):
+        guarded_by("_lock")  # no fields
+    with pytest.raises(TypeError):
+        guarded_by(3, "_x")
+
+
+def test_disabled_witness_records_nothing():
+    g = _Guarded()
+    g.bump_racy_path()
+    assert locking.witness().observations() == []
+
+
+# -- held/unheld observation -------------------------------------------------
+
+def test_witness_sees_held_and_unheld_writes():
+    locking.enable_lock_witness()
+    g = _Guarded()  # constructed under the witness: lock gets wrapped
+    g.bump_locked_path()
+    g.bump_locked_path()
+    g.bump_racy_path()
+    row = _obs("_Guarded", "_n")
+    assert row["held"] == 2 and row["unheld"] == 1
+    assert row["lock"] == "_lock"
+    assert any("test_lock_witness" in s for s in row["unheld_sites"])
+
+
+def test_init_writes_are_not_observations():
+    locking.enable_lock_witness()
+    _Guarded()  # only construction writes
+    assert _obs("_Guarded", "_n") is None
+
+
+def test_rlock_ownership_probed_without_wrapping():
+    """Instances that predate enable_lock_witness still witness
+    correctly when the guard is an RLock (native _is_owned probe);
+    plain-Lock instances are skipped, never misreported."""
+
+    @guarded_by("_lock", "_v")
+    class R:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._v = 0
+
+        def set_locked(self, v):
+            with self._lock:
+                self._v = v
+
+        def set_racy(self, v):
+            self._v = v
+
+    r = R()  # BEFORE enable: no wrapper
+    locking.enable_lock_witness()
+    r.set_locked(1)
+    r.set_racy(2)
+    row = _obs("R", "_v")
+    assert row["held"] == 1 and row["unheld"] == 1
+
+    g = _Guarded()  # plain Lock, but constructed after enable: wrapped
+    g.bump_locked_path()
+    assert _obs("_Guarded", "_n")["held"] == 1
+
+
+def test_plain_lock_created_before_enable_is_undecidable():
+    g = _Guarded()
+    locking.enable_lock_witness()
+    g.bump_racy_path()
+    # Ownership of an unwrapped plain Lock is undecidable for "this
+    # thread"; the witness must skip, not fabricate a contradiction.
+    assert _obs("_Guarded", "_n") is None
+
+
+def test_cross_thread_writes_attributed_per_thread():
+    locking.enable_lock_witness()
+    g = _Guarded()
+    threads = [threading.Thread(target=g.bump_locked_path)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    row = _obs("_Guarded", "_n")
+    assert row["held"] == 8 and row["unheld"] == 0
+
+
+# -- dump / witness-check ----------------------------------------------------
+
+def _witness_check(dump_path):
+    from yugabyte_db_tpu.analysis.__main__ import main
+
+    return main(["--witness-check", dump_path])
+
+
+def test_witness_check_clean_dump_exits_zero(tmp_path, capsys):
+    locking.enable_lock_witness()
+    g = _Guarded()
+    g.bump_locked_path()
+    path = str(tmp_path / "wit.json")
+    locking.dump_lock_witness(path)
+    assert _witness_check(path) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_witness_check_contradiction_exits_two(tmp_path, capsys):
+    """An unheld write to a field the TREE declares @guarded_by must
+    fail the check.  CircuitBreaker._state is declared in
+    storage/breaker.py, so a forged unheld observation contradicts."""
+    locking.enable_lock_witness()
+    from yugabyte_db_tpu.storage.breaker import CircuitBreaker
+
+    b = CircuitBreaker("witness-test")
+    b.record_failure(RuntimeError("x"))          # held writes
+    b._state = "open"                            # deliberate unheld write
+    path = str(tmp_path / "wit.json")
+    locking.dump_lock_witness(path)
+    assert _witness_check(path) == 2
+    out = capsys.readouterr().out
+    assert "CircuitBreaker._state" in out and "contradiction" in out
+
+
+def test_witness_check_rejects_non_dump(tmp_path):
+    p = tmp_path / "not_a_dump.json"
+    p.write_text("{}")
+    assert _witness_check(str(p)) == 1
+
+
+# -- the tier-1 integration round --------------------------------------------
+
+def test_sweep_and_core_classes_witness_clean(tmp_path):
+    """One deterministic fault-sweep round plus direct breaker/residency
+    exercise under the witness: every observed write to a declared field
+    holds its declared lock (``--witness-check`` exits 0)."""
+    from yugabyte_db_tpu.integration.fault_sweep import FaultSweep
+    from yugabyte_db_tpu.storage.breaker import CircuitBreaker
+    from yugabyte_db_tpu.storage.residency import HbmCache
+
+    path = str(tmp_path / "sweep_witness.json")
+    with tempfile.TemporaryDirectory() as root:
+        summary = FaultSweep(root, seed=1234, ops_per_round=8,
+                             schedule=("wal_sync", "hbm_eviction"),
+                             witness_out=path).run()
+    assert summary["rounds"] == 2
+
+    # Direct breaker/residency exercise folded into the same dump.
+    locking.enable_lock_witness()
+    b = CircuitBreaker("wit", failure_threshold=1, cooldown_s=0.0)
+    b.record_failure(RuntimeError("boom"))       # trips open
+    assert b.allow()                             # half-open probe
+    b.record_success()                           # closes
+    cache = HbmCache()
+
+    class Owner:
+        pass
+
+    o = Owner()
+    key = cache.register(o, label="wit")
+    cache.acquire(key, lambda: (object(), 128), priority="high")
+    cache.invalidate(key)
+    locking.dump_lock_witness(path)
+
+    res = _witness_check(path)
+    assert res == 0
+    row = _obs("CircuitBreaker", "_state")
+    assert row is not None and row["unheld"] == 0
+
+
+@pytest.mark.slow
+def test_randomized_sweep_witness_clean(tmp_path):
+    from yugabyte_db_tpu.integration.fault_sweep import run_sweep
+
+    path = str(tmp_path / "rand_witness.json")
+    with tempfile.TemporaryDirectory() as root:
+        run_sweep(root, seed=1977, rounds=8, ops_per_round=24,
+                  witness_out=path)
+    assert _witness_check(path) == 0

@@ -95,3 +95,35 @@ def test_every_daemon_scrapeable(tmp_path):
         assert any(t["name"] == "m" for t in tables)
     finally:
         c.shutdown()
+
+
+def test_registry_hammer_counts_are_exact():
+    """8 threads hammering one registry's counters/histograms/gauges:
+    every increment lands (regression test for the unsynchronized
+    read-modify-write counter bumps the iraces/ pass flagged)."""
+    import threading
+
+    reg = MetricRegistry()
+    ent = reg.entity(role="hammer")
+    c = ent.counter("hammer_total")
+    h = ent.histogram("hammer_us", buckets=(10, 100, 1000))
+    g = ent.gauge("hammer_last")
+    n_threads, n_ops = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(n_ops):
+            c.increment()
+            h.observe(i % 1000)
+            g.set(tid)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.get() == n_threads * n_ops
+    assert h.count == n_threads * n_ops
+    assert g.get() in range(n_threads)

@@ -132,7 +132,7 @@ def test_list_rules_names_all_families():
     for family in ("layering/", "jax/", "locks/", "errors/"):
         assert any(n.startswith(family) for n in names), names
     inames = set(all_project_rules())
-    for family in ("ilocks/", "ierrors/", "irpc/", "ijax/"):
+    for family in ("ilocks/", "ierrors/", "irpc/", "ijax/", "iraces/"):
         assert any(n.startswith(family) for n in inames), inames
 
 
@@ -980,3 +980,296 @@ def test_baseline_budget_absorbs_only_grandfathered_count(tmp_path):
     fresh, absorbed = apply_baseline(raw, budget)
     assert absorbed == 1
     assert [v.line for v in fresh] == [max(v.line for v in raw)]
+
+
+# -- iraces/ lock-set race detection -----------------------------------------
+
+RACY_COUNTER = """\
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def start(self):
+            threading.Thread(target=self._loop).start()
+
+        def _loop(self):
+            with self._lock:
+                self._n = self._n + 1
+
+        def bump(self):
+            self._n += 1
+"""
+
+
+def test_iraces_unguarded_shared_write_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/c.py": RACY_COUNTER})
+    (v,) = fired(res, "iraces/unguarded-shared-write")
+    assert v.line == 16 and "_n" in v.message
+    assert "Counter" in v.message
+
+
+def test_iraces_unguarded_shared_write_clean_when_locked(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/c.py": """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n = self._n + 1
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """})
+    assert not fired(res, "iraces/unguarded-shared-write")
+
+
+def test_iraces_fires_on_guarded_by_declaration_alone(tmp_path):
+    """@guarded_by marks the class shared by assertion: no thread root
+    needed for the write to be a finding."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/d.py": """\
+        import threading
+
+        from yugabyte_db_tpu.utils.locking import guarded_by
+
+        @guarded_by("_lock", "_state")
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = "idle"
+
+            def set(self, s):
+                self._state = s
+    """})
+    (v,) = fired(res, "iraces/unguarded-shared-write")
+    assert "guarded_by" in v.message
+
+
+def test_iraces_inconsistent_lock_set_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/s.py": """\
+        import threading
+
+        class Split:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._v = 0
+
+            def start(self):
+                threading.Thread(target=self.write_a).start()
+
+            def write_a(self):
+                with self._a:
+                    self._v = 1
+
+            def write_b(self):
+                with self._b:
+                    self._v = 2
+    """})
+    (v,) = fired(res, "iraces/inconsistent-lock-set")
+    assert "_v" in v.message and "no common lock" in v.message
+
+
+def test_iraces_inconsistent_lock_set_clean_with_common_lock(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/s.py": """\
+        import threading
+
+        class Split:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._v = 0
+
+            def start(self):
+                threading.Thread(target=self.write_a).start()
+
+            def write_a(self):
+                with self._a:
+                    self._v = 1
+
+            def write_b(self):
+                with self._a:
+                    self._v = 2
+    """})
+    assert not fired(res, "iraces/inconsistent-lock-set")
+
+
+def test_iraces_guarded_read_unguarded_write_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/g.py": """\
+        import threading
+
+        class Gauge:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0
+
+            def start(self):
+                threading.Thread(target=self.read).start()
+
+            def read(self):
+                with self._lock:
+                    return self._v
+
+            def bump(self):
+                self._v = self._v + 1
+    """})
+    (v,) = fired(res, "iraces/guarded-read-unguarded-write")
+    assert "readers hold" in v.message
+
+
+def test_iraces_guarded_read_unguarded_write_clean(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/g.py": """\
+        import threading
+
+        class Gauge:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0
+
+            def start(self):
+                threading.Thread(target=self.read).start()
+
+            def read(self):
+                with self._lock:
+                    return self._v
+
+            def bump(self):
+                with self._lock:
+                    self._v = self._v + 1
+    """})
+    assert not fired(res, "iraces/guarded-read-unguarded-write")
+
+
+def test_iraces_callback_lambda_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/r.py": """\
+        import threading
+        import weakref
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def add(self, owner, k):
+                with self._lock:
+                    self._items.update({k: owner})
+                weakref.ref(owner, lambda r: self._items.pop(k, None))
+    """})
+    (v,) = fired(res, "iraces/callback-into-locked-state")
+    assert "weakref callback" in v.message and "_items" in v.message
+
+
+def test_iraces_callback_rlock_reentry_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/r.py": """\
+        import threading
+        import weakref
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items.update({k: v})
+
+            def register(self, owner, k):
+                weakref.ref(owner, self._on_death)
+
+            def _on_death(self, ref):
+                with self._lock:
+                    self._items.pop(ref, None)
+    """})
+    assert any("re-entrant" in v.message
+               for v in fired(res, "iraces/callback-into-locked-state"))
+
+
+def test_iraces_callback_clean_with_deferred_queue(tmp_path):
+    """The fix shape: the death callback appends to an undeclared
+    atomic deque; guarded state is drained under the lock elsewhere."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/r.py": """\
+        import collections
+        import threading
+        import weakref
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+                self._dead = collections.deque()
+
+            def add(self, owner, k):
+                with self._lock:
+                    self._items.update({k: owner})
+                weakref.ref(owner, lambda r: self._dead.append(k))
+    """})
+    assert not fired(res, "iraces/callback-into-locked-state")
+
+
+def test_iraces_suppression_honored(tmp_path):
+    code = RACY_COUNTER.replace(
+        "            self._n += 1",
+        "            # yb-lint: disable=iraces/unguarded-shared-write\n"
+        "            self._n += 1")
+    res = lint(tmp_path, {"yugabyte_db_tpu/util/c.py": code})
+    assert not fired(res, "iraces/unguarded-shared-write")
+    assert res.suppressed >= 1
+
+
+def test_iraces_in_sarif_with_fingerprint(tmp_path):
+    p = tmp_path / "yugabyte_db_tpu" / "util" / "c.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(RACY_COUNTER))
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis",
+         "--format=sarif", str(tmp_path / "yugabyte_db_tpu")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2
+    sarif = json.loads(proc.stdout)
+    run = sarif["runs"][0]
+    assert any(r["id"].startswith("iraces/")
+               for r in run["tool"]["driver"]["rules"])
+    (res,) = [r for r in run["results"]
+              if r["ruleId"] == "iraces/unguarded-shared-write"]
+    assert "ybLintBaselineKey/v1" in res["partialFingerprints"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("util/c.py")
+
+
+def test_iraces_changed_only_scopes_to_dirty_files(tmp_path):
+    """Race findings anchor on the write site's file, so --changed-only
+    mutes a committed racy class and reports the same shape in a dirty
+    file — while lock-set inference still runs whole-program."""
+    pkg = tmp_path / "yugabyte_db_tpu"
+    (pkg / "util").mkdir(parents=True)
+    (pkg / "util" / "old.py").write_text(textwrap.dedent(RACY_COUNTER))
+    git_env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "JAX_PLATFORMS": "cpu"}
+    for cmd in (["git", "init", "-q"], ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=tmp_path, check=True, env=git_env,
+                       capture_output=True)
+    (pkg / "util" / "new.py").write_text(
+        textwrap.dedent(RACY_COUNTER).replace("Counter", "Tally"))
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis", "--no-baseline",
+         "--changed-only", "--format=json", str(pkg)],
+        cwd=REPO_ROOT, capture_output=True, text=True, env=git_env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    race = [v for v in data["violations"]
+            if v["rule"] == "iraces/unguarded-shared-write"]
+    assert {v["file"] for v in race} == {"yugabyte_db_tpu/util/new.py"}

@@ -37,6 +37,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="rewrite the baseline from the current tree "
                          "instead of reporting")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--witness-check", metavar="DUMP", default=None,
+                    help="cross-check a runtime lock-witness dump "
+                         "(utils/locking.py, --lock_witness) against the "
+                         "static @guarded_by facts; exits 2 on any "
+                         "contradiction")
     args = ap.parse_args(argv)
 
     rules = core.all_rules()
@@ -48,6 +53,9 @@ def main(argv: list[str] | None = None) -> int:
     paths = args.paths
     if not paths:
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+    if args.witness_check:
+        return _witness_check(args.witness_check, paths)
 
     baseline = None
     if not args.no_baseline and not args.write_baseline:
@@ -75,6 +83,41 @@ def main(argv: list[str] | None = None) -> int:
               "text": reporting.render_text}[args.format]
     print(render(result))
     return 0 if result.ok else 2
+
+
+def _witness_check(dump_path: str, paths: list[str]) -> int:
+    """Compare a runtime lock-witness dump against the tree's static
+    @guarded_by facts.  Exit 0 when consistent, 2 on contradiction."""
+    from yugabyte_db_tpu.analysis import fields
+    from yugabyte_db_tpu.analysis.callgraph import build_index
+    from yugabyte_db_tpu.utils.locking import load_witness_dump
+
+    try:
+        dump = load_witness_dump(dump_path)
+    except (OSError, ValueError) as e:
+        print(f"yb-lint: {e}", file=sys.stderr)
+        return 1
+    repo_root = core._find_repo_root(paths)
+    srcs = []
+    for path, rel in core.iter_python_files(paths, repo_root):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                srcs.append(core.SourceFile(path, rel, f.read()))
+        except (OSError, SyntaxError, ValueError):
+            continue
+    index = build_index(srcs)
+    problems = fields.witness_contradictions(index, dump)
+    n_obs = len(dump.get("observations", ()))
+    n_facts = len(fields.static_guarded_facts(index))
+    if problems:
+        print(f"yb-lint witness-check: {len(problems)} contradiction(s) "
+              f"across {n_obs} observation(s) / {n_facts} static fact(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 2
+    print(f"yb-lint witness-check: OK — {n_obs} observation(s) consistent "
+          f"with {n_facts} static @guarded_by fact(s)")
+    return 0
 
 
 def _changed_files(repo_root: str) -> set[str] | None:

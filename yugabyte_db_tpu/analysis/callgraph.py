@@ -59,6 +59,27 @@ _BLOCKING_QUALNAME_TAILS = ("Proxy.call", "Transport.send",
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 _TIMEOUT_WORDS = ("timeout", "deadline")
 
+# Container methods that mutate the receiver in place: `self._d.pop(k)`
+# is a write to self._d's state even though no attribute is rebound.
+# (iraces/ treats these as write sites; the runtime witness cannot see
+# them, which is exactly why the static pass must.)
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "move_to_end", "rotate",
+})
+
+# Constructors whose result is a mutable container.  A field must be
+# assigned one of these (or a literal/comprehension) somewhere in its
+# class before _MUTATOR_METHODS calls on it count as mutations —
+# `self.session.insert(...)` and `self.clock.update(...)` are domain
+# methods on objects that synchronize themselves.
+_CONTAINER_CTORS = frozenset({
+    "dict", "list", "set", "frozenset", "bytearray", "OrderedDict",
+    "defaultdict", "deque", "Counter", "ChainMap", "WeakSet",
+    "WeakValueDictionary", "WeakKeyDictionary",
+})
+
 # Tokens whose presence in a while-loop's test or body mark the loop as
 # BOUNDED: either by a retry budget (deadline/attempts — the
 # utils.retry discipline) or by service lifecycle (a daemon's
@@ -141,6 +162,11 @@ class FunctionInfo:
     returns_status: bool = False       # returns a utils.status Status
     return_calls: list = field(default_factory=list)  # raw names returned
     uploads: list = field(default_factory=list)  # (line, kind, arg text)
+    # self.<attr> access sites for iraces/: (attr, line, kind, held)
+    # where kind is "read" | "write" | "mut" and held the lock tokens
+    # held lexically at the site (entry-context added interprocedurally
+    # by analysis/fields.py).
+    field_accesses: list = field(default_factory=list)
 
 
 @dataclass
@@ -153,6 +179,12 @@ class ClassInfo:
     attr_types: dict = field(default_factory=dict)   # attr -> raw class name
     lock_attrs: dict = field(default_factory=dict)   # attr -> "Lock"|"RLock"
     lock_aliases: dict = field(default_factory=dict)  # cv attr -> lock attr
+    guarded_decl: dict = field(default_factory=dict)  # field -> lock attr
+    #   (from literal @guarded_by("_lock", "_f", ...) class decorators)
+    container_attrs: set = field(default_factory=set)  # attrs assigned a
+    #   container literal/ctor somewhere; only these can have "mut"
+    #   accesses (a .insert/.update on an unknown type is a domain
+    #   method, not a container mutation)
 
 
 def _is_handler_name(name: str) -> bool:
@@ -358,7 +390,75 @@ class _FunctionScanner(ast.NodeVisitor):
                     self.info.returns_rpc_resp = True
         self.generic_visit(node)
 
+    # -- self.<field> accesses (iraces/) -------------------------------------
+    def _record_access(self, attr: str, line: int, kind: str) -> None:
+        if self.cls is not None:
+            self.info.field_accesses.append(
+                (attr, line, kind, frozenset(self.held)))
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        """attr name when ``node`` is ``self.<attr>``, else None."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _note_container(self, tgt: ast.AST, value: ast.AST | None) -> None:
+        if self.cls is None or value is None:
+            return
+        attr = self._self_attr(tgt)
+        if attr is None:
+            return
+        if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                              ast.SetComp, ast.DictComp)):
+            self.cls.container_attrs.add(attr)
+        elif isinstance(value, ast.Call):
+            name = call_name(value).rsplit(".", 1)[-1]
+            if name in _CONTAINER_CTORS:
+                self.cls.container_attrs.add(attr)
+
+    def _record_write_target(self, tgt: ast.AST, line: int) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._record_write_target(elt, line)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._record_write_target(tgt.value, line)
+            return
+        attr = self._self_attr(tgt)
+        if attr is None and isinstance(tgt, ast.Subscript):
+            # self._d[k] = v mutates self._d.
+            attr = self._self_attr(tgt.value)
+        if attr is not None:
+            self._record_access(attr, line, "write")
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, ast.Load):
+            attr = self._self_attr(node)
+            if attr is not None:
+                self._record_access(attr, node.lineno, "read")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record_write_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record_write_target(node.target, node.lineno)
+            self._note_container(node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            self._record_write_target(tgt, node.lineno)
+        self.generic_visit(node)
+
     def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._record_write_target(tgt, node.lineno)
+            self._note_container(tgt, node.value)
         if isinstance(node.value, ast.Call) \
                 and is_blocking_raw(call_name(node.value)):
             bound = getattr(self, "_rpc_bound", None)
@@ -375,6 +475,10 @@ class _FunctionScanner(ast.NodeVisitor):
             self.info.uploads.append(fact)
         raw = call_name(node)
         if raw:
+            mut_parts = raw.split(".")
+            if len(mut_parts) == 3 and mut_parts[0] == "self" \
+                    and mut_parts[2] in _MUTATOR_METHODS:
+                self._record_access(mut_parts[1], node.lineno, "mut")
             if raw.endswith(_HOST_SYNC_TAILS):
                 self.info.host_syncs.append(
                     (node.lineno,
@@ -481,6 +585,18 @@ class ProjectIndex:
                                    bases=[dotted_name(b) for b in stmt.bases])
                     mod.classes[stmt.name] = ci
                     self.classes[ci.qualname] = ci
+                    for dec in stmt.decorator_list:
+                        if not isinstance(dec, ast.Call):
+                            continue
+                        if dotted_name(dec.func).rsplit(".", 1)[-1] \
+                                != "guarded_by":
+                            continue
+                        lits = [a.value for a in dec.args
+                                if isinstance(a, ast.Constant)
+                                and isinstance(a.value, str)]
+                        if len(lits) >= 2:
+                            for fld in lits[1:]:
+                                ci.guarded_decl[fld] = lits[0]
                     self._collect_class_attrs(stmt, ci)
                     index_scope(stmt.body, f"{prefix}.{stmt.name}"
                                 if prefix else stmt.name, ci)
@@ -830,6 +946,16 @@ class ProjectIndex:
         return result
 
     # -- misc queries --------------------------------------------------------
+    def resolve_ref(self, raw: str, info: FunctionInfo) -> list[str]:
+        """Project qualnames for a dotted callable REFERENCE written
+        inside ``info`` (a Thread target, an executor-submit argument, a
+        weakref death callback) — same tiers as call resolution."""
+        mod = self.modules.get(info.module)
+        if mod is None or not raw:
+            return []
+        return list(self._resolve_one(raw, info, mod,
+                                      self._local_var_types(info, mod)))
+
     def handlers(self):
         """Service-handler entry points (`_h_*` / `handle*` methods)."""
         return [f for f in self.functions.values()

@@ -129,6 +129,7 @@ def _load_rule_modules() -> None:
     _LOADED = True
     from yugabyte_db_tpu.analysis import (  # noqa: F401
         error_discipline,
+        fields,
         ierrors,
         ijax,
         ilocks,

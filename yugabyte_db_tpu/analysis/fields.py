@@ -1,0 +1,547 @@
+"""iraces/: per-class lock-set inference over ``self.<field>`` accesses.
+
+The lock-*order* rules (ilocks/) prove the locks compose; nothing proved
+the locks are *used*.  This pass closes that gap in the style of lock-set
+race detection (Eraser) and compositional ownership reasoning (RacerD):
+
+1. **Field access sites.** callgraph's scanner records every
+   ``self.<field>`` read, rebind, and in-place container mutation with
+   the lock tokens held lexically at the site.
+
+2. **Entry lock-sets.** Locks held at a *call site* protect the callee's
+   body too (``_drain_dead`` is only ever called under ``_lock``), so a
+   fixpoint over the call graph computes, per function, the set of
+   possible held-at-entry lock sets from every observed caller.  A
+   function nobody in the project calls is assumed externally callable
+   with nothing held; a ``*_locked`` function is credited its class's
+   guarding lock (the convention ilocks/ enforces).
+
+3. **Thread roots.** A class is only racy if more than one thread can
+   touch it.  Roots are functions handed to ``threading.Thread(target=)``,
+   ``Timer``, executor ``.submit``, metric collector/callback-gauge
+   registrations, weakref death callbacks, ``__del__``, and RPC service
+   handlers; everything reachable from a root runs off the constructing
+   thread.  Classes carrying a ``@guarded_by`` declaration
+   (utils/locking.py) are shared by assertion and always checked.
+
+Rules:
+
+- ``iraces/unguarded-shared-write`` — a write site where some path holds
+  none of the class's locks, while the field is declared ``@guarded_by``
+  or written under a lock elsewhere.
+- ``iraces/inconsistent-lock-set`` — every access is locked, but the
+  intersection of the lock sets is empty (``_a`` here, ``_b`` there).
+- ``iraces/guarded-read-unguarded-write`` — readers take a lock the
+  writers bypass (no declaration, no locked write anywhere).
+- ``iraces/callback-into-locked-state`` — a weakref/GC callback mutates
+  guarded state: inline (a death-callback lambda) or by re-entering an
+  RLock-guarded method, which can interleave with a critical section
+  mid-iteration on the same thread — the PR-6 bug shape.
+
+The runtime half lives in utils/locking.py: the lock witness records
+(field, lock-held) observations under ``--lock_witness`` and
+``--witness-check`` fails when runtime contradicts a static "guarded"
+fact derived here (see :func:`static_guarded_facts`).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from yugabyte_db_tpu.analysis import callgraph
+from yugabyte_db_tpu.analysis.core import (
+    Violation,
+    call_name,
+    dotted_name,
+    project_rule,
+)
+
+# Construction/serialization methods: the object is not shared yet (or
+# the interpreter serializes access), so their writes are not sites.
+_EXEMPT_METHODS = frozenset({
+    "__init__", "__new__", "__getstate__", "__setstate__", "__del__",
+})
+
+# Bound on distinct entry lock-sets tracked per function; beyond it the
+# sets collapse to their intersection (sound: never claims a lock held
+# on a path that might not hold it).
+_ENTRY_SET_CAP = 8
+
+_GC_KINDS = frozenset({"weakref", "gc"})
+
+_SYN_SUFFIX = ".<locked>"  # *_locked in a multi-lock class: held, unknown which
+
+
+@dataclass
+class _Access:
+    attr: str
+    line: int
+    kind: str            # "read" | "write" | "mut"
+    fn: object           # FunctionInfo
+    held_always: frozenset  # own-lock tokens held on EVERY path to the site
+    may_unheld: bool     # some path reaches the site with no own lock
+
+
+@dataclass
+class _ClassModel:
+    ci: object                       # ClassInfo
+    threaded: bool
+    own_tokens: frozenset            # this class's lock tokens (+ synthetic)
+    fields: dict                     # attr -> list[_Access]
+    decl_tokens: dict                # attr -> declared lock token
+    lock_short: dict                 # token -> "_lock" (attr name, messages)
+
+
+class _Model:
+    def __init__(self, index):
+        self.index = index
+        self.registrations = []      # (kind, expr_node, FunctionInfo)
+        self.threaded_fns = set()
+        self.gc_reachable = set()
+        self.entry = {}
+        self.classes = {}            # class qualname -> _ClassModel
+        self._build()
+
+    # -- thread roots --------------------------------------------------------
+    def _collect_registrations(self):
+        for fn in self.index.functions.values():
+            node = fn.node
+            if node is None or not hasattr(node, "body"):
+                continue
+            stack = list(node.body)
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue  # nested defs are their own FunctionInfos
+                if isinstance(n, ast.Call):
+                    reg = _registration(n)
+                    if reg is not None:
+                        self.registrations.append((reg[0], reg[1], fn))
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _root_quals(self, kinds=None):
+        quals = set()
+        for kind, expr, fn in self.registrations:
+            if kinds is not None and kind not in kinds:
+                continue
+            if isinstance(expr, ast.Lambda):
+                # Calls inside the lambda body run in the callback context.
+                for sub in ast.walk(expr.body):
+                    if isinstance(sub, ast.Call):
+                        quals.update(self.index.resolve_ref(
+                            call_name(sub), fn))
+                continue
+            quals.update(self.index.resolve_ref(dotted_name(expr), fn))
+        if kinds is None or "gc" in kinds:
+            quals.update(f.qualname for f in self.index.functions.values()
+                         if f.name == "__del__")
+        return quals
+
+    def _reachable(self, roots):
+        seen = set(roots)
+        stack = list(roots)
+        while stack:
+            fn = self.index.functions.get(stack.pop())
+            if fn is None:
+                continue
+            for cs in fn.calls:
+                for callee in cs.callees:
+                    if callee not in seen:
+                        seen.add(callee)
+                        stack.append(callee)
+        return seen
+
+    # -- entry lock-sets -----------------------------------------------------
+    def _entry_sets(self, external):
+        index = self.index
+        in_edges: dict[str, bool] = {}
+        for fn in index.functions.values():
+            for cs in fn.calls:
+                for callee in cs.callees:
+                    in_edges[callee] = True
+        entry: dict[str, set] = {}
+        for q in index.functions:
+            if q in external or q not in in_edges:
+                entry[q] = {frozenset()}
+        # Saturated callees keep a single intersection set; intersections
+        # only shrink and unsaturated sets only grow, so this terminates.
+        saturated: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for fn in index.functions.values():
+                src = entry.get(fn.qualname)
+                if not src:
+                    continue
+                for cs in fn.calls:
+                    if not cs.callees:
+                        continue
+                    contrib = {cs.held | e for e in src}
+                    for callee in cs.callees:
+                        cur = entry.setdefault(callee, set())
+                        if callee in saturated:
+                            new = {frozenset.intersection(*cur, *contrib)}
+                        else:
+                            new = cur | contrib
+                            if len(new) > _ENTRY_SET_CAP:
+                                saturated.add(callee)
+                                new = {frozenset.intersection(*new)}
+                        if new != cur:
+                            entry[callee] = new
+                            changed = True
+        for q in index.functions:
+            if not entry.get(q):
+                entry[q] = {frozenset()}
+        return entry
+
+    # -- per-class field tables ----------------------------------------------
+    def _build(self):
+        index = self.index
+        self._collect_registrations()
+        handler_quals = {f.qualname for f in index.handlers()}
+        all_roots = self._root_quals() | handler_quals
+        self.threaded_fns = self._reachable(all_roots)
+        self.gc_reachable = self._reachable(self._root_quals(_GC_KINDS))
+        self.entry = self._entry_sets(external=all_roots)
+
+        methods_by_class: dict[str, list] = {}
+        for fn in index.functions.values():
+            if fn.cls is not None:
+                methods_by_class.setdefault(
+                    f"{fn.module}.{fn.cls}", []).append(fn)
+
+        for cq, ci in index.classes.items():
+            if not ci.lock_attrs and not ci.guarded_decl:
+                continue
+            methods = methods_by_class.get(cq, [])
+            threaded = bool(ci.guarded_decl) or any(
+                m.qualname in self.threaded_fns for m in methods)
+            syn = cq + _SYN_SUFFIX
+            own = set()
+            for attr in ci.lock_attrs:
+                own.add(f"{cq}.{ci.lock_aliases.get(attr, attr)}")
+            decl_tokens = {}
+            for fld, lk in ci.guarded_decl.items():
+                tok = f"{cq}.{ci.lock_aliases.get(lk, lk)}"
+                own.add(tok)
+                decl_tokens[fld] = tok
+            own.add(syn)
+            own = frozenset(own)
+            lock_short = {tok: tok.rsplit(".", 1)[-1] for tok in own}
+
+            # The `*_locked` convention means "caller holds the class's
+            # guarding lock" — credit a specific lock only when the class
+            # has exactly ONE candidate AND some call site corroborates it
+            # (calls a *_locked method while holding that lock).  Without
+            # corroboration the convention may refer to an EXTERNAL lock
+            # (engines are serialized by the tablet's write lock), so a
+            # synthetic token keeps the site non-racy without letting it
+            # vouch for other sites.
+            reals = [a for a, k in ci.lock_attrs.items() if k != "Condition"]
+            conv = syn
+            if len(reals) == 1:
+                cand = f"{cq}.{reals[0]}"
+                for fn in methods:
+                    if any(cand in cs.held
+                           and (cs.raw.rsplit(".", 1)[-1].endswith("_locked")
+                                or any(self.index.functions[c].requires_lock
+                                       for c in cs.callees
+                                       if c in self.index.functions))
+                           for cs in fn.calls):
+                        conv = cand
+                        break
+
+            fields: dict[str, list] = {}
+            skip_attrs = (set(ci.lock_attrs) | set(ci.lock_aliases)
+                          | set(ci.guarded_decl.values()))
+            for fn in methods:
+                base_sets = self.entry.get(fn.qualname) or [frozenset()]
+                extra = frozenset({conv}) if fn.requires_lock else frozenset()
+                for attr, line, kind, held in fn.field_accesses:
+                    if attr in skip_attrs:
+                        continue
+                    if kind == "mut" and attr not in ci.container_attrs:
+                        continue
+                    sets = [(e | held | extra) & own for e in base_sets]
+                    fields.setdefault(attr, []).append(_Access(
+                        attr=attr, line=line, kind=kind, fn=fn,
+                        held_always=frozenset.intersection(*sets),
+                        may_unheld=any(not s for s in sets)))
+            self.classes[cq] = _ClassModel(
+                ci=ci, threaded=threaded, own_tokens=own, fields=fields,
+                decl_tokens=decl_tokens, lock_short=lock_short)
+
+    # -- shared fact: is this field guarded? ---------------------------------
+    def guard_token(self, cm: _ClassModel, attr: str) -> str | None:
+        """The lock token the class guards ``attr`` with: the declared
+        lock, else any lock some non-init write site always holds."""
+        tok = cm.decl_tokens.get(attr)
+        if tok is not None:
+            return tok
+        syn = cm.ci.qualname + _SYN_SUFFIX
+        for a in cm.fields.get(attr, ()):
+            real = a.held_always - {syn}
+            if a.kind in ("write", "mut") and a.fn.name != "__init__" \
+                    and real:
+                return sorted(real)[0]
+        return None
+
+
+def _registration(node: ast.Call):
+    """(kind, callback_expr) when ``node`` hands a callable to another
+    execution context, else None."""
+    raw = call_name(node)
+    if not raw:
+        return None
+    tail = raw.rsplit(".", 1)[-1]
+    kws = {k.arg: k.value for k in node.keywords if k.arg}
+    args = node.args
+    if tail == "Thread":
+        tgt = kws.get("target")
+        return ("thread", tgt) if tgt is not None else None
+    if tail == "Timer":
+        tgt = args[1] if len(args) > 1 else kws.get("function")
+        return ("timer", tgt) if tgt is not None else None
+    if tail == "submit" and "." in raw and args:
+        return ("executor", args[0])
+    if raw.startswith("weakref") and tail in ("ref", "finalize") \
+            and len(args) > 1:
+        return ("weakref", args[1])
+    if tail == "add_collector" and args:
+        return ("collector", args[0])
+    if tail == "gauge":
+        tgt = args[1] if len(args) > 1 else kws.get("fn")
+        return ("collector", tgt) if tgt is not None else None
+    return None
+
+
+def _model(index) -> _Model:
+    m = getattr(index, "_iraces_model", None)
+    if m is None:
+        m = index._iraces_model = _Model(index)
+    return m
+
+
+def _site_label(a: _Access) -> str:
+    return f"{a.fn.rel}:{a.line}"
+
+
+def _short(cm: _ClassModel, tokens) -> str:
+    names = sorted(cm.lock_short.get(t, t) for t in tokens)
+    return "/".join(names) if names else "<none>"
+
+
+# -- rules --------------------------------------------------------------------
+
+@project_rule("iraces/unguarded-shared-write")
+def check_unguarded_shared_write(index):
+    """A write site reachable with no class lock held, on a field the
+    class elsewhere treats as lock-protected (declared or locked
+    writes)."""
+    model = _model(index)
+    for cm in model.classes.values():
+        if not cm.threaded:
+            continue
+        syn = cm.ci.qualname + _SYN_SUFFIX
+        for attr, accesses in cm.fields.items():
+            decl_tok = cm.decl_tokens.get(attr)
+            sites = [a for a in accesses
+                     if a.fn.name not in _EXEMPT_METHODS]
+            locked_writes = [a for a in sites
+                             if a.kind in ("write", "mut")
+                             and a.held_always - {syn}]
+            for a in sites:
+                if a.kind == "read" or not a.may_unheld:
+                    continue
+                evidence = None
+                if decl_tok is not None:
+                    evidence = (f"declared @guarded_by("
+                                f"\"{cm.lock_short[decl_tok]}\")")
+                else:
+                    others = [w for w in locked_writes if w is not a]
+                    if others:
+                        w = others[0]
+                        evidence = (f"written under "
+                                    f"`{_short(cm, w.held_always - {syn})}`"
+                                    f" at {_site_label(w)}")
+                if evidence is None:
+                    continue
+                yield Violation(
+                    "iraces/unguarded-shared-write", a.fn.rel, a.line,
+                    f"`self.{attr}` written without a lock on "
+                    f"multi-threaded class `{cm.ci.name}` — field is "
+                    f"{evidence}; take the lock or defer the mutation",
+                    f"usw:{cm.ci.name}.{attr}")
+
+
+@project_rule("iraces/inconsistent-lock-set")
+def check_inconsistent_lock_set(index):
+    """Every access is locked, but no single lock is common to all of
+    them — mutual exclusion holds pairwise only by luck."""
+    model = _model(index)
+    for cm in model.classes.values():
+        if not cm.threaded:
+            continue
+        syn = cm.ci.qualname + _SYN_SUFFIX
+        for attr, accesses in cm.fields.items():
+            shared = [a for a in accesses
+                      if a.fn.name not in _EXEMPT_METHODS]
+            sites = [a for a in shared
+                     if not a.may_unheld and syn not in a.held_always]
+            writes = [a for a in sites if a.kind in ("write", "mut")]
+            if len(sites) < 2 or not writes:
+                continue
+            # Unguarded (non-construction) sites are the other rules'
+            # findings; here every shared site holds SOME lock.
+            if any(a.may_unheld for a in shared):
+                continue
+            common = frozenset.intersection(*[a.held_always for a in sites])
+            if common:
+                continue
+            first = sites[0]
+            other = next((a for a in sites[1:]
+                          if a.held_always != first.held_always), sites[1])
+            yield Violation(
+                "iraces/inconsistent-lock-set", other.fn.rel, other.line,
+                f"`self.{attr}` on `{cm.ci.name}` is locked everywhere "
+                f"but by no common lock: `{_short(cm, first.held_always)}` "
+                f"at {_site_label(first)} vs "
+                f"`{_short(cm, other.held_always)}` here",
+                f"ils:{cm.ci.name}.{attr}")
+
+
+@project_rule("iraces/guarded-read-unguarded-write")
+def check_guarded_read_unguarded_write(index):
+    """Readers lock, writers don't: the lock documents an intent the
+    write path silently violates (no declaration, no locked write)."""
+    model = _model(index)
+    for cm in model.classes.values():
+        if not cm.threaded:
+            continue
+        syn = cm.ci.qualname + _SYN_SUFFIX
+        for attr, accesses in cm.fields.items():
+            if attr in cm.decl_tokens:
+                continue
+            sites = [a for a in accesses
+                     if a.fn.name not in _EXEMPT_METHODS]
+            if any(a.kind in ("write", "mut") and a.held_always - {syn}
+                   for a in sites):
+                continue  # iraces/unguarded-shared-write territory
+            locked_reads = [a for a in sites
+                            if a.kind == "read" and a.held_always - {syn}
+                            and not a.may_unheld]
+            if not locked_reads:
+                continue
+            for a in sites:
+                if a.kind == "read" or not a.may_unheld:
+                    continue
+                r = locked_reads[0]
+                yield Violation(
+                    "iraces/guarded-read-unguarded-write", a.fn.rel, a.line,
+                    f"`self.{attr}` written without the "
+                    f"`{_short(cm, r.held_always - {syn})}` that readers hold "
+                    f"(e.g. {_site_label(r)}) on multi-threaded class "
+                    f"`{cm.ci.name}`",
+                    f"grw:{cm.ci.name}.{attr}")
+
+
+@project_rule("iraces/callback-into-locked-state")
+def check_callback_into_locked_state(index):
+    """Weakref death callbacks and ``__del__`` run at arbitrary
+    allocation points — possibly re-entrantly on a thread already inside
+    the class.  Mutating guarded state from one corrupts invariants even
+    when an RLock "protects" it (re-entry succeeds mid-critical-section).
+    Fix shape: enqueue into an unguarded atomic buffer, drain under the
+    lock (storage/residency.py `_dead`)."""
+    model = _model(index)
+    # Inline lambdas registered as weakref callbacks.
+    for kind, expr, fn in model.registrations:
+        if kind not in _GC_KINDS or not isinstance(expr, ast.Lambda):
+            continue
+        cm = model.classes.get(f"{fn.module}.{fn.cls}") if fn.cls else None
+        if cm is None:
+            continue
+        for sub in ast.walk(expr.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            parts = call_name(sub).split(".")
+            if len(parts) == 3 and parts[0] == "self" \
+                    and parts[2] in callgraph._MUTATOR_METHODS:
+                tok = model.guard_token(cm, parts[1])
+                if tok is not None:
+                    yield Violation(
+                        "iraces/callback-into-locked-state",
+                        fn.rel, sub.lineno,
+                        f"weakref callback mutates `self.{parts[1]}` "
+                        f"(guarded by `{cm.lock_short.get(tok, tok)}`) on "
+                        f"`{cm.ci.name}` — callbacks fire at arbitrary "
+                        f"allocation points; enqueue and drain under the "
+                        f"lock instead",
+                        f"cbl:{cm.ci.name}.{parts[1]}")
+    # Methods reachable from a GC/weakref root that write guarded state
+    # under an RLock: re-entrant acquisition succeeds mid-critical-section.
+    for cm in model.classes.values():
+        for attr, accesses in cm.fields.items():
+            for a in accesses:
+                if a.kind == "read" or a.fn.name in _EXEMPT_METHODS:
+                    continue
+                if a.fn.qualname not in model.gc_reachable \
+                        and a.fn.name != "__del__":
+                    continue
+                if a.fn.name == "__del__" or not a.held_always:
+                    tok = model.guard_token(cm, attr)
+                    if tok is None:
+                        continue
+                    yield Violation(
+                        "iraces/callback-into-locked-state",
+                        a.fn.rel, a.line,
+                        f"`self.{attr}` (guarded by "
+                        f"`{cm.lock_short.get(tok, tok)}`) mutated on a "
+                        f"GC/weakref callback path without the lock on "
+                        f"`{cm.ci.name}`",
+                        f"cbl:{cm.ci.name}.{attr}")
+                    continue
+                rlocked = [t for t in a.held_always
+                           if index.lock_kind(t) == "RLock"]
+                if rlocked:
+                    yield Violation(
+                        "iraces/callback-into-locked-state",
+                        a.fn.rel, a.line,
+                        f"`self.{attr}` mutated under re-entrant "
+                        f"`{_short(cm, rlocked)}` on a GC/weakref callback "
+                        f"path — the callback can interleave with a "
+                        f"critical section on the SAME thread "
+                        f"(`{cm.ci.name}`); defer via an atomic queue",
+                        f"cbl:{cm.ci.name}.{attr}")
+
+
+# -- witness cross-check ------------------------------------------------------
+
+def static_guarded_facts(index) -> dict:
+    """(class simple name, field) -> declared lock attr, for every
+    ``@guarded_by`` declaration in the tree.  The runtime witness keys
+    observations by simple class name; declarations are rare enough
+    that collisions don't arise in practice."""
+    facts = {}
+    for ci in index.classes.values():
+        for fld, lock_attr in ci.guarded_decl.items():
+            facts[(ci.name, fld)] = lock_attr
+    return facts
+
+
+def witness_contradictions(index, dump: dict) -> list[str]:
+    """Human-readable contradiction lines: runtime saw an unheld write
+    to a field the static pass calls guarded.  Empty list == consistent."""
+    facts = static_guarded_facts(index)
+    out = []
+    for obs in dump.get("observations", ()):
+        key = (obs.get("class"), obs.get("field"))
+        unheld = int(obs.get("unheld", 0))
+        if unheld > 0 and key in facts:
+            sites = ", ".join(obs.get("unheld_sites", [])[:3]) or "?"
+            out.append(
+                f"{key[0]}.{key[1]}: {unheld} write(s) without "
+                f"`{facts[key]}` held (declared @guarded_by) — e.g. {sites}")
+    return out

@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from yugabyte_db_tpu.utils.locking import guarded_by
 from yugabyte_db_tpu.utils.retry import RetryPolicy
 
 # A location lookup retries only transient master-side failures; a
@@ -40,6 +41,7 @@ class TableLocations:
     tablets: list[TabletLocation] = field(default_factory=list)  # sorted
 
 
+@guarded_by("_lock", "_tables")
 class MetaCache:
     def __init__(self, client):
         self._client = client

@@ -91,7 +91,7 @@ class FaultSweep:
                  faults: tuple = FAULT_CATALOG,
                  schedule: tuple | None = None,
                  num_tservers: int = 3, num_tablets: int = 2,
-                 keyspace: int = 48):
+                 keyspace: int = 48, witness_out: str | None = None):
         self.data_root = data_root
         self.seed = seed
         self.rounds = len(schedule) if schedule is not None else rounds
@@ -116,6 +116,10 @@ class FaultSweep:
         self.mc: MiniCluster | None = None
         self.client = None
         self.table = None
+        # Dump lock-witness observations here after the sweep (also
+        # honors the --lock_witness flag without a path, for ad-hoc
+        # runs; the dump is meant for yb-lint --witness-check).
+        self.witness_out = witness_out
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -156,6 +160,14 @@ class FaultSweep:
             self.mc = None
 
     def run(self) -> dict:
+        from yugabyte_db_tpu.utils import locking
+
+        # Enable BEFORE setup so every lock the cluster creates is
+        # ownership-tracked from birth.
+        wit = self.witness_out is not None or bool(
+            FLAGS.get("lock_witness"))
+        if wit:
+            locking.enable_lock_witness()
         self.setup()
         try:
             for rnd in range(self.rounds):
@@ -178,6 +190,10 @@ class FaultSweep:
                     "keys": len(self.oracle)}
         finally:
             self.teardown()
+            if wit:
+                if self.witness_out is not None:
+                    locking.dump_lock_witness(self.witness_out)
+                locking.disable_lock_witness()
 
     # -- one round -----------------------------------------------------------
 
@@ -401,9 +417,17 @@ def run_sweep(data_root: str, seed: int, rounds: int = 5,
 
 
 if __name__ == "__main__":  # replay a failing seed: python -m ... <seed>
+    # With --witness-out PATH the replay records lock-witness
+    # observations for yb-lint --witness-check.
     import sys
     import tempfile
 
+    argv = list(sys.argv[1:])
+    wout = None
+    if "--witness-out" in argv:
+        i = argv.index("--witness-out")
+        wout = argv[i + 1]
+        del argv[i:i + 2]
     with tempfile.TemporaryDirectory() as root:
-        print(run_sweep(root, int(sys.argv[1]) if len(sys.argv) > 1
-                        else 1234))
+        print(run_sweep(root, int(argv[0]) if argv else 1234,
+                        witness_out=wout))

@@ -31,6 +31,8 @@ import threading
 import time
 import weakref
 
+from yugabyte_db_tpu.utils.locking import guarded_by
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -81,6 +83,8 @@ def health_report() -> dict:
                          for b in bad]}
 
 
+@guarded_by("_lock", "_state", "_opened_at", "_probe_inflight",
+            "consecutive_failures", "trips", "last_error")
 class CircuitBreaker:
     """closed -> open -> half-open (single probe) state machine.
 

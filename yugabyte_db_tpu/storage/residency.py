@@ -39,6 +39,7 @@ import weakref
 from collections import OrderedDict, deque
 
 from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.locking import guarded_by
 from yugabyte_db_tpu.utils.memtracker import root_tracker
 from yugabyte_db_tpu.utils.metrics import hbm_cache_entity
 from yugabyte_db_tpu.utils.sync_point import sync_point
@@ -73,6 +74,11 @@ class _Entry:
         return self.nbytes + self.aux_bytes
 
 
+# _dead is deliberately NOT declared: the weakref death callback
+# appends to it lock-free (atomic deque), _drain_dead consumes under
+# _lock — see register().
+@guarded_by("_lock", "_entries", "_pools", "_next_key", "_resident",
+            "_peak_resident")
 class HbmCache:
     """Process-wide capacity-budgeted cache of device plane groups.
 
@@ -132,8 +138,14 @@ class HbmCache:
             self._next_key += 1
             e = _Entry(key, label or type(owner).__name__, tracker)
             if owner is not None:
+                # Deliberate: the death callback only ENQUEUES into a
+                # deque (append is atomic under the GIL); _drain_dead
+                # consumes under _lock. This is the deferred-mutation
+                # shape the rule prescribes, not the race it flags.
                 e.owner_ref = weakref.ref(
-                    owner, lambda _r, k=key: self._dead.append(k))
+                    owner,
+                    # yb-lint: disable=iraces/callback-into-locked-state
+                    lambda _r, k=key: self._dead.append(k))
             self._entries[key] = e
             return key
 

@@ -54,8 +54,9 @@ class RetryableRequests:
             return
         with open(self.path, "rb") as f:
             d = codec.decode(f.read())
-        for cid, pairs in d.items():
-            self.clients[cid] = OrderedDict(pairs)
+        with self._lock:
+            for cid, pairs in d.items():
+                self.clients[cid] = OrderedDict(pairs)
 
     def dump(self) -> dict:
         with self._lock:

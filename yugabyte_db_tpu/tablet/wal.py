@@ -23,6 +23,7 @@ import zlib
 from dataclasses import dataclass
 
 from yugabyte_db_tpu.utils import codec
+from yugabyte_db_tpu.utils.locking import guarded_by
 
 _HEADER = struct.Struct("<II")
 
@@ -62,6 +63,8 @@ class LogEntry:
                         rec[5] if len(rec) > 5 else 0)
 
 
+@guarded_by("_lock", "_file", "_file_path", "_file_size", "_buffer",
+            "_buffer_bytes", "last_appended")
 class Log:
     """A tablet's durable log of replicated operations."""
 

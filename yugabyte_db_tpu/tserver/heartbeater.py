@@ -11,15 +11,22 @@ from __future__ import annotations
 import threading
 import time
 
+from yugabyte_db_tpu.utils.locking import guarded_by
 from yugabyte_db_tpu.utils.retry import RetryPolicy
 
 
+# The heartbeat thread and the server's start/stop/trigger callers share
+# these; _wake/-thread lifecycle needs no lock (Event is self-locking,
+# _thread is written before start() returns).
+@guarded_by("_lock", "_leader_hint", "_running", "last_response",
+            "consecutive_failures")
 class Heartbeater:
     def __init__(self, server, master_uuids: list[str],
                  interval_s: float = 0.5):
         self.server = server
         self.master_uuids = list(master_uuids)
         self.interval_s = interval_s
+        self._lock = threading.Lock()
         self._leader_hint: str | None = None
         self._running = False
         self._thread: threading.Thread | None = None
@@ -34,14 +41,16 @@ class Heartbeater:
             initial_backoff_s=0.05, max_backoff_s=0.5)
 
     def start(self) -> None:
-        self._running = True
+        with self._lock:
+            self._running = True
         self._thread = threading.Thread(
             target=self._loop, name=f"heartbeat-{self.server.uuid}",
             daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
-        self._running = False
+        with self._lock:
+            self._running = False
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
@@ -54,10 +63,12 @@ class Heartbeater:
         while self._running:
             try:
                 self._heartbeat_once()
-                self.consecutive_failures = 0
+                with self._lock:
+                    self.consecutive_failures = 0
             except Exception:
-                self.consecutive_failures += 1
-                self._leader_hint = None
+                with self._lock:
+                    self.consecutive_failures += 1
+                    self._leader_hint = None
             self._wake.wait(timeout=self.interval_s)
             self._wake.clear()
 
@@ -85,11 +96,13 @@ class Heartbeater:
                     last = e
                     continue
                 if resp.get("code") == "not_leader":
-                    self._leader_hint = resp.get("leader_hint")
+                    with self._lock:
+                        self._leader_hint = resp.get("leader_hint")
                     last = resp
                     continue
-                self._leader_hint = target
-                self.last_response = resp
+                with self._lock:
+                    self._leader_hint = target
+                    self.last_response = resp
                 self.server.process_heartbeat_response(resp)
                 return
             attempt.note(last)

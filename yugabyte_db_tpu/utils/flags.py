@@ -126,6 +126,12 @@ FLAGS.define("fault.tpu_dispatch", 0.0,
              "storage/breaker.py circuit breaker and the host re-serve "
              "path",
              ("unsafe", "runtime", "hidden"))
+FLAGS.define("lock_witness", False,
+             "record (field, lock-held) observations for every "
+             "@guarded_by-declared field write (utils/locking.py); dump "
+             "is cross-checked against yb-lint's static guarded facts "
+             "via python -m yugabyte_db_tpu.analysis --witness-check",
+             ("advanced", "runtime", "hidden"))
 FLAGS.define("fault.seed", 0,
              "non-zero: seed the fault-injection RNG so probabilistic "
              "faults replay deterministically (the sweep harness sets "
