@@ -1141,6 +1141,138 @@ def bench_cluster_write(n_rows=60_000, writers=4, batch=256):
     }
 
 
+def bench_ycsb_a_cluster(n_keys=20_000, n_ops=24_000, workers=4,
+                         batch=64, theta=0.99):
+    """YCSB-A at cluster scope: 50/50 zipfian point-read/update through
+    the full RF=3 write path (session batcher -> tserver RPC -> WAL ->
+    Raft group commit -> commit-ack) — the mixed workload the write-path
+    overhaul targets, where writes previously throttled the whole mix.
+    Baseline: YCSB-A 107,120 ops/s across 3 nodes => ~35.7K per node
+    (docs/yb-perf-v1.0.7.md:585-601)."""
+    import bisect
+    import tempfile
+    import threading
+
+    from yugabyte_db_tpu.client.session import YBSession
+    from yugabyte_db_tpu.integration.mini_cluster import MiniCluster
+    from yugabyte_db_tpu.models.datatypes import DataType
+    from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
+
+    # Zipfian(theta) CDF over the keyspace — YCSB's request distribution.
+    weights = [1.0 / (i + 1) ** theta for i in range(n_keys)]
+    cdf = []
+    acc = 0.0
+    for w in weights:
+        acc += w
+        cdf.append(acc)
+
+    def zipf(rng):
+        return bisect.bisect_left(cdf, rng.random() * acc)
+
+    with tempfile.TemporaryDirectory() as root:
+        mc = MiniCluster(root, num_tservers=3).start()
+        try:
+            mc.wait_tservers_registered()
+            client = mc.client()
+            client.create_table("ycsba", [
+                ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+                ColumnSchema("v", DataType.STRING),
+            ], num_tablets=6)
+            table = client.open_table("ycsba")
+            load = YBSession(mc.client("load"))
+            for i in range(n_keys):
+                load.insert(table, {"k": f"user{i:08d}", "v": f"val{i}"})
+                if load.pending_ops >= 256:
+                    load.flush()
+            load.flush()
+
+            per = n_ops // workers
+            errors = []
+
+            def worker(w):
+                try:
+                    rng = random.Random(100 + w)
+                    s = YBSession(mc.client(f"mix{w}"))
+                    done = 0
+                    while done < per:
+                        half = min(batch, per - done) // 2 or 1
+                        for _ in range(half):
+                            i = zipf(rng)
+                            s.insert(table, {"k": f"user{i:08d}",
+                                             "v": f"v{rng.random():.6f}"})
+                        s.flush()
+                        got = s.get_many(table, [
+                            {"k": f"user{zipf(rng):08d}"}
+                            for _ in range(half)])
+                        assert all(r is not None for r in got)
+                        done += 2 * half
+                except Exception as e:  # surfaced after join
+                    errors.append(e)
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+        finally:
+            mc.shutdown()
+    return {
+        "metric": "ycsb_a_mixed_ops_per_sec",
+        "value": round(n_ops / dt, 1),
+        "unit": (f"ops/s (50/50 zipfian read/write, RF=3 cluster, "
+                 f"{workers} sessions, batch {batch})"),
+        "vs_baseline": round(n_ops / dt / (107_120 / 3), 2),
+    }
+
+
+def bench_device_flush(schema, rows, make_engine, n=65_536):
+    """Flush cost after the device-side overhaul: one memtable of n rows
+    built into a sorted columnar run. The device path stages the op log,
+    computes the sort permutation host-side, and materializes the padded
+    planes in one jitted scatter (ops/flush.py) — seeding HBM residency
+    with no separate upload; the host path is the pre-overhaul numpy /
+    native build, timed on identical contents."""
+    from yugabyte_db_tpu.utils.flags import FLAGS
+    from yugabyte_db_tpu.utils.metrics import flush_path_count
+
+    work = rows[:n]
+    old = FLAGS.get("tpu_device_flush")
+
+    def timed_flush(device):
+        FLAGS.set("tpu_device_flush", device)
+        eng = make_engine("tpu", schema, {"rows_per_block": 2048})
+        eng.apply(work)
+        t0 = time.perf_counter()
+        eng.flush()
+        dt = time.perf_counter() - t0
+        eng.close()
+        return dt
+
+    try:
+        timed_flush(True)  # warm the scatter compile for this bucket
+        d0 = flush_path_count("device")
+        dev_dt = min(timed_flush(True) for _ in range(3))
+        assert flush_path_count("device") == d0 + 3, \
+            "device flush fell back to host"
+        host_dt = min(timed_flush(False) for _ in range(2))
+    finally:
+        FLAGS.set("tpu_device_flush", old)
+    return {
+        "metric": "postflush_device_flush_ms",
+        "value": round(dev_dt * 1000, 1),
+        "unit": f"ms (device-path memtable flush, {len(work)} rows)",
+        "vs_baseline": None,  # no comparable in-reference microbenchmark
+        "host_flush_ms": round(host_dt * 1000, 1),
+        "speedup_vs_host": round(host_dt / dev_dt, 2),
+        "rows_per_sec": round(len(work) / dev_dt, 1),
+    }
+
+
 def bench_compact(schema, rows, max_ht, make_engine):
     """4-run merge with REAL history GC: base load + 3 update/delete
     waves over the same keyspace (multi-version groups, tombstones),
@@ -1215,6 +1347,7 @@ def main():
     # cluster write first: it is host-CPU-bound and measures low when run
     # after the TPU workloads' background threads/memory are resident
     cluster_write = bench_cluster_write()
+    ycsb_a_cluster = bench_ycsb_a_cluster()
     tpu, cpu, versions, headline = bench_aggregate(
         schema, rows, max_ht, make_engine, S)
     for sub in (
@@ -1229,7 +1362,9 @@ def main():
         *bench_kernel_scan(),
         *bench_tpch(make_engine),
         bench_write(schema, rows, make_engine),
+        bench_device_flush(schema, rows, make_engine),
         cluster_write,
+        ycsb_a_cluster,
         bench_compact(schema, rows, max_ht, make_engine),
     ):
         print("# " + json.dumps(sub))

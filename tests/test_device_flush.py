@@ -1,0 +1,284 @@
+"""Device-side memtable flush: the single-dispatch replay (ops.flush)
+must produce runs byte-identical to the host columnar build, and every
+ineligible or faulted flush must fall back to the host path untouched.
+
+Reference analog: the rocksdb flush-job tests asserting the built
+SSTable matches the memtable contents (src/yb/rocksdb/db/flush_job_test.cc)
+— here "matches" is literal plane equality, because the authoritative
+host planes are read back from the very arrays the device will scan.
+
+Runs on the CPU JAX backend (conftest) — same kernels the TPU executes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.storage import RowVersion, ScanSpec, make_engine
+from yugabyte_db_tpu.storage.residency import hbm_cache
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+from yugabyte_db_tpu.utils.fault_injection import arm_fault_once, clear_faults
+from yugabyte_db_tpu.utils.flags import FLAGS
+from yugabyte_db_tpu.utils.metrics import flush_path_count
+import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401  (registers 'tpu')
+
+
+def make_schema():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("a", DataType.INT64),
+        ColumnSchema("b", DataType.STRING),
+        ColumnSchema("c", DataType.DOUBLE),
+        ColumnSchema("d", DataType.INT32),
+    ], table_id="t")
+
+
+def enc(schema, k, r):
+    return schema.encode_primary_key(
+        {"k": k, "r": r}, compute_hash_code(schema, {"k": k}))
+
+
+def ids(schema):
+    return {c.name: c.col_id for c in schema.value_columns}
+
+
+@pytest.fixture
+def device_flush_flag():
+    old = FLAGS.get("tpu_device_flush")
+    yield lambda v: FLAGS.set("tpu_device_flush", bool(v))
+    FLAGS.set("tpu_device_flush", old)
+    clear_faults()
+
+
+@pytest.fixture
+def budget_flag():
+    old = FLAGS.get("tpu_hbm_budget_bytes")
+    yield lambda v: FLAGS.set("tpu_hbm_budget_bytes", int(v))
+    FLAGS.set("tpu_hbm_budget_bytes", old)
+    hbm_cache().evict_unpinned()
+
+
+def sample_rows(schema, n=200, seed=11):
+    """Apply-order rows with every plane family exercised: multi-version
+    keys, tombstones, nulls, TTL expiry, doubles, varlen strings, and
+    same-(key, ht) write_id ties."""
+    rnd = random.Random(seed)
+    cids = ids(schema)
+    rows, ht = [], 0
+    for i in range(n):
+        ht += rnd.randrange(1, 3)
+        key = enc(schema, rnd.choice(["p", "q", "rr"]), i % 41)
+        roll = rnd.random()
+        if roll < 0.1:
+            rows.append(RowVersion(key, ht=ht, tombstone=True,
+                                   write_id=i % 7))
+        elif roll < 0.55:
+            rows.append(RowVersion(
+                key, ht=ht, liveness=True, write_id=i % 7,
+                columns={cids["a"]: rnd.randrange(-1000, 1000),
+                         cids["b"]: rnd.choice(["xy", "xyz-longer", None,
+                                                "commonprefix-aa",
+                                                "commonprefix-ab"]),
+                         cids["c"]: rnd.uniform(-5, 5),
+                         cids["d"]: rnd.randrange(-50, 50)},
+                expire_ht=ht + 40 if rnd.random() < 0.2 else MAX_HT))
+        else:
+            col = rnd.choice(["a", "b", "c", "d"])
+            val = {"a": rnd.randrange(-1000, 1000),
+                   "b": rnd.choice(["w", None]),
+                   "c": rnd.uniform(-5, 5),
+                   "d": rnd.randrange(-50, 50)}[col]
+            rows.append(RowVersion(key, ht=ht, write_id=i % 7,
+                                   columns={cids[col]: val}))
+    return rows, ht
+
+
+def assert_runs_identical(a, b):
+    """Byte-level equality of two ColumnarRuns: every plane, every host
+    payload, every block bound, every metadata field."""
+    assert a.B == b.B and a.R == b.R
+    for name in ("valid", "group_start", "tomb", "live",
+                 "ht_hi", "ht_lo", "exp_hi", "exp_lo", "key_planes"):
+        assert np.array_equal(getattr(a, name), getattr(b, name)), name
+    assert set(a.cols) == set(b.cols)
+    for cid, ca in a.cols.items():
+        cb = b.cols[cid]
+        assert np.array_equal(ca.set_, cb.set_), cid
+        assert np.array_equal(ca.isnull, cb.isnull), cid
+        assert np.array_equal(ca.cmp_planes, cb.cmp_planes), cid
+        assert (ca.arith is None) == (cb.arith is None)
+        if ca.arith is not None:
+            assert np.array_equal(ca.arith, cb.arith), cid
+        if ca.varlen is not None:
+            assert ca.varlen == cb.varlen, cid
+    assert np.array_equal(a.row_keys, b.row_keys)
+    assert a.blocks == b.blocks
+    for f in ("num_versions", "min_key", "max_key", "max_ht",
+              "max_group_versions", "max_key_len", "varlen_max_len"):
+        assert getattr(a, f) == getattr(b, f), f
+
+
+def assert_same_scan(cpu, tpu, spec_kwargs):
+    a = cpu.scan(ScanSpec(**spec_kwargs))
+    b = tpu.scan(ScanSpec(**spec_kwargs))
+    assert a.columns == b.columns
+    assert len(a.rows) == len(b.rows)
+    for ra, rb in zip(a.rows, b.rows):
+        for i, (va, vb) in enumerate(zip(ra, rb)):
+            if isinstance(va, float):
+                assert vb == pytest.approx(va, rel=1e-4, abs=1e-4)
+            else:
+                assert va == vb, f"col={a.columns[i]} spec={spec_kwargs}"
+
+
+def test_device_flush_planes_identical_to_host_build(device_flush_flag):
+    """The replayed-on-device run must equal the host columnar build
+    bit-for-bit — same sort, same block packing, same padding encoding."""
+    schema = make_schema()
+    rows, _ = sample_rows(schema)
+
+    device_flush_flag(True)
+    on = make_engine("tpu", schema, dict(rows_per_block=64))
+    on.apply(rows)
+    dev0 = flush_path_count("device")
+    on.flush()
+    assert flush_path_count("device") == dev0 + 1
+
+    device_flush_flag(False)
+    off = make_engine("tpu", schema, dict(rows_per_block=64))
+    off.apply(rows)
+    host0 = flush_path_count("host")
+    off.flush()
+    assert flush_path_count("host") == host0 + 1
+
+    assert_runs_identical(on.runs[-1].crun, off.runs[-1].crun)
+
+
+def test_device_flush_scan_identity_vs_cpu_oracle(device_flush_flag):
+    device_flush_flag(True)
+    schema = make_schema()
+    rows, max_ht = sample_rows(schema, n=300, seed=7)
+    cpu = make_engine("cpu", schema, {})
+    tpu = make_engine("tpu", schema, dict(rows_per_block=64))
+    cpu.apply(rows); tpu.apply(rows)
+    cpu.flush(); tpu.flush()
+    for rht in (MAX_HT, max_ht // 2, max_ht - 20, 1):
+        assert_same_scan(cpu, tpu, dict(read_ht=rht))
+    lo, hi = enc(schema, "p", 5), enc(schema, "p", 30)
+    assert_same_scan(cpu, tpu, dict(lower=lo, upper=hi, read_ht=MAX_HT))
+
+
+def test_write_id_tie_ordering(device_flush_flag):
+    """Two writes to the same key in one batch share a hybrid time and
+    order by write_id — the flush sort key must break the tie so the
+    later write wins, exactly as the CPU oracle resolves it."""
+    device_flush_flag(True)
+    schema = make_schema()
+    cids = ids(schema)
+    key = enc(schema, "p", 1)
+    rows = [
+        RowVersion(key, ht=10, liveness=True, write_id=0,
+                   columns={cids["a"]: 1}),
+        RowVersion(key, ht=10, write_id=1, columns={cids["a"]: 2}),
+        RowVersion(key, ht=10, write_id=2, columns={cids["a"]: 3}),
+    ]
+    cpu = make_engine("cpu", schema, {})
+    tpu = make_engine("tpu", schema, dict(rows_per_block=64))
+    cpu.apply(rows); tpu.apply(rows)
+    cpu.flush(); tpu.flush()
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    got = tpu.scan(ScanSpec(read_ht=MAX_HT, projection=["k", "r", "a"]))
+    assert [r[-1] for r in got.rows] == [3]
+
+
+def test_budget_gate_falls_back_to_host(device_flush_flag, budget_flag):
+    """A flush whose padded planes exceed --tpu_hbm_budget_bytes must
+    take the host path (the seed would immediately thrash the cache)."""
+    device_flush_flag(True)
+    budget_flag(1000)
+    schema = make_schema()
+    rows, _ = sample_rows(schema, n=100, seed=3)
+    cpu = make_engine("cpu", schema, {})
+    tpu = make_engine("tpu", schema, dict(rows_per_block=64))
+    cpu.apply(rows); tpu.apply(rows)
+    host0, dev0 = flush_path_count("host"), flush_path_count("device")
+    cpu.flush(); tpu.flush()
+    assert flush_path_count("host") == host0 + 1
+    assert flush_path_count("device") == dev0
+    budget_flag(0)
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+
+
+def test_oversized_keys_fall_back_to_host(device_flush_flag):
+    """Keys past the 32-byte prefix planes make the host-side memcmp
+    sort inexact — the engine must refuse the device path."""
+    device_flush_flag(True)
+    schema = make_schema()
+    cids = ids(schema)
+    rows = [RowVersion(enc(schema, "x" * 40 + str(i), i), ht=5 + i,
+                       liveness=True, columns={cids["a"]: i})
+            for i in range(8)]
+    tpu = make_engine("tpu", schema, dict(rows_per_block=64))
+    tpu.apply(rows)
+    host0, dev0 = flush_path_count("host"), flush_path_count("device")
+    tpu.flush()
+    assert flush_path_count("host") == host0 + 1
+    assert flush_path_count("device") == dev0
+    got = tpu.scan(ScanSpec(read_ht=MAX_HT, projection=["a"]))
+    assert sorted(r[0] for r in got.rows) == list(range(8))
+
+
+def test_dispatch_fault_falls_back_then_recovers(device_flush_flag):
+    """A device fault mid-flush lands on the breaker and the flush
+    retries on the host path — no data loss, and the NEXT flush (fault
+    cleared, breaker still closed) is back on the device path."""
+    device_flush_flag(True)
+    schema = make_schema()
+    cids = ids(schema)
+    cpu = make_engine("cpu", schema, {})
+    tpu = make_engine("tpu", schema, dict(rows_per_block=64))
+    rows1, _ = sample_rows(schema, n=60, seed=1)
+    cpu.apply(rows1); tpu.apply(rows1)
+    host0, dev0 = flush_path_count("host"), flush_path_count("device")
+    arm_fault_once("fault.tpu_dispatch")
+    cpu.flush(); tpu.flush()
+    assert flush_path_count("host") == host0 + 1
+    assert flush_path_count("device") == dev0
+
+    rows2 = [RowVersion(enc(schema, "z", i), ht=10_000 + i, liveness=True,
+                        columns={cids["a"]: i}) for i in range(20)]
+    cpu.apply(rows2); tpu.apply(rows2)
+    cpu.flush(); tpu.flush()
+    assert flush_path_count("device") == dev0 + 1
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+
+
+def test_seeded_run_survives_eviction_roundtrip(device_flush_flag,
+                                                budget_flag):
+    """The seeded device payload must be evictable like any demand
+    upload, and the re-upload (from the round-tripped host planes) must
+    scan identically — host planes stay authoritative."""
+    device_flush_flag(True)
+    schema = make_schema()
+    rows, _ = sample_rows(schema, n=150, seed=9)
+    cpu = make_engine("cpu", schema, {})
+    tpu = make_engine("tpu", schema, dict(rows_per_block=64))
+    cpu.apply(rows); tpu.apply(rows)
+    dev0 = flush_path_count("device")
+    cpu.flush(); tpu.flush()
+    assert flush_path_count("device") == dev0 + 1
+
+    # Seeded payload is already resident: the first scan must not
+    # demand-upload the freshly flushed run.
+    up0 = hbm_cache().stats()["demand_upload_bytes"]
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert hbm_cache().stats()["demand_upload_bytes"] == up0
+
+    assert hbm_cache().evict_unpinned() > 0
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert hbm_cache().stats()["demand_upload_bytes"] > up0

@@ -228,6 +228,10 @@ def test_sweep_and_core_classes_witness_clean(tmp_path):
     assert res == 0
     row = _obs("CircuitBreaker", "_state")
     assert row is not None and row["unheld"] == 0
+    # The sweep's writes ran through the group-commit pipeline: its
+    # bookkeeping watermark must only ever move under the raft lock.
+    row = _obs("RaftConsensus", "_gc_handled_index")
+    assert row is not None and row["unheld"] == 0
 
 
 @pytest.mark.slow

@@ -42,8 +42,22 @@ from dataclasses import dataclass
 from yugabyte_db_tpu.consensus.metadata import ConsensusMetadata, RaftConfig
 from yugabyte_db_tpu.consensus.transport import Transport, TransportError
 from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
+from yugabyte_db_tpu.utils.flags import FLAGS
 from yugabyte_db_tpu.utils.hybrid_time import HybridTime
-from yugabyte_db_tpu.utils.metrics import count_swallowed
+from yugabyte_db_tpu.utils.locking import guarded_by
+from yugabyte_db_tpu.utils.metrics import (count_fault_fired, count_swallowed,
+                                           observe_group_commit_batch)
+from yugabyte_db_tpu.utils.retry import Deadline
+
+
+def _as_deadline(timeout) -> Deadline:
+    """Normalize a float-seconds timeout or a Deadline to a Deadline —
+    the PR-7 propagation convention: callers that already carry a
+    deadline pass it through so every wait debits ONE budget instead of
+    restarting a fresh 10 s at each layer."""
+    if isinstance(timeout, Deadline):
+        return timeout
+    return Deadline.after(float(timeout))
 
 
 class Role(enum.Enum):
@@ -96,6 +110,7 @@ class _PeerState:
         self.thread: threading.Thread | None = None
 
 
+@guarded_by("_lock", "_gc_handled_index", "_gc_last_dispatch")
 class RaftConsensus:
     def __init__(self, tablet_id: str, cmeta: ConsensusMetadata, log: Log,
                  transport: Transport, clock, apply_cb,
@@ -155,6 +170,16 @@ class RaftConsensus:
 
         self._peers: dict[str, _PeerState] = {}
         self._applying = False  # single-applier guard (inline + thread)
+        # Cross-request group commit (the reference's Log::AsyncAppend
+        # batching across independent requests): leader appends park in
+        # the log buffer and set _gc_event; the pipeline thread wakes,
+        # waits out --raft_group_commit_window_us, then issues ONE peer
+        # signal (one AppendEntries round per peer) and ONE WAL sync for
+        # everything admitted in the window. _gc_handled_index is the
+        # high-water mark of entries already handed to a window.
+        self._gc_event = threading.Event()
+        self._gc_handled_index = self._last_index
+        self._gc_last_dispatch = 0.0  # monotonic time of the last round
         self._threads: list[threading.Thread] = []
         # Invoked (tablet_id, peer_uuid) when a peer needs entries evicted
         # from the cache — wired by the tserver to kick remote bootstrap.
@@ -171,9 +196,12 @@ class RaftConsensus:
                              name=f"raft-timer-{self.uuid}", daemon=True)
         a = threading.Thread(target=self._run_apply,
                              name=f"raft-apply-{self.uuid}", daemon=True)
-        self._threads += [t, a]
+        g = threading.Thread(target=self._run_group_commit,
+                             name=f"raft-gc-{self.uuid}", daemon=True)
+        self._threads += [t, a, g]
         t.start()
         a.start()
+        g.start()
 
     def shutdown(self) -> None:
         with self._lock:
@@ -183,6 +211,7 @@ class RaftConsensus:
             self._peers.clear()
             self._apply_cond.notify_all()
             self._commit_cond.notify_all()
+        self._gc_event.set()
         for p in peers:
             p.signal.set()
         for t in self._threads:
@@ -251,29 +280,33 @@ class RaftConsensus:
 
     # -- write path ----------------------------------------------------------
     def replicate(self, op_type: str, body, ht: int | None = None,
-                  timeout: float = 10.0) -> LogEntry:
+                  timeout: float | Deadline = 10.0) -> LogEntry:
         """Leader-only: append, replicate to a majority, apply; returns the
         committed entry (with its assigned op id + hybrid time)."""
-        entry = self.append_leader(op_type, body, ht)
-        self.wait_applied(entry.op_id, timeout)
+        deadline = _as_deadline(timeout)
+        entry = self.append_leader(op_type, body, ht, deadline=deadline)
+        self.wait_applied(entry.op_id, deadline)
         return entry
 
     def append_leader(self, op_type: str, body, ht: int | None = None,
-                      decoded_rows=None, on_append=None) -> LogEntry:
+                      decoded_rows=None, on_append=None,
+                      deadline: Deadline | None = None) -> LogEntry:
         """Leader append + durability, without waiting for commit. Callers
-        that need the outcome follow with wait_applied().
+        that need the outcome follow with wait_committed()/wait_applied().
         ``decoded_rows`` rides on the in-memory entry so the leader's own
         apply skips re-decoding the body (followers decode from wire).
 
         Multi-peer groups DEFER the leader's own fsync off the admission
         path: the entry only counts toward the majority once synced, but
         two follower disks already form a majority (standard Raft — a
-        leader may lose its unsynced tail), and each replication thread
-        syncs the log right after its send (amortized group commit), so
-        a majority that needs the leader's disk (one follower down) is
-        never more than one replication round away. Single-peer groups
-        sync inline — there is nobody else to carry durability."""
+        leader may lose its unsynced tail), and the group-commit pipeline
+        plus each replication thread sync the log off the admission path
+        (amortized group commit), so a majority that needs the leader's
+        disk (one follower down) is never more than one replication round
+        away. Single-peer groups sync inline — there is nobody else to
+        carry durability."""
         with self._lock:
+            self._wait_inflight_room_locked(deadline)
             entry = self._leader_append_locked(op_type, body, ht,
                                                decoded_rows)
             if on_append is not None:
@@ -286,6 +319,27 @@ class RaftConsensus:
         if not defer:
             self._ensure_durable(entry.op_id.index)
         return entry
+
+    def _wait_inflight_room_locked(self, deadline: Deadline | None) -> None:
+        """Backpressure: block admission while the append->apply window
+        is full (--raft_max_inflight_ops). Bounds the commit-ack apply
+        queue — a stalled apply stage pushes back on writers instead of
+        buffering unboundedly."""
+        try:
+            limit = int(FLAGS.get("raft_max_inflight_ops"))
+        except KeyError:
+            limit = 0
+        if limit <= 0 or self._last_index - self._applied_index < limit:
+            return
+        dl = deadline if deadline is not None else Deadline.after(5.0)
+        while self._last_index - self._applied_index >= limit:
+            if self._role != Role.LEADER:
+                raise NotLeader(self.uuid, self._leader_uuid)
+            if not self._running or dl.expired():
+                raise TimeoutError(
+                    f"write backpressure: {self._last_index - self._applied_index} "
+                    f"ops in flight (limit {limit})")
+            self._commit_cond.wait(timeout=dl.timeout(0.05))
 
     def _leader_append_locked(self, op_type: str, body, ht: int | None,
                               decoded_rows=None) -> LogEntry:
@@ -302,8 +356,84 @@ class RaftConsensus:
         # majority (self's match = _durable_index) once synced. Concurrent
         # appends share one fsync — the WAL's group-commit design.
         self._append_local_locked(entry, sync=False)
-        self._signal_peers_locked()
+        window_s = self._gc_window_s()
+        if window_s > 0:
+            now = time.monotonic()
+            if now - self._gc_last_dispatch >= window_s:
+                # Pipeline idle: dispatch this append's round inline —
+                # the same latency as the no-window path (no thread
+                # handoff for a lone writer).
+                batch = self._last_index - self._gc_handled_index
+                self._gc_handled_index = self._last_index
+                self._gc_last_dispatch = now
+                self._signal_peers_locked()
+                observe_group_commit_batch(batch)
+            else:
+                # A round just went out: park the append; the pipeline
+                # thread coalesces everything admitted within the window
+                # into one WAL sync + one AppendEntries round per peer.
+                self._gc_event.set()
+        else:
+            self._signal_peers_locked()
         return entry
+
+    @staticmethod
+    def _gc_window_s() -> float:
+        try:
+            return FLAGS.get("raft_group_commit_window_us") / 1e6
+        except KeyError:
+            return 0.0
+
+    # -- group-commit pipeline ----------------------------------------------
+    def _run_group_commit(self) -> None:
+        try:
+            self._group_commit_loop()
+        except Exception:  # a dead pipeline must never be silent
+            logging.getLogger(__name__).exception(
+                "raft %s: group-commit thread died", self.uuid)
+
+    def _group_commit_loop(self) -> None:
+        while True:
+            self._gc_event.wait(timeout=0.5)
+            with self._lock:
+                if not self._running:
+                    return
+            if not self._gc_event.is_set():
+                continue
+            # Conveyor pacing: appends only land here while a round is
+            # already hot (idle appends dispatch inline in
+            # _leader_append_locked), so hold back until the window since
+            # the last dispatch elapses — everything admitted meanwhile
+            # shares this round.
+            window_s = self._gc_window_s()
+            with self._lock:
+                since = time.monotonic() - self._gc_last_dispatch
+            if 0 < since < window_s:
+                time.sleep(window_s - since)
+            self._gc_event.clear()
+            with self._lock:
+                if self._role != Role.LEADER:
+                    continue
+                last = self._last_index
+                batch = last - self._gc_handled_index
+                if batch <= 0:
+                    continue
+                self._gc_handled_index = last
+                self._gc_last_dispatch = time.monotonic()
+                # One AppendEntries round per peer for the whole window.
+                self._signal_peers_locked()
+            observe_group_commit_batch(batch)
+            try:
+                # One WAL sync for the window, concurrent with the peer
+                # sends (the replication threads re-check durability
+                # after their round, so a failure here only defers
+                # self's vote toward the majority).
+                self._ensure_durable(last)
+            except Exception as e:  # noqa: BLE001 — retried by peers/timer
+                count_swallowed("raft.group_commit_sync", e)
+                with self._lock:
+                    self._gc_handled_index = min(self._gc_handled_index,
+                                                 self._durable_index)
 
     def _ensure_durable(self, index: int) -> None:
         """Fsync the log up to at least ``index`` (batched across callers),
@@ -319,7 +449,8 @@ class RaftConsensus:
                 if self._role == Role.LEADER:
                     self._advance_commit_locked()
 
-    def change_config(self, new_peers: list[str], timeout: float = 10.0) -> LogEntry:
+    def change_config(self, new_peers: list[str],
+                      timeout: float | Deadline = 10.0) -> LogEntry:
         """Replicate a new replica set (one-at-a-time membership change).
         Validation and append are atomic under the lock so two racing
         changes cannot both enter flight."""
@@ -724,6 +855,15 @@ class RaftConsensus:
         must not disappear into a huge committed backlog (its follower
         would miss heartbeats long enough to start an election) — it
         applies a bounded slice and hands the rest to the apply thread."""
+        try:
+            if FLAGS.get("fault.raft_apply_stall") > 0:
+                # Deterministic widening of the commit-ack/apply window
+                # (the commit_ack_crash sweep round): committed entries
+                # stay queued; acks still go out at commit.
+                count_fault_fired("fault.raft_apply_stall")
+                return
+        except KeyError:
+            pass
         with self._lock:
             if self._applying:
                 return
@@ -757,11 +897,38 @@ class RaftConsensus:
                 self._applying = False
                 self._apply_cond.notify_all()
 
-    def wait_applied(self, op_id: OpId, timeout: float) -> None:
+    def wait_applied(self, op_id: OpId, timeout: float | Deadline) -> None:
         """Block until the entry is applied. Raises NotLeader if it was
         truncated (definitely aborted) and TimeoutError if the outcome is
         still UNKNOWN — a timed-out entry may yet commit."""
-        deadline = time.monotonic() + timeout
+        self._wait_watermark(op_id, _as_deadline(timeout), applied=True)
+
+    def wait_committed(self, op_id: OpId, timeout: float | Deadline) -> None:
+        """Block until the entry is majority-durable (commit-time ack —
+        the pipelined-apply write path acks here). The entry may not yet
+        be APPLIED locally: the apply stage drains asynchronously behind
+        the MVCC read fence (safe time cannot pass an unapplied write).
+        Raises NotLeader if the entry was truncated and TimeoutError
+        while the outcome is still unknown."""
+        self._wait_watermark(op_id, _as_deadline(timeout), applied=False)
+
+    def wait_apply_drained(self, timeout: float | Deadline = 10.0) -> bool:
+        """Block until the apply stage catches up with the commit
+        watermark observed at entry — the barrier maintenance operations
+        (flush, snapshot) take so a commit-acked write can't be missing
+        from the memtable they capture. False on timeout/shutdown."""
+        dl = _as_deadline(timeout)
+        with self._lock:
+            target = self._commit_index
+            while self._applied_index < target:
+                remaining = dl.remaining()
+                if remaining <= 0 or not self._running:
+                    return False
+                self._commit_cond.wait(timeout=remaining)
+        return True
+
+    def _wait_watermark(self, op_id: OpId, deadline: Deadline,
+                        applied: bool) -> None:
         with self._lock:
             while True:
                 e = self._entries.get(op_id.index)
@@ -771,9 +938,11 @@ class RaftConsensus:
                     raise NotLeader(self.uuid, self._leader_uuid)  # truncated
                 if e.op_id.term != op_id.term:
                     raise NotLeader(self.uuid, self._leader_uuid)  # truncated
-                if self._applied_index >= op_id.index:
+                watermark = (self._applied_index if applied
+                             else self._commit_index)
+                if watermark >= op_id.index:
                     return
-                remaining = deadline - time.monotonic()
+                remaining = deadline.remaining()
                 if remaining <= 0 or not self._running:
                     raise TimeoutError(f"commit timeout for {op_id}")
                 self._commit_cond.wait(timeout=remaining)
@@ -897,6 +1066,7 @@ class RaftConsensus:
             self._leader_uuid = self.uuid
             self._last_broadcast = time.monotonic()
             self._leader_since = self._last_broadcast
+            self._gc_handled_index = self._last_index
             self._peers.clear()
             self._sync_peer_threads_locked()
             # Assert leadership with a no_op; committing it commits all

@@ -23,6 +23,11 @@ Each round fires one fault from the catalog mid-workload:
                     from the host byte-identically and later recover.
 ``hbm_eviction``    ``hbm_cache().evict_unpinned()`` hammered from a side
                     thread while scans run (mid-scan eviction pressure).
+``commit_ack_crash`` ``fault.raft_apply_stall`` held while one write is
+                    acked at COMMIT time (pipelined apply still queued),
+                    then the leader crashes before applying; after
+                    restart the acked write must survive WAL replay and
+                    every peer's apply lag must drain back to 0.
 ==================  =======================================================
 
 Invariants after every round (each returns a list of error strings):
@@ -65,7 +70,7 @@ from yugabyte_db_tpu.utils.memtracker import root_tracker
 from yugabyte_db_tpu.utils.metrics import faults_fired
 
 FAULT_CATALOG = ("wal_sync", "respond_dropped", "leader_crash",
-                 "device_dispatch", "hbm_eviction")
+                 "device_dispatch", "hbm_eviction", "commit_ack_crash")
 
 # Catalog entries backed by a maybe_fault() point (armed one-shot and
 # asserted against the yb_faults_fired metric).
@@ -223,6 +228,9 @@ class FaultSweep:
         if fault == "leader_crash":
             self._crash_and_restart_leader()
             return None
+        if fault == "commit_ack_crash":
+            self._commit_ack_crash()
+            return None
         if fault == "hbm_eviction":
             # Eviction pressure racing the scans the round keeps issuing.
             def pound():
@@ -238,6 +246,57 @@ class FaultSweep:
             t.start()
             return t
         raise ValueError(f"unknown fault {fault!r}")
+
+    def _commit_ack_crash(self) -> None:
+        """The pipelined-apply durability round: hold
+        ``fault.raft_apply_stall`` so commit-time acks go out while
+        every apply stays queued, take one acked write inside that
+        window, then crash the leader BEFORE anything applies. The
+        acked write must come back from WAL replay (checked by
+        check_acked_writes via the round's scans), and once the stall
+        clears every peer's apply lag (the yb_apply_lag_ops gauge
+        source: commit_index - applied_index) must drain to 0."""
+        stall_base = faults_fired("fault.raft_apply_stall")
+        FLAGS.set("fault.raft_apply_stall", 1.0, force=True)
+        try:
+            # Acked at commit; apply is stalled cluster-wide, so the
+            # ack/apply window is provably open when the leader dies.
+            self._one_op(kind="insert")
+            counts = {
+                uuid: sum(1 for p in ts.tablet_manager.peers()
+                          if p.is_leader())
+                for uuid, ts in self.mc.tservers.items()}
+            victim = max(counts, key=counts.get)
+            self.mc.stop_tserver(victim)
+        finally:
+            FLAGS.set("fault.raft_apply_stall", 0.0, force=True)
+        if faults_fired("fault.raft_apply_stall") <= stall_base:
+            self.errors.append(
+                "commit_ack_crash: fault.raft_apply_stall never fired "
+                "(apply was not stalled during the ack window)")
+        self.mc.restart_tserver(victim)
+        self.mc.wait_tservers_registered()
+        # A current-term entry drags the stalled old-term entries to
+        # commit on the new leader, then every queue must drain.
+        self._one_op(kind="insert")
+        self._await_apply_drain()
+
+    def _await_apply_drain(self, timeout_s: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        lag = {}
+        while time.monotonic() < deadline:
+            lag = {}
+            for uuid, ts in self.mc.tservers.items():
+                for peer in ts.tablet_manager.peers():
+                    rs = peer.raft.stats()
+                    d = rs["commit_index"] - rs["applied_index"]
+                    if d > 0:
+                        lag[f"{uuid}/{peer.tablet_id}"] = d
+            if not lag:
+                return
+            time.sleep(0.05)
+        self.errors.append(
+            f"commit_ack_crash: apply lag never drained to 0: {lag}")
 
     def _crash_and_restart_leader(self) -> None:
         counts = {

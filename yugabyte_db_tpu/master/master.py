@@ -26,6 +26,7 @@ from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.tablet.wal import Log
 from yugabyte_db_tpu.utils.hybrid_time import HybridClock
 from yugabyte_db_tpu.utils.metrics import count_swallowed
+from yugabyte_db_tpu.utils.retry import Deadline
 from yugabyte_db_tpu.utils.trace import RpczStore, trace_request
 
 SYS_CATALOG_ID = "sys.catalog"
@@ -208,6 +209,14 @@ class Master:
     def _not_leader(self) -> dict:
         return {"code": "not_leader", "leader_hint": self.raft.leader_uuid()}
 
+    @staticmethod
+    def _op_deadline(p: dict) -> Deadline:
+        """The client's remaining budget for a replicated catalog op
+        (PR-7 deadline propagation): the append backpressure wait and
+        the apply wait debit this ONE deadline instead of restarting a
+        hardcoded 10 s at each layer."""
+        return Deadline.after(float(p.get("timeout", 10.0)))
+
     # -- ddl ----------------------------------------------------------------
     def _h_master_create_table(self, p: dict):
         if not self.raft.is_leader():
@@ -251,7 +260,7 @@ class Master:
               "schema": schema.to_dict(), "num_tablets": len(parts),
               "engine": engine, "tablets": tablets}
         try:
-            self.raft.replicate("catalog", op)
+            self.raft.replicate("catalog", op, timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         errors = self._dispatch_tablet_creates(op)
@@ -352,7 +361,7 @@ class Master:
         try:
             self.raft.replicate("catalog", {
                 "op": "alter_table", "table_id": t.table_id,
-                "schema": new_schema})
+                "schema": new_schema}, timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         errors = []
@@ -414,7 +423,7 @@ class Master:
                         "columns": columns, "include": include,
                         "index_table": itable}}
         try:
-            self.raft.replicate("catalog", op)
+            self.raft.replicate("catalog", op, timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         self._push_index_sets(base.table_id)
@@ -454,7 +463,7 @@ class Master:
         try:
             self.raft.replicate("catalog", {
                 "op": "drop_index", "table_id": base.table_id,
-                "name": p["name"]})
+                "name": p["name"]}, timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         self._push_index_sets(base.table_id)
@@ -470,7 +479,8 @@ class Master:
         tablets = self.catalog.tablets_of(t.table_id)
         try:
             self.raft.replicate("catalog",
-                                {"op": "delete_table", "table_id": t.table_id})
+                                {"op": "delete_table", "table_id": t.table_id},
+                                timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         for info in tablets:
@@ -552,7 +562,7 @@ class Master:
         except Exception as e:  # noqa: BLE001
             return {"code": "error", "message": str(e)}
         try:
-            self.raft.replicate("catalog", op)
+            self.raft.replicate("catalog", op, timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         return {"code": "ok"}
@@ -586,7 +596,7 @@ class Master:
                                 f"type {name} in use by table {t.name}"}
             op = {"op": "drop_type", "name": name}
         try:
-            self.raft.replicate("catalog", op)
+            self.raft.replicate("catalog", op, timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         return {"code": "ok"}
@@ -640,14 +650,14 @@ class Master:
                 try:
                     self.raft.replicate("catalog", {
                         "op": "sequence_alloc", "name": p["name"],
-                        "n": n})
+                        "n": n}, timeout=self._op_deadline(p))
                 except NotLeader:
                     return self._not_leader()
             return {"code": "ok", "base": base}
         else:
             return {"code": "error", "message": f"bad action {action}"}
         try:
-            self.raft.replicate("catalog", op)
+            self.raft.replicate("catalog", op, timeout=self._op_deadline(p))
         except NotLeader:
             return self._not_leader()
         return {"code": "ok"}
@@ -683,7 +693,8 @@ class Master:
                 self.raft.replicate("catalog", {
                     "op": "snapshot_record", "snapshot_id": sid,
                     "table": p["table"], "state": "CREATING",
-                    "tablets": [ti.tablet_id for ti in tablets]})
+                    "tablets": [ti.tablet_id for ti in tablets]},
+                    timeout=self._op_deadline(p))
             except NotLeader:
                 return self._not_leader()
             errs = self._snapshot_fanout(tablets, sid, "create_snapshot")
@@ -692,7 +703,8 @@ class Master:
                 self.raft.replicate("catalog", {
                     "op": "snapshot_record", "snapshot_id": sid,
                     "table": p["table"], "state": state,
-                    "tablets": [ti.tablet_id for ti in tablets]})
+                    "tablets": [ti.tablet_id for ti in tablets]},
+                    timeout=self._op_deadline(p))
             except NotLeader:
                 return self._not_leader()
             if errs:
@@ -727,7 +739,8 @@ class Master:
                         "message": f"delete {sid}: {errs[0]}"}
             try:
                 self.raft.replicate("catalog", {
-                    "op": "snapshot_remove", "snapshot_id": sid})
+                    "op": "snapshot_remove", "snapshot_id": sid},
+                    timeout=self._op_deadline(p))
             except NotLeader:
                 return self._not_leader()
             return {"code": "ok"}

@@ -115,6 +115,22 @@ class DeviceRun:
                 entry["arith"] = up(col.arith)
             self.arrays["cols"][cid] = entry
 
+    @classmethod
+    def from_arrays(cls, run: ColumnarRun, window_blocks: int, arrays,
+                    device=None) -> "DeviceRun":
+        """Wrap device planes produced ON DEVICE (ops.flush) instead of
+        uploading host planes — the arrays must already carry this
+        class's padding encoding, with the block axis padded to the
+        window multiple. Lets a flush seed the residency cache without
+        a host->device round trip."""
+        self = cls.__new__(cls)
+        self.run = run
+        self.K = window_blocks
+        self.B = int(arrays["valid"].shape[0])
+        self.device = device or jax.devices()[0]
+        self.arrays = arrays
+        return self
+
     @property
     def num_windows(self) -> int:
         return self.B // self.K

@@ -1,0 +1,105 @@
+"""Device memtable flush: replay the apply-order op log into run planes.
+
+Reference analog: the rocksdb flush building an SSTable off the memtable
+iterator (src/yb/rocksdb/db/flush_job.cc) — here the "build" is one
+device scatter. The host stages the memtable's op log as flat
+apply-order planes (the same vectorized encoders the columnar build
+uses), computes the flush sort permutation and block packing with
+memcmp sort keys (exact whenever keys fit the 32-byte prefix planes),
+and this kernel materializes the SORTED, BLOCK-PACKED device planes in
+a single dispatch:
+
+    out[dst[j]] = staged[perm[j]]
+
+for every fixed-width plane at once. The outputs are already padded to
+the DeviceRun block multiple, so the engine seeds them directly into
+the residency cache — the freshly-flushed run is HBM-resident without
+a second host->device upload, and the authoritative host planes are
+read back from the very arrays the device will scan (byte-identical by
+construction).
+
+Division of labor (same reasoning as ops.compact): XLA's variadic sort
+is catastrophically slow to compile for 10-word lexsorts, so the ORDER
+is computed host-side with one stable argsort over memcmp byte keys;
+the device does the data motion.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("R",))
+def replay_flush(staged, perm, dst, gs, is_real, exp_hi_default,
+                 exp_lo_default, R: int):
+    """Scatter staged apply-order planes into sorted padded run planes.
+
+    ``staged``: {ht_hi, ht_lo, exp_hi, exp_lo: [m] i32; tomb, live: [m]
+    bool; cols: {cid: {set, isnull: [m] bool, cmp: [m, P] i32,
+    arith?: [m] f32}}} — apply-order rows, padded to a size bucket.
+    ``perm[j]``: staged row index of sorted position j (pad entries 0).
+    ``dst[j]``: flat output slot of sorted position j (pad entries out
+    of range, dropped). ``gs[j]``: sorted-order group-start bit.
+    ``is_real``: [Bp] bool, True for blocks the host run owns — padding
+    blocks keep the DeviceRun padding encoding (valid False, group_start
+    True, expiry 0) so a seeded payload is indistinguishable from a
+    demand re-upload.
+
+    Returns the DeviceRun.arrays structure (no key planes — keys stay
+    host-side, as in every uploaded run).
+    """
+    Bp = is_real.shape[0]
+    S = Bp * R
+
+    def scat(init, vals):
+        return init.at[dst].set(vals[perm], mode="drop")
+
+    z_b = jnp.zeros((S,), jnp.bool_)
+    z_i = jnp.zeros((S,), jnp.int32)
+    real_rows = jnp.repeat(is_real, R)
+
+    out = {
+        "valid": z_b.at[dst].set(True, mode="drop").reshape(Bp, R),
+        # Unfilled rows are each their own group (the _alloc contract).
+        "group_start": jnp.ones((S,), jnp.bool_)
+        .at[dst].set(gs, mode="drop").reshape(Bp, R),
+        "tomb": scat(z_b, staged["tomb"]).reshape(Bp, R),
+        "live": scat(z_b, staged["live"]).reshape(Bp, R),
+        "ht_hi": scat(z_i, staged["ht_hi"]).reshape(Bp, R),
+        "ht_lo": scat(z_i, staged["ht_lo"]).reshape(Bp, R),
+        "exp_hi": scat(jnp.where(real_rows, exp_hi_default, 0),
+                       staged["exp_hi"]).reshape(Bp, R),
+        "exp_lo": scat(jnp.where(real_rows, exp_lo_default, 0),
+                       staged["exp_lo"]).reshape(Bp, R),
+        "cols": {},
+    }
+    for cid, col in staged["cols"].items():
+        P_ = col["cmp"].shape[-1]
+        entry = {
+            "set": scat(z_b, col["set"]).reshape(Bp, R),
+            "isnull": scat(z_b, col["isnull"]).reshape(Bp, R),
+            "cmp": jnp.zeros((S, P_), jnp.int32)
+            .at[dst].set(col["cmp"][perm], mode="drop")
+            .reshape(Bp, R, P_),
+        }
+        if "arith" in col:
+            entry["arith"] = scat(jnp.zeros((S,), jnp.float32),
+                                  col["arith"]).reshape(Bp, R)
+        out["cols"][cid] = entry
+    return out
+
+
+def flush_plane_nbytes(Bp: int, R: int, schema) -> int:
+    """Predicted HBM footprint of the replayed planes — the budget gate
+    the engine checks BEFORE staging an upload (must agree with
+    DeviceRun.nbytes / ops.device_run.plane_nbytes for the same run)."""
+    per_slot = 4 * 1 + 4 * 4  # valid/group_start/tomb/live + ht/exp
+    for c in schema.value_columns:
+        planes = 2 if c.dtype.device_planes == 2 else 1
+        per_slot += 2 * 1 + 4 * planes  # set/isnull + cmp
+        if c.dtype.is_numeric:
+            per_slot += 4  # arith f32
+    return Bp * R * per_slot

@@ -57,7 +57,8 @@ from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.utils import planes as P
 from yugabyte_db_tpu.utils.fault_injection import FaultInjected, maybe_fault
-from yugabyte_db_tpu.utils.metrics import (count_host_verify_rows,
+from yugabyte_db_tpu.utils.metrics import (count_flush_path,
+                                           count_host_verify_rows,
                                            count_swallowed)
 
 # Failures the circuit breaker attributes to the DEVICE path: injected
@@ -131,6 +132,15 @@ class TpuRun:
         """Run leaving the run set for good (compaction, restore,
         close): drop resident planes and the registration itself."""
         hbm_cache().invalidate(self._res_key)
+
+    def seed_device(self, dev: DeviceRun) -> None:
+        """Admit an already-built DeviceRun (the device flush output) as
+        this run's resident payload — budgeted and tracker-accounted
+        like any demand upload; a no-op hit if something already
+        uploaded. Eviction works normally afterwards: the host planes
+        stay authoritative and the next access re-uploads."""
+        hbm_cache().acquire(self._res_key, lambda: (dev, dev.nbytes),
+                            nbytes_hint=self._nbytes_hint())
 
     def pallas_tensors(self, col_order: tuple):
         """Device tensors in the pallas kernel's ref order (bool planes
@@ -323,18 +333,29 @@ class TpuStorageEngine(StorageEngine):
         if self.memtable.max_ht is not None:
             self.flushed_frontier_ht = max(self.flushed_frontier_ht,
                                            self.memtable.max_ht)
-        # Native flush: one C pass over the memtable emits the packed
-        # run buffers (no per-row Python); generic fallback otherwise.
-        crun = ColumnarRun.build_from_memtable(self.schema, self.memtable,
-                                               self.rows_per_block)
-        if crun is None:
-            entries = self.memtable.drain_sorted()
-            self.persist.save_new(entries)
-            crun = ColumnarRun.build(self.schema, entries,
-                                     self.rows_per_block)
-        elif self.persist.enabled:
-            self.persist.save_new(list(crun.iter_entries()))
-        self.runs.append(TpuRun(crun, self.device_tracker))
+        # Device flush first: replay the memtable op log into sorted run
+        # planes in one device scatter, leaving the run HBM-resident
+        # with no separate upload (--tpu_device_flush). Host build when
+        # ineligible or over the residency budget: the native one-C-pass
+        # path, generic drain+build behind it.
+        seeded = self._device_flush()
+        if seeded is not None:
+            crun, trun = seeded
+            if self.persist.enabled:
+                self.persist.save_new(list(crun.iter_entries()))
+        else:
+            count_flush_path("host")
+            crun = ColumnarRun.build_from_memtable(
+                self.schema, self.memtable, self.rows_per_block)
+            if crun is None:
+                entries = self.memtable.drain_sorted()
+                self.persist.save_new(entries)
+                crun = ColumnarRun.build(self.schema, entries,
+                                         self.rows_per_block)
+            elif self.persist.enabled:
+                self.persist.save_new(list(crun.iter_entries()))
+            trun = TpuRun(crun, self.device_tracker)
+        self.runs.append(trun)
         self.memtable = make_memtable()
         self._plan_cache.clear()
         self._drop_overlay_cache()
@@ -342,6 +363,214 @@ class TpuStorageEngine(StorageEngine):
         if len(self.runs) > 1:
             self._warm_overlay_scatter()
         sync_point("tpu_engine:flush:done")
+
+    def _device_flush(self):
+        """The device flush path: stage the memtable's apply-order op
+        log through the columnar encoders, compute the flush sort
+        (key asc, ht desc, write_id desc — drain_sorted()'s order) and
+        block packing host-side with one stable argsort over memcmp
+        keys, then materialize the sorted padded run planes in a single
+        device scatter (ops.flush.replay_flush). The outputs seed the
+        residency cache directly AND round-trip back as the host planes,
+        so device and host content are byte-identical by construction.
+
+        Returns (crun, trun) on success, None when ineligible — flag
+        off, no op log (capped), keys beyond the exact 32-byte prefix,
+        run over the HBM residency budget, breaker open, or a device
+        fault mid-flush (recorded on the breaker) — sending the caller
+        to the host build."""
+        from yugabyte_db_tpu.ops import flush as dflush
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        try:
+            if not FLAGS.get("tpu_device_flush"):
+                return None
+        except KeyError:
+            return None
+        rows = self.memtable.versions_since(0)
+        if not rows:
+            return None
+        n = len(rows)
+        keys = [r.key for r in rows]
+        max_key_len = max(map(len, keys))
+        if max_key_len > 32:
+            # Sorted-order group boundaries come from prefix-plane
+            # equality — exact only when every key fits the 32-byte
+            # device prefix (the same eligibility device compaction
+            # enforces).
+            return None
+        R = self.rows_per_block
+        # Stage apply-order planes through the columnar encoders: one
+        # block whose row capacity is the bucketed op count (pad rows
+        # are never gathered, and bucketing keeps the device program
+        # count bounded).
+        m = 1 << max(10, (n - 1).bit_length())
+        try:
+            staged = ColumnarRun(self.schema, rows_per_block=m)
+            staged.B = 1
+            staged._alloc(1)
+            staged._fill_block(0, [(r.key, [r]) for r in rows])
+        except (OverflowError, ValueError, TypeError):
+            return None  # value shape the encoders reject: host path
+        wid = np.fromiter((r.write_id for r in rows), np.int64, n)
+        sk = self._flush_sortkey(staged.key_planes[0, :n],
+                                 staged.ht_hi[0, :n],
+                                 staged.ht_lo[0, :n], wid)
+        perm = np.argsort(sk, kind="stable").astype(np.int32)
+        kw_s = staged.key_planes[0][perm]
+        new_group = np.empty(n, dtype=bool)
+        new_group[0] = True
+        new_group[1:] = (kw_s[1:] != kw_s[:-1]).any(axis=1)
+        gstarts = np.flatnonzero(new_group)
+        sizes = np.diff(np.append(gstarts, n))
+        try:
+            ranges = ColumnarRun.pack_group_ranges(sizes.tolist(), R)
+        except ValueError:
+            return None  # an over-block key group: the host build's call
+        B = len(ranges)
+        Bp = padded_blocks(B, PAD_BLOCKS)
+        budget = hbm_cache().budget()
+        if budget and dflush.flush_plane_nbytes(Bp, R,
+                                                self.schema) > budget:
+            return None  # run exceeds the residency budget: host build
+        if not self.breaker.allow():
+            return None
+        try:
+            return self._device_flush_dispatch(
+                rows, keys, staged, perm, kw_s, new_group, gstarts,
+                sizes, ranges, Bp, max_key_len)
+        except DEVICE_FAULT_TYPES as e:
+            self.breaker.record_failure(e)
+            return None
+
+    def _device_flush_dispatch(self, rows, keys, staged, perm, kw_s,
+                               new_group, gstarts, sizes, ranges, Bp,
+                               max_key_len):
+        from yugabyte_db_tpu.ops import flush as dflush
+        from yugabyte_db_tpu.storage.columnar import BlockMeta
+
+        self._device_fault_point()
+        n = len(rows)
+        m = staged.R
+        R = self.rows_per_block
+        B = len(ranges)
+        rows_per = np.array([nr for _g0, _gn, nr in ranges], np.int64)
+        block_of = np.repeat(np.arange(B, dtype=np.int64), rows_per)
+        offs = np.cumsum(rows_per) - rows_per
+        dst = (block_of * R
+               + (np.arange(n, dtype=np.int64)
+                  - np.repeat(offs, rows_per))).astype(np.int32)
+        pad = m - n
+        # Pad rows: gather staged row 0, scatter out of range (dropped).
+        perm_p = (np.concatenate([perm, np.zeros(pad, np.int32)])
+                  if pad else perm)
+        dst_p = (np.concatenate([dst, np.full(pad, Bp * R, np.int32)])
+                 if pad else dst)
+        gs_p = (np.concatenate([new_group, np.zeros(pad, bool)])
+                if pad else new_group)
+        staged_tree = {
+            "ht_hi": staged.ht_hi[0], "ht_lo": staged.ht_lo[0],
+            "exp_hi": staged.exp_hi[0], "exp_lo": staged.exp_lo[0],
+            "tomb": staged.tomb[0], "live": staged.live[0],
+            "cols": {},
+        }
+        for cid, col in staged.cols.items():
+            entry = {"set": col.set_[0], "isnull": col.isnull[0],
+                     "cmp": col.cmp_planes[0]}
+            if col.arith is not None:
+                entry["arith"] = col.arith[0]
+            staged_tree["cols"][cid] = entry
+        is_real = np.zeros(Bp, dtype=bool)
+        is_real[:B] = True
+        ehi, elo = P.scalar_ht_planes(MAX_HT)
+        out = dflush.replay_flush(staged_tree, perm_p, dst_p, gs_p,
+                                  is_real, ehi, elo, R=R)
+        # The device planes round-trip back as the run's HOST planes
+        # (one copy per plane; np.array so they're owned and writable —
+        # never a read-only view of a device buffer).
+        host = jax.tree_util.tree_map(np.array, out)
+
+        run = ColumnarRun(self.schema, R)
+        run.B = B
+        run._alloc(B)
+        run.valid = host["valid"][:B]
+        run.group_start = host["group_start"][:B]
+        run.tomb = host["tomb"][:B]
+        run.live = host["live"][:B]
+        run.ht_hi = host["ht_hi"][:B]
+        run.ht_lo = host["ht_lo"][:B]
+        run.exp_hi = host["exp_hi"][:B]
+        run.exp_lo = host["exp_lo"][:B]
+        for cid, col in run.cols.items():
+            h = host["cols"][cid]
+            col.set_ = h["set"][:B]
+            col.isnull = h["isnull"][:B]
+            col.cmp_planes = h["cmp"][:B]
+            if col.arith is not None:
+                col.arith = h["arith"][:B]
+
+        # Keys and row payloads stay host-side (no key planes on
+        # device): the same flat scatter, in numpy.
+        def scatter(dest, vals):
+            dest.reshape((B * R,) + dest.shape[2:])[dst] = vals
+
+        scatter(run.key_planes, kw_s)
+        keys_arr = np.empty(n, dtype=object)
+        keys_arr[:] = keys
+        keys_s = keys_arr[perm]
+        scatter(run.row_keys, keys_s)
+        vers_arr = np.empty(n, dtype=object)
+        vers_arr[:] = rows
+        scatter(run.row_versions, vers_arr[perm])
+        bpos = dst // R
+        rpos = dst % R
+        for cid, col in run.cols.items():
+            if col.varlen is None:
+                continue
+            src = staged.cols[cid].varlen[0]
+            for j in range(n):
+                v = src[perm[j]]
+                if v is not None:
+                    col.varlen[bpos[j]][rpos[j]] = v
+
+        group_keys = keys_s[gstarts]
+        for b, (g0, gn, nrows) in enumerate(ranges):
+            run.blocks[b] = BlockMeta(group_keys[g0],
+                                      group_keys[g0 + gn - 1], nrows)
+        run.min_key = group_keys[0]
+        run.max_key = run.blocks[B - 1].max_key
+        run.num_versions = n
+        run.max_ht = staged.max_ht
+        run.max_group_versions = int(sizes.max())
+        run.max_key_len = max_key_len
+        run.varlen_max_len = dict(staged.varlen_max_len)
+
+        trun = TpuRun(run, self.device_tracker)
+        trun.seed_device(DeviceRun.from_arrays(run, PAD_BLOCKS, out))
+        self.breaker.record_success()
+        count_flush_path("device")
+        return run, trun
+
+    @staticmethod
+    def _flush_sortkey(kw_part, ht_hi_part, ht_lo_part, wid):
+        """_sortkey_bytes plus a trailing inverted write_id: the FLUSH
+        order (key asc, ht desc, write_id desc) — exactly
+        drain_sorted()'s version order — as ONE memcmp key per row."""
+        n, W = kw_part.shape
+        buf = np.empty((n, W + 4), dtype=np.uint32)
+        buf[:, :W] = (kw_part.view(np.uint32)
+                      ^ np.uint32(0x80000000)).byteswap()
+        buf[:, W] = (~(ht_hi_part.view(np.uint32)
+                       ^ np.uint32(0x80000000))).byteswap()
+        buf[:, W + 1] = (~(ht_lo_part.view(np.uint32)
+                           ^ np.uint32(0x80000000))).byteswap()
+        w = wid.view(np.uint64)
+        buf[:, W + 2] = (~(w >> np.uint64(32))
+                         .astype(np.uint32)).byteswap()
+        buf[:, W + 3] = (~(w & np.uint64(0xFFFFFFFF))
+                         .astype(np.uint32)).byteswap()
+        return np.ascontiguousarray(buf).view(
+            f"S{4 * (W + 4)}").reshape(n)
 
     _scatter_warmed: set = set()
     _scatter_warm_lock = __import__("threading").Lock()

@@ -409,13 +409,28 @@ class Tablet:
     def read_time(self) -> HybridTime:
         return self.mvcc.safe_time()
 
+    def _read_fence(self, read_ht: int, deadline=None) -> None:
+        """MVCC read fence for the pipelined-apply write path: a write is
+        acked at COMMIT and applies asynchronously, with its pending HT
+        holding safe time below it until the apply lands. A read at or
+        above that HT must wait for the drain or it would miss an acked
+        write. Best-effort on timeout: proceeding matches pre-pipelining
+        behaviour, and apply lag is already bounded by write backpressure
+        (--raft_max_inflight_ops)."""
+        timeout = 10.0
+        if deadline is not None:
+            timeout = max(0.0, min(timeout, deadline.remaining()))
+        self.mvcc.wait_for_safe_time(HybridTime(read_ht), timeout=timeout)
+
     def scan(self, spec: ScanSpec, deadline=None) -> ScanResult:
+        self._read_fence(spec.read_ht, deadline)
         return self.engine.scan_batch([spec], deadline=deadline)[0]
 
     def scan_wire(self, spec: ScanSpec, fmt: str = "cql", deadline=None):
         """Scan serving serialized protocol bytes (storage page server;
         reference: rows_data serialized once at the tablet,
         src/yb/common/ql_rowblock.h:66)."""
+        self._read_fence(spec.read_ht, deadline)
         return self.engine.scan_batch_wire([spec], fmt,
                                            deadline=deadline)[0]
 
@@ -424,12 +439,16 @@ class Tablet:
         """One engine batch for many scans (the multi-key read RPC's
         storage hop — point gets share the bloom/merge machinery).
         ``deadline`` is the RPC edge's propagated budget (utils.retry)."""
+        if specs:
+            self._read_fence(max(s.read_ht for s in specs), deadline)
         return self.engine.scan_batch(specs, deadline=deadline)
 
     def scan_wire_many(self, specs: list[ScanSpec], fmt: str = "cql",
                        deadline=None):
         """One engine batch of wire-serialized scans — the batched read
         RPC's storage hop for the native request-batch serving path."""
+        if specs:
+            self._read_fence(max(s.read_ht for s in specs), deadline)
         return self.engine.scan_batch_wire(specs, fmt, deadline=deadline)
 
     def point_serve(self, keys: list[bytes], read_ht: int, col_id: int):
@@ -439,6 +458,7 @@ class Tablet:
         forces the general read path (which resolves them)."""
         if self.participant.txns:
             return None
+        self._read_fence(read_ht)
         return self.engine.point_serve(keys, read_ht, col_id)
 
     # -- maintenance --------------------------------------------------------

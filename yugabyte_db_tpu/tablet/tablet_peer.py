@@ -83,11 +83,11 @@ class TabletPeer:
         return self.raft.is_leader()
 
     # -- write path ---------------------------------------------------------
-    def write(self, rows: list[RowVersion], timeout: float = 10.0,
+    def write(self, rows: list[RowVersion], timeout=10.0,
               client_id: str | None = None,
               request_id: int | None = None) -> HybridTime:
         """Leader-side write: stamp a hybrid time, replicate through Raft,
-        return once applied on this replica.
+        return once majority-durable (commit-time ack; apply is pipelined).
 
         A (client_id, request_id) pair makes the write EXACTLY-ONCE under
         client retries: a replayed id returns the original write's hybrid
@@ -211,28 +211,35 @@ class TabletPeer:
             if op_id.index <= applied:
                 self._inflight_rids.pop(k, None)
 
-    def write_finish(self, admitted, timeout: float = 10.0) -> HybridTime:
-        """Completion phase: wait for commit+apply. Safe to run OUTSIDE
-        the admission lock. MVCC resolution is NOT the waiter's job —
-        the apply stage / truncation hooks resolve the pending HT
-        whether or not anyone is waiting (clients may vanish after
-        admission; a timed-out waiter needs no background babysitter)."""
+    def write_finish(self, admitted, timeout=10.0) -> HybridTime:
+        """Completion phase: wait for COMMIT (majority-durable), not
+        apply — the pipelined-apply ack point. The apply stage drains
+        committed entries asynchronously behind the MVCC read fence
+        (the pending HT added at admission holds safe time below this
+        write until it applies), so an acked-but-unapplied write is
+        never visible to a read and never lost (majority-durable WAL
+        entries replay on restart). Safe to run OUTSIDE the admission
+        lock. MVCC resolution is NOT the waiter's job — the apply stage
+        / truncation hooks resolve the pending HT whether or not anyone
+        is waiting. ``timeout`` is float seconds or a utils.retry
+        Deadline. The rid registration is NOT popped on success: the
+        entry may not have reached the durable dedup registry yet (that
+        happens at apply) — _purge_inflight_rids sweeps it once
+        applied."""
         kind = admitted[0]
         if kind == "dup":
             return admitted[1]
         if kind == "inflight":
             _k, op_id, ht = admitted
-            self.raft.wait_applied(op_id, timeout)
+            self.raft.wait_committed(op_id, timeout)
             return ht
         _k, op_id, ht, rid_key = admitted
         try:
-            self.raft.wait_applied(op_id, timeout)
+            self.raft.wait_committed(op_id, timeout)
         except NotLeader:
             if rid_key is not None:
                 self._inflight_rids.pop(rid_key, None)
             raise
-        if rid_key is not None:
-            self._inflight_rids.pop(rid_key, None)
         return ht
 
     # -- transaction write path ---------------------------------------------
@@ -391,6 +398,10 @@ class TabletPeer:
     # -- maintenance --------------------------------------------------------
     def flush(self) -> None:
         with self._maintenance_lock:
+            # Pipelined apply: a write is acked at commit, so drain the
+            # apply stage first or the flush could capture a memtable
+            # missing acked rows (and advance no frontier for them).
+            self.raft.wait_apply_drained()
             self.tablet.flush()
             # Everything at/below the flushed frontier is durable in the
             # engine's runs: bound the in-memory Raft entry cache too.
@@ -404,6 +415,7 @@ class TabletPeer:
         a concurrent flush between the dump and the tail capture would
         otherwise evict entries out of both."""
         with self._maintenance_lock:
+            self.raft.wait_apply_drained()
             self.tablet.flush()
             self.raft.evict_cache(self.tablet.meta.flushed_op_index)
             entries = self.tablet.engine.dump_entries()

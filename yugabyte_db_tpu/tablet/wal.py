@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass
 
@@ -156,11 +157,13 @@ class Log:
 
         if maybe_fault("fault.wal_sync_failed"):
             raise FaultInjected("injected WAL sync failure")
+        from yugabyte_db_tpu.utils.metrics import observe_wal_sync_ms
         from yugabyte_db_tpu.utils.watchdog import watchdog
 
         # Standing stall check (reference: kernel_stack_watchdog.h):
         # a wedged fsync surfaces as a flagged stall, not silence.
         with watchdog().watch("wal.sync", threshold_s=2.0):
+            start = time.monotonic()
             with self._lock:
                 if self._file is None and self._buffer:
                     self._open_segment_locked(max(1, self.last_appended.index))
@@ -169,6 +172,7 @@ class Log:
                     self._file.flush()
                     if self.fsync:
                         os.fsync(self._file.fileno())
+            observe_wal_sync_ms((time.monotonic() - start) * 1e3)
 
     # -- read / replay -----------------------------------------------------
     def read_all(self, min_index: int = 0):

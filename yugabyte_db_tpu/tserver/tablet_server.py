@@ -168,6 +168,11 @@ class TabletServer:
                     int(rs["role"] == "LEADER"))
                 ent.gauge("tablet_last_index").set(rs["last_index"])
                 ent.gauge("tablet_commit_index").set(rs["commit_index"])
+                # Pipelined-apply backlog: entries acked at commit but
+                # not yet applied into the engine. Nonzero transiently;
+                # stuck-nonzero means the apply stage stalled.
+                ent.gauge("yb_apply_lag_ops").set(
+                    max(0, rs["commit_index"] - rs["applied_index"]))
                 ent.gauge("tablet_run_versions").set(
                     es.get("run_versions", 0))
                 ent.gauge("tablet_memtable_versions").set(
@@ -479,6 +484,9 @@ class TabletServer:
             peer = self.tablet_manager.get(p["tablet_id"])
         except TabletNotFound:
             return {"code": "not_found"}
+        # ONE deadline for the whole write RPC: admission backpressure,
+        # the commit wait, and any retry rounds debit the same budget.
+        deadline = Deadline.after(float(p.get("timeout", 10.0)))
         if p.get("propagated_ht"):
             from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
 
@@ -510,8 +518,7 @@ class TabletServer:
                                     "leader_hint": e.leader_hint}
             if admitted is not None:
                 try:
-                    ht = peer.write_finish(admitted,
-                                           timeout=p.get("timeout", 10.0))
+                    ht = peer.write_finish(admitted, timeout=deadline)
                 except NotLeader as e:
                     return {"code": "not_leader",
                             "leader_hint": e.leader_hint}
@@ -562,7 +569,7 @@ class TabletServer:
                                 return {"code": "error", "message": str(e)}
                         try:
                             ht = peer.write(
-                                rows, timeout=p.get("timeout", 10.0),
+                                rows, timeout=deadline,
                                 client_id=p.get("client_id"),
                                 request_id=p.get("request_id"))
                         except NotLeader as e:
@@ -585,8 +592,7 @@ class TabletServer:
                                 "leader_hint": e.leader_hint}
             if admitted is not None:
                 try:
-                    ht = peer.write_finish(admitted,
-                                           timeout=p.get("timeout", 10.0))
+                    ht = peer.write_finish(admitted, timeout=deadline)
                 except NotLeader as e:
                     return {"code": "not_leader",
                             "leader_hint": e.leader_hint}
@@ -668,8 +674,9 @@ class TabletServer:
             return {"code": "not_leader",
                     "leader_hint": peer.raft.leader_uuid()}
         try:
-            ht = peer.write_finish(("inflight",) + inflight,
-                                   timeout=p.get("timeout", 10.0))
+            ht = peer.write_finish(
+                ("inflight",) + inflight,
+                timeout=Deadline.after(float(p.get("timeout", 10.0))))
         except NotLeader as e:
             return {"code": "not_leader", "leader_hint": e.leader_hint}
         except TimeoutError:
@@ -746,6 +753,20 @@ class TabletServer:
             err = self._pin_read_point(peer, max(explicit), timeout)
             if err is not None:
                 return None, None, err
+        prop = p.get("propagated_ht") or 0
+        if prop and any(s.read_ht == wire.MAX_HT for s in specs):
+            # Session read-your-writes under pipelined apply: writes ack
+            # at COMMIT, and the apply stage drains asynchronously — a
+            # fresh read must wait for safe time to reach everything the
+            # client already observed (its own acked writes ride in
+            # propagated_ht), or it would read below them.
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
+
+            timeout = (deadline.timeout() if deadline is not None
+                       else p.get("timeout", 4.0))
+            if not peer.tablet.mvcc.wait_for_safe_time(_HT(prop),
+                                                       timeout=timeout):
+                return None, None, {"code": "timed_out"}
         read_ht = peer.read_time().value
         for s in specs:
             if s.read_ht == wire.MAX_HT:
@@ -1088,17 +1109,27 @@ class TabletServer:
 
         peer.tablet.clock.update(HybridTime(p.get("propagated_ht", 0)))
         commit_ht = coord.choose_commit_ht(p["txn_id"], peer.tablet.clock)
+        # Deadline propagation (PR-7 convention): the append's
+        # backpressure wait and the apply wait debit the client's one
+        # remaining budget instead of a fresh hardcoded 10 s each.
+        deadline = Deadline.after(float(p.get("timeout", 10.0)))
         try:
             entry = peer.raft.append_leader("txn_status", {
                 "action": "commit", "txn_id": p["txn_id"],
                 "commit_ht": commit_ht,
                 "participants": p.get("participants", []),
-            }, ht=commit_ht)
+            }, ht=commit_ht, deadline=deadline)
         except NotLeader as e:
             coord.finish_commit_attempt(p["txn_id"])
             return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            coord.finish_commit_attempt(p["txn_id"])
+            return {"code": "timed_out"}
         try:
-            peer.raft.wait_applied(entry.op_id, 10.0)
+            # Commit stays an apply-time barrier (NOT the commit-time
+            # ack of plain writes): the coordinator's status registry
+            # must reflect "committed" before the client is told so.
+            peer.raft.wait_applied(entry.op_id, deadline)
         except NotLeader as e:
             # Entry truncated: the commit definitively did not happen.
             coord.finish_commit_attempt(p["txn_id"])
@@ -1113,7 +1144,8 @@ class TabletServer:
                 try:
                     while True:
                         try:
-                            peer.raft.wait_applied(entry.op_id, 10.0)
+                            peer.raft.wait_applied(entry.op_id,
+                                                   Deadline.after(10.0))
                             break
                         except NotLeader:
                             break

@@ -137,3 +137,29 @@ FLAGS.define("fault.seed", 0,
              "faults replay deterministically (the sweep harness sets "
              "this; 0 = unseeded)",
              ("unsafe", "runtime", "hidden"))
+FLAGS.define("raft_group_commit_window_us", 200,
+             "microseconds the leader-side commit pipeline waits after "
+             "the first append before issuing one WAL sync + one "
+             "AppendEntries round per peer for every entry admitted in "
+             "the window; 0 disables coalescing (every append signals "
+             "peers immediately, the pre-group-commit behaviour)",
+             ("evolving", "runtime"))
+FLAGS.define("raft_max_inflight_ops", 4096,
+             "backpressure bound on the leader's append->apply window: "
+             "append_leader blocks while last_index - applied_index "
+             "reaches this many entries (bounded apply-queue depth for "
+             "the ack-at-commit pipeline)",
+             ("evolving", "runtime"))
+FLAGS.define("tpu_device_flush", True,
+             "build flush runs on-device: replay the memtable op log "
+             "into staged columnar planes and apply the sort "
+             "permutation via a jitted gather (ops/flush.py), "
+             "pre-seeding the run's resident device planes; falls back "
+             "to the host path when the run exceeds the HBM residency "
+             "budget or the device dispatch faults",
+             ("evolving", "runtime"))
+FLAGS.define("fault.raft_apply_stall", 0.0,
+             "non-zero: the Raft apply stage stalls (committed entries "
+             "stay unapplied) — used by the commit_ack_crash fault-sweep "
+             "round to widen the commit-ack/apply window deterministically",
+             ("unsafe", "runtime", "hidden"))

@@ -71,6 +71,40 @@ class _WitnessLock:
     def locked(self):
         return self._inner.locked()
 
+    # Condition-variable protocol: threading.Condition copies these three
+    # from its lock at construction. Without them it falls back to
+    # non-reentrant-Lock defaults, which misdetect ownership of a wrapped
+    # RLock (acquire(0) re-enters and "succeeds") and release only one
+    # level across a wait.
+    def _is_owned(self):
+        probe = getattr(self._inner, "_is_owned", None)
+        if probe is not None:
+            return probe()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        depth = getattr(self._tls, "depth", 0)
+        saver = getattr(self._inner, "_release_save", None)
+        if saver is not None:
+            inner_state = saver()
+        else:
+            self._inner.release()
+            inner_state = None
+        self._tls.depth = 0
+        return inner_state, depth
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(inner_state)
+        else:
+            self._inner.acquire()
+        self._tls.depth = depth
+
 
 def _ownership(lock) -> int:
     """1/0 when decidable for the current thread, _UNTRACKED otherwise."""

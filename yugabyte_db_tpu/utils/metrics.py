@@ -328,6 +328,77 @@ def hbm_cache_entity() -> MetricEntity:
 _HOST_VERIFY_ENTITY: MetricEntity | None = None
 
 
+# -- write-path observability --------------------------------------------------
+# WAL sync latency bucket bounds (milliseconds): 1/16 ms .. ~32 s.
+WAL_SYNC_MS_BUCKETS = tuple(0.0625 * (2 ** i) for i in range(20))
+
+_WRITE_PATH_ENTITY: MetricEntity | None = None
+_FLUSH_PATH_ENTITIES: dict[str, MetricEntity] = {}
+
+
+def _write_path_entity() -> MetricEntity:
+    global _WRITE_PATH_ENTITY
+    with _SERVE_LOCK:
+        if _WRITE_PATH_ENTITY is None:
+            _WRITE_PATH_ENTITY = _PROCESS_REGISTRY.entity()
+        return _WRITE_PATH_ENTITY
+
+
+def observe_group_commit_batch(entries: int) -> None:
+    """Record one leader-side group-commit round: bump the
+    ``yb_group_commit_batch_size`` histogram with the number of Raft
+    entries coalesced into this WAL sync + AppendEntries window. A p50
+    stuck at 1 means concurrent writers are not actually sharing
+    replication rounds. Never raises."""
+    try:
+        _write_path_entity().histogram(
+            "yb_group_commit_batch_size",
+            buckets=BATCH_SIZE_BUCKETS).observe(entries)
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("observe_group_commit_batch failed")
+
+
+def observe_wal_sync_ms(ms: float) -> None:
+    """Record one WAL group-commit sync duration (flush + fsync) on the
+    ``yb_wal_sync_ms`` histogram. Never raises."""
+    try:
+        _write_path_entity().histogram(
+            "yb_wal_sync_ms", buckets=WAL_SYNC_MS_BUCKETS).observe(ms)
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("observe_wal_sync_ms failed")
+
+
+def count_flush_path(path: str) -> None:
+    """Bump ``yb_flush_device{path=device|host}``: which build path a
+    memtable flush took. ``device`` = the op log replayed into columnar
+    planes with the sort permutation applied on-device (ops/flush.py);
+    ``host`` = the numpy/native fallback. Never raises."""
+    try:
+        with _SERVE_LOCK:
+            ent = _FLUSH_PATH_ENTITIES.get(path)
+            if ent is None:
+                ent = _PROCESS_REGISTRY.entity(path=path)
+                _FLUSH_PATH_ENTITIES[path] = ent
+        ent.counter("yb_flush_device").increment()
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("count_flush_path failed for %s", path)
+
+
+def flush_path_count(path: str) -> int:
+    """Current ``yb_flush_device{path=...}`` value (0 if never bumped)."""
+    with _SERVE_LOCK:
+        ent = _FLUSH_PATH_ENTITIES.get(path)
+    return ent.counter("yb_flush_device").get() if ent is not None else 0
+
+
+def group_commit_percentile(p: float):
+    """Approximate percentile of ``yb_group_commit_batch_size`` (0 when
+    no group-commit round has been recorded) — bench/test introspection."""
+    h = _write_path_entity().histogram("yb_group_commit_batch_size",
+                                       buckets=BATCH_SIZE_BUCKETS)
+    return h.percentile(p)
+
+
 def count_host_verify_rows(n: int) -> None:
     """Bump ``yb_scan_host_verify_rows`` by the number of fetched rows
     the host re-verified after a device scan. The device predicate mask
