@@ -37,7 +37,18 @@ import random
 import sys
 import time
 
-NUM_KEYS = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+# Optional flags (scanned out before the positional NUM_KEYS):
+#   --compile_witness         count XLA trace/compile events per
+#                             @compile_contract jit entry (utils/jitting)
+#   --compile-witness-out P   dump the compile witness to P for
+#                             yb-lint --witness-check
+_ARGV = sys.argv[1:]
+COMPILE_WITNESS = "--compile_witness" in _ARGV
+CWITNESS_OUT = None
+if "--compile-witness-out" in _ARGV:
+    CWITNESS_OUT = _ARGV[_ARGV.index("--compile-witness-out") + 1]
+_POS = [a for a in _ARGV if not a.startswith("--") and a != CWITNESS_OUT]
+NUM_KEYS = int(_POS[0]) if _POS else 200_000
 TIMED_ITERS = 5
 
 # BASELINE.md calibration: ~29K scanned rows/s/vCPU on the reference's
@@ -118,10 +129,21 @@ def bench_aggregate(schema, rows, max_ht, make_engine, S, n_concurrent=32,
             q.popleft().finish()
 
     pipeline(batches[: depth + 2])  # warm compiles
+    # Steady state starts here: every program the measured region needs
+    # exists, so any further compile is a recompile charged to a request
+    # (yb_jit_compiles{entry} + the compile witness when enabled).
+    from yugabyte_db_tpu.utils import jitting, metrics
+
+    warm_compiles = dict(metrics.jit_compiles())
+    jitting.mark_steady_state()
     t0 = time.perf_counter()
     pipeline(batches)
     tdt = time.perf_counter() - t0
     tpu_rows_s = versions * n_concurrent * n_batches / tdt
+    steady_recompiles = {
+        k: v - warm_compiles.get(k, 0)
+        for k, v in metrics.jit_compiles().items()
+        if v != warm_compiles.get(k, 0)}
 
     return tpu, cpu, versions, {
         "metric": "aggregate_range_scan_rows_per_sec",
@@ -133,6 +155,8 @@ def bench_aggregate(schema, rows, max_ht, make_engine, S, n_concurrent=32,
         "single_scan_latency_ms": round(lat * 1000, 1),
         "single_scan_rows_per_sec": round(versions / lat, 1),
         "load_s": round(load_s, 1),
+        # {} proves the measured region recompiled nothing.
+        "steady_state_recompiles": steady_recompiles,
     }
 
 
@@ -1340,6 +1364,10 @@ def main():
     from yugabyte_db_tpu import storage as S
     from yugabyte_db_tpu.storage import make_engine
 
+    if COMPILE_WITNESS or CWITNESS_OUT:
+        from yugabyte_db_tpu.utils import jitting
+        jitting.enable_compile_witness()
+
     schema = _make_schema()
     rows, max_ht = _make_rows(schema, NUM_KEYS)
 
@@ -1370,6 +1398,16 @@ def main():
         print("# " + json.dumps(sub))
         details[sub["metric"]] = {k: v for k, v in sub.items()
                                   if k != "metric"}
+
+    from yugabyte_db_tpu.utils import metrics
+    compiles = metrics.jit_compiles()
+    print("# " + json.dumps({"metric": "jit_compiles_per_entry",
+                             "value": sum(compiles.values()),
+                             "unit": "XLA compiles (whole suite)",
+                             "per_entry": compiles}))
+    if CWITNESS_OUT:
+        from yugabyte_db_tpu.utils import jitting
+        jitting.dump_compile_witness(CWITNESS_OUT)
 
     headline["details"] = details
     headline["baseline_note"] = (

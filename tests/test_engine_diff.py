@@ -102,19 +102,16 @@ def load_sample(schema, cpu, tpu, n=300, seed=5):
     return ht
 
 
-def test_diff_single_run_full_scan():
+def test_diff_full_scan_and_range_bounds():
+    # Full scans (the fully-unbounded range) and range edges share one
+    # engine pair: the former test_diff_single_run_full_scan used the
+    # identical workload, so its read-point sweep rides here.
     schema, cpu, tpu = both_engines()
     max_ht = load_sample(schema, cpu, tpu)
     cpu.flush(); tpu.flush()
     assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
     assert_same_scan(cpu, tpu, dict(read_ht=max_ht // 2))
     assert_same_scan(cpu, tpu, dict(read_ht=1))
-
-
-def test_diff_range_bounds():
-    schema, cpu, tpu = both_engines()
-    load_sample(schema, cpu, tpu)
-    cpu.flush(); tpu.flush()
     lo = enc(schema, "p", 10)
     hi = enc(schema, "p", 60)
     assert_same_scan(cpu, tpu, dict(lower=lo, upper=hi, read_ht=MAX_HT))
@@ -218,22 +215,17 @@ def test_diff_aggregate_group_by_fallback():
         aggregates=[AggSpec("count", None), AggSpec("sum", "a")]))
 
 
-def test_diff_aggregates_multi_run_fallback():
-    schema, cpu, tpu = both_engines()
-    load_sample(schema, cpu, tpu, n=120, seed=20)
-    cpu.flush(); tpu.flush()
-    load_sample(schema, cpu, tpu, n=120, seed=21)
-    cpu.flush(); tpu.flush()
-    assert_same_scan(cpu, tpu, dict(
-        read_ht=MAX_HT, aggregates=[AggSpec("count", None), AggSpec("sum", "a")]))
-
-
 def test_diff_compaction_equivalence():
     schema, cpu, tpu = both_engines()
     ht = load_sample(schema, cpu, tpu, n=250, seed=31)
     cpu.flush(); tpu.flush()
     load_sample(schema, cpu, tpu, n=250, seed=32)
     cpu.flush(); tpu.flush()
+    # Pre-compaction this is exactly the two-overlapping-runs shape the
+    # former test_diff_aggregates_multi_run_fallback rebuilt from
+    # scratch: the aggregate multi-run fallback asserts ride here.
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT, aggregates=[AggSpec("count", None), AggSpec("sum", "a")]))
     cpu.compact(history_cutoff_ht=ht)
     tpu.compact(history_cutoff_ht=ht)
     assert cpu.stats()["num_runs"] == tpu.stats()["num_runs"] == 1

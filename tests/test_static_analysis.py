@@ -132,7 +132,8 @@ def test_list_rules_names_all_families():
     for family in ("layering/", "jax/", "locks/", "errors/"):
         assert any(n.startswith(family) for n in names), names
     inames = set(all_project_rules())
-    for family in ("ilocks/", "ierrors/", "irpc/", "ijax/", "iraces/"):
+    for family in ("ilocks/", "ierrors/", "irpc/", "ijax/", "iraces/",
+                   "ijit/"):
         assert any(n.startswith(family) for n in inames), inames
 
 
@@ -1273,3 +1274,255 @@ def test_iraces_changed_only_scopes_to_dirty_files(tmp_path):
     race = [v for v in data["violations"]
             if v["rule"] == "iraces/unguarded-shared-write"]
     assert {v["file"] for v in race} == {"yugabyte_db_tpu/util/new.py"}
+
+
+# -- interprocedural: ijit ---------------------------------------------------
+
+IJIT_KERN = """\
+    import functools
+
+    import jax
+
+    from yugabyte_db_tpu.utils.jitting import compile_contract
+
+
+    @functools.lru_cache(maxsize=8)
+    @compile_contract("toy_entry", max_compiles=8)
+    def compiled_toy(sig):
+        def run(x):
+            return x * 2
+        return jax.jit(run)
+"""
+
+IJIT_SERVE = """\
+    import jax
+    import numpy as np
+
+    from yugabyte_db_tpu.ops.kern import compiled_toy
+
+
+    def point_serve(req, arr):
+        fn = compiled_toy({body})
+        return fn(arr)
+"""
+
+
+def test_ijit_unstable_static_arg_fires(tmp_path):
+    """A per-request value (request attribute) in a factory position:
+    every distinct value compiles a new program."""
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py":
+            IJIT_SERVE.format(body="req.limit")})
+    (v,) = fired(res, "ijit/unstable-static-arg")
+    assert "toy_entry" in v.message and "sig" in v.message
+    assert v.fingerprint == "ijit:toy_entry:point_serve:sig"
+
+
+def test_ijit_shape_from_data_fires(tmp_path):
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py":
+            IJIT_SERVE.format(body="arr.shape[0]")})
+    (v,) = fired(res, "ijit/shape-from-data")
+    assert "bucketing" in v.message
+    assert not fired(res, "ijit/unstable-static-arg")
+
+
+def test_ijit_bucketed_shape_is_clean(tmp_path):
+    """Routing the data-derived size through a bucketing helper bounds
+    the compile count: sanctioned."""
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py": """\
+            from yugabyte_db_tpu.ops.agg_fold import safe_window_blocks
+            from yugabyte_db_tpu.ops.kern import compiled_toy
+
+
+            def point_serve(req, arr):
+                fn = compiled_toy(safe_window_blocks(arr.shape[0]))
+                return fn(arr)
+        """})
+    assert not fired(res, "ijit/shape-from-data")
+    assert not fired(res, "ijit/unstable-static-arg")
+
+
+def test_ijit_cold_path_is_silent(tmp_path):
+    """The identical call in a function no serve path reaches: compile
+    cost off the hot path is startup cost, not a finding."""
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py":
+            IJIT_SERVE.format(body="req.limit").replace(
+                "point_serve", "warmup_helper")})
+    assert not fired(res, "ijit/unstable-static-arg")
+
+
+def test_ijit_self_capture_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/kern.py": """\
+        import jax
+
+
+        class Folder:
+            @jax.jit
+            def kernel(self, x):
+                return x + self.offset
+    """})
+    (v,) = fired(res, "ijit/mutable-closure-capture")
+    assert "self.offset" in v.message
+
+
+def test_ijit_global_capture_fires(tmp_path):
+    """A module global rebound via ``global`` elsewhere is mutable
+    state baked in at trace time; a never-rebound module constant is
+    not a capture."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/kern.py": """\
+        import jax
+
+        _MODE = 0
+        _SCALE = 4
+
+
+        def set_mode(m):
+            global _MODE
+            _MODE = m
+
+
+        @jax.jit
+        def kernel(x):
+            return x * _SCALE + _MODE
+    """})
+    (v,) = fired(res, "ijit/mutable-closure-capture")
+    assert "_MODE" in v.message and "_SCALE" not in v.message
+
+
+def test_ijit_factory_param_inner_is_clean(tmp_path):
+    """The factory pattern itself: the inner function reading enclosing
+    factory params is the sanctioned shape, not a capture."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/ops/kern.py": """\
+        import functools
+
+        import jax
+
+
+        @functools.lru_cache(maxsize=8)
+        def compiled_scale(n):
+            def run(x):
+                return x * n
+            return jax.jit(run)
+    """})
+    assert not fired(res, "ijit/mutable-closure-capture")
+
+
+def test_ijit_hot_path_transfer_fires(tmp_path):
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py": """\
+            import numpy as np
+
+            from yugabyte_db_tpu.ops.kern import compiled_toy
+
+
+            def point_serve(sig, arr):
+                fn = compiled_toy(sig)
+                res = fn(arr)
+                return np.asarray(res)
+        """})
+    (v,) = fired(res, "ijit/hot-path-transfer")
+    assert "device_get" in v.message and "point_serve" in v.message
+
+
+def test_ijit_explicit_device_get_is_clean(tmp_path):
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py": """\
+            import jax
+            import numpy as np
+
+            from yugabyte_db_tpu.ops.kern import compiled_toy
+
+
+            def point_serve(sig, arr):
+                fn = compiled_toy(sig)
+                res = fn(arr)
+                res = jax.device_get(res)
+                return np.asarray(res)
+        """})
+    assert not fired(res, "ijit/hot-path-transfer")
+
+
+def test_ijit_suppression_honored(tmp_path):
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py": """\
+            import numpy as np
+
+            from yugabyte_db_tpu.ops.kern import compiled_toy
+
+
+            def point_serve(sig, arr):
+                fn = compiled_toy(sig)
+                res = fn(arr)
+                # Deliberate single-scalar fetch; measured not hot.
+                return np.asarray(res)  # yb-lint: disable=ijit/hot-path-transfer
+        """})
+    assert not fired(res, "ijit/hot-path-transfer")
+    assert res.suppressed >= 1
+
+
+def test_ijit_in_sarif_with_fingerprint(tmp_path):
+    p = tmp_path / "yugabyte_db_tpu" / "ops" / "kern.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(IJIT_KERN))
+    s = tmp_path / "yugabyte_db_tpu" / "storage" / "serve.py"
+    s.parent.mkdir(parents=True, exist_ok=True)
+    s.write_text(textwrap.dedent(IJIT_SERVE.format(body="req.limit")))
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis",
+         "--format=sarif", str(tmp_path / "yugabyte_db_tpu")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2
+    sarif = json.loads(proc.stdout)
+    run = sarif["runs"][0]
+    assert any(r["id"].startswith("ijit/")
+               for r in run["tool"]["driver"]["rules"])
+    (res,) = [r for r in run["results"]
+              if r["ruleId"] == "ijit/unstable-static-arg"]
+    assert "ybLintBaselineKey/v1" in res["partialFingerprints"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("storage/serve.py")
+
+
+def test_ijit_changed_only_scopes_to_dirty_files(tmp_path):
+    """ijit findings anchor on the serve-path call site, so
+    --changed-only mutes a committed caller and reports the same shape
+    in a dirty one — jit-entry fact extraction still runs
+    whole-program (the entry module itself stays committed)."""
+    pkg = tmp_path / "yugabyte_db_tpu"
+    (pkg / "ops").mkdir(parents=True)
+    (pkg / "storage").mkdir(parents=True)
+    (pkg / "ops" / "kern.py").write_text(textwrap.dedent(IJIT_KERN))
+    (pkg / "storage" / "old.py").write_text(
+        textwrap.dedent(IJIT_SERVE.format(body="req.limit")))
+    git_env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "JAX_PLATFORMS": "cpu"}
+    for cmd in (["git", "init", "-q"], ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=tmp_path, check=True, env=git_env,
+                       capture_output=True)
+    # Same hot-root name in a second module: the serve-path set is
+    # matched by name, so the dirty file carries the same shape.
+    (pkg / "storage" / "new.py").write_text(
+        textwrap.dedent(IJIT_SERVE.format(body="req.limit")))
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis", "--no-baseline",
+         "--changed-only", "--format=json", str(pkg)],
+        cwd=REPO_ROOT, capture_output=True, text=True, env=git_env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    hits = [v for v in data["violations"]
+            if v["rule"] == "ijit/unstable-static-arg"]
+    assert {v["file"] for v in hits} == {"yugabyte_db_tpu/storage/new.py"}

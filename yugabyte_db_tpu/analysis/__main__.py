@@ -38,10 +38,12 @@ def main(argv: list[str] | None = None) -> int:
                          "instead of reporting")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--witness-check", metavar="DUMP", default=None,
-                    help="cross-check a runtime lock-witness dump "
-                         "(utils/locking.py, --lock_witness) against the "
-                         "static @guarded_by facts; exits 2 on any "
-                         "contradiction")
+                    help="cross-check a runtime witness dump against the "
+                         "tree's static facts — a lock-witness dump "
+                         "(utils/locking.py, --lock_witness) against "
+                         "@guarded_by, or a compile-witness dump "
+                         "(utils/jitting.py, --compile_witness) against "
+                         "@compile_contract; exits 2 on any contradiction")
     args = ap.parse_args(argv)
 
     rules = core.all_rules()
@@ -86,14 +88,29 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _witness_check(dump_path: str, paths: list[str]) -> int:
-    """Compare a runtime lock-witness dump against the tree's static
-    @guarded_by facts.  Exit 0 when consistent, 2 on contradiction."""
-    from yugabyte_db_tpu.analysis import fields
+    """Compare a runtime witness dump against the tree's static facts —
+    a lock-witness dump against @guarded_by (analysis/fields.py) or a
+    compile-witness dump against @compile_contract (analysis/ijit.py),
+    dispatched on the dump's ``kind``.  Exit 0 when consistent, 2 on
+    contradiction, 1 on an unreadable or unrecognized dump."""
+    import json
+
+    from yugabyte_db_tpu.analysis import fields, ijit
     from yugabyte_db_tpu.analysis.callgraph import build_index
+    from yugabyte_db_tpu.utils.jitting import load_compile_witness_dump
     from yugabyte_db_tpu.utils.locking import load_witness_dump
 
     try:
-        dump = load_witness_dump(dump_path)
+        with open(dump_path, "r", encoding="utf-8") as f:
+            kind = json.load(f).get("kind")
+    except (OSError, ValueError) as e:
+        print(f"yb-lint: {e}", file=sys.stderr)
+        return 1
+    try:
+        if kind == "yb-compile-witness":
+            dump = load_compile_witness_dump(dump_path)
+        else:
+            dump = load_witness_dump(dump_path)
     except (OSError, ValueError) as e:
         print(f"yb-lint: {e}", file=sys.stderr)
         return 1
@@ -106,9 +123,15 @@ def _witness_check(dump_path: str, paths: list[str]) -> int:
         except (OSError, SyntaxError, ValueError):
             continue
     index = build_index(srcs)
-    problems = fields.witness_contradictions(index, dump)
+    if kind == "yb-compile-witness":
+        problems = ijit.compile_contradictions(index, dump)
+        n_facts = len(ijit.static_compile_facts(index))
+        fact_desc = "static @compile_contract fact(s)"
+    else:
+        problems = fields.witness_contradictions(index, dump)
+        n_facts = len(fields.static_guarded_facts(index))
+        fact_desc = "static @guarded_by fact(s)"
     n_obs = len(dump.get("observations", ()))
-    n_facts = len(fields.static_guarded_facts(index))
     if problems:
         print(f"yb-lint witness-check: {len(problems)} contradiction(s) "
               f"across {n_obs} observation(s) / {n_facts} static fact(s):")
@@ -116,7 +139,7 @@ def _witness_check(dump_path: str, paths: list[str]) -> int:
             print(f"  {p}")
         return 2
     print(f"yb-lint witness-check: OK — {n_obs} observation(s) consistent "
-          f"with {n_facts} static @guarded_by fact(s)")
+          f"with {n_facts} {fact_desc}")
     return 0
 
 

@@ -125,6 +125,96 @@ def _upload_fact(node: ast.Call) -> tuple[int, str, str] | None:
     return (node.lineno, kind, arg)
 
 
+def _contract_decorator(fn) -> tuple[str, int] | None:
+    """(entry, max_compiles) from a literal @compile_contract("name",
+    max_compiles=N) decorator, else None. Non-literal declarations are
+    ignored — the runtime decorator rejects them anyway."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        if dotted_name(dec.func).rsplit(".", 1)[-1] != "compile_contract":
+            continue
+        entry = None
+        budget = None
+        if dec.args and isinstance(dec.args[0], ast.Constant) \
+                and isinstance(dec.args[0].value, str):
+            entry = dec.args[0].value
+        if len(dec.args) > 1 and isinstance(dec.args[1], ast.Constant) \
+                and isinstance(dec.args[1].value, int):
+            budget = dec.args[1].value
+        for kw in dec.keywords:
+            if kw.arg == "max_compiles" \
+                    and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int):
+                budget = kw.value.value
+        if entry is not None and budget is not None:
+            return (entry, budget)
+    return None
+
+
+def _direct_static_params(fn) -> set[str]:
+    """Parameter names that are jit-static for a directly decorated
+    function: static_argnums/static_argnames literals on the
+    ``partial(jax.jit, ...)`` (or ``jax.jit(...)``) decorator."""
+    from yugabyte_db_tpu.analysis import jax_hygiene
+
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        name = dotted_name(dec.func).rsplit(".", 1)[-1]
+        is_partial_jit = name == "partial" and any(
+            dotted_name(a).rsplit(".", 1)[-1] in ("jit", "pjit")
+            for a in dec.args)
+        if name not in ("jit", "pjit") and not is_partial_jit:
+            continue
+        argnums: list = []
+        argnames: list = []
+        for kw in dec.keywords:
+            if kw.arg == "static_argnums":
+                argnums = [v for v in jax_hygiene._literal_elems(kw.value)
+                           if isinstance(v, int)]
+            elif kw.arg == "static_argnames":
+                argnames = [v for v in jax_hygiene._literal_elems(kw.value)
+                            if isinstance(v, str)]
+        return jax_hygiene._static_param_names(fn, argnums, argnames)
+    return set()
+
+
+def _jit_factory_return(fn) -> ast.AST | None:
+    """The argument of a top-level ``return jax.jit(<X>)`` in ``fn``
+    (not inside a nested def), else None."""
+    for sub in _walk_skip_defs(fn.body):
+        if isinstance(sub, ast.Return) and isinstance(sub.value, ast.Call):
+            raw = call_name(sub.value)
+            if raw.rsplit(".", 1)[-1] in ("jit", "pjit") and sub.value.args:
+                return sub.value.args[0]
+    return None
+
+
+def _unwrap_traced(expr: ast.AST, factory, depth: int = 0) -> str | None:
+    """Simple name of the python function actually traced under a
+    ``jax.jit(...)`` factory return: unwraps ``partial``/``vmap``/
+    ``shard_map``/``pmap`` layers and follows local ``name = <call>``
+    bindings inside the factory body."""
+    if depth > 5 or expr is None:
+        return None
+    if isinstance(expr, ast.Name):
+        # A name bound to a wrapper call inside the factory body.
+        for sub in _walk_skip_defs(factory.body):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and any(isinstance(t, ast.Name) and t.id == expr.id
+                            for t in sub.targets):
+                return _unwrap_traced(sub.value, factory, depth + 1)
+        return expr.id
+    if isinstance(expr, ast.Call):
+        name = call_name(expr).rsplit(".", 1)[-1]
+        if name in ("partial", "vmap", "shard_map", "pmap", "checkpoint",
+                    "remat") and expr.args:
+            return _unwrap_traced(expr.args[0], factory, depth + 1)
+    return None
+
+
 @dataclass
 class CallSite:
     raw: str                       # dotted call text as written
@@ -167,6 +257,21 @@ class FunctionInfo:
     # held lexically at the site (entry-context added interprocedurally
     # by analysis/fields.py).
     field_accesses: list = field(default_factory=list)
+    # (target name, raw call text, line) for `x = f(...)` bindings —
+    # ijit/ traces device-value provenance through these.
+    assign_calls: list = field(default_factory=list)
+    # Device->host transfer candidates for ijit/hot-path-transfer:
+    # (line, kind, operand text) where kind is "item" | "asarray" |
+    # "cast". Same sites as host_syncs, but with the operand kept so
+    # the rule can ask whether a *device* value is being fetched.
+    transfers: list = field(default_factory=list)
+    # Jit-entry facts (None for ordinary functions): dict with kind
+    # ("factory" | "direct"), line, entry/budget from a literal
+    # @compile_contract decorator (None when uncontracted),
+    # static_params (factory params, or jit static_argnums/argnames),
+    # inner (qualname of the traced callee for factories), and
+    # captures ([(kind, name, line)] with kind "self" | "global").
+    jit_entry: dict | None = None
 
 
 @dataclass
@@ -467,7 +572,26 @@ class _FunctionScanner(ast.NodeVisitor):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     bound.add(tgt.id)
+        if isinstance(node.value, ast.Call):
+            raw = call_name(node.value)
+            if raw:
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                    for elt in elts:
+                        if isinstance(elt, ast.Name):
+                            self.info.assign_calls.append(
+                                (elt.id, raw, node.lineno))
         self.generic_visit(node)
+
+    @staticmethod
+    def _operand_text(node: ast.AST) -> str:
+        text = dotted_name(node)
+        if text:
+            return text
+        try:
+            return ast.unparse(node)
+        except Exception:  # noqa: BLE001 — best-effort label
+            return ""
 
     def visit_Call(self, node: ast.Call):
         fact = _upload_fact(node)
@@ -483,14 +607,23 @@ class _FunctionScanner(ast.NodeVisitor):
                 self.info.host_syncs.append(
                     (node.lineno,
                      f"`{raw.rsplit('.', 1)[-1]}()` host sync"))
+                self.info.transfers.append(
+                    (node.lineno, "item", raw.rsplit(".", 1)[0]))
             elif raw in _HOST_TRANSFER:
                 self.info.host_syncs.append(
                     (node.lineno, f"`{raw}(...)` host transfer"))
+                if node.args:
+                    self.info.transfers.append(
+                        (node.lineno, "asarray",
+                         self._operand_text(node.args[0])))
             elif raw in ("float", "int", "bool") and node.args \
                     and not isinstance(node.args[0], ast.Constant) \
                     and not _mentions_static_shape(node.args[0]):
                 self.info.host_syncs.append(
                     (node.lineno, f"`{raw}(...)` concretizing cast"))
+                self.info.transfers.append(
+                    (node.lineno, "cast",
+                     self._operand_text(node.args[0])))
             if raw.endswith('.get') and node.args \
                     and isinstance(node.args[0], ast.Constant) \
                     and node.args[0].value == "code":
@@ -539,6 +672,7 @@ class _ModuleModel:
         self.imports: dict[str, str] = {}       # alias -> dotted target
         self.classes: dict[str, ClassInfo] = {}  # simple name -> ClassInfo
         self.functions: dict[str, str] = {}      # simple name -> qualname
+        self.mutable_globals: set[str] = set()   # names in `global` stmts
 
 
 class ProjectIndex:
@@ -561,6 +695,7 @@ class ProjectIndex:
             if src.module:
                 self._resolve_calls(src)
         self._mark_traced(srcs)
+        self._mark_jit_entries()
 
     # -- pass A: symbol tables + raw function facts --------------------------
     def _index_module(self, src: SourceFile) -> None:
@@ -576,6 +711,11 @@ class ProjectIndex:
                 for alias in node.names:
                     mod.imports[alias.asname or alias.name] = \
                         f"{node.module}.{alias.name}"
+            elif isinstance(node, ast.Global):
+                # A `global X` declaration anywhere makes X a rebindable
+                # module global — a jitted closure capturing it bakes in
+                # whichever value was live at trace time (ijit/).
+                mod.mutable_globals.update(node.names)
 
         def index_scope(body, prefix, cls: ClassInfo | None):
             for stmt in body:
@@ -873,6 +1013,113 @@ class ProjectIndex:
                 info = by_key.get((src.rel, fn.lineno))
                 if info is not None:
                     info.traced = True
+
+    # -- jit-entry facts (ijit/) ---------------------------------------------
+    def _mark_jit_entries(self) -> None:
+        """Attach ``jit_entry`` facts to every compiled entry point: a
+        function directly decorated ``@jax.jit`` (or via ``partial``),
+        or a factory whose body ``return``s ``jax.jit(...)``. Records
+        the static parameters (every factory param IS a compile key;
+        ``static_argnums``/``static_argnames`` for direct jits), the
+        literal ``@compile_contract`` declaration when present, the
+        traced inner function, and its closure captures."""
+        from yugabyte_db_tpu.analysis import jax_hygiene
+
+        for info in list(self.functions.values()):
+            node = info.node
+            if node is None:
+                continue
+            mod = self.modules.get(info.module)
+            if mod is None:
+                continue
+            contract = _contract_decorator(node)
+            if jax_hygiene._jit_decorated(node):
+                static = _direct_static_params(node)
+                info.jit_entry = {
+                    "kind": "direct", "line": node.lineno,
+                    "entry": contract[0] if contract else None,
+                    "budget": contract[1] if contract else None,
+                    "static_params": tuple(sorted(static)),
+                    "inner": info.qualname,
+                    "captures": self._captures(node, node, mod),
+                }
+                continue
+            ret = _jit_factory_return(node)
+            if ret is None:
+                continue
+            inner_name = _unwrap_traced(ret, node)
+            inner_qual = None
+            inner_node = None
+            if inner_name:
+                cand = f"{info.qualname}.{inner_name}"
+                if cand in self.functions:
+                    inner_qual = cand
+                elif inner_name in mod.functions:
+                    inner_qual = mod.functions[inner_name]
+                if inner_qual:
+                    inner_node = self.functions[inner_qual].node
+            factory_params = tuple(
+                a.arg for a in node.args.posonlyargs + node.args.args
+                + node.args.kwonlyargs)
+            info.jit_entry = {
+                "kind": "factory", "line": node.lineno,
+                "entry": contract[0] if contract else None,
+                "budget": contract[1] if contract else None,
+                "static_params": factory_params,
+                "inner": inner_qual,
+                "captures": self._captures(inner_node, node, mod)
+                if inner_node is not None else [],
+            }
+
+    def _captures(self, traced_node, enclosing, mod) -> list:
+        """(kind, name, line) facts for names the traced function reads
+        from outside its own scope: ``self`` attribute state and module
+        globals rebound via ``global`` elsewhere. Enclosing-factory
+        params/locals and module constants are static per compile and
+        not captures."""
+        if traced_node is None:
+            return []
+        bound = {a.arg for a in traced_node.args.posonlyargs
+                 + traced_node.args.args + traced_node.args.kwonlyargs}
+        for extra in (traced_node.args.vararg, traced_node.args.kwarg):
+            if extra is not None:
+                bound.add(extra.arg)
+        for sub in ast.walk(traced_node):
+            if isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, (ast.Store, ast.Del)):
+                bound.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(sub.name)
+                bound.update(a.arg for a in sub.args.posonlyargs
+                             + sub.args.args + sub.args.kwonlyargs)
+            elif isinstance(sub, ast.Lambda):
+                bound.update(a.arg for a in sub.args.posonlyargs
+                             + sub.args.args + sub.args.kwonlyargs)
+        out = []
+        seen: set[tuple] = set()
+        for sub in ast.walk(traced_node):
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "self" \
+                    and isinstance(sub.ctx, ast.Load):
+                key = ("self", sub.attr)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(("self", sub.attr, sub.lineno))
+            elif isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in mod.mutable_globals \
+                    and sub.id not in bound:
+                key = ("global", sub.id)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(("global", sub.id, sub.lineno))
+        return out
+
+    def jit_entries(self) -> list[FunctionInfo]:
+        """Every function carrying a jit_entry fact."""
+        return [f for f in self.functions.values()
+                if f.jit_entry is not None]
 
     # -- transitive summaries ------------------------------------------------
     def trans_locks(self, qualname: str) -> frozenset:

@@ -132,6 +132,7 @@ def _load_rule_modules() -> None:
         fields,
         ierrors,
         ijax,
+        ijit,
         ilocks,
         irpc,
         jax_hygiene,

@@ -1,0 +1,468 @@
+"""Rule family 10 — interprocedural compile discipline (``ijit/``).
+
+The one failure mode no other family catches is the classic silent perf
+killer of a JAX serving stack: unintended retracing and host<->device
+round-trips on the hot path. A jitted entry point recompiles whenever a
+static argument, a closure capture, or an array shape changes — each
+recompile is tens-to-hundreds of milliseconds of XLA work charged to
+whichever request was unlucky enough to trigger it.
+
+The pass is anchored on the ``@compile_contract`` declarations of
+``utils/jitting.py`` (the compile analog of ``@guarded_by``): the
+callgraph records a ``jit_entry`` fact per compiled entry point —
+decorator site, static parameters, contract budget, the traced inner
+function and its closure captures — and four rules walk the serve paths
+(``scan_batch_async`` / ``point_serve`` / flush / compaction dispatch)
+to every jit boundary:
+
+- ``ijit/unstable-static-arg`` — a per-request value (request fields,
+  fresh mutable literals, clock/rng reads) flows into a static position
+  of a jitted entry: one recompile per distinct value.
+- ``ijit/mutable-closure-capture`` — the traced function reads ``self``
+  state or a ``global``-rebindable module name: traces silently bake in
+  whichever value was live at trace time.
+- ``ijit/shape-from-data`` — a ``len(...)``/``.shape`` row count
+  reaches a static position without passing a sanctioned bucketing
+  helper (``*bucket*``, ``safe_window_blocks``, ``*pow2*``, ...):
+  shape-polymorphic recompile storms.
+- ``ijit/hot-path-transfer`` — an implicit ``np.asarray`` / ``.item()``
+  / concretizing cast on a *device* value (the result of a compiled
+  dispatch) reachable from a serve path. Each one is a blocking
+  device fetch; the sanctioned shape is one explicit batched
+  ``jax.device_get`` per dispatch (see tpu_engine's round-1 fetch).
+
+The runtime compile witness (``--compile_witness``) cross-validates:
+:func:`compile_contradictions` fails a witness dump when any entry
+exceeded its declared budget or an entry this pass proved stable
+recompiled in steady state.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from yugabyte_db_tpu.analysis.core import (
+    Violation,
+    call_name,
+    dotted_name,
+    project_rule,
+)
+
+RULE_UNSTABLE = "ijit/unstable-static-arg"
+RULE_CLOSURE = "ijit/mutable-closure-capture"
+RULE_SHAPE = "ijit/shape-from-data"
+RULE_TRANSFER = "ijit/hot-path-transfer"
+
+_MAX_DEPTH = 8
+
+# Serve-path roots: every function with one of these names (the batch
+# scan issue path and its finish()-side fetch half — batch objects are
+# reached through constructors the callgraph cannot follow — the
+# point-read path, the sharded serve APIs, flush, and compaction
+# dispatch). Walks are cheap and firing requires a jit-entry or
+# device-value fact, so over-approximating roots adds no noise.
+_HOT_ROOT_NAMES = frozenset({
+    "scan_batch_async", "finish", "point_serve",
+    "sharded_row_page", "sharded_aggregate",
+    "flush", "compact", "maybe_compact",
+})
+
+# A call through any of these (substring on the last path component)
+# sanctifies a data-derived size: the result is drawn from a bounded
+# bucket ladder, so the compile-key space stays bounded.
+_BUCKET_TOKENS = ("bucket", "pow2", "pad_to", "round_up")
+_BUCKET_NAMES = frozenset({"safe_window_blocks"})
+
+# Parameters whose attributes are per-request state when read directly
+# in a static position.
+_REQUEST_PARAMS = frozenset({"spec", "req", "request", "query", "op",
+                             "payload", "row", "rows", "batch"})
+
+_CLOCK_RNG = frozenset({"time", "monotonic", "perf_counter",
+                        "process_time", "random", "randrange", "randint",
+                        "uniform", "choice", "getrandbits"})
+
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+# -- serve-path reachability --------------------------------------------------
+
+def _hot_reachable(index) -> dict:
+    """qualname -> call chain (tuple of qualnames) for every function
+    reachable from a serve-path root, roots included."""
+    roots = [f for f in index.functions.values()
+             if f.name in _HOT_ROOT_NAMES]
+    out: dict = {}
+    for root in sorted(roots, key=lambda f: f.qualname):
+        queue = [(root.qualname, (root.qualname,))]
+        while queue:
+            qual, chain = queue.pop(0)
+            if qual in out or len(chain) > _MAX_DEPTH:
+                continue
+            out[qual] = chain
+            fn = index.functions.get(qual)
+            if fn is None:
+                continue
+            for cs in fn.calls:
+                for callee in cs.callees:
+                    if callee not in out:
+                        queue.append((callee, chain + (callee,)))
+    return out
+
+
+# -- static-argument classification -------------------------------------------
+
+def _is_sanctioned(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            tail = call_name(sub).rsplit(".", 1)[-1]
+            if tail in _BUCKET_NAMES \
+                    or any(t in tail for t in _BUCKET_TOKENS):
+                return True
+    return False
+
+
+def _assigned_expr(name: str, fn_node) -> ast.AST | None:
+    """The value expression of a top-level ``name = ...`` binding in the
+    function body (last one wins), skipping nested defs."""
+    from yugabyte_db_tpu.analysis.callgraph import _walk_skip_defs
+
+    found = None
+    for sub in _walk_skip_defs(fn_node.body):
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    found = sub.value
+    return found
+
+
+def _classify_static(expr: ast.AST, fn_node,
+                     depth: int = 0) -> tuple[str, str] | None:
+    """("unstable"|"shape", reason) when ``expr`` is a per-request
+    compile key, else None. Sanctioned bucketing anywhere in the
+    expression (or its one-hop provenance) clears it."""
+    if depth > 3 or expr is None:
+        return None
+    if _is_sanctioned(expr):
+        return None
+    if isinstance(expr, ast.Constant):
+        return None
+    if isinstance(expr, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        kind = type(expr).__name__.replace("Comp", " comprehension") \
+            .lower()
+        return ("unstable", f"fresh mutable {kind} literal — a new "
+                            f"object per call is a new (or unhashable) "
+                            f"jit cache key")
+    if isinstance(expr, ast.Tuple):
+        for elt in expr.elts:
+            got = _classify_static(elt, fn_node, depth + 1)
+            if got:
+                return got
+        return None
+    if isinstance(expr, ast.Call):
+        tail = call_name(expr).rsplit(".", 1)[-1]
+        head = call_name(expr).split(".", 1)[0]
+        if tail == "len":
+            return ("shape", "a `len(...)` row count")
+        if tail in _CLOCK_RNG or head in ("time", "random"):
+            return ("unstable", f"a per-call `{call_name(expr)}()` value")
+        for sub in list(expr.args) + [kw.value for kw in expr.keywords]:
+            got = _classify_static(sub, fn_node, depth + 1)
+            if got:
+                return got
+        return None
+    if isinstance(expr, (ast.Attribute, ast.Subscript)):
+        text = dotted_name(expr)
+        if not text:
+            try:
+                text = ast.unparse(expr)
+            except Exception:  # noqa: BLE001 — best-effort label
+                text = ""
+        if ".shape" in text or (isinstance(expr, ast.Subscript)
+                                and ".shape" in dotted_name(expr.value)):
+            # Mesh.shape is the device-axis map — cluster topology, a
+            # per-process constant, not a data-derived array shape.
+            if "mesh.shape" not in text:
+                return ("shape", f"an array shape read (`{text}`)")
+            return None
+        headm = _IDENT_RE.match(text)
+        if headm and headm.group(0) in _REQUEST_PARAMS \
+                and _is_param(headm.group(0), fn_node):
+            return ("unstable", f"the per-request field `{text}`")
+        return None
+    if isinstance(expr, ast.BinOp):
+        for side in (expr.left, expr.right):
+            got = _classify_static(side, fn_node, depth + 1)
+            if got:
+                return got
+        return None
+    if isinstance(expr, ast.Name):
+        if _is_param(expr.id, fn_node):
+            return None  # caller's own (already-static) parameter
+        return _classify_static(_assigned_expr(expr.id, fn_node), fn_node,
+                                depth + 1)
+    return None
+
+
+def _is_param(name: str, fn_node) -> bool:
+    args = fn_node.args
+    every = args.posonlyargs + args.args + args.kwonlyargs
+    if any(a.arg == name for a in every):
+        return True
+    return (args.vararg is not None and args.vararg.arg == name) \
+        or (args.kwarg is not None and args.kwarg.arg == name)
+
+
+def _entry_label(callee_info) -> str:
+    fact = callee_info.jit_entry
+    return (fact.get("entry") or callee_info.name) if fact else \
+        callee_info.name
+
+
+def _static_args_at(call: ast.Call, callee_info) -> list:
+    """(param name, expr) for every argument landing in a static
+    position of the jit entry ``callee_info``."""
+    fact = callee_info.jit_entry
+    node = callee_info.node
+    params = [a.arg for a in node.args.posonlyargs + node.args.args]
+    out = []
+    if fact["kind"] == "factory":
+        # Every factory argument is a compile key.
+        for i, a in enumerate(call.args):
+            out.append((params[i] if i < len(params) else f"arg{i}", a))
+        for kw in call.keywords:
+            if kw.arg:
+                out.append((kw.arg, kw.value))
+        return out
+    static = set(fact["static_params"])
+    for i, a in enumerate(call.args):
+        if i < len(params) and params[i] in static:
+            out.append((params[i], a))
+    for kw in call.keywords:
+        if kw.arg and kw.arg in static:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+def _iter_static_arg_findings(index):
+    """(entry label, rule, Violation) for every per-request value in a
+    static position of a jit entry called on a serve path."""
+    from yugabyte_db_tpu.analysis.callgraph import _walk_skip_defs
+
+    hot = _hot_reachable(index)
+    seen: set[tuple] = set()
+    for qual in sorted(hot):
+        fn = index.functions.get(qual)
+        if fn is None or fn.node is None or fn.traced:
+            continue
+        for sub in _walk_skip_defs(fn.node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            raw = call_name(sub)
+            if not raw:
+                continue
+            for callee_qual in index.resolve_ref(raw, fn):
+                callee = index.functions.get(callee_qual)
+                if callee is None or callee.jit_entry is None:
+                    continue
+                entry = _entry_label(callee)
+                for param, expr in _static_args_at(sub, callee):
+                    got = _classify_static(expr, fn.node)
+                    if not got:
+                        continue
+                    cls, why = got
+                    rule = RULE_SHAPE if cls == "shape" else RULE_UNSTABLE
+                    key = (fn.rel, getattr(expr, "lineno", sub.lineno),
+                           rule, param)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    line = getattr(expr, "lineno", sub.lineno)
+                    if rule == RULE_SHAPE:
+                        msg = (f"{why} reaches static parameter "
+                               f"`{param}` of jit entry `{entry}` from "
+                               f"serve path {hot[qual][0].rsplit('.', 1)[-1]}"
+                               f" — every distinct row count compiles a "
+                               f"new program; route the size through a "
+                               f"bucketing helper in ops/ "
+                               f"(safe_window_blocks, *_bucket) first")
+                    else:
+                        msg = (f"{why} flows into static parameter "
+                               f"`{param}` of jit entry `{entry}` from "
+                               f"serve path {hot[qual][0].rsplit('.', 1)[-1]}"
+                               f" — jit recompiles per distinct value; "
+                               f"hoist it to a traced argument or a "
+                               f"bounded config key")
+                    yield entry, rule, Violation(
+                        rule, fn.rel, line, msg,
+                        f"ijit:{entry}:{fn.name}:{param}")
+
+
+def _iter_capture_findings(index):
+    for info in sorted(index.jit_entries(), key=lambda f: f.qualname):
+        fact = info.jit_entry
+        entry = _entry_label(info)
+        for kind, name, line in fact.get("captures", ()):
+            if kind == "self":
+                msg = (f"jit entry `{entry}` closes over instance state "
+                       f"`self.{name}` — the first trace bakes the "
+                       f"value in and later rebinds are silently "
+                       f"ignored (or force a retrace per object); pass "
+                       f"it as an explicit argument")
+            else:
+                msg = (f"jit entry `{entry}` closes over module global "
+                       f"`{name}`, which is rebound via `global` "
+                       f"elsewhere — traces bake in whichever value "
+                       f"was live at trace time; pass it as an "
+                       f"explicit argument")
+            yield entry, RULE_CLOSURE, Violation(
+                RULE_CLOSURE, info.rel, line, msg,
+                f"ijit:{entry}:capture:{name}")
+
+
+# -- the registered rules -----------------------------------------------------
+
+@project_rule(RULE_UNSTABLE)
+def check_unstable_static_arg(index):
+    for _entry, rule, v in _iter_static_arg_findings(index):
+        if rule == RULE_UNSTABLE:
+            yield v
+
+
+@project_rule(RULE_SHAPE)
+def check_shape_from_data(index):
+    for _entry, rule, v in _iter_static_arg_findings(index):
+        if rule == RULE_SHAPE:
+            yield v
+
+
+@project_rule(RULE_CLOSURE)
+def check_mutable_closure_capture(index):
+    for _entry, _rule, v in _iter_capture_findings(index):
+        yield v
+
+
+@project_rule(RULE_TRANSFER)
+def check_hot_path_transfer(index):
+    """Implicit device->host fetches on serve paths.
+
+    A name bound to the result of a compiled dispatch (directly, or
+    through a factory-built callable) is a device value; `np.asarray` /
+    `.item()` / concretizing casts on it are one blocking transfer
+    each. The sanctioned shape is a single explicit `jax.device_get`
+    per dispatch — it batches every output in one fetch and makes the
+    sync visible. Suppress deliberate single-value fetches inline."""
+    hot = _hot_reachable(index)
+    for qual in sorted(hot):
+        fn = index.functions.get(qual)
+        if fn is None or fn.traced or not fn.transfers:
+            continue
+        device = _device_names(fn, index)
+        for line, kind, operand in fn.transfers:
+            headm = _IDENT_RE.match(operand)
+            head = headm.group(0) if headm else ""
+            if head not in device and ".dev." not in operand \
+                    and not operand.endswith(".dev"):
+                continue
+            what = {"item": f"`.item()` on `{operand}`",
+                    "asarray": f"implicit `np.asarray({operand})`",
+                    "cast": f"concretizing cast of `{operand}`"}[kind]
+            via = " -> ".join(c.rsplit(".", 1)[-1] for c in hot[qual])
+            yield Violation(
+                RULE_TRANSFER, fn.rel, line,
+                f"{what} fetches a device value on the serve path "
+                f"(via {via}) — each implicit transfer is a blocking "
+                f"round-trip; fetch every output of the dispatch in "
+                f"one explicit `jax.device_get`",
+                f"ijit:transfer:{fn.name}:{head or kind}")
+
+
+def _device_names(fn, index) -> set[str]:
+    """Local names in ``fn`` bound to device values: results of direct
+    jit-entry calls, or of callables returned by jit-entry factories.
+    A name later re-fetched via ``jax.device_get`` is host again."""
+    factories: set[str] = set()
+    for target, raw, _line in fn.assign_calls:
+        for q in index.resolve_ref(raw, fn):
+            info = index.functions.get(q)
+            if info is not None and info.jit_entry is not None \
+                    and info.jit_entry["kind"] == "factory":
+                factories.add(target)
+    device: set[str] = set()
+    fetched: set[str] = set()
+    for target, raw, _line in fn.assign_calls:
+        head = raw.split(".", 1)[0]
+        if raw.rsplit(".", 1)[-1] == "device_get":
+            fetched.add(target)
+            continue
+        if head in factories:
+            device.add(target)
+            continue
+        for q in index.resolve_ref(raw, fn):
+            info = index.functions.get(q)
+            if info is not None and info.jit_entry is not None:
+                device.add(target)
+    return device - fetched
+
+
+# -- witness cross-validation -------------------------------------------------
+
+def static_compile_facts(index) -> dict:
+    """entry -> {budget, rel, line, qualname, kind} for every literal
+    @compile_contract declaration in the tree."""
+    out: dict = {}
+    for info in index.jit_entries():
+        fact = info.jit_entry
+        if fact.get("entry") is None:
+            continue
+        out[fact["entry"]] = {
+            "budget": fact["budget"], "rel": info.rel,
+            "line": fact["line"], "qualname": info.qualname,
+            "kind": fact["kind"],
+        }
+    return out
+
+
+def _unstable_entries(index) -> set[str]:
+    """Entries the static pass could NOT prove stable: any ijit finding
+    (suppressed or not) against them weakens the steady-state
+    guarantee."""
+    out = {e for e, _r, _v in _iter_static_arg_findings(index)}
+    out |= {e for e, _r, _v in _iter_capture_findings(index)}
+    return out
+
+
+def compile_contradictions(index, dump: dict) -> list[str]:
+    """Runtime compile-witness observations that contradict the static
+    compile contracts: an uncontracted entry, a budget overrun, or a
+    steady-state recompile of an entry the static pass proved stable."""
+    facts = static_compile_facts(index)
+    unstable = _unstable_entries(index)
+    problems = []
+    for obs in dump.get("observations", ()):
+        entry = obs.get("entry")
+        compiles = int(obs.get("compiles", 0))
+        steady = int(obs.get("steady", 0))
+        fact = facts.get(entry)
+        if fact is None:
+            problems.append(
+                f"entry `{entry}`: observed {compiles} compile(s) at "
+                f"runtime but the tree declares no @compile_contract "
+                f"for it")
+            continue
+        if compiles > fact["budget"]:
+            sites = ", ".join(obs.get("sites", ())[:3]) or "?"
+            problems.append(
+                f"entry `{entry}`: {compiles} compile(s) exceed the "
+                f"declared budget max_compiles={fact['budget']} "
+                f"({fact['rel']}:{fact['line']}; first sites: {sites})")
+            continue
+        if steady > 0 and entry not in unstable:
+            problems.append(
+                f"entry `{entry}`: statically proven stable, but "
+                f"recompiled {steady} time(s) after steady-state mark "
+                f"— a compile key varies at runtime that the static "
+                f"pass cannot see")
+    return problems

@@ -96,7 +96,8 @@ class FaultSweep:
                  faults: tuple = FAULT_CATALOG,
                  schedule: tuple | None = None,
                  num_tservers: int = 3, num_tablets: int = 2,
-                 keyspace: int = 48, witness_out: str | None = None):
+                 keyspace: int = 48, witness_out: str | None = None,
+                 compile_witness_out: str | None = None):
         self.data_root = data_root
         self.seed = seed
         self.rounds = len(schedule) if schedule is not None else rounds
@@ -125,6 +126,9 @@ class FaultSweep:
         # honors the --lock_witness flag without a path, for ad-hoc
         # runs; the dump is meant for yb-lint --witness-check).
         self.witness_out = witness_out
+        # Same contract for the compile witness (utils/jitting.py):
+        # per-entry XLA compile counts, honoring --compile_witness.
+        self.compile_witness_out = compile_witness_out
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -165,7 +169,7 @@ class FaultSweep:
             self.mc = None
 
     def run(self) -> dict:
-        from yugabyte_db_tpu.utils import locking
+        from yugabyte_db_tpu.utils import jitting, locking
 
         # Enable BEFORE setup so every lock the cluster creates is
         # ownership-tracked from birth.
@@ -173,6 +177,12 @@ class FaultSweep:
             FLAGS.get("lock_witness"))
         if wit:
             locking.enable_lock_witness()
+        # Likewise before the setup scans: warmup compiles are part of
+        # each entry's budget.
+        cwit = self.compile_witness_out is not None or bool(
+            FLAGS.get("compile_witness"))
+        if cwit:
+            jitting.enable_compile_witness()
         self.setup()
         try:
             for rnd in range(self.rounds):
@@ -199,6 +209,10 @@ class FaultSweep:
                 if self.witness_out is not None:
                     locking.dump_lock_witness(self.witness_out)
                 locking.disable_lock_witness()
+            if cwit:
+                if self.compile_witness_out is not None:
+                    jitting.dump_compile_witness(self.compile_witness_out)
+                jitting.disable_compile_witness()
 
     # -- one round -----------------------------------------------------------
 
@@ -477,16 +491,21 @@ def run_sweep(data_root: str, seed: int, rounds: int = 5,
 
 if __name__ == "__main__":  # replay a failing seed: python -m ... <seed>
     # With --witness-out PATH the replay records lock-witness
-    # observations for yb-lint --witness-check.
+    # observations, and with --compile-witness-out PATH per-jit-entry
+    # compile counts — both dumps feed yb-lint --witness-check.
     import sys
     import tempfile
 
     argv = list(sys.argv[1:])
-    wout = None
+    wout = cwout = None
     if "--witness-out" in argv:
         i = argv.index("--witness-out")
         wout = argv[i + 1]
         del argv[i:i + 2]
+    if "--compile-witness-out" in argv:
+        i = argv.index("--compile-witness-out")
+        cwout = argv[i + 1]
+        del argv[i:i + 2]
     with tempfile.TemporaryDirectory() as root:
         print(run_sweep(root, int(argv[0]) if argv else 1234,
-                        witness_out=wout))
+                        witness_out=wout, compile_witness_out=cwout))

@@ -28,6 +28,7 @@ import numpy as np
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import I32_MAX, I32_MIN
 from yugabyte_db_tpu.utils import planes as PL
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 DIGITS = 8  # base-2^16 digit vector length for exact integer sums
 
@@ -327,6 +328,7 @@ def pred_literal(kind: str, value):
 # -- the single-dispatch full-run aggregate program --------------------------
 
 @functools.lru_cache(maxsize=128)
+@compile_contract("full_aggregate", max_compiles=128)
 def compiled_full_aggregate(sig: dscan.ScanSig):
     """One jitted program: fori_loop the [w_first, w_last) windows of the
     run, fold partials, return (ivec, fvec). One dispatch + two transfers

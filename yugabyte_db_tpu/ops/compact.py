@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from yugabyte_db_tpu.ops.scan import I32_MAX, le2
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 
 def _seg_min(vals, gid, n):
@@ -88,6 +89,7 @@ def gc_mask(num_cols: int, N: int, s, cutoff_planes):
 
 
 @functools.lru_cache(maxsize=32)
+@compile_contract("gc_mask", max_compiles=32)
 def compiled_gc_mask(num_cols: int, N: int):
     return jax.jit(functools.partial(gc_mask, num_cols, N))
 
@@ -158,6 +160,7 @@ def gc_mask_host(num_cols: int, s, cutoff_planes) -> "np.ndarray":
 _PAD_ZLO = -(1 << 31)  # low plane of value 0 (bias-flipped)
 
 
+@compile_contract("resident_gc_mask", max_compiles=64)
 @jax.jit
 def resident_gc_mask(runs_planes, idx, new_group, cutoff_planes):
     """gc_mask over the merge order WITHOUT shipping the union's planes:

@@ -32,6 +32,7 @@ from jax import lax
 from yugabyte_db_tpu.ops import agg_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import le2
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 # np scalars, not jnp: module import must not touch the backend.
 I32_MIN = np.int32(-(1 << 31))
@@ -165,6 +166,7 @@ def finish_groups(sig: dscan.ScanSig, gs, live_any, col_notnull, col_val,
 
 
 @functools.lru_cache(maxsize=128)
+@compile_contract("flat_aggregate", max_compiles=128)
 def compiled_flat_aggregate(sig: dscan.ScanSig):
     """jit(run, row_lo, row_hi, read_hi, read_lo, rexp_hi, rexp_lo,
     pred_lits) -> (ivec, fvec) in agg_fold's packed format."""

@@ -30,8 +30,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 
+@compile_contract("replay_flush", max_compiles=64)
 @functools.partial(jax.jit, static_argnames=("R",))
 def replay_flush(staged, perm, dst, gs, is_real, exp_hi_default,
                  exp_lo_default, R: int):

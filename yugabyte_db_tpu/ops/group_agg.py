@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from yugabyte_db_tpu.ops.scan import I32_MAX, I32_MIN, resolve_window
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 NUM_BUCKETS = 512
 DIGITS = 8            # base-2^16 digits per integer accumulator (2^128 cap)
@@ -275,5 +276,6 @@ def grouped_aggregate(sig: GroupAggSig, run, iparams, fparams):
 
 
 @functools.lru_cache(maxsize=64)
+@compile_contract("grouped_aggregate", max_compiles=64)
 def compiled_grouped(sig: GroupAggSig):
     return jax.jit(functools.partial(grouped_aggregate, sig))

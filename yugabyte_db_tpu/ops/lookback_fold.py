@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from yugabyte_db_tpu.ops import flat_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import I32_MIN, le2
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 # Largest per-group version count the unrolled lookback compiles for.
 # Beyond it the engine falls back to seg_fold's associative scans.
@@ -80,6 +81,7 @@ def _shift_l(x, k):
 
 
 @functools.lru_cache(maxsize=128)
+@compile_contract("lookback_aggregate", max_compiles=128)
 def compiled_lookback_aggregate(sig: dscan.ScanSig):
     """jit(run, row_lo, row_hi, read_hi, read_lo, rexp_hi, rexp_lo,
     pred_lits) -> (ivec, fvec) in agg_fold's packed format; exact

@@ -28,6 +28,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from yugabyte_db_tpu.ops.scan import I32_MAX, I32_MIN, AggSig, PredSig
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 BLOCKS_PER_STEP = 8
 OUT_LANES = 128
@@ -177,6 +178,7 @@ def _kernel(aggs, preds, col_order, R, iparams_ref, *refs):
 
 
 @functools.lru_cache(maxsize=64)
+@compile_contract("pallas_flat_aggregate", max_compiles=64)
 def compiled_flat_aggregate(B: int, R: int, aggs: tuple, preds: tuple,
                             col_order: tuple, interpret: bool = False):
     """Build the pallas program for one static signature.

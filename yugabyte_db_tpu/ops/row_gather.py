@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 
 from yugabyte_db_tpu.ops.scan import resolve_window
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 # Fixed slots at the head of the int32 params vector; predicate literal
 # planes follow from PARAM_FIXED onward (layout per GatherSig.preds).
@@ -201,6 +202,7 @@ def gather_rows(sig: GatherSig, run, iparams, fparams):
 
 
 @functools.lru_cache(maxsize=128)
+@compile_contract("gather_batch", max_compiles=128)
 def compiled_gather_batch(sig: GatherSig, G: int):
     """G scans per dispatch: (run, i32[G,P], f32[G,F]) -> i32[G, M+1, W]."""
     fn = functools.partial(gather_rows, sig)

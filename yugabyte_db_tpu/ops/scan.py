@@ -34,6 +34,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 I32_MIN = np.int32(np.iinfo(np.int32).min)
 I32_MAX = np.int32(np.iinfo(np.int32).max)
@@ -425,6 +426,7 @@ def _eval_agg(name, ag: AggSig, result, col_idx, col_has, col_notnull,
 
 
 @functools.lru_cache(maxsize=256)
+@compile_contract("scan_window", max_compiles=256)
 def compiled_scan(sig: ScanSig):
     """One compiled XLA program per static scan signature."""
     fn = functools.partial(scan_window, sig)

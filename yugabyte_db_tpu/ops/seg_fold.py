@@ -35,6 +35,7 @@ from jax import lax
 from yugabyte_db_tpu.ops import flat_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import I32_MIN, le2
+from yugabyte_db_tpu.utils.jitting import compile_contract
 
 
 def supports(sig: dscan.ScanSig) -> bool:
@@ -97,6 +98,7 @@ def _suffix_first(found, payload, group_start):
 
 
 @functools.lru_cache(maxsize=128)
+@compile_contract("seg_aggregate", max_compiles=128)
 def compiled_seg_aggregate(sig: dscan.ScanSig):
     """jit(run, row_lo, row_hi, read_hi, read_lo, rexp_hi, rexp_lo,
     pred_lits) -> (ivec, fvec) in agg_fold's packed format; exact

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.ops import scan as dscan
+from yugabyte_db_tpu.utils.jitting import compile_contract
 from yugabyte_db_tpu.ops.agg_fold import (agg_init, check_limb_bound,
                                           finalize, fold_window, lower_aggs,
                                           pred_literal)
@@ -233,6 +234,7 @@ def _shard_body(sig: dscan.ScanSig, Tl: int, Bl: int, R: int,
 
 
 @functools.lru_cache(maxsize=64)
+@compile_contract("dist_agg", max_compiles=64)
 def _compiled_dist_agg(sig: dscan.ScanSig, mesh: Mesh, Tl: int, Bl: int):
     """One jitted shard_map program per (scan signature, mesh). Mesh is
     hashable and the cache entry keeps it alive only until eviction."""
@@ -318,6 +320,10 @@ def sharded_aggregate(st: ShardedTablets, spec: ScanSpec) -> ScanResult:
     acc, scanned = fn(st.arrays, jnp.asarray(lo), jnp.asarray(hi),
                       jnp.int32(r_hi), jnp.int32(r_lo),
                       jnp.int32(e_hi), jnp.int32(e_lo), tuple(pred_lits))
+    # Both outputs in one explicit fetch — finalize() reads every limb
+    # of acc, so an implicit per-limb transfer would pay the link
+    # round-trip len(acc) times.
+    acc, scanned = jax.device_get((acc, scanned))
 
     out_row, names = [], []
     for a, (fn_name, di) in zip(spec.aggregates, lowering):
@@ -404,6 +410,7 @@ def _rows_body(col_ids, pred_items, Tl, Bl, R, M, run, row_lo, row_hi,
 
 
 @functools.lru_cache(maxsize=64)
+@compile_contract("dist_rows", max_compiles=64)
 def _compiled_dist_rows(cols_desc, pred_items, mesh, Tl, Bl, R, M):
     spec_tb = P("t", "b")
     cols = {}
@@ -497,8 +504,10 @@ def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
     idx, cnt = fn(st.arrays, jnp.asarray(lo), jnp.asarray(hi),
                   jnp.int32(r_hi), jnp.int32(r_lo), jnp.int32(e_hi),
                   jnp.int32(e_lo), tuple(pred_lits))
-    idx = np.asarray(idx)    # [padded_T, mesh_b, M] global row indices
-    cnt = np.asarray(cnt)    # [padded_T, mesh_b]
+    # One explicit batched fetch for both outputs (one link round-trip,
+    # not one per array): idx [padded_T, mesh_b, M] global row indices,
+    # cnt [padded_T, mesh_b].
+    idx, cnt = jax.device_get((idx, cnt))
 
     projection = spec.projection or [c.name for c in schema.columns]
     key_pos = {c.name: i for i, c in enumerate(schema.key_columns)}

@@ -57,6 +57,7 @@ from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.utils import planes as P
 from yugabyte_db_tpu.utils.fault_injection import FaultInjected, maybe_fault
+from yugabyte_db_tpu.utils.jitting import compile_contract
 from yugabyte_db_tpu.utils.metrics import (count_flush_path,
                                            count_host_verify_rows,
                                            count_swallowed)
@@ -880,7 +881,7 @@ class TpuStorageEngine(StorageEngine):
             all_kvs = np.concatenate(
                 [cr.row_key_vals[b, :nv] for cr, b, nv in valid_blocks])
             if keep_dev is not None:
-                keep = np.asarray(keep_dev)
+                keep = jax.device_get(keep_dev)
         finally:
             for t in gc_pins:
                 t.unpin()
@@ -1203,9 +1204,12 @@ class TpuStorageEngine(StorageEngine):
                      jnp.int32(np.clip(row_lo - base, -(1 << 30), 1 << 30)),
                      jnp.int32(np.clip(row_hi - base, -(1 << 30), 1 << 30)),
                      r_hi_, r_lo_, e_hi_, e_lo_, pred_lits)
-            mask = np.asarray(res["result"])
+            # One explicit fetch for all three outputs instead of a
+            # blocking transfer per array.
+            res = jax.device_get(res)
+            mask = res["result"]
             ng = int(res["num_groups"])
-            start = np.asarray(res["start_idx"])
+            start = res["start_idx"]
             for g in np.nonzero(mask[:ng])[0]:
                 yield crun.key_at(base + int(start[g]))
 
@@ -2293,6 +2297,7 @@ class TpuStorageEngine(StorageEngine):
 
     @staticmethod
     @functools.lru_cache(maxsize=64)
+    @compile_contract("batched_grouped", max_compiles=256)
     def _batched_grouped_fn(sig):
         """jit(vmap) of the grouped-aggregate program: N same-signature
         GROUP BY scans (distinct bounds/read points/literals packed in
@@ -2472,6 +2477,7 @@ class TpuStorageEngine(StorageEngine):
     _MASK_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144)
 
     @staticmethod
+    @compile_contract("scatter_invalid", max_compiles=64)
     @jax.jit
     def _scatter_invalid(valid, idx):
         flat = valid.reshape(-1)
@@ -2917,6 +2923,7 @@ class TpuStorageEngine(StorageEngine):
 
     @staticmethod
     @functools.lru_cache(maxsize=64)
+    @compile_contract("batched_agg", max_compiles=256)
     def _batched_agg_fn(route: str, sig):
         """jit(vmap) of the per-spec aggregate program: N same-signature
         scans (distinct bounds / read points / predicate literals) in

@@ -132,6 +132,13 @@ FLAGS.define("lock_witness", False,
              "is cross-checked against yb-lint's static guarded facts "
              "via python -m yugabyte_db_tpu.analysis --witness-check",
              ("advanced", "runtime", "hidden"))
+FLAGS.define("compile_witness", False,
+             "count actual XLA trace/compile events per "
+             "@compile_contract-declared jit entry (utils/jitting.py); "
+             "dump is cross-checked against yb-lint's static compile "
+             "contracts via python -m yugabyte_db_tpu.analysis "
+             "--witness-check",
+             ("advanced", "runtime", "hidden"))
 FLAGS.define("fault.seed", 0,
              "non-zero: seed the fault-injection RNG so probabilistic "
              "faults replay deterministically (the sweep harness sets "

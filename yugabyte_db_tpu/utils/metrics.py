@@ -281,6 +281,42 @@ def faults_fired(name: str) -> int:
     return ent.counter("yb_faults_fired").get() if ent is not None else 0
 
 
+# -- compile-discipline observability -----------------------------------------
+_JIT_ENTITIES: dict[str, MetricEntity] = {}
+_JIT_LOCK = threading.Lock()
+
+
+def count_jit_compile(entry: str, n: int = 1) -> None:
+    """Bump ``yb_jit_compiles{entry=...}`` on the process registry: one
+    series per @compile_contract entry point (utils/jitting.py),
+    incremented on every actual XLA trace/compile event. Steady-state
+    growth of any series is a retrace bug — bench rounds snapshot these
+    counters around the measured loop to prove zero recompiles on hot
+    scan/aggregate keys. Never raises."""
+    try:
+        with _JIT_LOCK:
+            ent = _JIT_ENTITIES.get(entry)
+            if ent is None:
+                ent = _PROCESS_REGISTRY.entity(entry=entry)
+                _JIT_ENTITIES[entry] = ent
+        ent.counter("yb_jit_compiles").increment(n)
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("count_jit_compile failed for %s", entry)
+
+
+def jit_compiles(entry: str | None = None):
+    """Current ``yb_jit_compiles`` value for one entry (0 if never
+    compiled), or the full {entry: count} snapshot when ``entry`` is
+    None."""
+    with _JIT_LOCK:
+        ents = dict(_JIT_ENTITIES)
+    if entry is not None:
+        ent = ents.get(entry)
+        return ent.counter("yb_jit_compiles").get() if ent else 0
+    return {e: ent.counter("yb_jit_compiles").get()
+            for e, ent in sorted(ents.items())}
+
+
 # -- serving-path observability ----------------------------------------------
 # Batch-size bucket bounds (ops per drained request batch): 1 .. 4096.
 BATCH_SIZE_BUCKETS = tuple(2 ** i for i in range(13))
