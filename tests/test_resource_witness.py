@@ -1,0 +1,327 @@
+"""Runtime resource witness: pin attribution, lock-hold durations, and
+the ``--witness-check`` cross-validation against the ires/ + iholds/
+static facts.
+
+Tier 1 runs the witness over a deterministic two-round fault sweep and
+feeds the dump to ``python -m yugabyte_db_tpu.analysis --witness-check``
+(must exit 0: no runtime leak, no hold pair the static pass doesn't
+know).  Forged dumps — a leaked pin, a hold on an unsanctioned
+(class, kind) pair — must exit 2.
+"""
+
+import json
+import os
+import tempfile
+import threading
+
+import pytest
+
+from yugabyte_db_tpu.utils import locking, resources
+from yugabyte_db_tpu.utils.locking import guarded_by
+
+
+@pytest.fixture(autouse=True)
+def _witness_reset():
+    resources.witness().clear()
+    yield
+    resources.disable_resource_witness()
+    resources.witness().clear()
+
+
+def _witness_check(dump_path):
+    from yugabyte_db_tpu.analysis.__main__ import main
+
+    return main(["--witness-check", dump_path])
+
+
+# -- pin attribution ----------------------------------------------------------
+
+def test_pin_lifecycle_attributed_and_balanced():
+    """Every residency pin is attributed to its acquire site + thread;
+    a balanced acquire/release leaves nothing outstanding."""
+    from yugabyte_db_tpu.storage.residency import HbmCache
+
+    resources.enable_resource_witness()
+    cache = HbmCache()
+
+    class Owner:
+        pass
+
+    o = Owner()
+    key = cache.register(o, label="plane")
+    cache.pin(key, lambda: (object(), 256))
+    out = resources.witness().outstanding()
+    assert len(out) == 1
+    rec = out[0]
+    assert rec["key"] == f"plane#{key}"
+    assert "test_resource_witness" in rec["site"]
+    assert rec["thread"] == threading.current_thread().name
+    cache.unpin(key)
+    assert resources.witness().outstanding() == []
+    w = resources.witness()
+    assert w.pin_acquires == w.pin_releases == 1
+
+
+def test_external_pins_are_not_leaks():
+    """add_external entries are permanently pinned by design — excluded
+    from the leak set, but counted."""
+    from yugabyte_db_tpu.storage.residency import HbmCache
+
+    resources.enable_resource_witness()
+    cache = HbmCache()
+
+    class Owner:
+        pass
+
+    o = Owner()
+    cache.add_external(o, 512, label="mesh")
+    assert resources.witness().outstanding() == []
+    assert resources.witness().pin_acquires == 1
+
+
+def test_entry_teardown_retires_all_pins():
+    """invalidate() releases every pin on the key at once — balanced
+    teardown, not a leak."""
+    from yugabyte_db_tpu.storage.residency import HbmCache
+
+    resources.enable_resource_witness()
+    cache = HbmCache()
+
+    class Owner:
+        pass
+
+    o = Owner()
+    key = cache.register(o, label="run")
+    cache.pin(key, lambda: (object(), 64))
+    cache.acquire(key, lambda: (object(), 64), pin=True)
+    assert len(resources.witness().outstanding()) == 2
+    cache.invalidate(key)
+    assert resources.witness().outstanding() == []
+
+
+def test_real_leak_is_attributed(tmp_path):
+    """A pin never released surfaces in the dump with its acquire site,
+    and the dump contradicts the static clean bill (exit 2)."""
+    from yugabyte_db_tpu.storage.residency import HbmCache
+
+    resources.enable_resource_witness()
+    cache = HbmCache()
+
+    class Owner:
+        pass
+
+    o = Owner()
+    key = cache.register(o, label="leaky")
+    cache.pin(key, lambda: (object(), 64))   # never unpinned
+    path = str(tmp_path / "leak.json")
+    resources.dump_resource_witness(path)
+    dump = json.load(open(path))
+    assert dump["kind"] == "yb-resource-witness"
+    (leak,) = dump["leaks"]
+    assert leak["key"] == f"leaky#{key}"
+    assert "test_resource_witness" in leak["site"]
+    assert _witness_check(path) == 2
+    del o  # keep the owner alive until after the dump
+
+
+# -- lock-hold tracking -------------------------------------------------------
+
+@guarded_by("_lock", "_n")
+class _Demo:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._n = 0
+
+    def poke(self, blocking=None):
+        with self._lock:
+            self._n += 1
+            if blocking:
+                resources.note_blocking(blocking)
+
+
+def test_hold_across_blocking_recorded_with_class():
+    resources.enable_resource_witness()
+    d = _Demo()  # constructed under the witness: guard lock wrapped
+    d.poke(blocking="fsync")
+    d.poke(blocking="fsync")
+    d.poke()
+    (h,) = resources.witness().holds()
+    assert h["cls"] == "_Demo" and h["blocking"] == "fsync"
+    assert h["count"] == 2
+    assert "test_resource_witness" in h["site"]
+
+
+def test_unsanctioned_hold_pair_contradicts(tmp_path, capsys):
+    """No static hold site pairs (_Demo, fsync), so the runtime
+    observation means the static pass missed a path: exit 2."""
+    resources.enable_resource_witness()
+    _Demo().poke(blocking="fsync")
+    path = str(tmp_path / "hold.json")
+    resources.dump_resource_witness(path)
+    assert _witness_check(path) == 2
+    out = capsys.readouterr().out
+    assert "_Demo" in out and "no static hold site sanctions" in out
+
+
+def test_sanctioned_hold_pair_is_consistent(tmp_path):
+    """The WAL's segment roll-over fsyncs the old segment under
+    ``Log._lock`` (a justified, suppressed hold) — the runtime pair
+    (Log, fsync) is known to the static pass, so the check passes."""
+    from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
+
+    resources.enable_resource_witness()
+    with tempfile.TemporaryDirectory() as d:
+        # Tiny segments: every append rolls, closing (flush+fsync) the
+        # old segment inside append's critical section.
+        log = Log(d, segment_bytes=1, fsync=True)
+        for i in range(1, 4):
+            log.append(LogEntry(OpId(1, i), i, "write", {"i": i}))
+            log.sync()
+        log.close()
+    holds = {(h["cls"], h["blocking"])
+             for h in resources.witness().holds()}
+    assert ("Log", "fsync") in holds
+    path = os.path.join(tempfile.gettempdir(), "wal_hold.json")
+    resources.dump_resource_witness(path)
+    try:
+        assert _witness_check(path) == 0
+    finally:
+        os.unlink(path)
+
+
+def test_group_commit_fsync_runs_unlocked():
+    """The steady-state sync() path fsyncs OUTSIDE ``_lock`` (the
+    group-commit shape) — no hold observation without a roll-over."""
+    from yugabyte_db_tpu.tablet.wal import Log, LogEntry, OpId
+
+    resources.enable_resource_witness()
+    with tempfile.TemporaryDirectory() as d:
+        log = Log(d, fsync=True)  # default segments: no roll-over
+        for i in range(1, 4):
+            log.append(LogEntry(OpId(1, i), i, "write", {"i": i}))
+            log.sync()
+        holds = {(h["cls"], h["blocking"])
+                 for h in resources.witness().holds()}
+        assert ("Log", "fsync") not in holds
+        log.close()
+
+
+# -- metrics exposure ---------------------------------------------------------
+
+def test_hold_histogram_and_counters_on_metrics_page():
+    """yb_lock_hold_seconds{cls} and the witness counters render on a
+    daemon /metrics scrape (they live on the process registry)."""
+    import urllib.request
+
+    from yugabyte_db_tpu.server.webserver import Webserver
+    from yugabyte_db_tpu.utils.metrics import MetricRegistry
+
+    resources.enable_resource_witness()
+    d = _Demo()
+    d.poke()                               # one hold interval observed
+    resources.witness().pin_acquired(1, label="m")
+    resources.witness().pin_released(1)
+    ws = Webserver(MetricRegistry(), daemon_name="wit-test")
+    host, port = ws.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+    finally:
+        ws.stop()
+    assert "yb_lock_hold_seconds_bucket" in text
+    assert 'cls="_Demo"' in text
+    assert "yb_resource_pin_acquires" in text
+    assert "yb_resource_pin_releases" in text
+
+
+# -- dump-kind dispatch -------------------------------------------------------
+
+def test_loader_rejects_other_dump_kinds(tmp_path):
+    p = tmp_path / "lock.json"
+    p.write_text(json.dumps({"kind": "yb-lock-witness",
+                             "observations": []}))
+    with pytest.raises(ValueError):
+        resources.load_resource_witness_dump(str(p))
+
+
+def test_witness_check_dispatches_all_three_kinds(tmp_path):
+    """One CLI, three dump kinds: lock, compile, and resource dumps all
+    route to their own static-fact comparison."""
+    from yugabyte_db_tpu.utils import jitting
+
+    lock_path = str(tmp_path / "lock.json")
+    locking.enable_lock_witness()
+    locking.dump_lock_witness(lock_path)
+    locking.disable_lock_witness()
+
+    compile_path = str(tmp_path / "compile.json")
+    jitting.enable_compile_witness()
+    jitting.dump_compile_witness(compile_path)
+    jitting.disable_compile_witness()
+
+    res_path = str(tmp_path / "res.json")
+    resources.enable_resource_witness()
+    resources.dump_resource_witness(res_path)
+
+    for p in (lock_path, compile_path, res_path):
+        assert _witness_check(p) == 0, p
+
+
+def test_forged_leak_dump_exits_two(tmp_path, capsys):
+    p = tmp_path / "forged_leak.json"
+    p.write_text(json.dumps({
+        "version": 1, "kind": "yb-resource-witness",
+        "leaks": [{"key": "plane#9", "site": "engine.py:1",
+                   "thread": "scan-0", "external": False}],
+        "holds": [],
+        "counters": {"pin_acquires": 1, "pin_releases": 0}}))
+    assert _witness_check(str(p)) == 2
+    out = capsys.readouterr().out
+    assert "leaked pin `plane#9`" in out and "engine.py:1" in out
+
+
+def test_forged_hold_dump_exits_two(tmp_path, capsys):
+    p = tmp_path / "forged_hold.json"
+    p.write_text(json.dumps({
+        "version": 1, "kind": "yb-resource-witness",
+        "leaks": [],
+        "holds": [{"cls": "MetaCache", "blocking": "rpc", "count": 3,
+                   "site": "meta_cache.py:50"}],
+        "counters": {"pin_acquires": 0, "pin_releases": 0}}))
+    assert _witness_check(str(p)) == 2
+    out = capsys.readouterr().out
+    assert "MetaCache" in out and "no static hold site sanctions" in out
+
+
+# -- the tier-1 integration round ---------------------------------------------
+
+def test_sweep_resource_witness_clean(tmp_path):
+    """A deterministic two-round fault sweep under the resource witness:
+    the dump shows no leaked pin and no unsanctioned hold, and
+    ``--witness-check`` exits 0."""
+    from yugabyte_db_tpu.integration.fault_sweep import FaultSweep
+
+    path = str(tmp_path / "sweep_res.json")
+    with tempfile.TemporaryDirectory() as root:
+        summary = FaultSweep(root, seed=1234, ops_per_round=8,
+                             schedule=("device_dispatch", "hbm_eviction"),
+                             resource_witness_out=path).run()
+    assert summary["rounds"] == 2
+    dump = json.load(open(path))
+    assert dump["kind"] == "yb-resource-witness"
+    assert dump["leaks"] == []
+    assert dump["counters"]["pin_acquires"] == \
+        dump["counters"]["pin_releases"]
+    assert _witness_check(path) == 0
+
+
+@pytest.mark.slow
+def test_randomized_sweep_resource_witness_clean(tmp_path):
+    from yugabyte_db_tpu.integration.fault_sweep import run_sweep
+
+    path = str(tmp_path / "rand_res.json")
+    with tempfile.TemporaryDirectory() as root:
+        run_sweep(root, seed=1977, rounds=8, ops_per_round=24,
+                  resource_witness_out=path)
+    assert _witness_check(path) == 0

@@ -133,7 +133,7 @@ def test_list_rules_names_all_families():
         assert any(n.startswith(family) for n in names), names
     inames = set(all_project_rules())
     for family in ("ilocks/", "ierrors/", "irpc/", "ijax/", "iraces/",
-                   "ijit/"):
+                   "ijit/", "ires/", "iholds/"):
         assert any(n.startswith(family) for n in inames), inames
 
 
@@ -1525,4 +1525,342 @@ def test_ijit_changed_only_scopes_to_dirty_files(tmp_path):
     data = json.loads(proc.stdout)
     hits = [v for v in data["violations"]
             if v["rule"] == "ijit/unstable-static-arg"]
+    assert {v["file"] for v in hits} == {"yugabyte_db_tpu/storage/new.py"}
+
+
+# -- interprocedural: ires resource lifecycle --------------------------------
+
+def test_ires_leak_on_raise_fires(tmp_path):
+    """A raise-capable call sits between pin and unpin with no
+    finally/broad handler: any exception leaks the pin."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/bad.py": """\
+        class Scanner:
+            def scan(self, run):
+                run.pin()
+                rows = decode(42)
+                run.unpin()
+                return rows
+    """})
+    (v,) = fired(res, "ires/leak-on-raise")
+    assert v.line == 4 and "decode" in v.message and "pin" in v.message
+
+
+def test_ires_leak_on_raise_clean_with_finally(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/ok.py": """\
+        class Scanner:
+            def scan(self, run):
+                run.pin()
+                try:
+                    rows = decode(42)
+                finally:
+                    run.unpin()
+                return rows
+    """})
+    assert not fired(res, "ires/leak-on-raise")
+
+
+def test_ires_leak_on_early_return_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/bad.py": """\
+        class Scanner:
+            def scan(self, run, fast):
+                run.pin()
+                if fast:
+                    return 0
+                run.unpin()
+                return 1
+    """})
+    (v,) = fired(res, "ires/leak-on-early-return")
+    assert v.line == 5 and "skips the release" in v.message
+
+
+def test_ires_early_return_after_release_is_clean(tmp_path):
+    """Returns AFTER the release don't skip anything — only a return
+    between the acquire and the (non-finally) release fires."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/ok.py": """\
+        class Scanner:
+            def scan(self, run, fast):
+                run.pin()
+                rows = decode(42)
+                run.unpin()
+                if fast:
+                    return 0
+                return rows
+    """})
+    assert not fired(res, "ires/leak-on-early-return")
+    assert not fired(res, "ires/double-release")
+
+
+def test_ires_double_release_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/bad.py": """\
+        class Scanner:
+            def stop(self, run):
+                run.pin()
+                run.unpin()
+                run.unpin()
+    """})
+    (v,) = fired(res, "ires/double-release")
+    assert v.line == 5 and "double-release" in v.message
+
+
+def test_ires_double_release_clean_with_reacquire(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/ok.py": """\
+        class Scanner:
+            def stop(self, run):
+                run.pin()
+                run.unpin()
+                run.pin()
+                run.unpin()
+    """})
+    assert not fired(res, "ires/double-release")
+
+
+def test_ires_unbalanced_tracker_fires(tmp_path):
+    """A tracker debit on a frame-local tracker with a raise-capable
+    call before the credit: the charge leaks and skews the budget."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/bad.py": """\
+        class Upload:
+            def charge(self, n):
+                tracker = device_tracker()
+                tracker.consume(n)
+                planes = build(n)
+                tracker.release(n)
+                return planes
+    """})
+    (v,) = fired(res, "ires/unbalanced-tracker")
+    assert "tracker" in v.message
+
+
+def test_ires_unbalanced_tracker_clean_with_finally(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/ok.py": """\
+        class Upload:
+            def charge(self, n):
+                tracker = device_tracker()
+                tracker.consume(n)
+                try:
+                    planes = build(n)
+                finally:
+                    tracker.release(n)
+                return planes
+    """})
+    assert not fired(res, "ires/unbalanced-tracker")
+
+
+def test_ires_ownership_escape_is_clean(tmp_path):
+    """Passing the resource to a call (or storing it into self/a
+    container) transfers ownership out of the frame — no leak."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/ok.py": """\
+        class Scanner:
+            def hand_off(self, run, batch):
+                run.pin()
+                batch.adopt(run)
+                return batch
+
+            def keep(self, run):
+                run.pin()
+                self._held = run
+    """})
+    assert not fired(res, "ires/leak-on-early-return")
+    assert not fired(res, "ires/leak-on-raise")
+
+
+def test_ires_suppression_honored(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/storage/ok.py": """\
+        class Scanner:
+            def stop(self, run):
+                run.pin()
+                run.unpin()
+                # yb-lint: disable=ires/double-release
+                run.unpin()
+    """})
+    assert not fired(res, "ires/double-release")
+    assert res.suppressed >= 1
+
+
+# -- interprocedural: iholds lock-across-blocking ----------------------------
+
+def test_iholds_fsync_under_lock_fires(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/bad.py": """\
+        import os
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def save(self):
+                with self._lock:
+                    os.fsync(self._f)
+    """})
+    (v,) = fired(res, "iholds/lock-across-blocking")
+    assert v.line == 11 and "os.fsync" in v.message
+    assert "_lock" in v.message
+
+
+def test_iholds_fsync_outside_lock_is_clean(tmp_path):
+    """The group-commit shape: snapshot under the lock, block outside."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/ok.py": """\
+        import os
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def save(self):
+                with self._lock:
+                    f = self._f
+                os.fsync(f)
+    """})
+    assert not fired(res, "iholds/lock-across-blocking")
+
+
+def test_iholds_one_hop_through_helper_fires(tmp_path):
+    """The caller holds the lock across a helper whose transitive
+    summary blocks — only the call-graph pass can see it."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/bad.py": """\
+        import os
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def save(self):
+                with self._lock:
+                    self._sync_file()
+
+            def flush_unlocked(self):
+                self._sync_file()
+
+            def _sync_file(self):
+                os.fsync(self._f)
+    """})
+    # _sync_file is NOT locked on every entry (flush_unlocked), so the
+    # hold is save()'s fault and is reported at save's call site.
+    vs = fired(res, "iholds/lock-across-blocking")
+    assert any("_sync_file" in v.message and "save" in v.fingerprint
+               for v in vs)
+
+
+def test_iholds_cond_wait_on_own_lock_is_exempt(tmp_path):
+    """Waiting on a condition releases its aliased lock — the legal
+    release-and-wait pattern is not a hold."""
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/ok.py": """\
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._items = []
+
+            def take(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+    """})
+    assert not fired(res, "iholds/lock-across-blocking")
+
+
+def test_iholds_suppression_honored(tmp_path):
+    res = lint(tmp_path, {"yugabyte_db_tpu/tablet/ok.py": """\
+        import os
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def save(self):
+                with self._lock:
+                    # Justified: segment roll-over must be durable
+                    # before the lock drops.
+                    # yb-lint: disable=iholds/lock-across-blocking
+                    os.fsync(self._f)
+    """})
+    assert not fired(res, "iholds/lock-across-blocking")
+    assert res.suppressed >= 1
+
+
+IRES_BAD_DOUBLE = """\
+    class Scanner:
+        def stop(self, run):
+            run.pin()
+            run.unpin()
+            run.unpin()
+"""
+
+
+def test_ires_iholds_in_sarif_with_fingerprint(tmp_path):
+    p = tmp_path / "yugabyte_db_tpu" / "storage" / "bad.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(IRES_BAD_DOUBLE))
+    q = tmp_path / "yugabyte_db_tpu" / "tablet" / "bad.py"
+    q.parent.mkdir(parents=True, exist_ok=True)
+    q.write_text(textwrap.dedent("""\
+        import os
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def save(self):
+                with self._lock:
+                    os.fsync(self._f)
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis",
+         "--format=sarif", str(tmp_path / "yugabyte_db_tpu")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2
+    sarif = json.loads(proc.stdout)
+    run = sarif["runs"][0]
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert any(i.startswith("ires/") for i in ids)
+    assert any(i.startswith("iholds/") for i in ids)
+    (dr,) = [r for r in run["results"]
+             if r["ruleId"] == "ires/double-release"]
+    # Fingerprints are line-free (rule:qualname:obj) so SARIF baselining
+    # survives unrelated edits shifting the site.
+    fp = dr["partialFingerprints"]["ybLintBaselineKey/v1"]
+    assert "Scanner.stop" in fp and not any(ch.isdigit() for ch in
+                                            fp.rsplit(":", 1)[-1])
+    (hv,) = [r for r in run["results"]
+             if r["ruleId"] == "iholds/lock-across-blocking"]
+    assert "ybLintBaselineKey/v1" in hv["partialFingerprints"]
+    loc = hv["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("tablet/bad.py")
+
+
+def test_ires_changed_only_scopes_to_dirty_files(tmp_path):
+    pkg = tmp_path / "yugabyte_db_tpu"
+    (pkg / "storage").mkdir(parents=True)
+    (pkg / "storage" / "old.py").write_text(
+        textwrap.dedent(IRES_BAD_DOUBLE))
+    git_env = {**os.environ, "GIT_AUTHOR_NAME": "t",
+               "GIT_AUTHOR_EMAIL": "t@t", "GIT_COMMITTER_NAME": "t",
+               "GIT_COMMITTER_EMAIL": "t@t", "JAX_PLATFORMS": "cpu"}
+    for cmd in (["git", "init", "-q"], ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=tmp_path, check=True, env=git_env,
+                       capture_output=True)
+    (pkg / "storage" / "new.py").write_text(
+        textwrap.dedent(IRES_BAD_DOUBLE).replace("Scanner", "Reaper"))
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "yugabyte_db_tpu.analysis", "--no-baseline",
+         "--changed-only", "--format=json", str(pkg)],
+        cwd=REPO_ROOT, capture_output=True, text=True, env=git_env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    hits = [v for v in data["violations"]
+            if v["rule"] == "ires/double-release"]
     assert {v["file"] for v in hits} == {"yugabyte_db_tpu/storage/new.py"}

@@ -41,9 +41,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="cross-check a runtime witness dump against the "
                          "tree's static facts — a lock-witness dump "
                          "(utils/locking.py, --lock_witness) against "
-                         "@guarded_by, or a compile-witness dump "
+                         "@guarded_by, a compile-witness dump "
                          "(utils/jitting.py, --compile_witness) against "
-                         "@compile_contract; exits 2 on any contradiction")
+                         "@compile_contract, or a resource-witness dump "
+                         "(utils/resources.py, --pin_witness) against the "
+                         "resource-protocol facts; exits 2 on any "
+                         "contradiction")
     args = ap.parse_args(argv)
 
     rules = core.all_rules()
@@ -89,16 +92,19 @@ def main(argv: list[str] | None = None) -> int:
 
 def _witness_check(dump_path: str, paths: list[str]) -> int:
     """Compare a runtime witness dump against the tree's static facts —
-    a lock-witness dump against @guarded_by (analysis/fields.py) or a
+    a lock-witness dump against @guarded_by (analysis/fields.py), a
     compile-witness dump against @compile_contract (analysis/ijit.py),
-    dispatched on the dump's ``kind``.  Exit 0 when consistent, 2 on
-    contradiction, 1 on an unreadable or unrecognized dump."""
+    or a resource-witness dump against the resource-protocol facts
+    (analysis/ires.py + iholds.py), dispatched on the dump's ``kind``.
+    Exit 0 when consistent, 2 on contradiction, 1 on an unreadable or
+    unrecognized dump."""
     import json
 
-    from yugabyte_db_tpu.analysis import fields, ijit
+    from yugabyte_db_tpu.analysis import fields, ijit, ires
     from yugabyte_db_tpu.analysis.callgraph import build_index
     from yugabyte_db_tpu.utils.jitting import load_compile_witness_dump
     from yugabyte_db_tpu.utils.locking import load_witness_dump
+    from yugabyte_db_tpu.utils.resources import load_resource_witness_dump
 
     try:
         with open(dump_path, "r", encoding="utf-8") as f:
@@ -109,6 +115,8 @@ def _witness_check(dump_path: str, paths: list[str]) -> int:
     try:
         if kind == "yb-compile-witness":
             dump = load_compile_witness_dump(dump_path)
+        elif kind == "yb-resource-witness":
+            dump = load_resource_witness_dump(dump_path)
         else:
             dump = load_witness_dump(dump_path)
     except (OSError, ValueError) as e:
@@ -127,11 +135,19 @@ def _witness_check(dump_path: str, paths: list[str]) -> int:
         problems = ijit.compile_contradictions(index, dump)
         n_facts = len(ijit.static_compile_facts(index))
         fact_desc = "static @compile_contract fact(s)"
+        n_obs = len(dump.get("observations", ()))
+    elif kind == "yb-resource-witness":
+        problems = ires.resource_contradictions(index, dump)
+        n_facts = len(ires.static_resource_facts(index))
+        fact_desc = "static resource-protocol fact(s)"
+        # A resource dump carries leak records and hold observations, not
+        # a flat observation list like the other two kinds.
+        n_obs = len(dump.get("leaks", ())) + len(dump.get("holds", ()))
     else:
         problems = fields.witness_contradictions(index, dump)
         n_facts = len(fields.static_guarded_facts(index))
         fact_desc = "static @guarded_by fact(s)"
-    n_obs = len(dump.get("observations", ()))
+        n_obs = len(dump.get("observations", ()))
     if problems:
         print(f"yb-lint witness-check: {len(problems)} contradiction(s) "
               f"across {n_obs} observation(s) / {n_facts} static fact(s):")

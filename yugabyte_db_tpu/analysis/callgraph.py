@@ -101,6 +101,41 @@ _HOST_TRANSFER = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
 _UPLOAD_ASARRAY = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
                    "jax.numpy.array"}
 
+# -- resource protocols (ires/) ----------------------------------------------
+# Method name -> (kind, verb). The pairing token ("obj") is the receiver
+# text as written; release verbs that take the resource key as their
+# first argument (invalidate) pair on that argument instead, and
+# key-returning acquires (add_external) pair on the assignment target.
+# Tracker verbs only count on receivers that name a tracker, and probe
+# verbs only on breaker receivers — `consume`/`release`/`allow` are too
+# generic otherwise.
+_RESOURCE_VERBS = {
+    "pin": ("pin", "acquire"),
+    "unpin": ("pin", "release"),
+    "add_external": ("pin", "acquire"),
+    "invalidate": ("pin", "release"),
+    "retire": ("pin", "release"),
+    "consume": ("tracker", "acquire"),
+    "release": ("tracker", "release"),
+    "allow": ("probe", "acquire"),
+    "record_success": ("probe", "release"),
+    "record_failure": ("probe", "release"),
+    "trip": ("probe", "release"),
+}
+# Lifecycle methods OWN the protocol — a method literally named `pin`
+# is the acquire primitive, not a leak.
+_RESOURCE_LIFECYCLE_NAMES = frozenset(_RESOURCE_VERBS) | frozenset({
+    "register", "close", "reset", "release_pins", "_release_pins",
+    "detach", "invalidate_device",
+})
+
+# Blocking primitives for iholds/ (beyond the RPC seams above): the WAL
+# fsync, the device fetch barrier, sleeps, and `.wait()` on
+# conditions/events. `detail` carries the condition's aliased lock token
+# so waiting on the SAME lock (the legal release-and-wait pattern) is
+# exempt.
+_BLOCKING_FETCH = {"jax.device_get", "jax.block_until_ready"}
+
 
 def _upload_fact(node: ast.Call) -> tuple[int, str, str] | None:
     """(line, kind, first-arg text) when ``node`` uploads host data to
@@ -230,6 +265,20 @@ class CallSite:
 
 
 @dataclass
+class ResourceSite:
+    """One acquire/release event of a resource protocol (ires/)."""
+    line: int
+    kind: str              # "pin" | "tracker" | "probe"
+    verb: str              # "acquire" | "release"
+    obj: str               # pairing token (receiver / key arg / target)
+    arm: tuple = ()        # branch-arm path — prefix-incomparable paths
+    #                        are disjoint (the double-release test)
+    cleanup: str = ""      # "finally" | "handler" when the site sits in a
+    #                        try's cleanup region (protects acquires)
+    cleanup_broad: bool = False  # handler catches [Base]Exception / bare
+
+
+@dataclass
 class FunctionInfo:
     qualname: str
     module: str
@@ -272,6 +321,22 @@ class FunctionInfo:
     # inner (qualname of the traced callee for factories), and
     # captures ([(kind, name, line)] with kind "self" | "global").
     jit_entry: dict | None = None
+    # Resource-protocol sites for ires/: [ResourceSite] (acquire and
+    # release events with their pairing token, branch-arm path, and
+    # try/finally coverage).
+    resources: list = field(default_factory=list)
+    # Ownership-escape events for ires/: (line, name) — a local resource
+    # owner stored into `self.*`/a container/another object, passed to a
+    # call, or returned (= ownership transferred out of this frame).
+    escapes: list = field(default_factory=list)
+    # Return statements: (line, frozenset of names the returned
+    # expression mentions, trivial) — trivial means bare/None/constant.
+    returns: list = field(default_factory=list)
+    # Blocking facts for iholds/: (line, kind, detail, held) with kind
+    # "rpc" | "fsync" | "device_fetch" | "cond_wait" | "sleep" | "wait",
+    # detail the waited condition's aliased lock token (cond_wait only),
+    # and held the lock tokens held lexically at the site.
+    blocking: list = field(default_factory=list)
 
 
 @dataclass
@@ -663,6 +728,229 @@ class _FunctionScanner(ast.NodeVisitor):
                     self.info.uploads.append(fact)
 
 
+# Call tails that cannot realistically raise — excluded from the
+# "raise-capable point" test between an acquire and its release.
+_NO_RAISE_TAILS = frozenset({
+    "append", "add", "extend", "len", "isinstance", "monotonic", "time",
+    "debug", "info", "warning", "error", "get", "items", "keys", "values",
+    "frozenset", "set", "list", "dict", "tuple", "min", "max", "sorted",
+    "range", "enumerate", "zip", "id", "repr", "str", "int", "bool",
+})
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    for n in (t.elts if isinstance(t, ast.Tuple) else [t]):
+        if dotted_name(n).rsplit(".", 1)[-1] in ("Exception",
+                                                 "BaseException"):
+            return True
+    return False
+
+
+class _ResourceScanner(ast.NodeVisitor):
+    """Second pass per function: resource-protocol sites (ires/),
+    ownership escapes, return shapes, and blocking facts (iholds/).
+
+    Kept separate from _FunctionScanner because the lifecycle facts need
+    context the main scanner has no use for: a branch-arm path (the
+    double-release disjointness test) and the enclosing try's cleanup
+    region (a release in a ``finally`` or a broad handler protects the
+    matching acquire). Nested defs are skipped as usual.
+    """
+
+    def __init__(self, info: FunctionInfo, cls: ClassInfo | None,
+                 class_names: set):
+        self.info = info
+        self.cls = cls
+        self.class_names = class_names
+        self.held: list[str] = []
+        self.arm: list[str] = []
+        # ("finally", True) / ("handler", broad) region stack
+        self.cleanup: list[tuple[str, bool]] = []
+        # Call-node ids whose acquire obj is the assignment target
+        # (add_external / acquire(pin=True) return the resource key).
+        self._assign_obj: dict[int, str] = {}
+
+    _lock_token = _FunctionScanner._lock_token
+
+    # -- context stacks ------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        acquired = 0
+        for item in node.items:
+            tok = self._lock_token(item.context_expr)
+            if tok is not None:
+                self.held.append(tok)
+                acquired += 1
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(acquired):
+            self.held.pop()
+
+    def visit_If(self, node: ast.If):
+        self.visit(node.test)
+        self.arm.append(f"if{node.lineno}t")
+        for stmt in node.body:
+            self.visit(stmt)
+        self.arm[-1] = f"if{node.lineno}e"
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self.arm.pop()
+
+    def _visit_loop(self, node):
+        self.arm.append(f"loop{node.lineno}")
+        self.generic_visit(node)
+        self.arm.pop()
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+
+    def visit_Try(self, node: ast.Try):
+        self.arm.append(f"try{node.lineno}")
+        for stmt in node.body:
+            self.visit(stmt)
+        self.arm.pop()
+        for i, h in enumerate(node.handlers):
+            self.arm.append(f"exc{node.lineno}.{i}")
+            self.cleanup.append(("handler", _handler_is_broad(h)))
+            for stmt in h.body:
+                self.visit(stmt)
+            self.cleanup.pop()
+            self.arm.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self.cleanup.append(("finally", True))
+        for stmt in node.finalbody:
+            self.visit(stmt)
+        self.cleanup.pop()
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs are scanned as their own functions
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- escapes and returns -------------------------------------------------
+    def _escape_names(self, expr: ast.AST, line: int) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name):
+                self.info.escapes.append((line, sub.id))
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call) and len(node.targets) == 1:
+            raw = call_name(node.value)
+            tail = raw.rsplit(".", 1)[-1] if raw else ""
+            pin_kw = any(kw.arg == "pin"
+                         and isinstance(kw.value, ast.Constant)
+                         and kw.value.value is True
+                         for kw in node.value.keywords)
+            if tail == "add_external" or (tail == "acquire" and pin_kw):
+                tgt = dotted_name(node.targets[0])
+                if tgt:
+                    self._assign_obj[id(node.value)] = tgt
+        # Storing into an attribute/subscript hands the names in the
+        # value to another object's lifetime — an ownership escape.
+        for tgt in node.targets:
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            if any(isinstance(e, (ast.Attribute, ast.Subscript))
+                   for e in elts):
+                self._escape_names(node.value, node.lineno)
+                break
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return):
+        names = frozenset(
+            sub.id for sub in ast.walk(node.value)
+            if isinstance(sub, ast.Name)) if node.value is not None \
+            else frozenset()
+        trivial = node.value is None \
+            or isinstance(node.value, ast.Constant)
+        self.info.returns.append((node.lineno, names, trivial))
+        self.generic_visit(node)
+
+    def visit_Yield(self, node: ast.Yield):
+        if node.value is not None:
+            self._escape_names(node.value, node.lineno)
+        self.generic_visit(node)
+
+    # -- resource + blocking facts -------------------------------------------
+    def _blocking_fact(self, node: ast.Call, raw: str) -> None:
+        tail = raw.rsplit(".", 1)[-1]
+        kind = detail = None
+        if is_blocking_raw(raw):
+            kind = "rpc"
+        elif raw == "os.fsync":
+            kind = "fsync"
+        elif raw in _BLOCKING_FETCH:
+            kind = "device_fetch"
+        elif tail == "sleep":
+            kind = "sleep"
+        elif tail == "wait" and "." in raw:
+            recv = raw.rsplit(".", 1)[0]
+            kind, detail = "wait", ""
+            parts = recv.split(".")
+            if parts[0] == "self" and len(parts) == 2 \
+                    and self.cls is not None:
+                attr = parts[1]
+                if self.cls.lock_attrs.get(attr) == "Condition":
+                    # Waiting on a condition releases its (aliased) lock
+                    # — only OTHER held locks stay held across the wait.
+                    kind = "cond_wait"
+                    lock = self.cls.lock_aliases.get(attr, attr)
+                    detail = f"{self.cls.qualname}.{lock}"
+        if kind is not None:
+            self.info.blocking.append(
+                (node.lineno, kind, detail or "", frozenset(self.held)))
+
+    def _resource_fact(self, node: ast.Call, raw: str) -> None:
+        tail = raw.rsplit(".", 1)[-1]
+        recv = raw.rsplit(".", 1)[0] if "." in raw else ""
+        obj = None
+        if tail in ("add_external", "acquire"):
+            # Key-returning acquires pair on the assignment target; a
+            # discarded add_external is immediately unreleasable.
+            obj = self._assign_obj.get(id(node))
+            if obj is None and tail == "add_external":
+                obj = f"<discarded@{node.lineno}>"
+            if obj is None:
+                return
+            kind, verb = "pin", "acquire"
+        elif tail == "invalidate":
+            # Release-by-key: hbm_cache().invalidate(key).
+            obj = dotted_name(node.args[0]) if node.args else recv
+            kind, verb = "pin", "release"
+        elif tail in _RESOURCE_VERBS:
+            kind, verb = _RESOURCE_VERBS[tail]
+            if kind == "tracker" and "tracker" not in recv.lower():
+                return
+            if kind == "probe" and "breaker" not in recv.lower():
+                return
+            obj = recv
+        else:
+            return
+        if not obj:
+            return
+        region = self.cleanup[-1] if self.cleanup else ("", False)
+        self.info.resources.append(ResourceSite(
+            line=node.lineno, kind=kind, verb=verb, obj=obj,
+            arm=tuple(self.arm),
+            cleanup=region[0], cleanup_broad=region[1]))
+
+    def visit_Call(self, node: ast.Call):
+        raw = call_name(node)
+        if raw:
+            self._blocking_fact(node, raw)
+            self._resource_fact(node, raw)
+            # Any name passed as an argument escapes this frame's
+            # ownership (containers, constructors, helper calls alike).
+            for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                self._escape_names(sub, node.lineno)
+        self.generic_visit(node)
+
+
 class _ModuleModel:
     """Per-module symbol tables used during call resolution."""
 
@@ -684,6 +972,7 @@ class ProjectIndex:
         self.classes: dict[str, ClassInfo] = {}
         self.lock_kinds: dict[str, str] = {}     # token -> "Lock"|"RLock"
         self._method_name_index: dict[str, list[str]] = {}
+        self._local_types_memo: dict[str, dict[str, str]] = {}
         self._trans_locks: dict[str, frozenset] = {}
         self._trans_raises: dict[str, frozenset] = {}
         self._error_channel: dict[str, bool] = {}
@@ -765,6 +1054,9 @@ class ProjectIndex:
                     scanner = _FunctionScanner(info, cls, set(mod.classes))
                     for s in stmt.body:
                         scanner.visit(s)
+                    rscan = _ResourceScanner(info, cls, set(mod.classes))
+                    for s in stmt.body:
+                        rscan.visit(s)
                     index_scope(stmt.body, f"{prefix}.{stmt.name}"
                                 if prefix else stmt.name, cls)
 
@@ -919,8 +1211,14 @@ class ProjectIndex:
 
     def _local_var_types(self, info: FunctionInfo,
                          mod: _ModuleModel) -> dict[str, str]:
-        """var -> class qualname from annotations and constructor calls."""
+        """var -> class qualname from annotations and constructor calls.
+        Memoized: resolve_ref re-enters per reference and the AST walk
+        dominates analysis wall time otherwise."""
+        cached = self._local_types_memo.get(info.qualname)
+        if cached is not None:
+            return cached
         out: dict[str, str] = {}
+        self._local_types_memo[info.qualname] = out
         fn = info.node
         if fn is None:
             return out

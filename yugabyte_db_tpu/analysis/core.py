@@ -131,9 +131,11 @@ def _load_rule_modules() -> None:
         error_discipline,
         fields,
         ierrors,
+        iholds,
         ijax,
         ijit,
         ilocks,
+        ires,
         irpc,
         jax_hygiene,
         layering,

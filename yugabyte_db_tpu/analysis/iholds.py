@@ -1,0 +1,153 @@
+"""iholds/: locks held across blocking calls.
+
+The traffic-sweep SLO item dies first at a lock held across a blocking
+call: every other thread that needs the lock eats the block's full
+latency, so one fsync under ``Log._lock`` turns a p50 write into a p99
+stall.  The reference tree polices this by review convention ("no fsync
+under the lock" — see consensus/raft.py's group-commit pipeline, which
+moves durability outside ``_lock`` behind a dedicated ``_sync_lock``);
+this pass mechanizes the convention.
+
+Blocking facts (callgraph's ``_ResourceScanner``):
+
+- ``rpc`` — the ``transport.send`` seam (every outbound call);
+- ``fsync`` — ``os.fsync`` (the WAL/metadata durability point);
+- ``device_fetch`` — ``jax.device_get`` / ``jax.block_until_ready``
+  (the host blocks until the device round-trip completes);
+- ``cond_wait`` — ``Condition.wait``; the condition's aliased lock is
+  RELEASED for the duration, so waiting while holding only that lock is
+  the legal pattern — waiting while holding any OTHER lock is not;
+- ``wait`` — ``Event.wait``/joins (nothing is released);
+- ``sleep`` — ``time.sleep``.
+
+A lock is "held" at a fact through either the lexical ``with`` context
+or the ``iraces/`` entry lock-set fixpoint (the intersection of every
+observed caller's held-set — ``_flush_locked`` helpers inherit their
+caller's lock).  One interprocedural hop is reported at the call site
+too: calling a function whose transitive summary reaches a blocking
+fact while holding a lock the callee's entry-set does NOT already
+account for (otherwise the callee's own site reports it).
+
+The runtime half: utils/resources.py records per-lock hold durations
+into ``yb_lock_hold_seconds{cls}`` and flags locks observed held across
+:func:`~yugabyte_db_tpu.utils.resources.note_blocking` seams;
+``--witness-check`` fails when runtime observes a (class, blocking-kind)
+pair the static pass does not know (see :func:`static_hold_facts`).
+"""
+
+from __future__ import annotations
+
+from yugabyte_db_tpu.analysis import fields
+from yugabyte_db_tpu.analysis.core import Violation, project_rule
+
+_KIND_LABEL = {
+    "rpc": "a blocking RPC (`transport.send`)",
+    "fsync": "`os.fsync`",
+    "device_fetch": "a device fetch barrier",
+    "cond_wait": "`Condition.wait` on a DIFFERENT lock's condition",
+    "wait": "a wait that releases nothing",
+    "sleep": "`time.sleep`",
+}
+
+_TRANS_DEPTH = 40  # callgraph diameter bound for the blocking summary
+
+
+def _must_entry(model, qual: str) -> frozenset:
+    """Locks held on EVERY observed path into ``qual`` (the iraces/
+    entry-set intersection)."""
+    sets = model.entry.get(qual)
+    if not sets:
+        return frozenset()
+    return frozenset.intersection(*sets)
+
+
+def _trans_blocking(index, qual: str, _depth: int = 0) -> frozenset:
+    """(kind, detail) blocking facts reachable from ``qual``, memoized
+    on the index with a cycle guard."""
+    memo = getattr(index, "_iholds_trans", None)
+    if memo is None:
+        memo = index._iholds_trans = {}
+    if qual in memo:
+        return memo[qual]
+    if _depth > _TRANS_DEPTH:
+        return frozenset()
+    memo[qual] = frozenset()  # cycle guard: in-progress -> empty
+    info = index.functions.get(qual)
+    if info is None:
+        return frozenset()
+    facts = {(kind, detail) for _, kind, detail, _ in info.blocking}
+    for cs in info.calls:
+        for callee in cs.callees:
+            facts |= _trans_blocking(index, callee, _depth + 1)
+    memo[qual] = frozenset(facts)
+    return memo[qual]
+
+
+def _exempt(kind: str, detail: str, tok: str) -> bool:
+    # Waiting on a condition releases its own lock for the duration.
+    return kind == "cond_wait" and tok == detail
+
+
+def _lock_short(tok: str) -> str:
+    return tok.rsplit(".", 1)[-1]
+
+
+def _hold_sites(index):
+    """Every hold-across-blocking site: (info, line, kind, tok,
+    via_call_raw) — ``via_call_raw`` is None for direct facts, else the
+    raw text of the call whose transitive summary blocks."""
+    model = fields._model(index)
+    for info in sorted(index.functions.values(), key=lambda f: f.qualname):
+        must = _must_entry(model, info.qualname)
+        for line, kind, detail, held in info.blocking:
+            for tok in sorted(held | must):
+                if _exempt(kind, detail, tok):
+                    continue
+                yield info, line, kind, tok, None
+        for cs in info.calls:
+            if not cs.held:
+                continue
+            for callee in cs.callees:
+                callee_must = _must_entry(model, callee)
+                for kind, detail in sorted(_trans_blocking(index, callee)):
+                    for tok in sorted(cs.held):
+                        if _exempt(kind, detail, tok):
+                            continue
+                        if tok in callee_must:
+                            continue  # the callee's own site reports it
+                        yield info, cs.line, kind, tok, cs.raw
+
+
+@project_rule("iholds/lock-across-blocking")
+def check_lock_across_blocking(index):
+    seen = set()
+    for info, line, kind, tok, via in _hold_sites(index):
+        key = (info.qualname, line, kind, tok)
+        if key in seen:
+            continue
+        seen.add(key)
+        how = f"`{via}(...)` reaches {_KIND_LABEL[kind]}" if via \
+            else _KIND_LABEL[kind]
+        yield Violation(
+            "iholds/lock-across-blocking", info.rel, line,
+            f"`{_lock_short(tok)}` is held across {how} — every "
+            f"contender eats the block's full latency; move the blocking "
+            f"call outside the critical section (the raft group-commit "
+            f"shape: snapshot under the lock, block outside)",
+            f"lab:{info.qualname}:{_lock_short(tok)}:{kind}")
+
+
+# -- witness cross-check ------------------------------------------------------
+
+def static_hold_facts(index) -> list:
+    """Every (lock class simple name, blocking kind, qualname) hold
+    site the static pass can see — INCLUDING sites carrying a justified
+    inline suppression (suppression is applied downstream by the
+    runner).  The runtime witness keys its hold observations by the
+    lock owner's class name; a runtime pair absent from this set means
+    the static pass missed a path."""
+    facts = []
+    for info, line, kind, tok, _ in _hold_sites(index):
+        cls = tok.rsplit(".", 2)[-2] if tok.count(".") >= 2 else tok
+        facts.append((cls, kind, info.qualname))
+    return sorted(set(facts))

@@ -1,0 +1,279 @@
+"""ires/: resource-lifecycle leak detection over the protocol facts.
+
+The reference tree makes resource lifetimes structurally leak-free with
+C++ RAII (ScopedPendingOperation, ScopedTrackedConsumption); Python has
+no such guarantee, and the PR-6 review cycle caught three real pin-leak
+bugs by hand.  This family mechanizes that review: callgraph's
+``_ResourceScanner`` records every acquire/release site of the project's
+resource protocols —
+
+- **pin**: ``TpuRun.pin/unpin/retire``, ``HbmCache.add_external/
+  invalidate`` (key-returning acquire / release-by-key), and
+  ``acquire(..., pin=True)``;
+- **tracker**: ``MemTracker.consume/release`` on receivers naming a
+  tracker;
+- **probe**: the circuit breaker's half-open probe token
+  (``allow`` admits it; ``record_success/record_failure/trip`` retire it)
+
+— plus ownership-escape facts (the resource stored into ``self.*``/a
+container, passed to a call, or returned = ownership transferred out of
+the frame) and the try/finally/except coverage of each site.  The rules
+then ask the RAII question per function and pairing token: does every
+path from an acquire reach a release or an escape?
+
+- ``ires/leak-on-raise`` — releases exist but none sits in a ``finally``
+  or a broad handler, and a raise-capable point sits between the acquire
+  and the release: any exception leaks the resource.
+- ``ires/leak-on-early-return`` — a ``return`` between the acquire and
+  the release skips the release (or no path releases at all).
+- ``ires/double-release`` — two sequential releases of the same token
+  with no re-acquire between them (prefix-comparable branch arms; a
+  release in each arm of an ``if`` is fine).
+- ``ires/unbalanced-tracker`` — the same path logic applied to
+  ``MemTracker`` debits: a path that net-debits the tracker.
+
+Instance-held resources (``self._key = cache.add_external(...)``) are
+exempt: their lifetime spans methods and ``close``/``__del__`` own the
+release.  Protocol-owning methods (a method literally named ``pin`` is
+the acquire primitive) are exempt by name.  Probe tokens are special
+both ways: the receiver is ``self.breaker`` yet the token is
+per-dispatch, so it IS checked — and a non-trivial ``return`` counts as
+its escape (the probe rides the returned batch's ``finish()``).
+
+The runtime half lives in utils/resources.py: under ``--pin_witness``
+every residency acquire/release is attributed to an owner site and
+thread, and ``--witness-check`` fails when runtime contradicts the
+static clean bill (see :func:`resource_contradictions`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from yugabyte_db_tpu.analysis import callgraph
+from yugabyte_db_tpu.analysis.core import Violation, project_rule
+
+_KIND_NOUN = {"pin": "pin", "tracker": "tracker debit",
+              "probe": "breaker probe"}
+
+
+def _fp_obj(obj: str) -> str:
+    # "<discarded@123>" carries a line; fingerprints must not.
+    return obj.partition("@")[0]
+
+
+def _iter_groups(index):
+    """(info, kind, obj, sites) per function and pairing token, with
+    protocol-owning methods exempted by name."""
+    for info in index.functions.values():
+        if info.name in callgraph._RESOURCE_LIFECYCLE_NAMES:
+            continue
+        groups: dict[tuple, list] = {}
+        for s in info.resources:
+            groups.setdefault((s.kind, s.obj), []).append(s)
+        for (kind, obj), sites in sorted(groups.items()):
+            yield info, kind, obj, sites
+
+
+def _params(info) -> frozenset:
+    node = info.node
+    if node is None or not hasattr(node, "args"):
+        return frozenset()
+    a = node.args
+    return frozenset(p.arg for p in a.posonlyargs + a.args + a.kwonlyargs)
+
+
+def _raise_point(info, lo: int, hi: int, own_lines: set):
+    """(line, label) of the first raise-capable point strictly between
+    ``lo`` and ``hi``, else None."""
+    for cs in info.calls:
+        if not (lo < cs.line < hi) or cs.line in own_lines:
+            continue
+        tail = cs.raw.rsplit(".", 1)[-1]
+        if tail in callgraph._NO_RAISE_TAILS \
+                or tail in callgraph._RESOURCE_VERBS:
+            continue
+        return cs.line, f"`{cs.raw}(...)`"
+    if info.node is not None:
+        for sub in callgraph._walk_skip_defs(info.node.body):
+            if isinstance(sub, ast.Raise) and lo < sub.lineno < hi:
+                return sub.lineno, "`raise`"
+    return None
+
+
+def _disjoint(p1: tuple, p2: tuple) -> bool:
+    """Branch-arm paths that are not prefix-comparable sit in disjoint
+    arms — both cannot execute in one pass through the function."""
+    n = min(len(p1), len(p2))
+    return p1[:n] != p2[:n]
+
+
+def _findings(index) -> list:
+    """All (variant, kind, info, line, obj, message) findings, memoized
+    on the index — four rules share one walk."""
+    cached = getattr(index, "_ires_findings", None)
+    if cached is not None:
+        return cached
+    out = []
+    for info, kind, obj, sites in _iter_groups(index):
+        noun = _KIND_NOUN[kind]
+        if (obj == "self" or obj.startswith("self.")) and kind != "probe":
+            # Instance-held: lifetime spans methods; close/__del__ own it.
+            continue
+        if kind == "tracker" and obj.split(".", 1)[0] in _params(info):
+            # Debiting a tracker reachable from a parameter charges THAT
+            # object's lifetime (`e.tracker.consume(...)` belongs to the
+            # entry), not this frame's.
+            continue
+        acq = sorted((s for s in sites if s.verb == "acquire"),
+                     key=lambda s: s.line)
+        rel = sorted((s for s in sites if s.verb == "release"),
+                     key=lambda s: s.line)
+        for i in range(1, len(rel)):
+            r1, r2 = rel[i - 1], rel[i]
+            if r2.line == r1.line or _disjoint(r1.arm, r2.arm):
+                continue
+            if any(r1.line < a.line < r2.line for a in acq):
+                continue
+            if r1.cleanup == "handler" or r2.cleanup == "handler":
+                continue  # the handler runs instead of, not after, the body
+            out.append((
+                "double", kind, info, r2.line, obj,
+                f"`{obj}` {noun} released here and already released at "
+                f"line {r1.line} with no re-acquire between — "
+                f"double-release corrupts the refcount"))
+        if not acq:
+            continue
+        first = acq[0]
+        base = obj.split(".", 1)[0].split("(", 1)[0]
+        escaped = any(nm == base and line >= first.line
+                      for line, nm in info.escapes)
+        escaped = escaped or any(base in names and line >= first.line
+                                 for line, names, _ in info.returns)
+        if escaped and kind != "probe":
+            continue  # ownership transferred out of this frame
+        protected_raise = any(
+            r.cleanup == "finally"
+            or (r.cleanup == "handler" and r.cleanup_broad) for r in rel)
+        protected_return = any(r.cleanup == "finally" for r in rel)
+        if not rel:
+            if kind == "probe" and any(not trivial
+                                       for _, _, trivial in info.returns):
+                continue  # probe rides the returned value's finish()
+            out.append((
+                "early-return", kind, info, first.line, obj,
+                f"`{obj}` {noun} acquired here is never released and "
+                f"never escapes this frame — every path leaks it"))
+            continue
+        last_rel = rel[-1].line
+        if not protected_raise:
+            hazard = _raise_point(info, first.line, last_rel,
+                                  {s.line for s in sites})
+            if hazard is not None:
+                narrow = "; the handler that releases it catches only "\
+                    "specific types" if any(r.cleanup == "handler"
+                                            for r in rel) else ""
+                out.append((
+                    "raise", kind, info, hazard[0], obj,
+                    f"{hazard[1]} can raise while `{obj}` {noun} "
+                    f"(acquired line {first.line}) is unreleased, and no "
+                    f"finally/broad-handler releases it{narrow} — "
+                    f"an exception leaks the {noun}"))
+        if not protected_return and kind != "probe":
+            # Probes are exempt from the early-return variant both ways:
+            # a non-trivial return carries the probe out (the batch's
+            # finish() retires it) and the `if not allow(): return` guard
+            # is the NOT-admitted path — no probe exists there.
+            for rline, names, trivial in info.returns:
+                if not (first.line < rline < last_rel) or base in names:
+                    continue
+                out.append((
+                    "early-return", kind, info, rline, obj,
+                    f"returning here skips the release of `{obj}` {noun} "
+                    f"acquired at line {first.line} (released at line "
+                    f"{last_rel}, not in a finally)"))
+                break
+    index._ires_findings = out
+    return out
+
+
+def _emit(index, variant: str, rule: str, want_tracker: bool):
+    for v, kind, info, line, obj, msg in _findings(index):
+        if v != variant or (kind == "tracker") != want_tracker:
+            continue
+        abbr = rule.rsplit("/", 1)[-1][:3]
+        yield Violation(rule, info.rel, line, msg,
+                        f"{abbr}:{info.qualname}:{_fp_obj(obj)}")
+
+
+@project_rule("ires/leak-on-raise")
+def check_leak_on_raise(index):
+    yield from _emit(index, "raise", "ires/leak-on-raise", False)
+
+
+@project_rule("ires/leak-on-early-return")
+def check_leak_on_early_return(index):
+    yield from _emit(index, "early-return", "ires/leak-on-early-return",
+                     False)
+
+
+@project_rule("ires/double-release")
+def check_double_release(index):
+    yield from _emit(index, "double", "ires/double-release", False)
+
+
+@project_rule("ires/unbalanced-tracker")
+def check_unbalanced_tracker(index):
+    """MemTracker debits get one rule for every variant: any path that
+    net-debits the tracker (leaks the charge) or net-credits it
+    (double release) skews the HBM/memstore budget silently."""
+    for v, kind, info, line, obj, msg in _findings(index):
+        if kind != "tracker":
+            continue
+        yield Violation("ires/unbalanced-tracker", info.rel, line, msg,
+                        f"ubt:{info.qualname}:{_fp_obj(obj)}")
+
+
+# -- witness cross-check ------------------------------------------------------
+
+def static_resource_facts(index) -> list:
+    """Every protocol site the static pass models, as (qualname, kind,
+    verb, obj) — the denominator for the witness-check report."""
+    facts = []
+    for info in index.functions.values():
+        for s in info.resources:
+            facts.append((info.qualname, s.kind, s.verb, _fp_obj(s.obj)))
+    return facts
+
+
+def resource_contradictions(index, dump: dict) -> list[str]:
+    """Human-readable contradictions between a resource-witness dump
+    (utils/resources.py) and the static clean bill.  Two shapes:
+
+    - a pin still outstanding at dump time: the tree is statically
+      leak-free, so any runtime leak contradicts the pass — attributed
+      to its acquire site and thread;
+    - a lock observed held across a blocking call on a (class, kind)
+      pair the static pass does NOT know as a hold site (known sites
+      are either findings to fix or carry a justified suppression; an
+      unknown one means the static pass missed a path).
+    """
+    from yugabyte_db_tpu.analysis import iholds
+
+    out = []
+    for leak in dump.get("leaks", ()):
+        out.append(
+            f"leaked pin `{leak.get('key')}`: acquired at "
+            f"{leak.get('site', '?')} on thread "
+            f"{leak.get('thread', '?')}, never released")
+    sanctioned = iholds.static_hold_facts(index)
+    sanctioned_pairs = {(cls, kind) for cls, kind, _ in sanctioned}
+    for obs in dump.get("holds", ()):
+        pair = (obs.get("cls"), obs.get("blocking"))
+        if pair not in sanctioned_pairs:
+            out.append(
+                f"lock `{pair[0]}` held across `{pair[1]}` "
+                f"{int(obs.get('count', 0))} time(s) (e.g. "
+                f"{obs.get('site', '?')}) — no static hold site sanctions "
+                f"this pair")
+    return out

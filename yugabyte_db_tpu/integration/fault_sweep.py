@@ -39,7 +39,9 @@ Invariants after every round (each returns a list of error strings):
    and the host (CPU) serve path return byte-identical rows; the
    breaker must be recovered (``yb_engine_degraded == 0``) first.
 3. **No leaked residency pins** — ``hbm_cache().pinned_bytes() == 0``
-   once no scan is in flight.
+   once no scan is in flight. With the resource witness live
+   (``--resource-witness-out`` / ``--pin_witness``) a violation names
+   the acquire site and thread of every outstanding pin.
 4. **MemTracker baseline** — after evicting every unpinned entry the
    device subtree's consumption returns to the post-setup baseline
    (a leaked pin or unaccounted upload shows up here).
@@ -97,7 +99,8 @@ class FaultSweep:
                  schedule: tuple | None = None,
                  num_tservers: int = 3, num_tablets: int = 2,
                  keyspace: int = 48, witness_out: str | None = None,
-                 compile_witness_out: str | None = None):
+                 compile_witness_out: str | None = None,
+                 resource_witness_out: str | None = None):
         self.data_root = data_root
         self.seed = seed
         self.rounds = len(schedule) if schedule is not None else rounds
@@ -129,6 +132,11 @@ class FaultSweep:
         # Same contract for the compile witness (utils/jitting.py):
         # per-entry XLA compile counts, honoring --compile_witness.
         self.compile_witness_out = compile_witness_out
+        # And for the resource witness (utils/resources.py): pin
+        # acquire/release attribution + holds-across-blocking, honoring
+        # --pin_witness. With the witness live, the no-leaked-pins
+        # invariant names the exact acquire site of every leak.
+        self.resource_witness_out = resource_witness_out
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -169,7 +177,7 @@ class FaultSweep:
             self.mc = None
 
     def run(self) -> dict:
-        from yugabyte_db_tpu.utils import jitting, locking
+        from yugabyte_db_tpu.utils import jitting, locking, resources
 
         # Enable BEFORE setup so every lock the cluster creates is
         # ownership-tracked from birth.
@@ -183,6 +191,12 @@ class FaultSweep:
             FLAGS.get("compile_witness"))
         if cwit:
             jitting.enable_compile_witness()
+        # And before setup for the resource witness: the pre-fill pins
+        # and every guard lock the cluster constructs must be owned.
+        rwit = self.resource_witness_out is not None or bool(
+            FLAGS.get("pin_witness"))
+        if rwit:
+            resources.enable_resource_witness()
         self.setup()
         try:
             for rnd in range(self.rounds):
@@ -213,6 +227,11 @@ class FaultSweep:
                 if self.compile_witness_out is not None:
                     jitting.dump_compile_witness(self.compile_witness_out)
                 jitting.disable_compile_witness()
+            if rwit:
+                if self.resource_witness_out is not None:
+                    resources.dump_resource_witness(
+                        self.resource_witness_out)
+                resources.disable_resource_witness()
 
     # -- one round -----------------------------------------------------------
 
@@ -449,8 +468,18 @@ class FaultSweep:
         pinned = hbm_cache().pinned_bytes()
         external = self._external_bytes()
         if pinned > external:
-            return [f"leaked residency pins: {pinned} pinned bytes "
-                    f"({external} external)"]
+            msg = (f"leaked residency pins: {pinned} pinned bytes "
+                   f"({external} external)")
+            # With the resource witness live, name the culprits: the
+            # acquire site and thread of every pin still outstanding.
+            from yugabyte_db_tpu.utils import resources
+            if resources.resource_witness_enabled():
+                leaks = resources.witness().outstanding()
+                if leaks:
+                    msg += "".join(
+                        f"; {r['key']} acquired at {r['site']} "
+                        f"on {r['thread']}" for r in leaks)
+            return [msg]
         return []
 
     def _external_bytes(self) -> int:
@@ -491,13 +520,14 @@ def run_sweep(data_root: str, seed: int, rounds: int = 5,
 
 if __name__ == "__main__":  # replay a failing seed: python -m ... <seed>
     # With --witness-out PATH the replay records lock-witness
-    # observations, and with --compile-witness-out PATH per-jit-entry
-    # compile counts — both dumps feed yb-lint --witness-check.
+    # observations, with --compile-witness-out PATH per-jit-entry
+    # compile counts, and with --resource-witness-out PATH pin/hold
+    # attribution — all three dumps feed yb-lint --witness-check.
     import sys
     import tempfile
 
     argv = list(sys.argv[1:])
-    wout = cwout = None
+    wout = cwout = rwout = None
     if "--witness-out" in argv:
         i = argv.index("--witness-out")
         wout = argv[i + 1]
@@ -506,6 +536,11 @@ if __name__ == "__main__":  # replay a failing seed: python -m ... <seed>
         i = argv.index("--compile-witness-out")
         cwout = argv[i + 1]
         del argv[i:i + 2]
+    if "--resource-witness-out" in argv:
+        i = argv.index("--resource-witness-out")
+        rwout = argv[i + 1]
+        del argv[i:i + 2]
     with tempfile.TemporaryDirectory() as root:
         print(run_sweep(root, int(argv[0]) if argv else 1234,
-                        witness_out=wout, compile_witness_out=cwout))
+                        witness_out=wout, compile_witness_out=cwout,
+                        resource_witness_out=rwout))

@@ -648,6 +648,12 @@ class Master:
             with self._seq_lock:
                 base = self.catalog.sequences[p["name"]]
                 try:
+                    # Justified hold: the read of `base` must be atomic
+                    # with the alloc's position in the Raft log — two
+                    # racing nexts reading the same base would both hand
+                    # out [base, base+n). _seq_lock serializes only
+                    # sequence allocation, never the general catalog path.
+                    # yb-lint: disable=iholds/lock-across-blocking
                     self.raft.replicate("catalog", {
                         "op": "sequence_alloc", "name": p["name"],
                         "n": n}, timeout=self._op_deadline(p))

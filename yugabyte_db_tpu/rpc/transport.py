@@ -64,6 +64,9 @@ class SocketTransport(Transport):
         return p
 
     def send(self, dst: str, method: str, payload, timeout: float = 5.0):
+        from yugabyte_db_tpu.utils.resources import note_blocking
+
+        note_blocking("rpc")
         try:
             return self._proxy_for(dst).call(method, payload, timeout=timeout)
         except (ConnectionError, TimeoutError, OSError) as e:

@@ -52,6 +52,15 @@ HIGH_PRI_POOL_RATIO = 0.8
 _EXTERNAL = object()
 
 
+def _pin_witness():
+    """The resource witness when enabled, else None — every pin-count
+    transition below reports through this (utils/resources.py)."""
+    from yugabyte_db_tpu.utils import resources
+
+    w = resources.witness()
+    return w if w.enabled else None
+
+
 class _Entry:
     __slots__ = ("key", "label", "tracker", "owner_ref", "payload",
                  "nbytes", "aux", "aux_bytes", "pins", "pool", "external")
@@ -166,6 +175,9 @@ class HbmCache:
             e.payload = _EXTERNAL
             e.nbytes = int(nbytes)
             e.pins = 1
+            w = _pin_witness()
+            if w is not None:
+                w.pin_acquired(key, label=e.label, external=True)
             self._pools["high"][key] = e
             self._charge(e, e.nbytes)
         return key
@@ -224,6 +236,9 @@ class HbmCache:
                     self._move_pool(e, "high")
                 if pin:
                     e.pins += 1
+                    w = _pin_witness()
+                    if w is not None:
+                        w.pin_acquired(key, label=e.label)
                 hit = True
                 payload = e.payload
             else:
@@ -246,6 +261,9 @@ class HbmCache:
                 return
             if e.pins > 0:
                 e.pins -= 1
+                w = _pin_witness()
+                if w is not None:
+                    w.pin_released(key)
             # Unpinning may unlock deferred evictions.
             b = self.budget()
             if b and self._resident > b:
@@ -306,6 +324,9 @@ class HbmCache:
         self._pools[e.pool][e.key] = e
         if pin:
             e.pins += 1
+            w = _pin_witness()
+            if w is not None:
+                w.pin_acquired(e.key, label=e.label)
         self._charge(e, e.nbytes)
         self._m_upload.increment(e.nbytes)
         if b:
@@ -355,6 +376,11 @@ class HbmCache:
 
     def _release_entry(self, e: _Entry, evicted: bool) -> None:
         total = e.total_bytes
+        w = _pin_witness()
+        if w is not None:
+            # Entry teardown retires every pin on the key at once
+            # (invalidate / owner collected) — balanced, not a leak.
+            w.pins_cleared(e.key)
         self._pools[e.pool].pop(e.key, None)
         e.payload = None
         e.aux = {}

@@ -443,6 +443,12 @@ class TpuStorageEngine(StorageEngine):
         except DEVICE_FAULT_TYPES as e:
             self.breaker.record_failure(e)
             return None
+        except BaseException as e:
+            # Any other raise still retires the half-open probe admitted by
+            # allow() above — leaking it wedges the breaker's probe slot so
+            # it could never close again. The error itself propagates.
+            self.breaker.record_failure(e)
+            raise
 
     def _device_flush_dispatch(self, rows, keys, staged, perm, kw_s,
                                new_group, gstarts, sizes, ranges, Bp,
@@ -1264,6 +1270,12 @@ class TpuStorageEngine(StorageEngine):
         except DEVICE_FAULT_TYPES as e:
             self.breaker.record_failure(e)
             return _HostServeBatch(self, specs, deadline)
+        except BaseException as e:
+            # A non-device raise (planning bug, expired deadline between
+            # rounds) must still retire the probe allow() admitted, or the
+            # breaker's half-open slot stays consumed forever.
+            self.breaker.record_failure(e)
+            raise
 
     def _scan_batch_async_device(self, specs: list[ScanSpec],
                                  deadline=None) -> "_AsyncBatch":

@@ -71,6 +71,10 @@ class RetryableRequests:
         with open(tmp, "wb") as f:
             f.write(codec.encode(d))
             f.flush()
+            # Justified hold: snapshot() runs under the tablet's flush
+            # barrier (write + maintenance locks) by contract — the WAL
+            # frontier may not advance past state that isn't durable yet.
+            # yb-lint: disable=iholds/lock-across-blocking
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
 

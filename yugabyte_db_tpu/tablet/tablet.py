@@ -354,8 +354,16 @@ class Tablet:
                 ]
                 self._last_index += 1
                 op_id = OpId(self._term, self._last_index)
+                # Justified hold (here and the sync below): the standalone
+                # (non-consensus) tablet is single-writer BY DESIGN —
+                # append order must match apply order into the engine, and
+                # flush() swaps the memtable under this same lock. The
+                # replicated path acks at commit with pipelined apply
+                # instead; this path serves tests and single-node tools.
+                # yb-lint: disable=iholds/lock-across-blocking
                 self.log.append(LogEntry(op_id, ht.value, "write",
                                          _encode_rows(stamped)))
+                # yb-lint: disable=iholds/lock-across-blocking
                 self.log.sync()  # group commit point (batching comes from callers)
                 self.engine.apply(stamped)
                 self._applied_index = op_id.index
@@ -474,7 +482,13 @@ class Tablet:
             if self.coordinator is not None:
                 self.coordinator.snapshot()
             self.meta.flushed_op_index = self._applied_index
+            # Justified hold (save + sync): the flush barrier — the replay
+            # frontier may only advance (and WAL segments drop) while no
+            # write can move the memtable out from under the captured
+            # snapshot. Flush is rare maintenance, not the serving path.
+            # yb-lint: disable=iholds/lock-across-blocking
             self.meta.save(self.meta_path)
+            # yb-lint: disable=iholds/lock-across-blocking
             self.log.sync()
             self.log.gc(self.meta.flushed_op_index + 1)
 

@@ -273,6 +273,11 @@ class TabletPeer:
             # Tracked in MVCC like a write: a pinned read below this
             # entry's ht must wait for the apply, or it would miss the
             # intents entirely (they'd land after its intent-gate check).
+            # Justified hold: conflict check and log position must be
+            # atomic — two conflicting transactions checked against the
+            # same intent table could otherwise both replicate. Same
+            # shape as the reference's intent-admission serialization.
+            # yb-lint: disable=iholds/lock-across-blocking
             return self.replicate_txn_op("intents", body, timeout,
                                          track_mvcc=True)
 

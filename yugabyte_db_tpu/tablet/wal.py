@@ -115,6 +115,12 @@ class Log:
         if self._file is not None:
             self._file.flush()
             if self.fsync:
+                # Justified hold: roll-over happens mid-append, so the old
+                # segment must be durable before the lock drops — a sync()
+                # racing past would only fsync the NEW file.
+                from yugabyte_db_tpu.utils.resources import note_blocking
+                note_blocking("fsync")
+                # yb-lint: disable=iholds/lock-across-blocking
                 os.fsync(self._file.fileno())
             self._file.close()
             self._file = None
@@ -164,14 +170,29 @@ class Log:
         # a wedged fsync surfaces as a flagged stall, not silence.
         with watchdog().watch("wal.sync", threshold_s=2.0):
             start = time.monotonic()
+            f = None
             with self._lock:
                 if self._file is None and self._buffer:
                     self._open_segment_locked(max(1, self.last_appended.index))
                 self._flush_buffer_locked()
-                if self._file is not None:
-                    self._file.flush()
-                    if self.fsync:
-                        os.fsync(self._file.fileno())
+                f = self._file
+                if f is not None:
+                    # flush() stays under the lock: BufferedWriter is not
+                    # thread-safe against a concurrent _flush_buffer_locked.
+                    f.flush()
+            if f is not None and self.fsync:
+                try:
+                    from yugabyte_db_tpu.utils.resources import note_blocking
+                    note_blocking("fsync")
+                    # fsync OUTSIDE the lock — the group-commit shape:
+                    # appenders keep buffering into the next group while
+                    # this one reaches disk.
+                    os.fsync(f.fileno())
+                except (ValueError, OSError):
+                    # A concurrent roll-over closed this segment after we
+                    # snapshotted it; _close_file_locked flushed AND fsynced
+                    # it before closing, so the group is durable anyway.
+                    pass
             observe_wal_sync_ms((time.monotonic() - start) * 1e3)
 
     # -- read / replay -----------------------------------------------------
@@ -237,6 +258,12 @@ class Log:
                         f.write(_HEADER.pack(len(payload),
                                              zlib.crc32(payload)) + payload)
                     f.flush()
+                    # Justified hold: divergence repair rewrites segments in
+                    # place; an append interleaving with the rewrite would
+                    # corrupt the log, so the whole repair stays locked.
+                    # This is the rare follower-conflict path, never the
+                    # steady-state write path.
+                    # yb-lint: disable=iholds/lock-across-blocking
                     os.fsync(f.fileno())
                 os.replace(tmp, path)
             else:

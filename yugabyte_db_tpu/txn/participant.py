@@ -92,6 +92,10 @@ class TransactionParticipant:
         with open(tmp, "wb") as f:
             f.write(codec.encode(d))
             f.flush()
+            # Justified hold: runs under the tablet's flush barrier (see
+            # docstring) — intents must be durable before the WAL frontier
+            # advances past the segments they replay from.
+            # yb-lint: disable=iholds/lock-across-blocking
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
 

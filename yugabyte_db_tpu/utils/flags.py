@@ -139,6 +139,14 @@ FLAGS.define("compile_witness", False,
              "contracts via python -m yugabyte_db_tpu.analysis "
              "--witness-check",
              ("advanced", "runtime", "hidden"))
+FLAGS.define("pin_witness", False,
+             "attribute every residency pin acquire/release to an owner "
+             "site and thread, record per-lock hold durations into "
+             "yb_lock_hold_seconds{cls}, and flag locks held across "
+             "blocking seams (utils/resources.py); dump is cross-checked "
+             "against yb-lint's static resource facts via python -m "
+             "yugabyte_db_tpu.analysis --witness-check",
+             ("advanced", "runtime", "hidden"))
 FLAGS.define("fault.seed", 0,
              "non-zero: seed the fault-injection RNG so probabilistic "
              "faults replay deterministically (the sweep harness sets "

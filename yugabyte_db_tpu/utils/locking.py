@@ -35,27 +35,51 @@ import threading
 
 _UNTRACKED = -1  # lock ownership not decidable (lock created pre-enable)
 
+# Flipped by utils.resources.enable_resource_witness(): when True,
+# _WitnessLock reports outermost acquire/release transitions to the
+# resource witness (hold durations + holds-across-blocking). A plain
+# module global so the disabled-path cost is one falsy check.
+_HOLD_TRACKING = False
+
+
+def set_hold_tracking(on: bool) -> None:
+    global _HOLD_TRACKING
+    _HOLD_TRACKING = on
+
+
+def _resource_witness():
+    from yugabyte_db_tpu.utils import resources
+
+    return resources.witness()
+
 
 class _WitnessLock:
     """Wraps a Lock/RLock to track per-thread ownership (re-entrant
     count) so the witness can ask "does the *writing* thread hold it?"
     — ``Lock.locked()`` only answers "does anyone?"."""
 
-    __slots__ = ("_inner", "_tls")
+    __slots__ = ("_inner", "_tls", "_cls")
 
-    def __init__(self, inner):
+    def __init__(self, inner, cls_name: str = ""):
         self._inner = inner
         self._tls = threading.local()
+        self._cls = cls_name
 
     def acquire(self, *args, **kwargs):
         got = self._inner.acquire(*args, **kwargs)
         if got:
-            self._tls.depth = getattr(self._tls, "depth", 0) + 1
+            depth = getattr(self._tls, "depth", 0) + 1
+            self._tls.depth = depth
+            if depth == 1 and _HOLD_TRACKING:
+                _resource_witness().lock_acquired(self)
         return got
 
     def release(self):
         self._inner.release()
-        self._tls.depth = getattr(self._tls, "depth", 1) - 1
+        depth = getattr(self._tls, "depth", 1) - 1
+        self._tls.depth = depth
+        if depth == 0 and _HOLD_TRACKING:
+            _resource_witness().lock_released(self)
 
     def __enter__(self):
         self.acquire()
@@ -94,6 +118,10 @@ class _WitnessLock:
             self._inner.release()
             inner_state = None
         self._tls.depth = 0
+        # A condition wait genuinely drops the lock: close this hold
+        # interval (the re-acquire after the wait opens a new one).
+        if depth > 0 and _HOLD_TRACKING:
+            _resource_witness().lock_released(self)
         return inner_state, depth
 
     def _acquire_restore(self, state):
@@ -104,6 +132,8 @@ class _WitnessLock:
         else:
             self._inner.acquire()
         self._tls.depth = depth
+        if depth > 0 and _HOLD_TRACKING:
+            _resource_witness().lock_acquired(self)
 
 
 def _ownership(lock) -> int:
@@ -269,13 +299,13 @@ def _instrument(cls) -> None:
 
     def __setattr__(self, name, value):
         w = _WITNESS
-        if w.enabled:
+        if w.enabled or _HOLD_TRACKING:
             klass = type(self)
             if name in klass.__guard_locks__ \
                     and not isinstance(value, _WitnessLock) \
                     and hasattr(value, "acquire"):
-                value = _WitnessLock(value)
-            else:
+                value = _WitnessLock(value, klass.__name__)
+            elif w.enabled:
                 lock_attr = klass.__guarded_by__.get(name)
                 if lock_attr is not None \
                         and getattr(self, "_gb_constructed", False):

@@ -364,6 +364,45 @@ def hbm_cache_entity() -> MetricEntity:
 _HOST_VERIFY_ENTITY: MetricEntity | None = None
 
 
+# -- resource-witness observability -------------------------------------------
+# Lock-hold duration bucket bounds (seconds): 1us .. ~4.2s, powers of 4.
+LOCK_HOLD_S_BUCKETS = tuple(1e-6 * (4 ** i) for i in range(12))
+
+_LOCK_HOLD_ENTITIES: dict[str, MetricEntity] = {}
+_RESOURCE_WITNESS_ENTITY: MetricEntity | None = None
+
+
+def observe_lock_hold_s(cls: str, seconds: float) -> None:
+    """Record one lock hold interval (acquire -> release by one thread)
+    into the per-owner-class histogram ``yb_lock_hold_seconds{cls=...}``
+    on the process registry. Fed by the resource witness
+    (utils/resources.py, ``--pin_witness``); the p99 of this series is
+    the iholds/ story told live — a lock held across fsync/RPC shows up
+    as a fat tail on its class. Never raises."""
+    try:
+        with _SERVE_LOCK:
+            ent = _LOCK_HOLD_ENTITIES.get(cls)
+            if ent is None:
+                ent = _PROCESS_REGISTRY.entity(cls=cls)
+                _LOCK_HOLD_ENTITIES[cls] = ent
+        ent.histogram("yb_lock_hold_seconds",
+                      buckets=LOCK_HOLD_S_BUCKETS).observe(seconds)
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("observe_lock_hold_s failed for %s", cls)
+
+
+def resource_witness_entity() -> MetricEntity:
+    """The process-registry entity carrying the resource-witness
+    counters (``yb_resource_pin_acquires`` / ``yb_resource_pin_releases``
+    / ``yb_resource_holds_across_blocking``) — process-wide, so the
+    series render on every daemon's /metrics scrape."""
+    global _RESOURCE_WITNESS_ENTITY
+    with _SERVE_LOCK:
+        if _RESOURCE_WITNESS_ENTITY is None:
+            _RESOURCE_WITNESS_ENTITY = _PROCESS_REGISTRY.entity()
+        return _RESOURCE_WITNESS_ENTITY
+
+
 # -- write-path observability --------------------------------------------------
 # WAL sync latency bucket bounds (milliseconds): 1/16 ms .. ~32 s.
 WAL_SYNC_MS_BUCKETS = tuple(0.0625 * (2 ** i) for i in range(20))

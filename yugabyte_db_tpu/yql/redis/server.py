@@ -266,6 +266,11 @@ class RedisServiceImpl:
         return None
 
     def _batch_get(self, keys: list[str], conn) -> bytes:
+        # Session state (auth, _cur.db for rkeys, MONITOR feeds) resolves
+        # under the lock; the storage fetch runs OUTSIDE it so other
+        # connections' commands aren't serialized behind this batch's
+        # RPC round-trips. Pipelined GETs are not atomic in Redis (that
+        # is MULTI), so interleaved writes between them are legal.
         with self._lock:
             err = self._enter(conn, "GET")
             if err is not None:
@@ -273,17 +278,22 @@ class RedisServiceImpl:
             if self._monitors:
                 for k in keys:
                     self._feed_monitors(conn, "GET", [k])
-            return b"".join(resp.bulk(v) for v in self._get_values(keys))
+            rkeys = [self._rk(k) for k in keys]
+        return b"".join(resp.bulk(v) for v in self._fetch_values(rkeys))
 
     def _get_values(self, keys: list[str]) -> list:
-        """Values of plain string keys (field "") in key order — the
-        native batch serving path when every hop is eligible (raw
-        stored payload bytes), session.get_many otherwise (str).
-        resp.bulk encodes bytes and str to IDENTICAL reply bytes: the
-        stored column payload is exactly the value's utf-8
-        surrogateescape encoding (tagcodec T_STR). Callers hold _lock
-        (self._cur.db feeds the storage rkey)."""
-        rkeys = [self._rk(k) for k in keys]
+        """Values of plain string keys (field "") in key order. Callers
+        hold _lock (self._cur.db feeds the storage rkey)."""
+        return self._fetch_values([self._rk(k) for k in keys])
+
+    def _fetch_values(self, rkeys: list[str]) -> list:
+        """Fetch resolved rkeys — the native batch serving path when
+        every hop is eligible (raw stored payload bytes),
+        session.get_many otherwise (str). resp.bulk encodes bytes and
+        str to IDENTICAL reply bytes: the stored column payload is
+        exactly the value's utf-8 surrogateescape encoding (tagcodec
+        T_STR). Needs no lock: rkeys are pre-resolved and the session
+        handles are immutable."""
         values = self._native_get_values(rkeys)
         if values is None:
             values = [False] * len(rkeys)
