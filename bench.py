@@ -42,12 +42,28 @@ import time
 #                             @compile_contract jit entry (utils/jitting)
 #   --compile-witness-out P   dump the compile witness to P for
 #                             yb-lint --witness-check
+#   --only a,b / --skip a,b   run only / all-but the named sections
+#                             (section names printed in the final JSON's
+#                             "sections" map). The cluster sections run
+#                             isolated in child interpreters on a full
+#                             run, so --only is also how the parent asks
+#                             a child for exactly one section.
 _ARGV = sys.argv[1:]
 COMPILE_WITNESS = "--compile_witness" in _ARGV
-CWITNESS_OUT = None
-if "--compile-witness-out" in _ARGV:
-    CWITNESS_OUT = _ARGV[_ARGV.index("--compile-witness-out") + 1]
-_POS = [a for a in _ARGV if not a.startswith("--") and a != CWITNESS_OUT]
+
+
+def _flag_value(flag):
+    return _ARGV[_ARGV.index(flag) + 1] if flag in _ARGV else None
+
+
+CWITNESS_OUT = _flag_value("--compile-witness-out")
+_ONLY_RAW = _flag_value("--only")
+_SKIP_RAW = _flag_value("--skip")
+ONLY = set(_ONLY_RAW.split(",")) if _ONLY_RAW else None
+SKIP = set(_SKIP_RAW.split(",")) if _SKIP_RAW else set()
+_FLAG_VALS = {v for v in (CWITNESS_OUT, _ONLY_RAW, _SKIP_RAW)
+              if v is not None}
+_POS = [a for a in _ARGV if not a.startswith("--") and a not in _FLAG_VALS]
 NUM_KEYS = int(_POS[0]) if _POS else 200_000
 TIMED_ITERS = 5
 
@@ -851,6 +867,12 @@ def bench_oversubscribed(schema, rows, max_ht, make_engine, S, parts=4,
         st = cache.stats()
         churn = st["misses"] - m0
         upload_mb = (st["demand_upload_bytes"] - u0) / 1e6
+        # Compressed-plane accounting: how much smaller each demand
+        # re-upload is than the plain format would have been
+        # (--tpu_plane_encoding). Ratio < 1.0 is budget headroom.
+        enc_b = sum(e.plane_stats()["encoded_bytes"] for e in engines)
+        log_b = sum(e.plane_stats()["logical_bytes"] for e in engines)
+        enc_ratio = round(enc_b / log_b, 3) if log_b else 1.0
     finally:
         FLAGS.set("tpu_hbm_budget_bytes", old_budget)
         for e in engines:
@@ -864,7 +886,117 @@ def bench_oversubscribed(schema, rows, max_ht, make_engine, S, parts=4,
             (versions * rounds / dt) / CPP_NODE_SCAN_ROWS_S, 2),
         "demand_reuploads": churn,
         "demand_upload_mb": round(upload_mb, 1),
+        "plane_encoded_ratio": enc_ratio,
         "latency_ms": round(dt * 1000 / (parts * rounds), 1),
+    }
+
+
+def bench_oversubscribed_friendly(make_engine, S, parts=4, rounds=3,
+                                  n=None):
+    """The oversubscription shape on dictionary/RLE-friendly columns
+    (low-cardinality strings, long int runs, small per-block deltas) —
+    the workloads compressed planes exist for. Measures the SAME budget
+    twice: --tpu_plane_encoding=auto (compressed re-uploads) then =off
+    (plain re-uploads), and reports the re-upload byte reduction."""
+    import random as _r
+
+    from yugabyte_db_tpu.models.datatypes import DataType
+    from yugabyte_db_tpu.models.partition import compute_hash_code
+    from yugabyte_db_tpu.models.schema import (
+        ColumnKind, ColumnSchema, Schema,
+    )
+    from yugabyte_db_tpu.storage.residency import hbm_cache
+    from yugabyte_db_tpu.storage.row_version import RowVersion
+    from yugabyte_db_tpu.utils.flags import FLAGS
+
+    n = n or max(NUM_KEYS // 2, 20_000)
+    schema = Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("city", DataType.STRING),
+        ColumnSchema("grp", DataType.INT32),
+        ColumnSchema("seq", DataType.INT32),
+    ], table_id="bench_enc")
+    cid = {c.name: c.col_id for c in schema.columns}
+    cities = [f"city{j:03d}" for j in range(64)]
+    rng = _r.Random(13)
+    rows = []
+    ht = 100
+    for i in range(n):
+        key = schema.encode_primary_key(
+            {"k": f"user{i:06d}", "r": i % 7},
+            compute_hash_code(schema, {"k": f"user{i:06d}"}))
+        ht += 1
+        rows.append(RowVersion(key, ht=ht, liveness=True, columns={
+            cid["city"]: rng.choice(cities),
+            cid["grp"]: (i // 4096) * 1_000_000,
+            cid["seq"]: i % 10_000,
+        }))
+
+    def spec():
+        return S.ScanSpec(
+            read_ht=ht + 1,
+            predicates=[S.Predicate("city", "<", "city032")],
+            aggregates=[S.AggSpec("count", None), S.AggSpec("sum", "grp"),
+                        S.AggSpec("max", "seq")])
+
+    cache = hbm_cache()
+    old_budget = FLAGS.get("tpu_hbm_budget_bytes")
+    old_enc = FLAGS.get("tpu_plane_encoding")
+    chunk = len(rows) // parts
+    engines = []
+    versions = 0
+    try:
+        for p in range(parts):
+            e = make_engine("tpu", schema, {"rows_per_block": 2048})
+            e.apply(rows[p * chunk:(p + 1) * chunk])
+            e.flush()
+            engines.append(e)
+            versions += sum(t.crun.num_versions for t in e.runs)
+        total_planes = sum(t._nbytes_hint()
+                           for e in engines for t in e.runs)
+        FLAGS.set("tpu_hbm_budget_bytes", max(total_planes // parts, 1))
+
+        def measure():
+            for e in engines:  # warmup (compiles + first uploads)
+                e.scan(spec())
+            u0 = cache.stats()["demand_upload_bytes"]
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                for e in engines:
+                    e.scan(spec())
+            dt = time.perf_counter() - t0
+            return cache.stats()["demand_upload_bytes"] - u0, dt
+
+        FLAGS.set("tpu_plane_encoding", "auto")
+        for e in engines:
+            for t in e.runs:
+                t._dev_nbytes_hint = None
+                t.invalidate_device()
+        up_enc, dt_enc = measure()
+        FLAGS.set("tpu_plane_encoding", "off")
+        for e in engines:
+            for t in e.runs:
+                t._dev_nbytes_hint = None
+                t.invalidate_device()
+        up_plain, dt_plain = measure()
+    finally:
+        FLAGS.set("tpu_hbm_budget_bytes", old_budget)
+        FLAGS.set("tpu_plane_encoding", old_enc)
+        for e in engines:
+            e.close()
+    return {
+        "metric": "oversubscribed_friendly_scan_rows_per_sec",
+        "value": round(versions * rounds / dt_enc, 1),
+        "unit": (f"rows/s ({parts} engines round-robin, dict/RLE-friendly "
+                 f"columns, budget = working set / {parts}, encoded)"),
+        "vs_baseline": round(
+            (versions * rounds / dt_enc) / CPP_NODE_SCAN_ROWS_S, 2),
+        "vs_plain_planes": round(dt_plain / dt_enc, 2),
+        "demand_upload_mb": round(up_enc / 1e6, 1),
+        "demand_upload_mb_plain": round(up_plain / 1e6, 1),
+        "reupload_reduction_x": round(up_plain / up_enc, 2)
+        if up_enc else None,
     }
 
 
@@ -1358,8 +1490,44 @@ def bench_compact(schema, rows, max_ht, make_engine):
     }
 
 
+def _section_subprocess(name, timeout_s=1800):
+    """Run one bench section isolated in a child interpreter (via
+    ``--only name``): a native crash — the known in-process MiniCluster
+    segfault under bench_cluster_write — costs that section its rc, not
+    the whole headline run. Returns (sub-metric dicts, rc)."""
+    import subprocess
+
+    cmd = [sys.executable, __file__, "--only", name, str(NUM_KEYS)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s)
+        rc, out = proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as e:
+        rc, out = -1, (e.stdout or "")
+    subs = []
+    for line in out.splitlines():
+        if not line.startswith("# "):
+            continue
+        try:
+            d = json.loads(line[2:])
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "metric" in d and \
+                d["metric"] != "jit_compiles_per_entry":
+            subs.append(d)
+    if not subs:
+        subs = [{"metric": name, "error": f"section subprocess rc={rc}"}]
+    return subs, rc
+
+
+# Sections that consume the shared engine pair bench_aggregate builds.
+_DEP_AGG = ("aggregate", "ycsb_e", "point_read", "multisource")
+# Sections that consume the shared (schema, rows) dataset.
+_NEED_ROWS = _DEP_AGG + ("oversubscribed", "write", "device_flush",
+                         "compact")
+
+
 def main():
-    from __graft_entry__ import _make_rows, _make_schema
     import yugabyte_db_tpu.storage.tpu_engine  # noqa: F401 registers 'tpu'
     from yugabyte_db_tpu import storage as S
     from yugabyte_db_tpu.storage import make_engine
@@ -1368,33 +1536,78 @@ def main():
         from yugabyte_db_tpu.utils import jitting
         jitting.enable_compile_witness()
 
-    schema = _make_schema()
-    rows, max_ht = _make_rows(schema, NUM_KEYS)
+    def want(name):
+        return (ONLY is None or name in ONLY) and name not in SKIP
+
+    sections = {}  # name -> rc (0 ok; >0 exception; <0 signal/timeout)
+    subs = []
+
+    def run(name, fn):
+        if not want(name):
+            return
+        try:
+            out = fn()
+            sections[name] = 0
+        except Exception as e:  # noqa: BLE001 — a section must not kill the run
+            sections[name] = 1
+            out = {"metric": name, "error": repr(e)}
+        subs.extend(out if isinstance(out, (list, tuple)) else [out])
+
+    # Cluster sections first (host-CPU-bound: they measure low after the
+    # TPU workloads' background threads/memory are resident). On a full
+    # run each one is isolated in a child interpreter; with --only we ARE
+    # the child (or the user asked for exactly this section): in-process.
+    for cname, cfn in (("cluster_write", bench_cluster_write),
+                       ("ycsb_a_cluster", bench_ycsb_a_cluster)):
+        if not want(cname):
+            continue
+        if ONLY is None:
+            csubs, rc = _section_subprocess(cname)
+            sections[cname] = rc
+            subs.extend(csubs)
+        else:
+            run(cname, cfn)
+
+    schema = rows = max_ht = None
+    if any(want(n) for n in _NEED_ROWS):
+        from __graft_entry__ import _make_rows, _make_schema
+
+        schema = _make_schema()
+        rows, max_ht = _make_rows(schema, NUM_KEYS)
+
+    tpu = cpu = headline = None
+    if any(want(n) for n in _DEP_AGG):
+        try:
+            tpu, cpu, versions, headline = bench_aggregate(
+                schema, rows, max_ht, make_engine, S)
+            sections["aggregate"] = 0
+        except Exception as e:  # noqa: BLE001 — dependents degrade, run continues
+            sections["aggregate"] = 1
+            subs.append({"metric": "aggregate", "error": repr(e)})
+    if tpu is not None:
+        run("ycsb_e", lambda: bench_ycsb_e(schema, tpu, cpu, max_ht, S))
+        run("point_read",
+            lambda: bench_point_reads(schema, tpu, cpu, max_ht, S))
+    run("ycsb_mix", lambda: bench_ycsb_mix(make_engine, S))
+    run("index", bench_index)
+    run("redis", bench_redis)
+    run("serving_path", bench_serving_path)
+    if tpu is not None:
+        run("multisource",
+            lambda: bench_multisource(schema, tpu, cpu, max_ht, S))
+    run("oversubscribed",
+        lambda: bench_oversubscribed(schema, rows, max_ht, make_engine, S))
+    run("oversubscribed_friendly",
+        lambda: bench_oversubscribed_friendly(make_engine, S))
+    run("kernel_scan", bench_kernel_scan)
+    run("tpch", lambda: bench_tpch(make_engine))
+    run("write", lambda: bench_write(schema, rows, make_engine))
+    run("device_flush",
+        lambda: bench_device_flush(schema, rows, make_engine))
+    run("compact", lambda: bench_compact(schema, rows, max_ht, make_engine))
 
     details = {}
-    # cluster write first: it is host-CPU-bound and measures low when run
-    # after the TPU workloads' background threads/memory are resident
-    cluster_write = bench_cluster_write()
-    ycsb_a_cluster = bench_ycsb_a_cluster()
-    tpu, cpu, versions, headline = bench_aggregate(
-        schema, rows, max_ht, make_engine, S)
-    for sub in (
-        bench_ycsb_e(schema, tpu, cpu, max_ht, S),
-        bench_point_reads(schema, tpu, cpu, max_ht, S),
-        *bench_ycsb_mix(make_engine, S),
-        *bench_index(),
-        *bench_redis(),
-        *bench_serving_path(),
-        bench_multisource(schema, tpu, cpu, max_ht, S),
-        bench_oversubscribed(schema, rows, max_ht, make_engine, S),
-        *bench_kernel_scan(),
-        *bench_tpch(make_engine),
-        bench_write(schema, rows, make_engine),
-        bench_device_flush(schema, rows, make_engine),
-        cluster_write,
-        ycsb_a_cluster,
-        bench_compact(schema, rows, max_ht, make_engine),
-    ):
+    for sub in subs:
         print("# " + json.dumps(sub))
         details[sub["metric"]] = {k: v for k, v in sub.items()
                                   if k != "metric"}
@@ -1409,12 +1622,19 @@ def main():
         from yugabyte_db_tpu.utils import jitting
         jitting.dump_compile_witness(CWITNESS_OUT)
 
-    headline["details"] = details
-    headline["baseline_note"] = (
-        "vs_baseline compares one chip against a calibrated C++-class "
-        "16-vCPU reference NODE (~29K scanned rows/s/vCPU, BASELINE.md); "
-        "vs_cpu_engine compares against the in-repo CPU oracle engine")
-    print(json.dumps(headline))
+    if headline is not None and want("aggregate"):
+        headline["details"] = details
+        headline["sections"] = sections
+        headline["baseline_note"] = (
+            "vs_baseline compares one chip against a calibrated C++-class "
+            "16-vCPU reference NODE (~29K scanned rows/s/vCPU, BASELINE.md); "
+            "vs_cpu_engine compares against the in-repo CPU oracle engine")
+        print(json.dumps(headline))
+    else:
+        # Partial run (--only/--skip without the headline section):
+        # still end with ONE machine-readable JSON line.
+        print(json.dumps({"metric": "bench_sections",
+                          "sections": sections, "details": details}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,339 @@
+"""Plane-encoding byte-identity tests: compressed runs vs the CPU oracle.
+
+The compressed plane encodings (--tpu_plane_encoding: dictionary for
+varlen, RLE/delta16/const for ints, bit-packed bools — ops/encodings.py)
+must be invisible to every reader: scans over encoded runs return the
+exact rows/aggregates the CPU engine computes, on every path — the
+code-promoted dictionary predicates, each per-column fallback branch
+(dict overflow, low run-length, wide deltas), tombstones, TTL expiry,
+same-batch write_id ties, and the eviction → demand-re-upload round
+trip under a starved HBM budget.
+
+Runs on the CPU JAX backend (conftest) — same kernels the TPU executes.
+"""
+
+import gc
+import random
+
+import pytest
+
+from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.models.partition import compute_hash_code
+from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema, Schema
+from yugabyte_db_tpu.ops import encodings
+from yugabyte_db_tpu.storage import (
+    AggSpec, Predicate, RowVersion, ScanSpec, make_engine,
+)
+from yugabyte_db_tpu.storage.residency import hbm_cache
+from yugabyte_db_tpu.storage.row_version import MAX_HT
+from yugabyte_db_tpu.storage.tpu_engine import TpuStorageEngine
+from yugabyte_db_tpu.utils.flags import FLAGS
+
+CITIES = ["austin", "boston", "chicago", "denver", "el paso",
+          "fresno", "helena", "juneau"]
+
+
+def make_schema():
+    return Schema([
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("r", DataType.INT64, ColumnKind.RANGE),
+        ColumnSchema("city", DataType.STRING),
+        ColumnSchema("grp", DataType.INT32),     # long wide-delta runs -> rle
+        ColumnSchema("seq", DataType.INT32),     # small spans -> delta16
+        ColumnSchema("konst", DataType.INT32),   # one value -> const
+        ColumnSchema("wild", DataType.INT32),    # full-range -> plain
+    ], table_id="t")
+
+
+def enc_key(schema, k, r):
+    return schema.encode_primary_key(
+        {"k": k, "r": r}, compute_hash_code(schema, {"k": k}))
+
+
+def ids(schema):
+    return {c.name: c.col_id for c in schema.value_columns}
+
+
+def both_engines(opts=None):
+    schema = make_schema()
+    return (schema,
+            make_engine("cpu", schema, dict(opts or {})),
+            make_engine("tpu", schema, dict(opts or {}, rows_per_block=64)))
+
+
+def apply_both(cpu, tpu, rows):
+    cpu.apply(rows)
+    tpu.apply(rows)
+
+
+def assert_same_scan(cpu, tpu, spec_kwargs):
+    a = cpu.scan(ScanSpec(**spec_kwargs))
+    b = tpu.scan(ScanSpec(**spec_kwargs))
+    assert a.columns == b.columns
+    assert a.rows == b.rows, f"spec={spec_kwargs}"
+    assert (a.resume_key is None) == (b.resume_key is None)
+    return a, b
+
+
+def load_encoding_friendly(schema, cpu, tpu, n=400, seed=11):
+    """A workload each int column of which targets one encoding branch
+    and whose string column is low-cardinality (dictionary bait)."""
+    rnd = random.Random(seed)
+    cids = ids(schema)
+    ht = 0
+    for i in range(n):
+        ht += rnd.randrange(1, 3)
+        key = enc_key(schema, rnd.choice("pq"), i)
+        roll = rnd.random()
+        # Sparse tombstones: each one zeroes its row's cmp planes, which
+        # splits value runs — keep few enough per 64-row block that the
+        # rle bait column stays under the run-count cap.
+        if roll < 0.03:
+            apply_both(cpu, tpu, [RowVersion(key, ht=ht, tombstone=True)])
+            continue
+        apply_both(cpu, tpu, [RowVersion(
+            key, ht=ht, liveness=True,
+            # grp: long runs with million-wide steps — delta16's per-block
+            # span cap rules it out, so run-length encoding must win.
+            columns={cids["city"]: rnd.choice(CITIES + [None]),
+                     cids["grp"]: (i // 96) * 1_000_000,
+                     cids["seq"]: 3 * i,
+                     cids["konst"]: 7,
+                     cids["wild"]: rnd.randrange(-2**31, 2**31 - 1)},
+            expire_ht=ht + rnd.randrange(5, 300)
+            if rnd.random() < 0.12 else MAX_HT)])
+    return ht
+
+
+@pytest.fixture
+def encoding_flag():
+    old = FLAGS.get("tpu_plane_encoding")
+    yield lambda v: FLAGS.set("tpu_plane_encoding", v)
+    FLAGS.set("tpu_plane_encoding", old)
+
+
+@pytest.fixture
+def budget_flag():
+    gc.collect()
+    hbm_cache().evict_unpinned()
+    old = FLAGS.get("tpu_hbm_budget_bytes")
+    yield lambda v: FLAGS.set("tpu_hbm_budget_bytes", int(v))
+    FLAGS.set("tpu_hbm_budget_bytes", old)
+    hbm_cache().evict_unpinned()
+
+
+def force_encoded(tpu):
+    """Build every run's encoded tree (what a device access does) and
+    return the merged by-encoding byte map."""
+    by = {}
+    for t in tpu.runs:
+        assert t.crun.encoded_arrays() is not None
+        for k, v in t.crun.enc_stats["by_encoding"].items():
+            by[k] = by.get(k, 0) + v
+    return by
+
+
+def test_each_encoding_branch_selected_and_identical():
+    """Every selection branch fires on its bait column — and none of
+    them changes a single scanned byte."""
+    schema, cpu, tpu = both_engines()
+    load_encoding_friendly(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    by = force_encoded(tpu)
+    # One branch per bait column; bool planes bit-pack; the full-range
+    # random column must have stayed plain (the no-win fallback).
+    for kind in ("dict", "rle", "delta16", "const", "bits", "plain"):
+        assert kind in by, f"expected a {kind} leaf, got {by}"
+    stats = tpu.runs[0].crun.enc_stats
+    assert stats["encoded_bytes"] < stats["logical_bytes"]
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT,
+        aggregates=[AggSpec("count", None), AggSpec("sum", "grp"),
+                    AggSpec("min", "wild"), AggSpec("max", "seq")]))
+
+
+def test_dict_code_promotion_byte_identity():
+    """Range/equality predicates on the dictionary column promote to
+    code compares (no host re-verify on the aggregate path) and agree
+    with the oracle on every operator — including literals absent from
+    the dictionary and out of its range."""
+    schema, cpu, tpu = both_engines()
+    load_encoding_friendly(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    promoted = []
+    orig = TpuStorageEngine._promote_code_preds
+
+    def spy(self, trun, preds):
+        out = orig(self, trun, preds)
+        if out is not None:
+            promoted.append(len(out))
+        return out
+
+    TpuStorageEngine._promote_code_preds = spy
+    try:
+        cases = [
+            [Predicate("city", "=", "denver")],
+            [Predicate("city", "=", "dallas")],      # absent literal
+            [Predicate("city", "!=", "austin")],
+            [Predicate("city", "<", "chicago")],
+            [Predicate("city", "<=", "chicago")],
+            [Predicate("city", ">", "fresno")],
+            [Predicate("city", ">=", "fresnn")],     # absent, mid-range
+            [Predicate("city", "<", "aaaa")],        # below the dict
+            [Predicate("city", ">", "zzzz")],        # above the dict
+        ]
+        for preds in cases:
+            assert_same_scan(cpu, tpu, dict(
+                read_ht=MAX_HT, predicates=preds,
+                aggregates=[AggSpec("count", None),
+                            AggSpec("sum", "grp")]))
+    finally:
+        TpuStorageEngine._promote_code_preds = orig
+    assert len(promoted) >= len(cases)
+
+
+def test_dict_overflow_falls_back_plain(monkeypatch):
+    """A varlen column whose cardinality exceeds the dictionary capacity
+    stays in plain prefix planes (per-column fallback) while the rest of
+    the run still encodes — and scans stay byte-identical."""
+    monkeypatch.setattr(encodings, "DICT_MAX_VALUES", 4)
+    schema, cpu, tpu = both_engines()
+    load_encoding_friendly(schema, cpu, tpu)  # 8 cities > 4 slots
+    cpu.flush(); tpu.flush()
+    force_encoded(tpu)
+    crun = tpu.runs[0].crun
+    assert not crun.enc_dicts, "overflowed dict must not be encoded"
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    # The string predicate now takes the superset + host-verify path.
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT, predicates=[Predicate("city", "=", "denver")],
+        aggregates=[AggSpec("count", None)]))
+
+
+def test_encoding_off_is_plain_and_identical(encoding_flag):
+    """--tpu_plane_encoding=off: no encoded tree is ever built and the
+    results match both the oracle and the encoded run's results."""
+    schema, cpu, tpu = both_engines()
+    load_encoding_friendly(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    a, _ = assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    encoding_flag("off")
+    for t in tpu.runs:
+        t.invalidate_device()
+        assert t.crun.encoded_arrays() is None
+    b, _ = assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert a.rows == b.rows
+
+
+def test_tombstones_ttl_write_id_ties():
+    """MVCC edge shapes over encoded planes: row tombstones shadowing
+    same-batch writes (write_id ties at one hybrid time), TTL expiry
+    straddling read points, and null-vs-absent dictionary codes."""
+    schema, cpu, tpu = both_engines()
+    cids = ids(schema)
+    base = 1000
+    for i in range(120):
+        key = enc_key(schema, "p", i)
+        # One batch, one ht: column write then a higher-write_id rewrite.
+        apply_both(cpu, tpu, [
+            RowVersion(key, ht=base, liveness=True, write_id=2 * i,
+                       columns={cids["city"]: CITIES[i % 5],
+                                cids["grp"]: i // 30}),
+            RowVersion(key, ht=base, write_id=2 * i + 1,
+                       columns={cids["city"]: CITIES[(i + 1) % 5]}),
+        ])
+    for i in range(0, 120, 3):  # delete every third key in a later batch
+        apply_both(cpu, tpu, [RowVersion(enc_key(schema, "p", i),
+                                         ht=base + 10, tombstone=True)])
+    for i in range(120, 180):   # TTL'd rows expiring at base+50
+        apply_both(cpu, tpu, [RowVersion(
+            enc_key(schema, "p", i), ht=base + 20, liveness=True,
+            columns={cids["city"]: None, cids["grp"]: 99},
+            expire_ht=base + 50)])
+    cpu.flush(); tpu.flush()
+    force_encoded(tpu)
+    for rp in (base, base + 10, base + 30, base + 60, MAX_HT):
+        assert_same_scan(cpu, tpu, dict(read_ht=rp))
+        assert_same_scan(cpu, tpu, dict(
+            read_ht=rp, predicates=[Predicate("city", ">=", "boston")],
+            aggregates=[AggSpec("count", None)]))
+
+
+def test_eviction_demand_reupload_round_trip(budget_flag):
+    """Evict under a 1/4 budget and demand re-upload: the re-upload is
+    the compressed tree (smaller than the budget that evicted the
+    seeded planes would imply) and scans stay identical before/after."""
+    schema, cpu, tpu = both_engines()
+    load_encoding_friendly(schema, cpu, tpu)
+    cpu.flush(); tpu.flush()
+    a, _ = assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    trun = tpu.runs[0]
+    resident = trun.dev.nbytes
+    budget_flag(max(resident // 4, 1))
+    hbm_cache().evict_unpinned()
+    dev = trun.dev  # demand re-upload through the starved cache
+    assert dev.encoded, "re-upload must be the compressed tree"
+    assert dev.nbytes < resident
+    b, _ = assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert a.rows == b.rows
+    assert_same_scan(cpu, tpu, dict(
+        read_ht=MAX_HT, predicates=[Predicate("city", "=", "chicago")],
+        aggregates=[AggSpec("count", None), AggSpec("sum", "seq")]))
+
+
+def test_compaction_emits_encoded_runs():
+    """Compacting two encoded runs produces a run that re-encodes (the
+    merge path feeds the same builder) and matches the oracle across
+    the history cutoff."""
+    schema, cpu, tpu = both_engines()
+    ht = load_encoding_friendly(schema, cpu, tpu, n=250, seed=21)
+    cpu.flush(); tpu.flush()
+    load_encoding_friendly(schema, cpu, tpu, n=250, seed=22)
+    cpu.flush(); tpu.flush()
+    cpu.compact(history_cutoff_ht=ht)
+    tpu.compact(history_cutoff_ht=ht)
+    assert cpu.stats()["num_runs"] == tpu.stats()["num_runs"] == 1
+    by = force_encoded(tpu)
+    assert "dict" in by
+    assert_same_scan(cpu, tpu, dict(read_ht=MAX_HT))
+    assert_same_scan(cpu, tpu, dict(read_ht=ht))
+
+
+@pytest.mark.slow
+def test_randomized_predicate_sweep_encoded():
+    """Randomized predicate sweep over encoded runs at many read
+    points — the long-tail shapes the targeted cases above don't pin."""
+    schema, cpu, tpu = both_engines(
+        {"memtable_flush_versions": 97, "compaction_trigger": 4})
+    rnd = random.Random(42)
+    cids = ids(schema)
+    ht = 0
+    read_points = []
+    for step in range(600):
+        ht += rnd.randrange(1, 3)
+        key = enc_key(schema, rnd.choice("abc"), rnd.randrange(80))
+        roll = rnd.random()
+        if roll < 0.1:
+            rv = RowVersion(key, ht=ht, tombstone=True)
+        else:
+            rv = RowVersion(
+                key, ht=ht, liveness=True,
+                columns={cids["city"]: rnd.choice(CITIES + [None]),
+                         cids["grp"]: rnd.randrange(4),
+                         cids["seq"]: step,
+                         cids["konst"]: 7,
+                         cids["wild"]: rnd.randrange(-10**9, 10**9)},
+                expire_ht=ht + rnd.randrange(3, 80)
+                if rnd.random() < 0.15 else MAX_HT)
+        apply_both(cpu, tpu, [rv])
+        if step % 60 == 0:
+            read_points.append(ht)
+    ops = ["=", "!=", "<", "<=", ">", ">="]
+    for rp in read_points + [ht, MAX_HT]:
+        assert_same_scan(cpu, tpu, dict(read_ht=rp))
+        assert_same_scan(cpu, tpu, dict(
+            read_ht=rp,
+            predicates=[Predicate("city", rnd.choice(ops),
+                                  rnd.choice(CITIES))],
+            aggregates=[AggSpec("count", None), AggSpec("sum", "grp")]))

@@ -1347,6 +1347,47 @@ def test_ijit_bucketed_shape_is_clean(tmp_path):
     assert not fired(res, "ijit/unstable-static-arg")
 
 
+def test_ijit_raw_dict_width_fires(tmp_path):
+    """A dictionary width taken straight off the data (the unique-value
+    count of a column) in a factory position: every distinct cardinality
+    compiles a new program."""
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py": """\
+            import numpy as np
+
+            from yugabyte_db_tpu.ops.kern import compiled_toy
+
+
+            def point_serve(req, arr):
+                fn = compiled_toy(len(np.unique(arr)))
+                return fn(arr)
+        """})
+    (v,) = fired(res, "ijit/shape-from-data")
+    assert "bucketing" in v.message
+
+
+def test_ijit_pow2_bucketed_dict_width_is_clean(tmp_path):
+    """The plane encoder's dictionary-width ladder (pow2_bucket) bounds
+    the compile-key space, so a bucketed cardinality is sanctioned —
+    the same standing as safe_window_blocks for window counts."""
+    res = lint(tmp_path, {
+        "yugabyte_db_tpu/ops/kern.py": IJIT_KERN,
+        "yugabyte_db_tpu/storage/serve.py": """\
+            import numpy as np
+
+            from yugabyte_db_tpu.ops.encodings import pow2_bucket
+            from yugabyte_db_tpu.ops.kern import compiled_toy
+
+
+            def point_serve(req, arr):
+                fn = compiled_toy(pow2_bucket(len(np.unique(arr)) + 1))
+                return fn(arr)
+        """})
+    assert not fired(res, "ijit/shape-from-data")
+    assert not fired(res, "ijit/unstable-static-arg")
+
+
 def test_ijit_cold_path_is_silent(tmp_path):
     """The identical call in a function no serve path reaches: compile
     cost off the hot path is startup cost, not a finding."""

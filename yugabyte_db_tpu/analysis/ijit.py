@@ -72,7 +72,12 @@ _HOT_ROOT_NAMES = frozenset({
 # sanctifies a data-derived size: the result is drawn from a bounded
 # bucket ladder, so the compile-key space stays bounded.
 _BUCKET_TOKENS = ("bucket", "pow2", "pad_to", "round_up")
-_BUCKET_NAMES = frozenset({"safe_window_blocks"})
+# Exact helper names sanctioned even when no token matches.
+# ``pow2_bucket`` (ops/encodings.py) is the dictionary-width ladder the
+# plane encoder draws capacities from — a dict capacity reaching a jit
+# static position through it is bounded by construction, same standing
+# as the window-count ladder.
+_BUCKET_NAMES = frozenset({"safe_window_blocks", "pow2_bucket"})
 
 # Parameters whose attributes are per-request state when read directly
 # in a static position.

@@ -306,6 +306,10 @@ def pred_literal_host(kind: str, value):
     transfer instead of queueing a tiny H2D copy per predicate."""
     if kind == "i32":
         return np.int32(int(value))
+    if kind == "code":
+        # Promoted string predicate: the engine already translated the
+        # value to an int32 dictionary-code bound.
+        return np.int32(int(value))
     if kind == "f32":
         return np.float32(value)
     if kind == "i64":

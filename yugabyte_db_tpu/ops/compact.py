@@ -22,6 +22,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from yugabyte_db_tpu.ops import encodings
 from yugabyte_db_tpu.ops.scan import I32_MAX, le2
 from yugabyte_db_tpu.utils.jitting import compile_contract
 
@@ -177,8 +178,15 @@ def resident_gc_mask(runs_planes, idx, new_group, cutoff_planes):
     pads = idx < 0
     safe = jnp.maximum(idx, 0)
 
+    def dec(r, leaf):
+        # Encoded resident planes (--tpu_plane_encoding) decode inline;
+        # tomb always carries block dims (bits or plain), giving the
+        # run's (B, R) for block-dimension-free leaves (const).
+        B, R = encodings.leaf_dims(r["tomb"])
+        return encodings.decode_leaf(leaf, B, R).reshape(-1)
+
     def take(name, fill):
-        cat = jnp.concatenate([r[name].reshape(-1) for r in runs_planes])
+        cat = jnp.concatenate([dec(r, r[name]) for r in runs_planes])
         return jnp.where(pads, jnp.asarray(fill, cat.dtype), cat[safe])
 
     s = {
@@ -193,7 +201,7 @@ def resident_gc_mask(runs_planes, idx, new_group, cutoff_planes):
     num_cols = len(runs_planes[0]["sets"])
     sets = []
     for c in range(num_cols):
-        cat = jnp.concatenate([r["sets"][c].reshape(-1)
+        cat = jnp.concatenate([dec(r, r["sets"][c])
                                for r in runs_planes])
         sets.append(jnp.where(pads, False, cat[safe]))
     s["set_"] = (jnp.stack(sets) if sets

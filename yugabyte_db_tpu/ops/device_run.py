@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from yugabyte_db_tpu.models.datatypes import DataType
+from yugabyte_db_tpu.ops import encodings
 
 if TYPE_CHECKING:  # type-only: ops never depends on storage at runtime
     from yugabyte_db_tpu.storage.columnar import ColumnarRun
@@ -49,6 +50,11 @@ def plane_nbytes(run: ColumnarRun, window_blocks: int) -> int:
     from host plane shapes without uploading — the eviction hint that
     lets the residency cache make room *before* a demand upload."""
     pb = padded_blocks(run.B, window_blocks)
+    # Compressed runs (--tpu_plane_encoding) upload their encoded tree;
+    # the budget must account those bytes, not the logical plane bytes.
+    tree = getattr(run, "encoded_arrays", lambda: None)()
+    if tree is not None:
+        return encodings.tree_padded_nbytes(tree, run.B, pb)
 
     def padded(arr) -> int:
         per_block = 1
@@ -79,6 +85,31 @@ class DeviceRun:
         pad = padded_blocks(run.B, window_blocks) - B
         self.B = B + pad
         self.device = device or jax.devices()[0]
+
+        # Compressed upload: the run's cached encoded tree (if the
+        # encoding flag is on) uploads leaf-by-leaf with the same block
+        # padding semantics; kernels decode windows of it inline.
+        tree = getattr(run, "encoded_arrays", lambda: None)()
+        self.encoded = tree is not None
+        if tree is not None:
+
+            def up_leaf(leaf, ones=False):
+                padded = encodings.pad_leaf(leaf, self.B, ones=ones)
+                k = encodings.leaf_kind(padded)
+                if k is None:
+                    return jax.device_put(padded, self.device)
+                return {k: {n: jax.device_put(a, self.device)
+                            for n, a in padded[k].items()}}
+
+            self.arrays = {"cols": {}}
+            for name in ("valid", "group_start", "tomb", "live",
+                         "ht_hi", "ht_lo", "exp_hi", "exp_lo"):
+                self.arrays[name] = up_leaf(
+                    tree[name], ones=(name == "group_start"))
+            for cid, col in tree["cols"].items():
+                self.arrays["cols"][cid] = {
+                    n: up_leaf(p) for n, p in col.items()}
+            return
 
         def pad_b(arr):
             if pad == 0:
@@ -129,6 +160,10 @@ class DeviceRun:
         self.B = int(arrays["valid"].shape[0])
         self.device = device or jax.devices()[0]
         self.arrays = arrays
+        # The device flush emits dict leaves for string columns when the
+        # encoding flag is on; everything else it scatters stays plain
+        # until the run is evicted and demand re-uploads compressed.
+        self.encoded = encodings.tree_encoded(arrays)
         return self
 
     @property

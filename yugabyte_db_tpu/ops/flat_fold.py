@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 
 from yugabyte_db_tpu.ops import agg_fold
+from yugabyte_db_tpu.ops import encodings
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import le2
 from yugabyte_db_tpu.utils.jitting import compile_contract
@@ -48,7 +49,8 @@ def supports(sig: dscan.ScanSig) -> bool:
     predicate kinds only (the callers' device-exact set)."""
     if not sig.flat or sig.R > MAX_R or sig.B > MAX_B:
         return False
-    if any(ps.kind not in ("i32", "i64", "f64") for ps in sig.preds):
+    if any(ps.kind not in ("i32", "i64", "f64", "code")
+           for ps in sig.preds):
         return False
     for ag in sig.aggs:
         if ag.fn not in ("count", "sum", "min", "max"):
@@ -83,6 +85,12 @@ def _eval_pred_flat(ps: dscan.PredSig, cmp, arith, lit):
     """Elementwise exact predicate over full planes (i32/i64/f64)."""
     if ps.kind == "i32":
         v = cmp[..., 0]
+        return {"=": v == lit, "!=": v != lit, "<": v < lit,
+                "<=": v <= lit, ">": v > lit, ">=": v >= lit}[ps.op]
+    if ps.kind == "code":
+        # Promoted string predicate: exact compare on the decoded
+        # dictionary-code plane (see ops.scan._eval_pred).
+        v = cmp[..., 2]
         return {"=": v == lit, "!=": v != lit, "<": v < lit,
                 "<=": v <= lit, ">": v > lit, ">=": v >= lit}[ps.op]
     hi, lo = cmp[..., 0], cmp[..., 1]
@@ -175,6 +183,9 @@ def compiled_flat_aggregate(sig: dscan.ScanSig):
 
     def fn(run, row_lo, row_hi, read_hi, read_lo, rexp_hi, rexp_lo,
            pred_lits):
+        # Encoded leaves decode here as transients fused into the one
+        # elementwise program — HBM holds only the compressed planes.
+        run = encodings.decode_run(run)
         valid = run["valid"]
         visible = valid & le2(run["ht_hi"], run["ht_lo"], read_hi, read_lo)
         expired = le2(run["exp_hi"], run["exp_lo"], rexp_hi, rexp_lo)

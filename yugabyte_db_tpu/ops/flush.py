@@ -79,14 +79,28 @@ def replay_flush(staged, perm, dst, gs, is_real, exp_hi_default,
         "cols": {},
     }
     for cid, col in staged["cols"].items():
-        P_ = col["cmp"].shape[-1]
         entry = {
             "set": scat(z_b, col["set"]).reshape(Bp, R),
             "isnull": scat(z_b, col["isnull"]).reshape(Bp, R),
-            "cmp": jnp.zeros((S, P_), jnp.int32)
-            .at[dst].set(col["cmp"][perm], mode="drop")
-            .reshape(Bp, R, P_),
         }
+        if "codes" in col:
+            # Dictionary-encoded string column (--tpu_plane_encoding):
+            # scatter the staged row CODES and emit the encoded dict
+            # leaf directly — the uncompressed prefix planes never
+            # materialize in HBM. Unfilled/pad rows get the absent code
+            # (last slot), which decodes to prefix planes (0, 0) —
+            # byte-identical to the plain format's zeroed rows.
+            absent = col["dhi"].shape[0] - 1
+            codes = jnp.full((S,), absent, col["codes"].dtype)
+            codes = codes.at[dst].set(col["codes"][perm], mode="drop")
+            entry["cmp"] = {"dict": {"codes": codes.reshape(Bp, R),
+                                     "dhi": col["dhi"],
+                                     "dlo": col["dlo"]}}
+        else:
+            P_ = col["cmp"].shape[-1]
+            entry["cmp"] = (jnp.zeros((S, P_), jnp.int32)
+                            .at[dst].set(col["cmp"][perm], mode="drop")
+                            .reshape(Bp, R, P_))
         if "arith" in col:
             entry["arith"] = scat(jnp.zeros((S,), jnp.float32),
                                   col["arith"]).reshape(Bp, R)
@@ -96,8 +110,11 @@ def replay_flush(staged, perm, dst, gs, is_real, exp_hi_default,
 
 def flush_plane_nbytes(Bp: int, R: int, schema) -> int:
     """Predicted HBM footprint of the replayed planes — the budget gate
-    the engine checks BEFORE staging an upload (must agree with
-    DeviceRun.nbytes / ops.device_run.plane_nbytes for the same run)."""
+    the engine checks BEFORE staging an upload. Deliberately the PLAIN
+    plane estimate even when --tpu_plane_encoding emits dict leaves:
+    the flush dictionary sizes aren't known before staging, and a
+    conservative upper bound only ever sends a borderline flush to the
+    host build (which then demand-uploads the compressed form)."""
     per_slot = 4 * 1 + 4 * 4  # valid/group_start/tomb/live + ht/exp
     for c in schema.value_columns:
         planes = 2 if c.dtype.device_planes == 2 else 1

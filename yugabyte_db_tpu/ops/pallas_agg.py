@@ -221,7 +221,15 @@ def compiled_flat_aggregate(B: int, R: int, aggs: tuple, preds: tuple,
 def gather_tensors(dev_arrays, col_order):
     """The plane arrays in kernel ref order. Bool planes ship as int32:
     v5e mosaic restricts sub-32-bit compares and int8 tiles need 32
-    sublanes (the block here has 8)."""
+    sublanes (the block here has 8). Compressed runs
+    (--tpu_plane_encoding) materialize decoded planes here: the pallas
+    refs are raw tiled arrays, so the decoded tensors live as a cached
+    side-car on the run's residency entry instead of decoding in-kernel."""
+    from yugabyte_db_tpu.ops import encodings
+
+    if encodings.tree_encoded(dev_arrays):
+        dev_arrays = jax.jit(encodings.decode_run)(dev_arrays)
+
     def b2i(a):
         return a.astype(jnp.int32)
 

@@ -109,7 +109,7 @@ def _unpack_literals(sig: GatherSig, iparams, fparams):
         if ps.kind == "f32":
             lits.append(fparams[foff])
             foff += 1
-        elif ps.kind == "i32":
+        elif ps.kind in ("i32", "code"):
             lits.append(iparams[off])
             off += 1
         else:
@@ -127,7 +127,9 @@ def _window_parts(sig, r, base, m):
         cid = oc.col_id
         idx = r["col_idx"][cid]
         notnull = r["col_notnull"][cid]
-        cmp = r["cmp_w"][cid]
+        # Slice to the layout's plane count: dictionary-encoded string
+        # columns decode a third (code) plane the output never carries.
+        cmp = r["cmp_w"][cid][:, :oc.planes]
         parts.append(cmp if sig.flat else cmp[idx])
         parts.append((~notnull).astype(jnp.int32)[:, None])
         if oc.want_idx:

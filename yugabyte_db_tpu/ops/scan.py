@@ -34,6 +34,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
+from yugabyte_db_tpu.ops import encodings
 from yugabyte_db_tpu.utils.jitting import compile_contract
 
 I32_MIN = np.int32(np.iinfo(np.int32).min)
@@ -155,14 +156,20 @@ def resolve_window(sig, run, b0, row_lo, row_hi,
     """
     K, R = sig.K, sig.R
     N = K * R
-    valid = _window(run["valid"], b0, K)
-    group_start = _window(run["group_start"], b0, K)
-    tomb = _window(run["tomb"], b0, K)
-    live = _window(run["live"], b0, K)
-    ht_hi = _window(run["ht_hi"], b0, K)
-    ht_lo = _window(run["ht_lo"], b0, K)
-    exp_hi = _window(run["exp_hi"], b0, K)
-    exp_lo = _window(run["exp_lo"], b0, K)
+
+    def wp(leaf):
+        # Encoded leaves (ops.encodings) decode inline per window; plain
+        # ndarrays take the dynamic-slice path _window always used.
+        return encodings.wplane(leaf, b0, K, R)
+
+    valid = wp(run["valid"])
+    group_start = wp(run["group_start"])
+    tomb = wp(run["tomb"])
+    live = wp(run["live"])
+    ht_hi = wp(run["ht_hi"])
+    ht_lo = wp(run["ht_lo"])
+    exp_hi = wp(run["exp_hi"])
+    exp_lo = wp(run["exp_lo"])
 
     ridx = jnp.arange(N, dtype=jnp.int32)
 
@@ -203,8 +210,8 @@ def resolve_window(sig, run, b0, row_lo, row_hi,
     arith_w = {}
     for cs in sig.cols:
         c = run["cols"][cs.col_id]
-        set_c = _window(c["set"], b0, K)
-        null_c = _window(c["isnull"], b0, K)
+        set_c = wp(c["set"])
+        null_c = wp(c["isnull"])
         cand = alive & set_c
         first = _seg_min(jnp.where(cand, ridx, I32_MAX), gid, N)
         has = first != I32_MAX
@@ -214,9 +221,9 @@ def resolve_window(sig, run, b0, row_lo, row_hi,
         col_notnull[cs.col_id] = has & ~null_c[idx] & ~expired[idx]
         isnull_w[cs.col_id] = null_c
         set_w[cs.col_id] = set_c
-        cmp_w[cs.col_id] = _window(c["cmp"], b0, K)
+        cmp_w[cs.col_id] = wp(c["cmp"])
         if "arith" in c:
-            arith_w[cs.col_id] = _window(c["arith"], b0, K)
+            arith_w[cs.col_id] = wp(c["arith"])
 
     # 5. Row existence (liveness or any non-null column value).
     exists = live_exists
@@ -267,17 +274,21 @@ def _resolve_flat(sig, run, b0, row_lo, row_hi, pred_literals,
     col_notnull = {}
     cmp_w = {}
     arith_w = {}
+
+    def wp(leaf):
+        return encodings.wplane(leaf, b0, sig.K, sig.R)
+
     for cs in sig.cols:
         c = run["cols"][cs.col_id]
-        set_c = _window(c["set"], b0, sig.K)
-        null_c = _window(c["isnull"], b0, sig.K)
+        set_c = wp(c["set"])
+        null_c = wp(c["isnull"])
         has = alive & set_c
         col_idx[cs.col_id] = ridx
         col_has[cs.col_id] = has
         col_notnull[cs.col_id] = has & ~null_c & ~expired
-        cmp_w[cs.col_id] = _window(c["cmp"], b0, sig.K)
+        cmp_w[cs.col_id] = wp(c["cmp"])
         if "arith" in c:
-            arith_w[cs.col_id] = _window(c["arith"], b0, sig.K)
+            arith_w[cs.col_id] = wp(c["arith"])
 
     exists = live_exists
     for cs in sig.cols:
@@ -347,6 +358,15 @@ def _eval_pred(ps: PredSig, cmp, arith, idx, lit):
                 ">": v >= x, ">=": v >= x}[ps.op]
     if ps.kind == "i32":
         v = cmp[idx, 0]
+        x = lit
+        return {"=": v == x, "!=": v != x, "<": v < x, "<=": v <= x,
+                ">": v > x, ">=": v >= x}[ps.op]
+    if ps.kind == "code":
+        # Promoted string predicate on a dictionary-encoded column: the
+        # sorted dict makes code order == value order, so the host
+        # translated the literal to an int32 code bound and the compare
+        # is EXACT (no superset verify) on the decoded code plane.
+        v = cmp[idx, 2]
         x = lit
         return {"=": v == x, "!=": v != x, "<": v < x, "<=": v <= x,
                 ">": v > x, ">=": v >= x}[ps.op]

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from yugabyte_db_tpu.ops import encodings
 from yugabyte_db_tpu.ops import flat_fold
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.scan import I32_MIN, le2
@@ -41,7 +42,8 @@ from yugabyte_db_tpu.utils.jitting import compile_contract
 def supports(sig: dscan.ScanSig) -> bool:
     if sig.R > flat_fold.MAX_R or sig.B > flat_fold.MAX_B:
         return False
-    if any(ps.kind not in ("i32", "i64", "f64") for ps in sig.preds):
+    if any(ps.kind not in ("i32", "i64", "f64", "code")
+           for ps in sig.preds):
         return False
     for ag in sig.aggs:
         if ag.fn not in ("count", "sum", "min", "max"):
@@ -107,6 +109,7 @@ def compiled_seg_aggregate(sig: dscan.ScanSig):
 
     def fn(run, row_lo, row_hi, read_hi, read_lo, rexp_hi, rexp_lo,
            pred_lits):
+        run = encodings.decode_run(run)
         valid = run["valid"]
         gs = run["group_start"]
         ht_hi, ht_lo = run["ht_hi"], run["ht_lo"]

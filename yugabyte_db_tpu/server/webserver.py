@@ -62,6 +62,15 @@ def _memz() -> dict:
         out["hbm_cache"] = hbm_cache().stats()
     except ImportError:
         pass
+    try:
+        from yugabyte_db_tpu.utils.metrics import plane_stats_snapshot
+
+        # Compressed-plane accounting (--tpu_plane_encoding): per-tablet
+        # stored vs logical plane bytes, broken down by encoding kind —
+        # the host-side twin of hbm_cache's by_encoding residency split.
+        out["plane_encoding"] = plane_stats_snapshot()
+    except ImportError:
+        pass
     return out
 
 

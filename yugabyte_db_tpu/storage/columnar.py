@@ -110,6 +110,14 @@ class ColumnarRun:
 
         self._kv_cols: list[np.ndarray] | None = None
         self._kv_blocks_done: set[int] = set()
+        # Lazily-encoded compressed device plane tree (ops.encodings):
+        # (cache_key, tree) — recomputed when the encoding flag flips or
+        # alter_schema grows the column set. ``enc_dicts`` holds each
+        # dictionary-encoded column's sorted value list so the engine
+        # can translate string predicates to code-range compares.
+        self._enc_cache: tuple | None = None
+        self.enc_dicts: dict[int, list[bytes]] = {}
+        self.enc_stats: dict | None = None
         self.kv_ready = False  # True once every block's keys are decoded
         # Hashed-prefix bloom (storage.bloom): None = not built yet,
         # True = inapplicable (range-partitioned keys present).
@@ -481,6 +489,93 @@ class ColumnarRun:
             longest = max(map(len, raws))
             if longest > self.varlen_max_len.get(cid, 0):
                 self.varlen_max_len[cid] = longest
+
+    # -- compressed device planes (ops.encodings) ---------------------------
+    def encoded_arrays(self):
+        """The compressed device plane tree for this run, or None when
+        --tpu_plane_encoding=off (or the run is empty): upload the plain
+        planes instead. Encoded once per run and cached — demand
+        re-uploads after eviction reuse the same compressed tree."""
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        key = (FLAGS.get("tpu_plane_encoding"), len(self.cols))
+        if self._enc_cache is not None and self._enc_cache[0] == key:
+            return self._enc_cache[1]
+        tree = None
+        if key[0] != "off" and self.num_versions:
+            tree = self._encode_planes()
+        self._enc_cache = (key, tree)
+        return tree
+
+    def _encode_planes(self):
+        """One cheap stats pass per plane picks its encoding; every
+        fallback is per plane (a pathological column stays plain while
+        its neighbours compress)."""
+        from yugabyte_db_tpu.ops import encodings as enc
+
+        tree = {
+            "valid": enc.encode_bool_plane(self.valid),
+            "group_start": enc.encode_bool_plane(self.group_start),
+            "tomb": enc.encode_bool_plane(self.tomb),
+            "live": enc.encode_bool_plane(self.live),
+            "ht_hi": enc.encode_int_plane(self.ht_hi),
+            "ht_lo": enc.encode_int_plane(self.ht_lo),
+            "exp_hi": enc.encode_int_plane(self.exp_hi),
+            "exp_lo": enc.encode_int_plane(self.exp_lo),
+            "cols": {},
+        }
+        self.enc_dicts = {}
+        for cid, col in self.cols.items():
+            entry = {"set": enc.encode_bool_plane(col.set_),
+                     "isnull": enc.encode_bool_plane(col.isnull)}
+            cmp_leaf = None
+            if col.dtype in (DataType.STRING, DataType.BINARY):
+                cmp_leaf = self._encode_dict_col(cid, col)
+            if cmp_leaf is None:
+                cmp_leaf = enc.encode_int_plane(col.cmp_planes)
+            entry["cmp"] = cmp_leaf
+            if col.arith is not None and col.dtype in (
+                    DataType.FLOAT, DataType.DOUBLE):
+                # Float arith planes are the value itself and must
+                # upload; every other numeric kind aggregates exactly
+                # from the cmp planes on device, so its arith plane is
+                # redundant there and is simply omitted from the tree.
+                entry["arith"] = enc.encode_float_plane(col.arith)
+            tree["cols"][cid] = entry
+        self.enc_stats = enc.tree_stats(tree)
+        return tree
+
+    def _encode_dict_col(self, cid: int, col: ColumnData):
+        """Per-run sorted dictionary for a string/binary column, or None
+        (dict overflow / no set rows) — the caller falls back to the
+        prefix-plane int encodings. The dictionary is the sorted unique
+        FULL values, so codes order exactly as values do and the last
+        (absent) slot decodes the zero prefix planes unset/NULL rows
+        hold in the plain format."""
+        from yugabyte_db_tpu.ops import encodings as enc
+
+        if col.varlen is None:
+            return None
+        nn = col.set_ & ~col.isnull
+        bi, ri = np.nonzero(nn)
+        if bi.size == 0:
+            return None
+        raws = [_varlen_raw(col.varlen[b][r])
+                for b, r in zip(bi.tolist(), ri.tolist())]
+        uniq = sorted(set(raws))
+        if len(uniq) > enc.DICT_MAX_VALUES:
+            return None
+        cap = enc.pow2_bucket(len(uniq) + 1)
+        hi, lo = P.varlen_prefix_planes(uniq)
+        dhi = np.zeros(cap, np.int32)
+        dlo = np.zeros(cap, np.int32)
+        dhi[:len(uniq)] = hi
+        dlo[:len(uniq)] = lo
+        code_of = {v: i for i, v in enumerate(uniq)}
+        codes = np.full((self.B, self.R), cap - 1, np.int64)
+        codes[bi, ri] = [code_of[v] for v in raws]
+        self.enc_dicts[cid] = uniq
+        return enc.dict_leaf(codes, dhi, dlo)
 
     # -- host-side access (compaction input, materialization) -------------
     def iter_entries(self):

@@ -63,7 +63,8 @@ def _pin_witness():
 
 class _Entry:
     __slots__ = ("key", "label", "tracker", "owner_ref", "payload",
-                 "nbytes", "aux", "aux_bytes", "pins", "pool", "external")
+                 "nbytes", "aux", "aux_bytes", "pins", "pool", "external",
+                 "encoding")
 
     def __init__(self, key: int, label: str, tracker):
         self.key = key
@@ -77,6 +78,10 @@ class _Entry:
         self.pins = 0
         self.pool = "high"
         self.external = False
+        # Plane-format tag of the resident payload ("plain", "encoded",
+        # "external"); sampled duck-typed from the payload at admit so
+        # /memz can show which runs hold compressed bytes in HBM.
+        self.encoding = "plain"
 
     @property
     def total_bytes(self) -> int:
@@ -173,6 +178,7 @@ class HbmCache:
                 return key
             e.external = True
             e.payload = _EXTERNAL
+            e.encoding = "external"
             e.nbytes = int(nbytes)
             e.pins = 1
             w = _pin_witness()
@@ -317,6 +323,11 @@ class HbmCache:
             self._evict_until(max(b - int(hint), 0))
         payload, nbytes = build()
         e.payload = payload
+        # DeviceRun payloads carry .encoded (compressed plane tree vs
+        # plain planes under --tpu_plane_encoding); anything else —
+        # including a demand re-upload after eviction — defaults plain.
+        e.encoding = ("encoded" if getattr(payload, "encoded", False)
+                      else "plain")
         e.nbytes = int(nbytes)
         e.aux = {}
         e.aux_bytes = 0
@@ -390,6 +401,7 @@ class HbmCache:
         e.nbytes = 0
         e.aux_bytes = 0
         e.pins = 0
+        e.encoding = "plain"
         if evicted:
             self._m_evictions.increment()
             sync_point("hbm_cache:evict", e.label)
@@ -429,12 +441,20 @@ class HbmCache:
                 name: {"entries": len(pool),
                        "bytes": sum(e.total_bytes for e in pool.values())}
                 for name, pool in self._pools.items()}
+            by_enc: dict[str, dict] = {}
+            for pool in self._pools.values():
+                for e in pool.values():
+                    d = by_enc.setdefault(e.encoding,
+                                          {"entries": 0, "bytes": 0})
+                    d["entries"] += 1
+                    d["bytes"] += e.total_bytes
             out = {
                 "budget_bytes": self.budget(),
                 "resident_bytes": self._resident,
                 "peak_resident_bytes": self._peak_resident,
                 "registered": len(self._entries),
                 "pools": pools,
+                "by_encoding": by_enc,
             }
         out["pinned_bytes"] = self.pinned_bytes()
         out["hits"] = self._m_hits.get()

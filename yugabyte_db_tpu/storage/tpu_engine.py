@@ -42,6 +42,7 @@ import numpy as np
 from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.ops import agg_fold
+from yugabyte_db_tpu.ops import encodings
 from yugabyte_db_tpu.ops import scan as dscan
 from yugabyte_db_tpu.ops.device_run import (DeviceRun, dtype_kind,
                                             padded_blocks, plane_nbytes)
@@ -173,6 +174,23 @@ class _MaskedRun:
         self.dev = _MaskedRun._Dev(source.dev.B, arrays)
 
 
+class _CodePred:
+    """A string predicate promoted to a device-EXACT int32 compare
+    against a dictionary-encoded column's code plane
+    (--tpu_plane_encoding): the per-run dictionary is sorted, so the
+    engine bisects the literal into a code bound and the kernel compares
+    codes — no host verify round, unlike the prefix-plane superset path.
+    ``value`` is the already-translated int32 code bound; ``op`` is the
+    (possibly rewritten) code compare to apply."""
+
+    __slots__ = ("column", "op", "value")
+
+    def __init__(self, column: str, op: str, value: int):
+        self.column = column
+        self.op = op
+        self.value = value
+
+
 class _OverlayState:
     """Cached delta-overlay state (TpuStorageEngine._overlay): the
     masked primary, the key-sorted dirty rows with a parallel key list
@@ -257,6 +275,12 @@ class TpuStorageEngine(StorageEngine):
             crun = ColumnarRun.build(self.schema, entries, self.rows_per_block)
             self.runs.append(TpuRun(crun, self.device_tracker))
             self.flushed_frontier_ht = max(self.flushed_frontier_ht, crun.max_ht)
+        # Plane-encoding observability: yb_plane_bytes{encoding} /
+        # yb_plane_encoded_ratio sample plane_stats() at scrape time
+        # (weakly held — a dropped engine falls out of the series).
+        from yugabyte_db_tpu.utils.metrics import register_plane_stats
+
+        register_plane_stats(self)
 
     # -- writes ------------------------------------------------------------
     def apply(self, rows: list[RowVersion]) -> None:
@@ -276,6 +300,44 @@ class TpuStorageEngine(StorageEngine):
             self.flush()
             self.maybe_compact()
         self._track_memstore()
+
+    # -- plane-encoding introspection --------------------------------------
+    def plane_stats(self) -> dict:
+        """Per-tablet plane-encoding byte accounting for the
+        yb_plane_bytes{encoding} gauges and /memz: stored bytes per
+        encoding kind vs the logical (plain-format) bytes they replace,
+        over this engine's current run set. A run reports its encoded
+        stats only once something has actually built its encoded tree
+        (first device access under --tpu_plane_encoding=auto); until
+        then — and always with the flag off — it counts as plain, so
+        the ratio reflects bytes as stored, not a hypothetical."""
+        by: dict[str, int] = {}
+        logical = 0
+        for t in list(self.runs):
+            st = t.crun.enc_stats
+            if st is not None:
+                for k, v in st["by_encoding"].items():
+                    by[k] = by.get(k, 0) + int(v)
+                logical += int(st["logical_bytes"])
+            else:
+                nb = self._plain_run_nbytes(t.crun)
+                by["plain"] = by.get("plain", 0) + nb
+                logical += nb
+        return {"tablet": self.mem_tracker.name, "by_encoding": by,
+                "encoded_bytes": sum(by.values()),
+                "logical_bytes": logical}
+
+    @staticmethod
+    def _plain_run_nbytes(crun: ColumnarRun) -> int:
+        total = sum(a.nbytes for a in (
+            crun.valid, crun.group_start, crun.tomb, crun.live,
+            crun.ht_hi, crun.ht_lo, crun.exp_hi, crun.exp_lo))
+        for col in crun.cols.values():
+            total += col.set_.nbytes + col.isnull.nbytes
+            total += col.cmp_planes.nbytes
+            if col.arith is not None:
+                total += col.arith.nbytes
+        return total
 
     # -- lifecycle ---------------------------------------------------------
     def alter_schema(self, new_schema: Schema) -> None:
@@ -481,9 +543,16 @@ class TpuStorageEngine(StorageEngine):
             "tomb": staged.tomb[0], "live": staged.live[0],
             "cols": {},
         }
+        dict_cols = self._flush_dict_cols(staged, n)
         for cid, col in staged.cols.items():
-            entry = {"set": col.set_[0], "isnull": col.isnull[0],
-                     "cmp": col.cmp_planes[0]}
+            entry = {"set": col.set_[0], "isnull": col.isnull[0]}
+            if cid in dict_cols:
+                codes, dhi, dlo, _uniq = dict_cols[cid]
+                entry["codes"] = codes
+                entry["dhi"] = dhi
+                entry["dlo"] = dlo
+            else:
+                entry["cmp"] = col.cmp_planes[0]
             if col.arith is not None:
                 entry["arith"] = col.arith[0]
             staged_tree["cols"][cid] = entry
@@ -512,7 +581,18 @@ class TpuStorageEngine(StorageEngine):
             h = host["cols"][cid]
             col.set_ = h["set"][:B]
             col.isnull = h["isnull"][:B]
-            col.cmp_planes = h["cmp"][:B]
+            hc = h["cmp"]
+            if isinstance(hc, dict):
+                # Dict-encoded on device; the authoritative host planes
+                # decode the codes through the dictionary (numpy gather
+                # — byte-identical to what the device kernels decode).
+                e = hc["dict"]
+                codes = e["codes"][:B].astype(np.int64)
+                col.cmp_planes = np.ascontiguousarray(np.stack(
+                    [e["dhi"][codes], e["dlo"][codes]],
+                    axis=-1).astype(np.int32))
+            else:
+                col.cmp_planes = hc[:B]
             if col.arith is not None:
                 col.arith = h["arith"][:B]
 
@@ -557,6 +637,47 @@ class TpuStorageEngine(StorageEngine):
         self.breaker.record_success()
         count_flush_path("device")
         return run, trun
+
+    def _flush_dict_cols(self, staged, n: int):
+        """Per-column flush dictionaries (--tpu_plane_encoding): sorted
+        unique set non-null raw values of the staged op-log rows ->
+        {cid: (codes[m] u16, dhi, dlo, uniq)}. Built the same way
+        ColumnarRun._encode_dict_col builds them from run planes, so a
+        demand re-upload after eviction produces the SAME dictionary
+        (same codes) as the flush-seeded device form."""
+        from yugabyte_db_tpu.storage.columnar import _varlen_raw
+        from yugabyte_db_tpu.utils.flags import FLAGS
+
+        try:
+            if FLAGS.get("tpu_plane_encoding") == "off":
+                return {}
+        except KeyError:
+            return {}
+        out = {}
+        m = staged.R
+        for cid, col in staged.cols.items():
+            if col.varlen is None:
+                continue
+            nn = col.set_[0, :n] & ~col.isnull[0, :n]
+            idxs = np.nonzero(nn)[0]
+            if idxs.size == 0:
+                continue
+            src = col.varlen[0]
+            raws = [_varlen_raw(src[i]) for i in idxs.tolist()]
+            uniq = sorted(set(raws))
+            if len(uniq) > encodings.DICT_MAX_VALUES:
+                continue  # overflow: prefix planes, like the host encoder
+            cap = encodings.pow2_bucket(len(uniq) + 1)
+            code_of = {v: i for i, v in enumerate(uniq)}
+            codes = np.full(m, cap - 1, np.int64)
+            codes[idxs] = [code_of[v] for v in raws]
+            hi, lo = P.varlen_prefix_planes(uniq)
+            dhi = np.zeros(cap, np.int32)
+            dlo = np.zeros(cap, np.int32)
+            dhi[:len(uniq)] = hi
+            dlo[:len(uniq)] = lo
+            out[cid] = (codes.astype(np.uint16), dhi, dlo, uniq)
+        return out
 
     @staticmethod
     def _flush_sortkey(kw_part, ht_hi_part, ht_lo_part, wid):
@@ -1137,6 +1258,52 @@ class TpuStorageEngine(StorageEngine):
                 exact.append(p)
         return exact, superset, host_only
 
+    def _promote_code_preds(self, trun: TpuRun, preds):
+        """Translate superset string predicates into device-EXACT
+        dictionary-code predicates (_CodePred) against ``trun``'s
+        per-run sorted dictionaries, or None when any predicate can't
+        promote (encoding off, column not dictionary-encoded on this
+        run — overflow fallback — or a non-range operator).
+
+        The dictionary is the sorted unique set non-null values, so
+        order-preserving code translation is a bisect:
+        '<' v  -> code <  bisect_left,  '<=' v -> code <  bisect_right,
+        '>' v  -> code >= bisect_right, '>=' v -> code >= bisect_left;
+        '='/'!=' use the exact code, or -1 (matches/misses nothing set:
+        every eval site ANDs with the column's notnull mask). Promotion
+        requires the RESIDENT device form to be the encoded tree — a
+        device-flush-seeded run stays plain in HBM until evicted."""
+        dicts = getattr(trun.crun, "enc_dicts", None)
+        if not dicts or trun.crun.encoded_arrays() is None:
+            return None
+        out = []
+        for p in preds:
+            cid = self._name_to_id[p.column]
+            d = dicts.get(cid)
+            if d is None or p.op not in ("=", "!=", "<", "<=", ">", ">="):
+                return None
+            raw = (p.value.encode("utf-8", "surrogateescape")
+                   if isinstance(p.value, str) else bytes(p.value))
+            if p.op in ("=", "!="):
+                i = bisect.bisect_left(d, raw)
+                code = i if i < len(d) and d[i] == raw else -1
+                out.append(_CodePred(p.column, p.op, code))
+            elif p.op == "<":
+                out.append(_CodePred(p.column, "<",
+                                     bisect.bisect_left(d, raw)))
+            elif p.op == "<=":
+                out.append(_CodePred(p.column, "<",
+                                     bisect.bisect_right(d, raw)))
+            elif p.op == ">":
+                out.append(_CodePred(p.column, ">=",
+                                     bisect.bisect_right(d, raw)))
+            else:  # >=
+                out.append(_CodePred(p.column, ">=",
+                                     bisect.bisect_left(d, raw)))
+        if not trun.dev.encoded:
+            return None  # resident planes are the plain (seeded) form
+        return out
+
     def _aggs_device_eligible(self, spec: ScanSpec) -> bool:
         """Device aggregates need every aggregate column to be a numeric
         VALUE column (key columns live in the encoded key, not in planes;
@@ -1151,12 +1318,19 @@ class TpuStorageEngine(StorageEngine):
                 return False
         return True
 
+    def _pred_kind(self, p) -> str:
+        """Device plane kind a predicate compares against; promoted
+        dictionary-code predicates compare the int32 code plane."""
+        if isinstance(p, _CodePred):
+            return "code"
+        return self._kinds[self._name_to_id[p.column]]
+
     def _pred_sig_and_literals(self, preds, literal_fn=None):
         lit = _literal if literal_fn is None else literal_fn
         sigs, lits = [], []
         for p in preds:
             cid = self._name_to_id[p.column]
-            kind = self._kinds[cid]
+            kind = self._pred_kind(p)
             sigs.append(dscan.PredSig(cid, kind, p.op))
             lits.append(lit(kind, p.value))
         return tuple(sigs), tuple(lits)
@@ -1168,7 +1342,7 @@ class TpuStorageEngine(StorageEngine):
         the batched dispatch)."""
         return tuple(
             dscan.PredSig(self._name_to_id[p.column],
-                          self._kinds[self._name_to_id[p.column]], p.op)
+                          self._pred_kind(p), p.op)
             for p in preds)
 
     def _col_sigs(self):
@@ -1690,6 +1864,15 @@ class TpuStorageEngine(StorageEngine):
 
         if spec.is_aggregate:
             has_expr = any(a.expr is not None for a in spec.aggregates)
+            if single_source and runs and superset and not host_only:
+                # Dictionary-encoded string predicates promote to exact
+                # code-range compares: the aggregate stays a pure device
+                # fold instead of degrading to the gather+verify path.
+                promoted = self._promote_code_preds(runs[0], superset)
+                if promoted is not None:
+                    exact = exact + promoted
+                    superset = []
+                    pred_split = (exact, superset, host_only)
             if single_source and runs and not superset and not host_only \
                     and (spec.group_by or has_expr):
                 prep = self._grouped_prep(runs[0], spec, exact)
@@ -1916,8 +2099,10 @@ class TpuStorageEngine(StorageEngine):
         """Predicate literals -> (int32 plane list, f32 list), host values."""
         int_lits, f32_lits = [], []
         for p in preds:
-            kind = self._kinds[self._name_to_id[p.column]]
-            if kind == "f32":
+            kind = self._pred_kind(p)
+            if kind == "code":
+                int_lits.append(int(p.value))
+            elif kind == "f32":
                 f32_lits.append(float(p.value))
             elif kind == "i32":
                 int_lits.append(int(p.value))
@@ -2495,6 +2680,20 @@ class TpuStorageEngine(StorageEngine):
         flat = valid.reshape(-1)
         return flat.at[idx].set(False, mode="drop").reshape(valid.shape)
 
+    @staticmethod
+    @compile_contract("scatter_invalid_bits", max_compiles=64)
+    @jax.jit
+    def _scatter_invalid_bits(bw, idx):
+        """Bit-packed valid plane (--tpu_plane_encoding): decode the
+        words and scatter-clear in ONE fused program — the masked
+        overlay substitutes a plain bool plane, which every kernel
+        accepts because decode dispatch is per-leaf."""
+        B, W = bw.shape
+        bits = (bw[:, :, None] >> jnp.arange(32, dtype=jnp.int32)) \
+            & jnp.int32(1)
+        flat = bits.astype(jnp.bool_).reshape(B * W * 32)
+        return flat.at[idx].set(False, mode="drop").reshape(B, W * 32)
+
     def _overlay(self, mem):
         """The cached delta-overlay state for the current engine content:
         (masked_primary, dirty rows, per-read-point partial cache).
@@ -2608,14 +2807,19 @@ class TpuStorageEngine(StorageEngine):
         """The primary's device arrays with ``idx`` rows scatter-cleared
         from the valid plane; the index vector pads to a _MASK_BUCKETS
         size so at most a handful of scatter programs ever compile."""
-        size = primary.dev.arrays["valid"].size
+        vleaf = primary.dev.arrays["valid"]
+        packed = encodings.leaf_kind(vleaf) == "bits"
+        size = (vleaf["bits"]["bw"].size * 32 if packed else vleaf.size)
         bucket = next((b for b in self._MASK_BUCKETS
                        if b >= idx.size), idx.size)
         # Pad with an out-of-range index; mode="drop" discards it.
         pidx = np.full(bucket, size, dtype=np.int32)
         pidx[:idx.size] = idx
-        masked_valid = TpuStorageEngine._scatter_invalid(
-            primary.dev.arrays["valid"], jnp.asarray(pidx))
+        masked_valid = (
+            TpuStorageEngine._scatter_invalid_bits(
+                vleaf["bits"]["bw"], jnp.asarray(pidx)) if packed
+            else TpuStorageEngine._scatter_invalid(
+                vleaf, jnp.asarray(pidx)))
         masked_arrays = dict(primary.dev.arrays, valid=masked_valid)
         return _MaskedRun(primary, masked_arrays)
 

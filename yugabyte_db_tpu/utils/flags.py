@@ -173,6 +173,16 @@ FLAGS.define("tpu_device_flush", True,
              "to the host path when the run exceeds the HBM residency "
              "budget or the device dispatch faults",
              ("evolving", "runtime"))
+FLAGS.define("tpu_plane_encoding", "auto",
+             "compressed device plane encodings for columnar runs: "
+             "'auto' picks per-column encodings (dictionary for varlen, "
+             "RLE/delta16/const for ints, bit-packed bools) at build "
+             "time via a cheap stats pass and the kernels read the "
+             "compressed planes directly; 'off' uploads uncompressed "
+             "planes (the pre-encoding format). Pathological columns "
+             "(dictionary overflow, low run-length) transparently fall "
+             "back to uncompressed per plane",
+             ("evolving", "runtime"))
 FLAGS.define("fault.raft_apply_stall", 0.0,
              "non-zero: the Raft apply stage stalls (committed entries "
              "stay unapplied) — used by the commit_ack_crash fault-sweep "

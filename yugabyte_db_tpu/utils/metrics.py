@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 
 
 class Counter:
@@ -472,6 +473,77 @@ def group_commit_percentile(p: float):
     h = _write_path_entity().histogram("yb_group_commit_batch_size",
                                        buckets=BATCH_SIZE_BUCKETS)
     return h.percentile(p)
+
+
+# -- plane-encoding observability ---------------------------------------------
+# Compressed-plane accounting (--tpu_plane_encoding): engines register
+# themselves as providers; the gauges below sample them at scrape time,
+# so a closed/collected engine silently drops out (weakrefs, no
+# unregister call needed). Label values cover every encoding leaf kind
+# the columnar encoder can emit plus "plain" for unencoded planes.
+PLANE_ENCODINGS = ("plain", "bits", "const", "delta16", "rle", "dict")
+
+_PLANE_LOCK = threading.Lock()
+_PLANE_PROVIDERS: dict[int, weakref.ref] = {}
+_PLANE_ENTITIES: dict[str, MetricEntity] = {}
+_PLANE_RATIO_ENTITY: MetricEntity | None = None
+
+
+def register_plane_stats(provider) -> None:
+    """Register an engine-like ``provider`` whose ``plane_stats()``
+    returns ``{"tablet": str, "by_encoding": {kind: bytes},
+    "encoded_bytes": int, "logical_bytes": int}`` for its current run
+    set. First registration lazily creates the process-registry series
+    ``yb_plane_bytes{encoding=...}`` (stored bytes per plane encoding)
+    and ``yb_plane_encoded_ratio`` (stored / logical across all
+    providers; 1.0 when nothing is encoded). Never raises."""
+    global _PLANE_RATIO_ENTITY
+    try:
+        with _PLANE_LOCK:
+            _PLANE_PROVIDERS[id(provider)] = weakref.ref(provider)
+            if _PLANE_RATIO_ENTITY is None:
+                for k in PLANE_ENCODINGS:
+                    ent = _PROCESS_REGISTRY.entity(encoding=k)
+                    _PLANE_ENTITIES[k] = ent
+                    ent.gauge("yb_plane_bytes",
+                              fn=lambda k=k: plane_stats_snapshot()
+                              ["by_encoding"].get(k, 0))
+                _PLANE_RATIO_ENTITY = _PROCESS_REGISTRY.entity()
+                _PLANE_RATIO_ENTITY.gauge(
+                    "yb_plane_encoded_ratio",
+                    fn=lambda: plane_stats_snapshot()["encoded_ratio"])
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("register_plane_stats failed")
+
+
+def plane_stats_snapshot() -> dict:
+    """Aggregate plane-encoding stats over every live provider:
+    ``{"tablets": [per-provider dicts], "by_encoding": {kind: bytes},
+    "encoded_bytes", "logical_bytes", "encoded_ratio"}``. The ratio is
+    stored-over-logical bytes (< 1.0 means compression is winning)."""
+    with _PLANE_LOCK:
+        refs = list(_PLANE_PROVIDERS.items())
+    tablets = []
+    by: dict[str, int] = {}
+    for pid, ref in refs:
+        p = ref()
+        if p is None:
+            with _PLANE_LOCK:
+                _PLANE_PROVIDERS.pop(pid, None)
+            continue
+        try:
+            st = p.plane_stats()
+        except Exception:  # noqa: BLE001 — scrape must not die
+            count_swallowed("metrics.plane_stats")
+            continue
+        tablets.append(st)
+        for k, v in st.get("by_encoding", {}).items():
+            by[k] = by.get(k, 0) + int(v)
+    encoded = sum(by.values())
+    logical = sum(int(t.get("logical_bytes", 0)) for t in tablets)
+    return {"tablets": tablets, "by_encoding": by,
+            "encoded_bytes": encoded, "logical_bytes": logical,
+            "encoded_ratio": (encoded / logical) if logical else 1.0}
 
 
 def count_host_verify_rows(n: int) -> None:
