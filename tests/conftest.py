@@ -31,6 +31,25 @@ import numpy as np
 import pytest
 
 
+def pytest_collection_modifyitems(config, items):
+    """Capability probe for the mesh read path.
+
+    The shard_map API moved between jax generations (meshcompat.py holds
+    the seam); on an interpreter with NEITHER spelling the mesh rigs
+    cannot run at all.  Turn those into reasoned skips instead of 11
+    identical AttributeError failures, so tier-1 reports honest dots.
+    """
+    from yugabyte_db_tpu.parallel import meshcompat
+
+    reason = meshcompat.mesh_unavailable()
+    if reason is None:
+        return
+    skip = pytest.mark.skip(reason="mesh path unavailable: " + reason)
+    for item in items:
+        if "mesh" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

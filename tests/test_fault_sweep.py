@@ -13,6 +13,7 @@ import pytest
 
 from yugabyte_db_tpu.integration.fault_sweep import (ARMED_FLAG,
                                                      FAULT_CATALOG,
+                                                     HANDLER_FLAG,
                                                      FaultSweep, run_sweep)
 
 
@@ -24,7 +25,7 @@ def test_deterministic_schedule_covers_catalog():
     # Every armed fault point verifiably fired (the harness also
     # asserts this against yb_faults_fired internally).
     assert summary["faults_fired"] == {
-        name: 1 for name in ARMED_FLAG}
+        name: 1 for name in (*ARMED_FLAG, *HANDLER_FLAG)}
 
 
 @pytest.mark.slow

@@ -262,6 +262,7 @@ def test_live_missing_replica_repaired_without_failed_creates(tmp_path):
         c.shutdown()
 
 
+@pytest.mark.mesh
 def test_mesh_multi_tablet_aggregate(tmp_path):
     """Multi-tablet aggregates execute as ONE device program on the
     tserver's mesh (ts.multi_agg_scan -> parallel.sharded_aggregate with
@@ -307,5 +308,71 @@ def test_mesh_multi_tablet_aggregate(tmp_path):
         res3 = s.scan(table, ScanSpec(aggregates=[AggSpec("max", "s")]))
         assert res3.rows == [("val-99",)]
         assert ts.mesh_scan.fallbacks >= 1
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.mesh
+def test_mesh_multi_tablet_row_scan(tmp_path):
+    """Row scans over many tablets of one tserver ride the mesh as ONE
+    device program per page (ts.multi_row_scan ->
+    parallel.sharded_row_page), with LIMIT paging chained by the opaque
+    cross-tablet resume token; a flush replacing a tablet's run
+    invalidates the cached stack (in-place update or rebuild+close)
+    without leaking residency pins."""
+    c = MiniCluster(str(tmp_path), num_masters=1, num_tservers=1).start()
+    try:
+        c.wait_tservers_registered(1)
+        client = c.client()
+        table = client.create_table("meshrow", COLUMNS, num_tablets=4,
+                                    replication_factor=1, engine="tpu")
+        from yugabyte_db_tpu.client import YBSession
+        s = YBSession(client)
+        n = 240
+        for i in range(n):
+            s.insert(table, {"k": f"key{i}", "r": i, "v": i * 10,
+                             "s": f"val-{i}"})
+        assert s.flush() == n
+        ts = next(iter(c.tservers.values()))
+        for peer in ts.tablet_manager.peers():
+            peer.flush()
+        want = sorted((f"key{i}", i, i * 10, f"val-{i}")
+                      for i in range(n))
+
+        def mesh_served():
+            res = s.scan(table, ScanSpec())
+            assert sorted(res.rows) == want
+            return ts.mesh_scan.served_rows >= 1
+        wait_for(mesh_served, timeout=20.0, msg="rows riding the mesh")
+        # LIMIT + device-exact predicate through the mesh path.
+        res2 = s.scan(table, ScanSpec(
+            predicates=[Predicate("v", ">=", 1200)], limit=50))
+        assert len(res2.rows) == 50
+        assert all(r[2] >= 1200 for r in res2.rows)
+        # A flush replacing one tablet's run supersedes the cached
+        # stack; the next scan re-serves the NEW data on the mesh.
+        for i in range(n, n + 40):
+            s.insert(table, {"k": f"key{i}", "r": i, "v": i * 10,
+                             "s": f"val-{i}"})
+        s.flush()
+        for peer in ts.tablet_manager.peers():
+            peer.flush()
+            peer.compact()
+        want2 = sorted((f"key{i}", i, i * 10, f"val-{i}")
+                       for i in range(n + 40))
+
+        def mesh_served_again():
+            before = ts.mesh_scan.served_rows
+            res = s.scan(table, ScanSpec())
+            assert sorted(res.rows) == want2
+            return ts.mesh_scan.served_rows > before
+        wait_for(mesh_served_again, timeout=20.0,
+                 msg="post-flush rows riding the mesh")
+        # Stack cache bounded; superseded stacks released their pins.
+        from yugabyte_db_tpu.storage.residency import hbm_cache
+        assert len(ts.mesh_scan._stacks) <= ts.mesh_scan._max_cached
+        stats = hbm_cache().stats()
+        ext = stats["by_encoding"].get("external", {"entries": 0})
+        assert ext["entries"] <= ts.mesh_scan._max_cached + 4
     finally:
         c.shutdown()

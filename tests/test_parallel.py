@@ -27,6 +27,8 @@ from yugabyte_db_tpu.storage.columnar import ColumnarRun
 from yugabyte_db_tpu.storage.memtable import MemTable
 from yugabyte_db_tpu.storage.row_version import MAX_HT
 
+pytestmark = pytest.mark.mesh
+
 
 def make_schema():
     return Schema([
@@ -260,3 +262,199 @@ def test_sharded_row_pages_bounds_and_historical():
             want.extend(o.scan(ScanSpec(**kw)).rows)
         got = sharded_row_page(st, ScanSpec(limit=4096, **kw))
         assert sorted(got.rows) == sorted(want), rht
+
+
+def build_mvcc_tablets(seed, num_tablets=4, num_keys=240,
+                       rows_per_block=16):
+    """Multi-version histories with tombstones, TTL expiry and same-ht
+    write_id ties, with PER-TABLET oracles (row scans compare in tablet
+    order, unlike the union-oracle aggregate tests)."""
+    rng = random.Random(seed)
+    schema = make_schema()
+    mems = [MemTable() for _ in range(num_tablets)]
+    oracles = [make_engine("cpu", schema) for _ in range(num_tablets)]
+    cid = {c.name: c.col_id for c in schema.columns}
+    ht = 100
+    for i in range(num_keys):
+        key = enc(schema, f"user{i:05d}", rng.randrange(10))
+        t = i % num_tablets
+        for _ in range(rng.randrange(1, 4)):
+            ht += rng.randrange(1, 5)
+            roll = rng.random()
+            if roll < 0.08:
+                rv = RowVersion(key, ht=ht, tombstone=True)
+            elif roll < 0.16:
+                # TTL: some already expired at the read point, some not.
+                rv = RowVersion(key, ht=ht, liveness=True,
+                                expire_ht=ht + rng.randrange(1, 400),
+                                columns={cid["a"]: rng.randrange(10**9)})
+            elif roll < 0.24:
+                # Same-ht write_id tie: the later write_id wins.
+                rv = RowVersion(key, ht=ht, liveness=True, columns={
+                    cid["a"]: rng.randrange(10**9)})
+                mems[t].apply([rv])
+                oracles[t].apply([rv])
+                rv = RowVersion(key, ht=ht, write_id=1, columns={
+                    cid["a"]: rng.randrange(10**9)})
+            elif roll < 0.4:
+                rv = RowVersion(key, ht=ht, columns={
+                    cid["d"]: rng.randrange(-10**6, 10**6)})
+            else:
+                rv = RowVersion(key, ht=ht, liveness=True, columns={
+                    cid["a"]: rng.randrange(-10**12, 10**12),
+                    cid["c"]: rng.uniform(-1e6, 1e6),
+                    cid["d"]: rng.randrange(-10**6, 10**6),
+                })
+            mems[t].apply([rv])
+            oracles[t].apply([rv])
+    runs = [ColumnarRun.build(make_schema(), m.drain_sorted(),
+                              rows_per_block) for m in mems]
+    assert any(r.max_group_versions > 1 for r in runs)
+    return schema, runs, oracles, ht
+
+
+def _page_all(st, spec_kw, limit):
+    from yugabyte_db_tpu.parallel import sharded_row_page
+
+    got, token, pages = [], None, 0
+    while True:
+        res = sharded_row_page(st, ScanSpec(limit=limit, **spec_kw),
+                               resume=token)
+        got.extend(res.rows)
+        pages += 1
+        assert pages < 80
+        if res.resume_key is None:
+            return got, pages
+        token = res.resume_key
+
+
+def test_sharded_row_pages_mvcc(mesh):
+    """Row paging over MULTI-VERSION runs: on-device MVCC resolution
+    (visibility, tombstone shadowing, TTL, write_id ties) must match the
+    per-tablet CPU oracles at current and historical read points."""
+    schema, runs, oracles, max_ht = build_mvcc_tablets(seed=17)
+    st = ShardedTablets(schema, runs, mesh, window_blocks=2)
+    assert any(r.max_group_versions > 1 for r in st.runs)
+    for rht in (max_ht + 1, max_ht // 2 + 60):
+        spec_kw = dict(read_ht=rht, projection=["k", "r", "a", "d"])
+        want = []
+        for o in oracles:
+            want.extend(o.scan(ScanSpec(**spec_kw)).rows)
+        got, pages = _page_all(st, spec_kw, limit=64)
+        assert got == want, rht
+        assert pages > 1
+
+
+def test_sharded_row_pages_encoded_vs_plain(mesh):
+    """Encoded stacks (compressed device planes) serve byte-identical
+    pages to the uncompressed stack — including resume-token chains."""
+    schema, runs, oracles, max_ht = build_mvcc_tablets(seed=29)
+    st_enc = ShardedTablets(schema, runs, mesh, window_blocks=2,
+                            encode=True)
+    st_plain = ShardedTablets(schema, runs, mesh, window_blocks=2,
+                              encode=False)
+    assert st_enc.encoded and not st_plain.encoded
+    spec_kw = dict(read_ht=max_ht + 1, projection=["k", "r", "a", "c"])
+    got_e, _ = _page_all(st_enc, spec_kw, limit=96)
+    got_p, _ = _page_all(st_plain, spec_kw, limit=96)
+    assert got_e == got_p
+    want = []
+    for o in oracles:
+        want.extend(o.scan(ScanSpec(**spec_kw)).rows)
+    assert got_e == want
+
+
+def test_update_tablet_in_place(mesh):
+    """Single-tablet refresh: update_tablet rewrites one slot of the
+    stacked arrays on device (no rebuild), after which aggregates and
+    row pages serve the NEW run's data; per-device residency accounting
+    is unchanged (same shapes)."""
+    from yugabyte_db_tpu.parallel import sharded_row_page
+    from yugabyte_db_tpu.storage.residency import hbm_cache
+
+    schema, runs, oracles, max_ht = build_flat_world(seed=41,
+                                                     num_tablets=4,
+                                                     num_keys=200)
+    st = ShardedTablets(schema, runs, mesh, window_blocks=2,
+                        encode=False)
+    before = {d: v["resident_bytes"]
+              for d, v in hbm_cache().stats()["by_device"].items()}
+    # New data for tablet 2: rewrite every row's d to a sentinel value.
+    t = 2
+    mem = MemTable()
+    o2 = make_engine("cpu", schema)
+    cid = {c.name: c.col_id for c in schema.columns}
+    ht = max_ht
+    old = oracles[t].scan(ScanSpec(read_ht=max_ht + 1,
+                                   projection=["k", "r"]))
+    rng = random.Random(1)
+    for k, r in old.rows:
+        ht += 1
+        rv = RowVersion(enc(schema, k, r), ht=ht, liveness=True, columns={
+            cid["a"]: rng.randrange(10**9), cid["d"]: 777})
+        mem.apply([rv])
+        o2.apply([rv])
+    new_run = ColumnarRun.build(make_schema(), mem.drain_sorted(), 16)
+    assert st.update_tablet(t, new_run)
+    after = {d: v["resident_bytes"]
+             for d, v in hbm_cache().stats()["by_device"].items()}
+    assert after == before  # same shapes -> same per-device charge
+    spec_kw = dict(read_ht=ht + 1, projection=["k", "r", "a", "d"])
+    want = []
+    for i, o in enumerate(oracles):
+        want.extend((o2 if i == t else o).scan(ScanSpec(**spec_kw)).rows)
+    got, _ = _page_all(st, spec_kw, limit=4096)
+    assert got == want
+    res = sharded_row_page(st, ScanSpec(
+        read_ht=ht + 1, predicates=[Predicate("d", "=", 777)],
+        projection=["k", "d"], limit=4096))
+    assert len(res.rows) == len(old.rows)
+    # Encoded stacks can't splice a plain run in place: callers rebuild.
+    st_enc = ShardedTablets(schema, runs, mesh, window_blocks=2,
+                            encode=True)
+    if st_enc.encoded:
+        assert not st_enc.update_tablet(t, new_run)
+
+
+def test_stack_close_mid_serve(mesh):
+    """close() releases the stack's residency pin immediately but keeps
+    the arrays alive for in-flight pages — the flush/compaction
+    supersede-while-serving case must neither leak pins nor break the
+    page being served."""
+    from yugabyte_db_tpu.storage.residency import hbm_cache
+    from yugabyte_db_tpu.utils.memtracker import root_tracker
+
+    import gc
+
+    tracker = root_tracker().child("device").child("sharded")
+    gc.collect()
+    hbm_cache().stats()  # reap stacks dead from earlier tests first
+    base = tracker.consumption
+    schema, runs, oracles, max_ht = build_flat_world(seed=43,
+                                                     num_tablets=4,
+                                                     num_keys=200)
+    st = ShardedTablets(schema, runs, mesh, window_blocks=2)
+    assert tracker.consumption > base
+    spec_kw = dict(read_ht=max_ht + 1, projection=["k", "a"])
+    from yugabyte_db_tpu.parallel import sharded_row_page
+
+    first = sharded_row_page(st, ScanSpec(limit=32, **spec_kw))
+    assert first.resume_key is not None
+    st.close()
+    # Pin + MemTracker charge gone the moment the stack is superseded...
+    assert tracker.consumption == base
+    # ...and double-close stays a no-op.
+    st.close()
+    assert tracker.consumption == base
+    # The in-flight page chain still serves, byte-identical.
+    got = list(first.rows)
+    token = first.resume_key
+    while token is not None:
+        res = sharded_row_page(st, ScanSpec(limit=32, **spec_kw),
+                               resume=token)
+        got.extend(res.rows)
+        token = res.resume_key
+    want = []
+    for o in oracles:
+        want.extend(o.scan(ScanSpec(**spec_kw)).rows)
+    assert got == want

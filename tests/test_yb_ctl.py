@@ -92,6 +92,17 @@ def test_dashboards_and_memz(cluster):
     ts_tablets = _get(
         f"http://127.0.0.1:{ts['web_port']}/dashboards/tablets").decode()
     assert "leader" in ts_tablets or "follower" in ts_tablets
+    # per-device residency: the /memz hbm_cache.by_device split as a
+    # table (rows appear once device runs are resident; the endpoint
+    # itself must always serve)
+    hbm = _get(
+        f"http://127.0.0.1:{ts['web_port']}/dashboards/hbm-devices").decode()
+    assert "HBM devices" in hbm
+    hbm_json = json.loads(_get(
+        f"http://127.0.0.1:{ts['web_port']}/hbm-devices"))
+    assert isinstance(hbm_json, list)
+    ts_memz = json.loads(_get(f"http://127.0.0.1:{ts['web_port']}/memz"))
+    assert "by_device" in ts_memz["hbm_cache"]
     # prometheus endpoint still serves on every daemon
     prom = _get(base + "/metrics").decode()
     assert "rpc_requests_total" in prom

@@ -368,46 +368,117 @@ class YBSession:
         if spec.is_aggregate:
             return self._scan_aggregate(table, spec, timeout_s, stale_ok)
         locs = self.client.meta_cache.locations(table.name)
-        out_rows: list[tuple] = []
-        columns: list[str] = []
-        scanned = 0
-        remaining = spec.limit
         # Snapshot consistency across pages/tablets: the first sub-scan's
         # server-chosen read time is pinned for every subsequent request
         # (the reference's ConsistentReadPoint contract — the server returns
-        # the chosen read_ht precisely so the client can pin it).
-        read_ht = spec.read_ht
+        # the chosen read_ht precisely so the client can pin it). The
+        # mutable scan state is shared with the mesh-group helper.
+        state = {"rows": [], "columns": [], "scanned": 0,
+                 "read_ht": spec.read_ht}
+        # Mesh path first: CONSECUTIVE tablets led by the same tserver
+        # page as ONE ts.multi_row_scan group — the tserver runs them as
+        # one device program (tserver.mesh_scan) and the cross-tablet
+        # resume token stays opaque here. Consecutive-only keeps rows in
+        # partition (key) order; singleton or ineligible groups take the
+        # per-tablet path below.
+        groups: list[tuple[str | None, list]] = []
         for loc in locs.tablets:
-            resume = spec.lower
-            while True:
-                sub = ScanSpec(lower=resume, upper=spec.upper,
-                               read_ht=read_ht,
-                               predicates=spec.predicates,
-                               projection=spec.projection,
-                               limit=remaining,
-                               group_by=spec.group_by)
-                payload = {"spec": wire.encode_spec(sub)}
-                if stale_ok:
-                    payload["allow_stale"] = True
-                resp = self.client.tablet_rpc(
-                    table.name, loc, "ts.scan", payload,
-                    timeout_s=timeout_s,
-                    prefer=self._stale_prefer(loc) if stale_ok else None,
-                    mark_leader=not stale_ok)
-                if "read_ht" in resp:
-                    read_ht = resp["read_ht"]
-                res = wire.decode_result(resp)
-                columns = res.columns
-                out_rows.extend(res.rows)
-                scanned += res.rows_scanned
-                if remaining is not None:
-                    remaining -= len(res.rows)
-                    if remaining <= 0:
-                        return ScanResult(columns, out_rows, None, scanned)
-                if res.resume_key is None:
-                    break
-                resume = res.resume_key
-        return ScanResult(columns, out_rows, None, scanned)
+            leader = (loc.leader if (not stale_ok and not spec.group_by
+                                     and table.engine == "tpu") else None)
+            if groups and leader is not None and groups[-1][0] == leader:
+                groups[-1][1].append(loc)
+            else:
+                groups.append((leader, [loc]))
+        for leader, group in groups:
+            if spec.limit is not None and len(state["rows"]) >= spec.limit:
+                break
+            if leader is not None and len(group) >= 2 and \
+                    self._mesh_row_pages(leader, group, spec, state,
+                                         timeout_s):
+                continue
+            for loc in group:
+                resume = spec.lower
+                while True:
+                    remaining = (None if spec.limit is None
+                                 else spec.limit - len(state["rows"]))
+                    if remaining is not None and remaining <= 0:
+                        return ScanResult(state["columns"], state["rows"],
+                                          None, state["scanned"])
+                    sub = ScanSpec(lower=resume, upper=spec.upper,
+                                   read_ht=state["read_ht"],
+                                   predicates=spec.predicates,
+                                   projection=spec.projection,
+                                   limit=remaining,
+                                   group_by=spec.group_by)
+                    payload = {"spec": wire.encode_spec(sub)}
+                    if stale_ok:
+                        payload["allow_stale"] = True
+                    resp = self.client.tablet_rpc(
+                        table.name, loc, "ts.scan", payload,
+                        timeout_s=timeout_s,
+                        prefer=self._stale_prefer(loc) if stale_ok else None,
+                        mark_leader=not stale_ok)
+                    if "read_ht" in resp:
+                        state["read_ht"] = resp["read_ht"]
+                    res = wire.decode_result(resp)
+                    state["columns"] = res.columns
+                    state["rows"].extend(res.rows)
+                    state["scanned"] += res.rows_scanned
+                    if res.resume_key is None:
+                        break
+                    resume = res.resume_key
+        return ScanResult(state["columns"], state["rows"], None,
+                          state["scanned"])
+
+    def _mesh_row_pages(self, leader: str, group: list, spec: ScanSpec,
+                        state: dict, timeout_s: float) -> bool:
+        """Page one leader's consecutive-tablet group through
+        ts.multi_row_scan (the whole group served per page by ONE mesh
+        device program). Returns True when the group was fully served
+        (or the global limit filled) on the mesh; False rolls back any
+        partial mesh pages for the group and sends the caller down the
+        per-tablet path — so a mid-stream failure can never duplicate or
+        drop rows."""
+        mark_rows, mark_scanned = len(state["rows"]), state["scanned"]
+        resume = None
+        mesh_timeout = min(5.0, timeout_s)
+        while True:
+            remaining = (None if spec.limit is None
+                         else spec.limit - len(state["rows"]))
+            if remaining is not None and remaining <= 0:
+                return True
+            sub = ScanSpec(lower=spec.lower, upper=spec.upper,
+                           read_ht=state["read_ht"],
+                           predicates=spec.predicates,
+                           projection=spec.projection, limit=remaining)
+            payload = {"tablet_ids": [g.tablet_id for g in group],
+                       "spec": wire.encode_spec(sub),
+                       # Budget rides server-side (below the transport
+                       # timeout) so a slow pin returns a clean timed_out
+                       # and the per-tablet fallback still has time.
+                       "timeout": max(0.05, round(mesh_timeout * 0.8, 3))}
+            if resume is not None:
+                payload["resume"] = resume
+            try:
+                resp = self.client.transport.send(
+                    leader, "ts.multi_row_scan", payload,
+                    timeout=mesh_timeout)
+            except Exception as e:  # noqa: BLE001 — per-tablet fallback
+                count_swallowed("session.multi_row_scan", e)
+                resp = {}
+            if resp.get("code") != "ok":
+                del state["rows"][mark_rows:]
+                state["scanned"] = mark_scanned
+                return False
+            if "read_ht" in resp:
+                state["read_ht"] = resp["read_ht"]
+            res = wire.decode_result(resp)
+            state["columns"] = res.columns
+            state["rows"].extend(res.rows)
+            state["scanned"] += res.rows_scanned
+            if res.resume_key is None:
+                return True
+            resume = res.resume_key
 
     def _scan_aggregate(self, table: YBTable, spec: ScanSpec,
                         timeout_s: float,

@@ -28,6 +28,15 @@ Each round fires one fault from the catalog mid-workload:
                     then the leader crashes before applying; after
                     restart the acked write must survive WAL replay and
                     every peer's apply lag must drain back to 0.
+``chip_loss``       A mesh chip drops out mid paged row scan
+                    (``fault.mesh_dispatch`` armed between two pages of
+                    a mesh-served LIMIT scan): the MeshScanService
+                    releases every stacked placement, the request
+                    bounces to the per-tablet host path, and the full
+                    host re-serve must be byte-identical to the mesh
+                    serve taken before the loss. Per-device pins unwind
+                    to zero (the ``device/sharded`` MemTracker subtree
+                    reads 0 after the fault).
 ==================  =======================================================
 
 Invariants after every round (each returns a list of error strings):
@@ -72,7 +81,8 @@ from yugabyte_db_tpu.utils.memtracker import root_tracker
 from yugabyte_db_tpu.utils.metrics import faults_fired
 
 FAULT_CATALOG = ("wal_sync", "respond_dropped", "leader_crash",
-                 "device_dispatch", "hbm_eviction", "commit_ack_crash")
+                 "device_dispatch", "hbm_eviction", "commit_ack_crash",
+                 "chip_loss")
 
 # Catalog entries backed by a maybe_fault() point (armed one-shot and
 # asserted against the yb_faults_fired metric).
@@ -80,6 +90,13 @@ ARMED_FLAG = {
     "wal_sync": "fault.wal_sync_failed",
     "respond_dropped": "fault.ts_write_respond_failed",
     "device_dispatch": "fault.tpu_dispatch",
+}
+
+# Catalog entries whose handler arms AND reaches the fault point itself
+# (the round's trailing op/scan cannot be relied on to hit it); still
+# asserted against yb_faults_fired like the ARMED_FLAG entries.
+HANDLER_FLAG = {
+    "chip_loss": "fault.mesh_dispatch",
 }
 
 # "the row is absent" in the oracle / acceptable-value sets.
@@ -143,7 +160,8 @@ class FaultSweep:
     def setup(self) -> None:
         FLAGS.set("fault.seed", self.seed, force=True)
         self._fired_base = {n: faults_fired(f)
-                            for n, f in ARMED_FLAG.items()}
+                            for n, f in {**ARMED_FLAG,
+                                         **HANDLER_FLAG}.items()}
         self.mc = MiniCluster(
             self.data_root, num_tservers=self.num_tservers,
             # A fast breaker so degrade -> half-open probe -> recover
@@ -264,6 +282,9 @@ class FaultSweep:
         if fault == "commit_ack_crash":
             self._commit_ack_crash()
             return None
+        if fault == "chip_loss":
+            self._chip_loss()
+            return None
         if fault == "hbm_eviction":
             # Eviction pressure racing the scans the round keeps issuing.
             def pound():
@@ -343,6 +364,71 @@ class FaultSweep:
         finally:
             self.mc.restart_tserver(victim)
         self.mc.wait_tservers_registered()
+
+    def _chip_loss(self) -> None:
+        """The multi-chip availability round: a mesh chip drops out
+        between two pages of a mesh-served LIMIT row scan
+        (``fault.mesh_dispatch`` fires at the next dispatch). The
+        MeshScanService must release every stacked placement — the
+        ``device/sharded`` MemTracker subtree reads 0 and the stack
+        cache empties — and the full host re-serve must be
+        byte-identical to the mesh serve taken before the loss.
+
+        Mesh eligibility needs a single run and an empty memtable, so
+        the round flushes + compacts first; that legitimately moves
+        device residency, so the MemTracker baseline is re-anchored
+        BEFORE the stack is built — the end-of-round invariant then
+        measures the chip loss itself, not the flush."""
+        self._flush_tablets()
+        for ts in self.mc.tservers.values():
+            for peer in ts.tablet_manager.peers():
+                peer.compact()
+        self._quiesce_device()
+        self._device_baseline = root_tracker().child("device").consumption
+
+        def tpu_leaders(ts):
+            return [p for p in ts.tablet_manager.peers()
+                    if p.is_leader()
+                    and hasattr(p.tablet.engine, "_serve_host_batch")]
+
+        ts = max(self.mc.tservers.values(),
+                 key=lambda t: len(tpu_leaders(t)))
+        peers = tpu_leaders(ts)
+        if not peers:
+            self.errors.append("chip_loss: no TPU leader peers to scan")
+            return
+        read_ht = min(p.read_time().value for p in peers)
+        full = ScanSpec(read_ht=read_ht, projection=["k", "v"])
+        paged = ScanSpec(read_ht=read_ht, projection=["k", "v"], limit=8)
+        mesh_full = ts.mesh_scan.rows(peers, full)
+        first = ts.mesh_scan.rows(peers, paged)
+        if mesh_full is None or first is None:
+            self.errors.append(
+                "chip_loss: mesh path ineligible after flush+compact")
+            return
+        arm_fault_once("fault.mesh_dispatch")
+        self.fired_ledger["chip_loss"] = \
+            self.fired_ledger.get("chip_loss", 0) + 1
+        lost = ts.mesh_scan.rows(peers, paged, resume=first.resume_key)
+        if lost is not None:
+            self.errors.append(
+                "chip_loss: dispatch served despite the lost chip")
+        sharded = root_tracker().child("device").child(
+            "sharded").consumption
+        if sharded != 0:
+            self.errors.append(
+                f"chip_loss: {sharded} stacked bytes survived the "
+                "lost chip")
+        if ts.mesh_scan._stacks:
+            self.errors.append("chip_loss: stack cache not emptied")
+        host_rows = []
+        for p in peers:
+            host_rows.extend(
+                p.tablet.engine._serve_host_batch([full])[0].rows)
+        if mesh_full.rows != host_rows:
+            self.errors.append(
+                f"chip_loss: host re-serve diverged ({len(host_rows)} "
+                f"rows vs mesh {len(mesh_full.rows)})")
 
     def _one_op(self, kind: str | None = None) -> None:
         k = self.keys[self.rng.randrange(len(self.keys))]
@@ -454,13 +540,17 @@ class FaultSweep:
 
     def _quiesce_device(self) -> None:
         """Release every legitimate pin holder: the cached delta
-        overlays (which pin their primary run while cached) and all
-        unpinned residency. Whatever stays pinned afterward is a leak."""
+        overlays (which pin their primary run while cached), the mesh
+        services' stacked placements (rebuilt on the next eligible
+        scan), and all unpinned residency. Whatever stays pinned
+        afterward is a leak."""
         for ts in self.mc.tservers.values():
             for peer in ts.tablet_manager.peers():
                 eng = peer.tablet.engine
                 if hasattr(eng, "_drop_overlay_cache"):
                     eng._drop_overlay_cache()
+            if hasattr(ts, "mesh_scan"):
+                ts.mesh_scan.drop_stacks()
         hbm_cache().evict_unpinned()
 
     def check_residency_pins(self) -> list[str]:
@@ -500,7 +590,7 @@ class FaultSweep:
     def _check_fired_ledger(self) -> list[str]:
         errs = []
         for name, count in self.fired_ledger.items():
-            flag = ARMED_FLAG[name]
+            flag = ARMED_FLAG.get(name) or HANDLER_FLAG[name]
             fired = faults_fired(flag) - self._fired_base[name]
             if fired != count:
                 errs.append(

@@ -25,6 +25,13 @@ if TYPE_CHECKING:  # type-only: ops never depends on storage at runtime
     from yugabyte_db_tpu.storage.columnar import ColumnarRun
 
 
+def device_label(d) -> str:
+    """Canonical budget-bucket name for a jax Device — the string the
+    residency cache keys its per-device budget map and {device=...}
+    metric labels by (storage/residency.py)."""
+    return "%s:%d" % (d.platform, d.id)
+
+
 def dtype_kind(dt: DataType) -> str:
     if not dt.is_fixed_width:
         return "str"  # varlen/opaque: host payload + 8-byte prefix planes

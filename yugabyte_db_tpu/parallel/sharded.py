@@ -36,7 +36,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from yugabyte_db_tpu.models.schema import Schema
+from yugabyte_db_tpu.ops import encodings
+from yugabyte_db_tpu.ops import row_gather as RG
 from yugabyte_db_tpu.ops import scan as dscan
+from yugabyte_db_tpu.parallel import meshcompat
 from yugabyte_db_tpu.utils.jitting import compile_contract
 from yugabyte_db_tpu.ops.agg_fold import (agg_init, check_limb_bound,
                                           finalize, fold_window, lower_aggs,
@@ -51,6 +54,141 @@ from yugabyte_db_tpu.utils.memtracker import root_tracker
 
 # -- host-side assembly ------------------------------------------------------
 
+def shard_dev_bytes(tree) -> dict:
+    """Per-device byte map of a sharded array pytree: each leaf's
+    addressable shards charged to the chip holding them — the
+    ``dev_bytes`` the residency cache partitions its budget by.
+    Replicated leaves charge every device (each holds a copy)."""
+    from yugabyte_db_tpu.ops.device_run import device_label
+
+    out: dict[str, int] = {}
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (list, tuple)):
+            stack.extend(node)
+        elif node is not None:
+            for sh in node.addressable_shards:
+                lbl = device_label(sh.device)
+                out[lbl] = (out.get(lbl, 0)
+                            + int(sh.data.size) * sh.data.dtype.itemsize)
+    return out
+
+
+# -- encoding-aware tree structure -------------------------------------------
+#
+# Stacked planes may carry compressed leaves (ops.encodings): a leaf is
+# either a plain [T, B, ...] ndarray or a single-key dict naming the
+# encoding. shard_map in_specs, per-tablet slicing and device placement
+# all dispatch on that structure, captured once per stack as a hashable
+# ``enc_struct`` so the compiled-program caches key on it.
+
+_ENC_SPEC_PARTS = {
+    "bits": ("bw",),
+    "delta16": ("dbase", "doff"),
+    "rle": ("rid", "rvals"),
+    "dict": ("codes",),
+}
+
+
+def _tree_struct(tree):
+    """Hashable encoding structure of a stacked plane tree: leaf name ->
+    encoding kind (None = plain), per top-level plane and per column."""
+    planes = tuple(sorted((n, encodings.leaf_kind(l))
+                          for n, l in tree.items() if n != "cols"))
+    cols = tuple(sorted(
+        (cid, tuple(sorted((n, encodings.leaf_kind(p))
+                           for n, p in col.items())))
+        for cid, col in tree["cols"].items()))
+    return planes, cols
+
+
+def _leaf_spec(kind, spec_tb):
+    """shard_map PartitionSpec subtree for one leaf: components carrying
+    the (tablet, block) axes shard P("t", "b"); components without a
+    block axis (const cval, dict dhi/dlo) replicate."""
+    if kind is None:
+        return spec_tb
+    if kind == "const":
+        return {"const": {"cval": P()}}
+    parts = {n: spec_tb for n in _ENC_SPEC_PARTS[kind]}
+    if kind == "dict":
+        parts["dhi"] = P()
+        parts["dlo"] = P()
+    return {kind: parts}
+
+
+def _specs_from_struct(struct, spec_tb):
+    planes, cols = struct
+    out = {n: _leaf_spec(k, spec_tb) for n, k in planes}
+    out["cols"] = {cid: {n: _leaf_spec(k, spec_tb) for n, k in entry}
+                   for cid, entry in cols}
+    return out
+
+
+def _tree_shardings(struct, mesh):
+    specs = _specs_from_struct(struct, P("t", "b"))
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _tablet_slice(tree, t):
+    """Slice one tablet out of a device-local [Tl, Bl, ...] shard tree,
+    keeping encoded-leaf structure: replicated components (const cval,
+    dict dhi/dlo) carry no tablet axis and pass through unchanged."""
+    def one(leaf):
+        k = encodings.leaf_kind(leaf)
+        if k is None:
+            return leaf[t]
+        if k == "const":
+            return leaf
+        no_t = {"dict": ("dhi", "dlo")}.get(k, ())
+        return {k: {n: (a if n in no_t else a[t])
+                    for n, a in leaf[k].items()}}
+
+    out = {n: one(l) for n, l in tree.items() if n != "cols"}
+    out["cols"] = {cid: {n: one(p) for n, p in col.items()}
+                   for cid, col in tree["cols"].items()}
+    return out
+
+
+def _encode_stack(stacked):
+    """Re-encode stacked [T, B, ...] planes with the host encoders
+    (ops.encodings) over the flattened [T*B, ...] block axis, then fold
+    the leading axis of every block-dimensioned component back to
+    [T, B, ...]. Padding (invalid blocks / pad tablets) is already baked
+    into the plain planes, so decode is byte-identical by construction.
+    The stack-level encoder never emits dict leaves (those come from
+    per-run device flush output); pathological planes stay plain."""
+    T, B = stacked["valid"].shape[:2]
+
+    def enc(plane, how):
+        leaf = how(plane.reshape((T * B,) + plane.shape[2:]))
+        k = encodings.leaf_kind(leaf)
+        if k is None:
+            return plane
+        if k == "const":
+            return leaf
+        return {k: {n: a.reshape((T, B) + a.shape[1:])
+                    for n, a in leaf[k].items()}}
+
+    out = {n: enc(stacked[n], encodings.encode_bool_plane)
+           for n in ("valid", "group_start", "tomb", "live")}
+    for n in ("ht_hi", "ht_lo", "exp_hi", "exp_lo"):
+        out[n] = enc(stacked[n], encodings.encode_int_plane)
+    out["cols"] = {}
+    for cid, col in stacked["cols"].items():
+        e = {"set": enc(col["set"], encodings.encode_bool_plane),
+             "isnull": enc(col["isnull"], encodings.encode_bool_plane),
+             "cmp": enc(col["cmp"], encodings.encode_int_plane)}
+        if "arith" in col:
+            e["arith"] = enc(col["arith"], encodings.encode_float_plane)
+        out["cols"][cid] = e
+    return out
+
+
 class ShardedTablets:
     """Stacked, mesh-sharded device residency for T tablets' single runs.
 
@@ -60,7 +198,7 @@ class ShardedTablets:
     """
 
     def __init__(self, schema: Schema, runs: list[ColumnarRun], mesh: Mesh,
-                 window_blocks: int = 8):
+                 window_blocks: int = 8, encode: bool | None = None):
         if not runs:
             raise ValueError("need at least one run")
         R = runs[0].R
@@ -83,27 +221,110 @@ class ShardedTablets:
             raise AssertionError("local block count not a window multiple")
 
         stacked = self._stack(runs, pad_t)
-        spec_tb = P("t", "b")
+        if encode is None:
+            from yugabyte_db_tpu.utils.flags import FLAGS
+            encode = FLAGS.get("tpu_plane_encoding") != "off"
+        if encode:
+            stacked = _encode_stack(stacked)
+        self.enc_struct = _tree_struct(stacked)
+        self.encoded = encodings.tree_encoded(stacked)
         # Mesh placement must shard, not cache: plane-group residency for
         # sharded arrays is accounted (and pinned) via add_external below.
         self.arrays = jax.tree.map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, spec_tb)),  # yb-lint: disable=ijax/unmanaged-device-put
-            stacked)
+            lambda a, s: jax.device_put(a, s),  # yb-lint: disable=ijax/unmanaged-device-put
+            stacked, _tree_shardings(self.enc_struct, mesh))
         self.padded_T = self.T + pad_t
         # The stacked mesh arrays live outside the demand-upload path but
         # inside the same HBM budget: account them as a pinned external
-        # entry so /memz, /metrics and eviction pressure see them.
+        # entry so /memz, /metrics and eviction pressure see them.  The
+        # charge is a per-device map — one shard's bytes on the chip
+        # that actually holds it — so each chip's budget bucket sees its
+        # true share, not T devices each blamed for the whole stack.
         self._res_key = hbm_cache().add_external(
             self, device_nbytes(self.arrays),
-            root_tracker().child("device").child("sharded"), "sharded_mesh")
+            root_tracker().child("device").child("sharded"), "sharded_mesh",
+            dev_bytes=shard_dev_bytes(self.arrays))
 
     def close(self) -> None:
-        """Release the mesh arrays' residency accounting (the arrays
-        themselves free when the last reference dies)."""
+        """Release the mesh arrays' residency accounting. The arrays
+        stay usable for scans already holding this stack (they free when
+        the last reference dies) — a flush/compaction can supersede a
+        stack mid-serve without crashing the in-flight page."""
         if self._res_key is not None:
             hbm_cache().invalidate(self._res_key)
             self._res_key = None
-        self.arrays = None
+
+    def update_tablet(self, t: int, run: ColumnarRun,
+                      device_arrays=None) -> bool:
+        """Replace tablet ``t``'s slot of the stacked mesh arrays in
+        place (one jitted dynamic_update_slice over the tree) — the
+        incremental path when a flush/compaction swaps a single tablet's
+        run. ``device_arrays``, when given, is a DeviceRun.arrays tree
+        already ON device (ops.flush output): its planes reshard over
+        the mesh directly, no host round trip. Returns False when the
+        stack must be rebuilt instead (encoded stack, block overflow,
+        row-shape or column mismatch); residency accounting is unchanged
+        either way because every shape is."""
+        if self.encoded or t >= self.T or run.R != self.R:
+            return False
+        if max(run.B, 1) > self.B:
+            return False
+        src = None
+        if device_arrays is not None:
+            src = self._device_src(device_arrays)
+        if src is None:
+            src = self._stack([run], 0)
+        if _tree_struct(src) != self.enc_struct:
+            return False
+        spec_b = P(None, "b")
+        src = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(self.mesh, spec_b)),  # yb-lint: disable=ijax/unmanaged-device-put
+            src)
+        cols_desc = tuple(sorted(
+            (cid, "arith" in col)
+            for cid, col in self.arrays["cols"].items()))
+        fn = _compiled_stack_update(self.padded_T, self.B, self.R,
+                                    cols_desc)
+        out = fn(self.arrays, src, jnp.int32(t))
+        # Pin the result back to the stack's sharding (GSPMD is free to
+        # choose otherwise for the update program's output).
+        self.arrays = jax.tree.map(
+            lambda a, s: jax.device_put(a, s),  # yb-lint: disable=ijax/unmanaged-device-put
+            out, _tree_shardings(self.enc_struct, self.mesh))
+        self.runs = list(self.runs)
+        self.runs[t] = run
+        return True
+
+    def _device_src(self, arrays):
+        """[1, self.B, ...] plain source tree built from device-resident
+        run planes: encoded leaves decode ON DEVICE (ops.encodings jnp
+        decode — dict cmp drops its third code plane), the block axis
+        pads to the stack's B with the stack's padding values. Returns
+        None when the planes don't fit the stack's shape."""
+        B = int(arrays["valid"].shape[0])
+        if B > self.B or arrays["valid"].shape[1] != self.R:
+            return None
+
+        def prep(leaf, ones=False):
+            k = encodings.leaf_kind(leaf)
+            if k is not None:
+                leaf = encodings.decode_leaf(leaf, B, self.R)
+                if k == "dict":
+                    leaf = leaf[..., :2]
+            leaf = jnp.asarray(leaf)
+            pad = self.B - leaf.shape[0]
+            if pad:
+                fill = (jnp.ones if ones else jnp.zeros)(
+                    (pad,) + leaf.shape[1:], leaf.dtype)
+                leaf = jnp.concatenate([leaf, fill], axis=0)
+            return leaf[None]
+
+        out = {n: prep(arrays[n], ones=(n == "group_start"))
+               for n in ("valid", "group_start", "tomb", "live",
+                         "ht_hi", "ht_lo", "exp_hi", "exp_lo")}
+        out["cols"] = {cid: {n: prep(p) for n, p in col.items()}
+                       for cid, col in arrays["cols"].items()}
+        return out
 
     def _stack(self, runs, pad_t):
         B, R = self.B, self.R
@@ -214,11 +435,11 @@ def _shard_body(sig: dscan.ScanSig, Tl: int, Bl: int, R: int,
     block_off = jax.lax.axis_index("b") * Bl
     # Loop carries become device-varying inside the loop body; mark the
     # replicated initial values as varying so the carry types match.
-    varying = lambda x: jax.lax.pcast(x, ("t", "b"), to="varying")
+    varying = lambda x: meshcompat.varying(x, ("t", "b"))
     acc = jax.tree.map(varying, agg_init(sig.aggs))
     scanned = varying(jnp.int32(0))
     for t in range(Tl):
-        local = jax.tree.map(lambda a: a[t], run)
+        local = _tablet_slice(run, t)
         lo_t, hi_t = row_lo[t], row_hi[t]
         body = functools.partial(
             fold_window, sig, local, row_lo=lo_t, row_hi=hi_t,
@@ -235,34 +456,37 @@ def _shard_body(sig: dscan.ScanSig, Tl: int, Bl: int, R: int,
 
 @functools.lru_cache(maxsize=64)
 @compile_contract("dist_agg", max_compiles=64)
-def _compiled_dist_agg(sig: dscan.ScanSig, mesh: Mesh, Tl: int, Bl: int):
-    """One jitted shard_map program per (scan signature, mesh). Mesh is
-    hashable and the cache entry keeps it alive only until eviction."""
+def _compiled_dist_agg(sig: dscan.ScanSig, mesh: Mesh, enc_struct,
+                       Tl: int, Bl: int):
+    """One jitted shard_map program per (scan signature, mesh, stack
+    encoding structure). Mesh is hashable and the cache entry keeps it
+    alive only until eviction."""
     spec_tb = P("t", "b")
     in_specs = (
-        _run_specs(sig, spec_tb),  # stacked run pytree
+        _specs_from_struct(enc_struct, spec_tb),  # stacked run pytree
         P("t"), P("t"),            # row bounds
         P(), P(), P(), P(),        # read/expiry planes
         P(),                       # predicate literals (replicated)
     )
     body = functools.partial(_shard_body, sig, Tl, Bl, sig.R)
-    smapped = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                            out_specs=(_acc_specs(sig), P()))
+    smapped = meshcompat.shard_map(body, mesh, in_specs,
+                                   (_acc_specs(sig), P()))
     return jax.jit(smapped)
 
 
-def _run_specs(sig, spec_tb):
-    cols = {}
-    for cs in sig.cols:
-        entry = {"set": spec_tb, "isnull": spec_tb, "cmp": spec_tb}
-        if cs.kind != "str":
-            entry["arith"] = spec_tb
-        cols[cs.col_id] = entry
-    return {
-        "valid": spec_tb, "group_start": spec_tb, "tomb": spec_tb,
-        "live": spec_tb, "ht_hi": spec_tb, "ht_lo": spec_tb,
-        "exp_hi": spec_tb, "exp_lo": spec_tb, "cols": cols,
-    }
+@functools.lru_cache(maxsize=32)
+@compile_contract("stack_update", max_compiles=32)
+def _compiled_stack_update(padded_T: int, B: int, R: int, cols_desc):
+    """One in-place tablet-slot update program per stack shape: every
+    leaf gets its [1, B, ...] source written at block row ``t`` with a
+    traced dynamic_update_slice (no per-tablet recompiles)."""
+    def upd(dst, src, t):
+        return jax.tree.map(
+            lambda d, s: jax.lax.dynamic_update_slice(
+                d, s.astype(d.dtype), (t,) + (0,) * (d.ndim - 1)),
+            dst, src)
+
+    return jax.jit(upd)
 
 
 def _acc_specs(sig):
@@ -297,6 +521,10 @@ def sharded_aggregate(st: ShardedTablets, spec: ScanSpec) -> ScanResult:
         pred_lits.append(pred_literal(kinds[cid], p.value))
 
     for a in spec.aggregates:
+        if a.expr is not None:
+            # lower_aggs drops the expression tree silently; without
+            # this guard a sum(a*b) spec would fold the wrong thing.
+            raise ValueError("expression aggregates need the host path")
         if a.column and a.column not in name_to_id:
             raise ValueError(f"aggregate on key column {a.column}")
         if a.column and kinds[name_to_id[a.column]] == "str" and a.fn != "count":
@@ -316,7 +544,7 @@ def sharded_aggregate(st: ShardedTablets, spec: ScanSpec) -> ScanResult:
     e_hi, e_lo = PL.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
 
     Tl = st.padded_T // st.mesh.shape["t"]
-    fn = _compiled_dist_agg(sig, st.mesh, Tl, st.Bl)
+    fn = _compiled_dist_agg(sig, st.mesh, st.enc_struct, Tl, st.Bl)
     acc, scanned = fn(st.arrays, jnp.asarray(lo), jnp.asarray(hi),
                       jnp.int32(r_hi), jnp.int32(r_lo),
                       jnp.int32(e_hi), jnp.int32(e_lo), tuple(pred_lits))
@@ -339,108 +567,82 @@ def _kind(c):
 
 # -- sharded row/paging path -------------------------------------------------
 #
-# The cluster ROW read path on the mesh: each device computes the exact
-# flat-run match mask over its (tablet, block-range) shard and emits the
-# first M matching row indices; the host assembles LIMIT pages in tablet
-# order (a device's "b"-shard covers a contiguous disjoint row range, so
-# concatenating shard outputs in "b" order is already key order). This
-# is the device-sharded analog of the per-tablet parallel read fan-out
-# (reference: src/yb/client/batcher.h:80) — the reference scans one
-# tablet per thread; here tablets AND block ranges split over the mesh.
+# The cluster ROW read path on the mesh: each device runs the packed
+# row-gather program (ops.row_gather — the same MVCC resolve + top_k
+# compaction the single-chip engine serves pages with) over its
+# (tablet, block-range) shard, emitting the first M matches IN KEY ORDER
+# plus a per-device match count combined with psum over ICI; the host
+# assembles LIMIT pages in tablet order (a device's "b"-shard covers a
+# contiguous disjoint row range, so concatenating shard outputs in "b"
+# order is already key order) and decodes ONLY the page's rows from the
+# fetched value planes. This is the device-sharded analog of the
+# per-tablet parallel read fan-out (reference: src/yb/client/batcher.h:80)
+# — the reference scans one tablet per thread; here tablets AND block
+# ranges split over the mesh, and multi-version (MVCC) groups, encoded
+# planes, tombstones and TTL all resolve on device.
 
 _PAGE_BUCKETS = (128, 512, 2048)
 
 
-def _le2(a_hi, a_lo, b_hi, b_lo):
-    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
-
-
-def _flat_pred_mask(kind, cmp, lit):
-    if kind == "i32":
-        v = cmp[..., 0]
-        x = lit[0]
-        return {"=": v == x, "!=": v != x, "<": v < x, "<=": v <= x,
-                ">": v > x, ">=": v >= x}
-    hi, lo = cmp[..., 0], cmp[..., 1]
-    lhi, llo = lit
-    eq = (hi == lhi) & (lo == llo)
-    lt = (hi < lhi) | ((hi == lhi) & (lo < llo))
-    return {"=": eq, "!=": ~eq, "<": lt, "<=": lt | eq,
-            ">": ~(lt | eq), ">=": ~lt}
-
-
-def _rows_body(col_ids, pred_items, Tl, Bl, R, M, run, row_lo, row_hi,
-               r_hi, r_lo, e_hi, e_lo, pred_lits):
-    """Per-device: exact flat-run match masks over the [Tl, Bl, R] shard
-    and the first M matching global row indices per local tablet.
-    Semantics mirror the host page index (storage.host_page.masks):
-    MVCC visibility at the read point, tombstones, TTL, liveness/column
-    existence, device-exact predicates."""
+def _page_body(sig: RG.GatherSig, Tl: int, Bl: int, R: int,
+               run, iparams, fparams):
+    """Per-device: the packed gather over each local tablet's [Bl, R]
+    shard. ``iparams`` rows carry GLOBAL row bounds in the w_first/
+    w_last/row_lo/row_hi/scan_from slots; each shard rebases them to its
+    own block range (clipping to empty when the tablet's range misses
+    the shard) so the while_loop walks only overlapping windows — the
+    per-device trip counts diverge, which is exactly what the compat
+    seam's check_rep=False / varying-types split exists for."""
     base = jax.lax.axis_index("b") * (Bl * R)
-    n = Bl * R
-    ridx = base + jnp.arange(n, dtype=jnp.int32)
-    out_idx, out_cnt = [], []
+    KR = sig.K * R
+    Wl = Bl // sig.K
+    outs = []
+    counts = meshcompat.varying(jnp.int32(0), ("t", "b"))
     for t in range(Tl):
-        local = jax.tree.map(lambda a: a[t], run)
-        flat = lambda a: a.reshape((n,) + a.shape[2:])  # noqa: E731
-        visible = flat(local["valid"]) & _le2(
-            flat(local["ht_hi"]), flat(local["ht_lo"]), r_hi, r_lo)
-        expired = _le2(flat(local["exp_hi"]), flat(local["exp_lo"]),
-                       e_hi, e_lo)
-        alive = visible & ~flat(local["tomb"])
-        not_exp = ~expired
-        exists = alive & flat(local["live"]) & not_exp
-        notnull = {}
-        for cid in col_ids:
-            c = local["cols"][cid]
-            nn = alive & flat(c["set"]) & ~flat(c["isnull"]) & not_exp
-            notnull[cid] = nn
-            exists = exists | nn
-        match = exists & (ridx >= row_lo[t]) & (ridx < row_hi[t])
-        for (cid, kind, op), lit in zip(pred_items, pred_lits):
-            cmp = flat(local["cols"][cid]["cmp"])
-            match = match & notnull[cid] & \
-                _flat_pred_mask(kind, cmp, lit)[op]
-        cnt = jnp.sum(match, dtype=jnp.int32)
-        pos = jnp.nonzero(match, size=M, fill_value=n)[0]
-        out_idx.append((base + pos.astype(jnp.int32))[None, None, :])
-        out_cnt.append(cnt[None, None])
-    return (jnp.concatenate(out_idx, axis=0),
-            jnp.concatenate(out_cnt, axis=0))
+        local = _tablet_slice(run, t)
+        ip = iparams[t]
+        lo = jnp.clip(ip[2] - base, 0, Bl * R)
+        hi = jnp.clip(ip[3] - base, 0, Bl * R)
+        sf = jnp.clip(ip[8] - base, 0, Bl * R)
+        w_first = jnp.clip(lo // KR, 0, Wl - 1)
+        w_last = jnp.where(hi > lo,
+                           jnp.clip((hi - 1) // KR, 0, Wl - 1),
+                           w_first - 1)
+        head = jnp.stack([w_first, w_last, lo, hi, ip[4], ip[5], ip[6],
+                          ip[7], sf])
+        ipl = jnp.concatenate([head, ip[RG.PARAM_FIXED:]])
+        buf = RG.gather_rows(sig, local, ipl, fparams)
+        counts = counts + buf[sig.M, 0]
+        outs.append(buf[None, None])
+    # The per-device match-count combine rides ICI; the buffers ride the
+    # ("t", "b")-sharded output (the host fetches only the page's rows).
+    total = jax.lax.psum(counts, ("t", "b"))
+    return jnp.concatenate(outs, axis=0), total
 
 
 @functools.lru_cache(maxsize=64)
-@compile_contract("dist_rows", max_compiles=64)
-def _compiled_dist_rows(cols_desc, pred_items, mesh, Tl, Bl, R, M):
+@compile_contract("dist_page", max_compiles=64)
+def _compiled_dist_page(sig: RG.GatherSig, mesh: Mesh, enc_struct,
+                        Tl: int, Bl: int):
     spec_tb = P("t", "b")
-    cols = {}
-    for cid, has_arith in cols_desc:
-        entry = {"set": spec_tb, "isnull": spec_tb, "cmp": spec_tb}
-        if has_arith:
-            entry["arith"] = spec_tb
-        cols[cid] = entry
-    col_ids = tuple(cid for cid, _a in cols_desc)
-    run_spec = {
-        "valid": spec_tb, "group_start": spec_tb, "tomb": spec_tb,
-        "live": spec_tb, "ht_hi": spec_tb, "ht_lo": spec_tb,
-        "exp_hi": spec_tb, "exp_lo": spec_tb, "cols": cols,
-    }
-    body = functools.partial(_rows_body, col_ids, pred_items, Tl, Bl, R,
-                             M)
-    smapped = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(run_spec, P("t"), P("t"), P(), P(), P(), P(), P()),
-        out_specs=(P("t", "b"), P("t", "b")))
+    body = functools.partial(_page_body, sig, Tl, Bl, sig.R)
+    smapped = meshcompat.shard_map(
+        body, mesh,
+        (_specs_from_struct(enc_struct, spec_tb), P("t"), P()),
+        (P("t", "b"), P()))
     return jax.jit(smapped)
 
 
 def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
                      resume: bytes | None = None) -> ScanResult:
-    """LIMIT page over all tablets on the mesh: ONE device dispatch
-    computes every tablet's matching rows; the host takes the first
-    `limit` in (tablet, key) order and materializes them from the host
-    mirror (result-proportional work). Constraints: flat runs, exact
-    (i32/i64/f64 value-column) predicates, no aggregates.
+    """LIMIT page over all tablets on the mesh: ONE device dispatch runs
+    the packed MVCC row gather on every (tablet, block-range) shard; the
+    host takes the first `limit` in (tablet, key) order and decodes them
+    from the fetched value planes (result-proportional host work —
+    varlen/f32 payloads fetch by setter index from the host mirror, the
+    engine gather path's split). Serves multi-version AND encoded
+    stacks. Constraints (callers fall back to the per-tablet host path):
+    exact (i32/i64/f64 value-column) predicates, no aggregates.
 
     Cross-tablet paging: the returned resume_key encodes
     (tablet index, last key) — pass it back as ``resume`` to continue
@@ -448,12 +650,10 @@ def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
     if spec.is_aggregate:
         raise ValueError("sharded_row_page serves row scans")
     schema = st.schema
-    if any(r.max_group_versions > 1 for r in st.runs):
-        raise ValueError("sharded_row_page needs flat runs")
     name_to_id = {c.name: c.col_id for c in schema.value_columns}
     kinds = {c.col_id: _kind(c) for c in schema.value_columns}
     key_names = {c.name for c in schema.key_columns}
-    pred_items, pred_lits = [], []
+    pred_sigs, int_lits = [], []
     for p in spec.predicates:
         if p.column in key_names or p.op == "IN":
             raise ValueError(f"predicate on {p.column} not device-exact")
@@ -462,21 +662,35 @@ def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
         if kind not in ("i32", "i64", "f64"):
             raise ValueError(f"predicate kind {kind} not device-exact")
         if kind == "i32":
-            lit = (int(p.value),)
+            int_lits.append(int(p.value))
         elif kind == "i64":
             phi, plo = PL.i64_to_ordered_planes(
                 np.array([int(p.value)], dtype=np.int64))
-            lit = (int(phi[0]), int(plo[0]))
+            int_lits += [int(phi[0]), int(plo[0])]
         else:
             phi, plo = PL.f64_to_ordered_planes(
                 np.array([p.value], dtype=np.float64))
-            lit = (int(phi[0]), int(plo[0]))
-        pred_items.append((cid, kind, p.op))
-        pred_lits.append(tuple(jnp.int32(v) for v in lit))
+            int_lits += [int(phi[0]), int(plo[0])]
+        pred_sigs.append(dscan.PredSig(cid, kind, p.op))
 
     limit = spec.limit if spec.limit is not None else _PAGE_BUCKETS[-1]
     M = next((m for m in _PAGE_BUCKETS if m >= limit),
              -(-limit // 128) * 128)
+    projection = spec.projection or [c.name for c in schema.columns]
+    key_pos = {c.name: i for i, c in enumerate(schema.key_columns)}
+    out_cols = tuple(
+        RG.OutCol(name_to_id[nm],
+                  2 if kinds[name_to_id[nm]] in ("i64", "f64", "str")
+                  else 1,
+                  kinds[name_to_id[nm]] in ("str", "f32"))
+        for nm in projection if nm not in key_pos)
+    col_sigs = tuple(dscan.ColSig(c.col_id, kinds[c.col_id])
+                     for c in schema.value_columns)
+    flat = all(r.max_group_versions <= 1 for r in st.runs)
+    sig = RG.GatherSig(B=st.Bl, R=st.R, K=st.K, M=M, cols=col_sigs,
+                       preds=tuple(pred_sigs), apply_preds=True,
+                       out_cols=out_cols, flat=flat, packed=True)
+
     start_t = 0
     start_key = spec.lower
     from yugabyte_db_tpu.utils import codec as _codec
@@ -495,84 +709,98 @@ def sharded_row_page(st: ShardedTablets, spec: ScanSpec,
 
     r_hi, r_lo = PL.scalar_ht_planes(min(spec.read_ht, MAX_HT))
     e_hi, e_lo = PL.scalar_ht_planes(min(spec.read_ht, MAX_HT - 1))
+    ip = np.zeros((st.padded_T, RG.PARAM_FIXED + len(int_lits)),
+                  dtype=np.int32)
+    for t in range(st.padded_T):
+        ip[t], _f = RG.pack_params(0, 0, int(lo[t]), int(hi[t]),
+                                   (r_hi, r_lo, e_hi, e_lo), int_lits,
+                                   [])
+    fparams = np.zeros((1,), dtype=np.float32)
     Tl = st.padded_T // st.mesh.shape["t"]
-    cols_desc = tuple(
-        (c.col_id, st.runs[0].cols[c.col_id].arith is not None)
-        for c in schema.value_columns)
-    fn = _compiled_dist_rows(cols_desc, tuple(pred_items), st.mesh, Tl,
-                             st.Bl, st.R, M)
-    idx, cnt = fn(st.arrays, jnp.asarray(lo), jnp.asarray(hi),
-                  jnp.int32(r_hi), jnp.int32(r_lo), jnp.int32(e_hi),
-                  jnp.int32(e_lo), tuple(pred_lits))
+    fn = _compiled_dist_page(sig, st.mesh, st.enc_struct, Tl, st.Bl)
+    bufs, total = fn(st.arrays, jnp.asarray(ip), jnp.asarray(fparams))
     # One explicit batched fetch for both outputs (one link round-trip,
-    # not one per array): idx [padded_T, mesh_b, M] global row indices,
-    # cnt [padded_T, mesh_b].
-    idx, cnt = jax.device_get((idx, cnt))
+    # not one per array): bufs [padded_T, mesh_b, M+1, W] packed pages,
+    # total the psum-combined match count.
+    bufs, total = jax.device_get((bufs, total))
 
-    projection = spec.projection or [c.name for c in schema.columns]
-    key_pos = {c.name: i for i, c in enumerate(schema.key_columns)}
+    W, col_offs = RG.out_layout(sig)
     rows: list[tuple] = []
-    scanned = 0
     budget = limit
     mesh_b = st.mesh.shape["b"]
     shard_rows = st.Bl * st.R
+    KR = st.K * st.R
+    Wl = st.Bl // st.K
     resume_out = None
     for t, run in enumerate(st.runs):
         truncated = False
-        sel: list[int] = []
+        sel: list[tuple] = []  # (global row, buf row, shard base)
         for b in range(mesh_b):
-            c = int(cnt[t, b])
-            take = min(c, M)
-            if c > M:
-                truncated = True  # tablet has matches beyond M
-            sel.extend(int(g) for g in idx[t, b, :take])
-        scanned += sum(int(cnt[t, b]) for b in range(mesh_b))
+            buf = bufs[t, b]
+            c = int(buf[M, 0])
+            w_end = int(buf[M, 2])
+            base = b * shard_rows
+            lo_loc = min(max(int(lo[t]) - base, 0), shard_rows)
+            hi_loc = min(max(int(hi[t]) - base, 0), shard_rows)
+            w_last = (hi_loc - 1) // KR if hi_loc > lo_loc else -1
+            # Early exit (count hit M before w_last) leaves windows
+            # unscanned: matches may remain beyond the buffer.
+            if c > M or (c >= M and w_end <= min(w_last, Wl - 1)):
+                truncated = True
+            for m in range(min(c, M)):
+                sel.append((base + int(buf[m, 0]), buf[m], base))
         more_in_tablet = truncated or len(sel) > budget
         sel = sel[:budget]
-        for g in sel:
-            rows.append(_materialize_row(run, schema, g, projection,
-                                         key_pos))
+        for g, br, sbase in sel:
+            rows.append(_decode_buf_row(run, schema, br, col_offs,
+                                        sbase, projection, key_pos,
+                                        kinds))
         budget -= len(sel)
         page_full = budget <= 0
         if sel and (more_in_tablet
                     or (page_full and t + 1 < len(st.runs))):
-            resume_out = _codec.encode([t, run.key_at(sel[-1])])
+            resume_out = _codec.encode([t, run.key_at(sel[-1][0])])
             break
         if page_full:
             break
-    return ScanResult(list(projection), rows, resume_out, scanned)
+    return ScanResult(list(projection), rows, resume_out, int(total))
 
 
-def _materialize_row(run, schema, g, projection, key_pos):
-    """One selected global row from the run's host mirror (the same
-    payload sources the page server uses)."""
-    R = run.R
-    b, r = divmod(g, R)
+def _decode_buf_row(run, schema, buf_row, col_offs, shard_base,
+                    projection, key_pos, kinds):
+    """One packed gather output row -> result tuple (the engine's
+    fetched-plane decode split: fixed-width values from the device
+    planes, varlen/f32 payloads by setter index from the host mirror,
+    key columns from the group-start key)."""
+    from yugabyte_db_tpu.models.datatypes import DataType
+
     key_vals = None
     out = []
     for nm in projection:
         if nm in key_pos:
             if key_vals is None:
-                key_vals = run.key_vals_at(g)
+                key_vals = run.key_vals_at(shard_base + int(buf_row[0]))
             out.append(key_vals[key_pos[nm]])
             continue
         col = schema.column(nm)
-        cd = run.cols[col.col_id]
-        if not cd.set_[b, r] or cd.isnull[b, r]:
+        cmp_off, null_off, idx_off = col_offs[col.col_id]
+        if buf_row[null_off]:
             out.append(None)
             continue
-        kind = _kind(col)
+        kind = kinds[col.col_id]
         if kind in ("str", "f32"):
+            g = shard_base + int(buf_row[idx_off])
+            b, r = divmod(g, run.R)
             out.append(run.row_versions[b][r].columns[col.col_id])
         elif kind == "i32":
-            v = int(cd.cmp_planes[b, r, 0])
-            from yugabyte_db_tpu.models.datatypes import DataType
-
+            v = int(buf_row[cmp_off])
             out.append(bool(v) if col.dtype == DataType.BOOL else v)
         elif kind == "i64":
             out.append(int(PL.ordered_planes_to_i64(
-                cd.cmp_planes[b, r, 0:1], cd.cmp_planes[b, r, 1:2])[0]))
+                buf_row[cmp_off:cmp_off + 1],
+                buf_row[cmp_off + 1:cmp_off + 2])[0]))
         else:
             out.append(float(PL.ordered_planes_to_f64(
-                cd.cmp_planes[b, r, 0:1], cd.cmp_planes[b, r, 1:2])[0]))
+                buf_row[cmp_off:cmp_off + 1],
+                buf_row[cmp_off + 1:cmp_off + 2])[0]))
     return tuple(out)

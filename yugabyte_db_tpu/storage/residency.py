@@ -5,11 +5,21 @@ with a high-pri/low-pri pool split (sized and wired for docdb in
 docdb_rocksdb_util.cc) that lets SSTable working sets exceed RAM.  Here
 the cached unit is a whole columnar run's device plane group: the
 host-side ``ColumnarRun`` stays authoritative, ``TpuRun`` demand-uploads
-its ``DeviceRun`` through this cache on first access, and when the
-process-wide budget (``--tpu_hbm_budget_bytes``) is exceeded the least
-recently used unpinned plane group is dropped, releasing its device
-buffers and debiting the owning engine's ``device`` MemTracker subtree
-so /memz and /metrics show true residency.
+its ``DeviceRun`` through this cache on first access, and when a
+device's budget (``--tpu_hbm_budget_bytes``, PER DEVICE — each chip has
+its own HBM) is exceeded the least recently used unpinned plane group
+*on that device* is dropped, releasing its device buffers and debiting
+the owning engine's ``device`` MemTracker subtree so /memz and /metrics
+show true residency.
+
+The budget is a per-device map, not one process-wide pool: every entry
+belongs to exactly one owning device (demand re-uploads go back to it),
+except sharded mesh stacks, whose external registration carries a
+per-device byte map — one shard's bytes charged to the chip actually
+holding it.  Admission and eviction are scoped to the admitting
+device, so a hot working set on chip 0 never evicts chip 3's shards.
+On a single-device host the map has one bucket and behavior is
+byte-identical to the old process-wide budget.
 
 Scan resistance mirrors the reference's two-pool policy: point-get and
 bounded-scan traffic is admitted to (or promoted into) the protected
@@ -41,11 +51,16 @@ from collections import OrderedDict, deque
 from yugabyte_db_tpu.utils.flags import FLAGS
 from yugabyte_db_tpu.utils.locking import guarded_by
 from yugabyte_db_tpu.utils.memtracker import root_tracker
-from yugabyte_db_tpu.utils.metrics import hbm_cache_entity
+from yugabyte_db_tpu.utils.metrics import hbm_cache_entity, hbm_device_entity
 from yugabyte_db_tpu.utils.sync_point import sync_point
 
 # Fraction of the budget reserved for the protected (high-pri) pool.
 HIGH_PRI_POOL_RATIO = 0.8
+
+# Device bucket for callers that never name a device (single-chip hosts,
+# tests driving the cache directly).  Callers on a real mesh pass
+# "<platform>:<id>" strings (parallel.meshcompat.device_label).
+DEFAULT_DEVICE = "device:0"
 
 # Sentinel payload for externally-owned residency (bytes uploaded outside
 # the cache but accounted through it, e.g. the sharded mesh arrays).
@@ -64,9 +79,10 @@ def _pin_witness():
 class _Entry:
     __slots__ = ("key", "label", "tracker", "owner_ref", "payload",
                  "nbytes", "aux", "aux_bytes", "pins", "pool", "external",
-                 "encoding")
+                 "encoding", "device", "dev_bytes")
 
-    def __init__(self, key: int, label: str, tracker):
+    def __init__(self, key: int, label: str, tracker,
+                 device: str = DEFAULT_DEVICE):
         self.key = key
         self.label = label
         self.tracker = tracker
@@ -82,6 +98,12 @@ class _Entry:
         # "external"); sampled duck-typed from the payload at admit so
         # /memz can show which runs hold compressed bytes in HBM.
         self.encoding = "plain"
+        # The owning device: demand re-uploads target it, and its budget
+        # bucket is the one this entry's bytes count against.
+        self.device = device
+        # External mesh stacks only: per-device byte map (one shard's
+        # bytes on the chip holding it).  None for single-device entries.
+        self.dev_bytes: dict | None = None
 
     @property
     def total_bytes(self) -> int:
@@ -92,7 +114,7 @@ class _Entry:
 # appends to it lock-free (atomic deque), _drain_dead consumes under
 # _lock — see register().
 @guarded_by("_lock", "_entries", "_pools", "_next_key", "_resident",
-            "_peak_resident")
+            "_peak_resident", "_dev_resident")
 class HbmCache:
     """Process-wide capacity-budgeted cache of device plane groups.
 
@@ -121,6 +143,11 @@ class HbmCache:
         self._next_key = 1
         self._resident = 0
         self._peak_resident = 0
+        # Per-device residency + demand-upload accounting.  Buckets are
+        # created on first charge; each gets its {device=...}-labeled
+        # gauge/counter pair on the process registry.
+        self._dev_resident: dict[str, int] = {}
+        self._dev_upload: dict[str, object] = {}
         ent = hbm_cache_entity()
         self._m_hits = ent.counter("yb_hbm_cache_hits")
         self._m_misses = ent.counter("yb_hbm_cache_misses")
@@ -134,7 +161,7 @@ class HbmCache:
 
     @staticmethod
     def budget() -> int:
-        """Current byte budget; 0 means unbounded."""
+        """Current byte budget PER DEVICE; 0 means unbounded."""
         try:
             return int(FLAGS.get("tpu_hbm_budget_bytes"))
         except KeyError:
@@ -142,15 +169,19 @@ class HbmCache:
 
     # -- registration ---------------------------------------------------------
 
-    def register(self, owner, tracker=None, label: str = "") -> int:
+    def register(self, owner, tracker=None, label: str = "",
+                 device: str = DEFAULT_DEVICE) -> int:
         """A residency key for ``owner`` (a TpuRun or similar).  The
         entry auto-invalidates when ``owner`` is collected; ``tracker``
-        (the engine's device MemTracker) is charged while resident."""
+        (the engine's device MemTracker) is charged while resident.
+        ``device`` names the owning chip's budget bucket — demand
+        re-uploads for this key must target that device."""
         with self._lock:
             self._drain_dead()
             key = self._next_key
             self._next_key += 1
-            e = _Entry(key, label or type(owner).__name__, tracker)
+            e = _Entry(key, label or type(owner).__name__, tracker,
+                       device=device or DEFAULT_DEVICE)
             if owner is not None:
                 # Deliberate: the death callback only ENQUEUES into a
                 # deque (append is atomic under the GIL); _drain_dead
@@ -164,13 +195,17 @@ class HbmCache:
             return key
 
     def add_external(self, owner, nbytes: int, tracker=None,
-                     label: str = "external") -> int:
+                     label: str = "external",
+                     device: str = DEFAULT_DEVICE,
+                     dev_bytes: dict | None = None) -> int:
         """Account ``nbytes`` of device residency uploaded outside the
         cache (sharded mesh arrays, the overlay's masked valid plane).
         External entries are permanently pinned until invalidated (or
         their owner is collected); they overflow the budget rather than
-        being evictable."""
-        key = self.register(owner, tracker, label)
+        being evictable.  ``dev_bytes`` (device name -> bytes) charges a
+        multi-device upload per shard — the sharded mesh stacks — and
+        overrides ``nbytes``/``device`` when given."""
+        key = self.register(owner, tracker, label, device=device)
         with self._lock:
             self._drain_dead()
             e = self._entries.get(key)
@@ -179,7 +214,11 @@ class HbmCache:
             e.external = True
             e.payload = _EXTERNAL
             e.encoding = "external"
-            e.nbytes = int(nbytes)
+            if dev_bytes:
+                e.dev_bytes = {d: int(n) for d, n in dev_bytes.items()}
+                e.nbytes = sum(e.dev_bytes.values())
+            else:
+                e.nbytes = int(nbytes)
             e.pins = 1
             w = _pin_witness()
             if w is not None:
@@ -259,6 +298,16 @@ class HbmCache:
         """Acquire + pin: the payload stays resident until :meth:`unpin`."""
         return self.acquire(key, build, nbytes_hint, priority, pin=True)
 
+    def peek(self, key: int):
+        """The resident payload, or None — never builds, never reorders
+        the LRU pools.  For opportunistic reuse of planes that happen to
+        be on device (e.g. feeding a stacked-mesh tablet update from the
+        device-flush output) where a miss should NOT trigger an upload."""
+        with self._lock:
+            self._drain_dead()
+            e = self._entries.get(key)
+            return e.payload if e is not None else None
+
     def unpin(self, key: int) -> None:
         with self._lock:
             self._drain_dead()
@@ -270,10 +319,10 @@ class HbmCache:
                 w = _pin_witness()
                 if w is not None:
                     w.pin_released(key)
-            # Unpinning may unlock deferred evictions.
+            # Unpinning may unlock deferred evictions on this device.
             b = self.budget()
-            if b and self._resident > b:
-                self._evict_until(b)
+            if b and self._dev_resident.get(e.device, 0) > b:
+                self._evict_until(b, e.device)
 
     # -- derived-tensor side cars (pallas gather tensors) --------------------
 
@@ -298,8 +347,8 @@ class HbmCache:
             e.aux_bytes += int(nbytes)
             self._charge(e, int(nbytes))
             b = self.budget()
-            if b and self._resident > b:
-                self._evict_until(b)
+            if b and self._dev_resident.get(e.device, 0) > b:
+                self._evict_until(b, e.device)
 
     # -- internals ------------------------------------------------------------
 
@@ -318,9 +367,12 @@ class HbmCache:
 
     def _admit(self, e: _Entry, build, hint, priority, pin: bool):
         b = self.budget()
-        root_tracker().child("device").set_limit(b or None)
+        # The device MemTracker limit is the SUM of per-device budgets:
+        # one flag value per chip seen so far.
+        ndev = max(1, len(self._dev_resident))
+        root_tracker().child("device").set_limit((b * ndev) or None)
         if b and hint:
-            self._evict_until(max(b - int(hint), 0))
+            self._evict_until(max(b - int(hint), 0), e.device)
         payload, nbytes = build()
         e.payload = payload
         # DeviceRun payloads carry .encoded (compressed plane tree vs
@@ -340,16 +392,37 @@ class HbmCache:
                 w.pin_acquired(e.key, label=e.label)
         self._charge(e, e.nbytes)
         self._m_upload.increment(e.nbytes)
+        up = self._dev_upload.get(e.device)
+        if up is not None:
+            up.increment(e.nbytes)
         if b:
-            self._rebalance_high(b)
-            self._evict_until(b)
+            self._rebalance_high(b, e.device)
+            self._evict_until(b, e.device)
         sync_point("hbm_cache:admit", e.label)
         return payload
+
+    def _bump_dev(self, device: str, nbytes: int) -> None:
+        """Adjust one device's residency bucket (lock held); first touch
+        lazily creates the {device=...}-labeled metric series."""
+        if device not in self._dev_resident:
+            self._dev_resident[device] = 0
+            ent = hbm_device_entity(device)
+            ent.gauge("yb_hbm_resident_bytes",
+                      lambda d=device: self.device_resident_bytes(d))
+            self._dev_upload[device] = ent.counter(
+                "yb_hbm_demand_upload_bytes")
+        self._dev_resident[device] += nbytes
 
     def _charge(self, e: _Entry, nbytes: int) -> None:
         self._resident += nbytes
         if self._resident > self._peak_resident:
             self._peak_resident = self._resident
+        if e.dev_bytes is not None and nbytes == e.nbytes:
+            # External multi-device initial charge: split per shard.
+            for d, n in e.dev_bytes.items():
+                self._bump_dev(d, n)
+        else:
+            self._bump_dev(e.device, nbytes)
         if e.tracker is not None:
             e.tracker.consume(nbytes)
 
@@ -358,29 +431,38 @@ class HbmCache:
         e.pool = pool
         self._pools[pool][e.key] = e
 
-    def _rebalance_high(self, b: int) -> None:
+    def _rebalance_high(self, b: int, device: str) -> None:
+        """High-pool cap, per device: one chip's protected working set
+        can't demote another chip's."""
         cap = int(b * HIGH_PRI_POOL_RATIO)
         high = self._pools["high"]
         hb = sum(en.total_bytes for en in high.values()
-                 if not en.external)
+                 if not en.external and en.device == device)
         for k in list(high.keys()):
             if hb <= cap:
                 break
             en = high[k]
-            if en.external:
+            if en.external or en.device != device:
                 continue
             self._move_pool(en, "low")
             hb -= en.total_bytes
 
-    def _evict_until(self, target: int) -> None:
-        while self._resident > target:
-            if not self._evict_one():
+    def _evict_until(self, target: int, device: str | None = None) -> None:
+        """Evict LRU-first until ``device``'s bucket (or, with
+        device=None, global residency) is within ``target``."""
+        def over():
+            if device is None:
+                return self._resident > target
+            return self._dev_resident.get(device, 0) > target
+        while over():
+            if not self._evict_one(device):
                 break  # everything left is pinned: allowed overflow
 
-    def _evict_one(self) -> bool:
+    def _evict_one(self, device: str | None = None) -> bool:
         for pool_name in ("low", "high"):
             for en in self._pools[pool_name].values():
-                if en.pins == 0 and not en.external:
+                if en.pins == 0 and not en.external and (
+                        device is None or en.device == device):
                     self._release_entry(en, evicted=True)
                     return True
         return False
@@ -396,6 +478,12 @@ class HbmCache:
         e.payload = None
         e.aux = {}
         self._resident -= total
+        if e.dev_bytes is not None:
+            for d, n in e.dev_bytes.items():
+                self._bump_dev(d, -n)
+            e.dev_bytes = None
+        else:
+            self._bump_dev(e.device, -total)
         if e.tracker is not None:
             e.tracker.release(total)
         e.nbytes = 0
@@ -412,6 +500,14 @@ class HbmCache:
         with self._lock:
             self._drain_dead()
             return self._resident
+
+    def device_resident_bytes(self, device: str | None = None):
+        """One device's resident bytes, or the full {device: bytes} map
+        when ``device`` is None."""
+        with self._lock:
+            if device is not None:
+                return self._dev_resident.get(device, 0)
+            return dict(self._dev_resident)
 
     def pinned_bytes(self) -> int:
         with self._lock:
@@ -448,13 +544,30 @@ class HbmCache:
                                           {"entries": 0, "bytes": 0})
                     d["entries"] += 1
                     d["bytes"] += e.total_bytes
+            b = self.budget()
+            by_dev: dict[str, dict] = {
+                dev: {"resident_bytes": n, "budget_bytes": b,
+                      "entries": 0, "pinned_bytes": 0}
+                for dev, n in sorted(self._dev_resident.items())}
+            for pool in self._pools.values():
+                for e in pool.values():
+                    devs = (e.dev_bytes if e.dev_bytes is not None
+                            else {e.device: e.total_bytes})
+                    for dev, n in devs.items():
+                        d = by_dev.setdefault(
+                            dev, {"resident_bytes": 0, "budget_bytes": b,
+                                  "entries": 0, "pinned_bytes": 0})
+                        d["entries"] += 1
+                        if e.pins > 0:
+                            d["pinned_bytes"] += n
             out = {
-                "budget_bytes": self.budget(),
+                "budget_bytes": b,
                 "resident_bytes": self._resident,
                 "peak_resident_bytes": self._peak_resident,
                 "registered": len(self._entries),
                 "pools": pools,
                 "by_encoding": by_enc,
+                "by_device": by_dev,
             }
         out["pinned_bytes"] = self.pinned_bytes()
         out["hits"] = self._m_hits.get()

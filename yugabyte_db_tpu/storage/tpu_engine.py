@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import bisect
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +45,9 @@ from yugabyte_db_tpu.models.schema import Schema
 from yugabyte_db_tpu.ops import agg_fold
 from yugabyte_db_tpu.ops import encodings
 from yugabyte_db_tpu.ops import scan as dscan
-from yugabyte_db_tpu.ops.device_run import (DeviceRun, dtype_kind,
-                                            padded_blocks, plane_nbytes)
+from yugabyte_db_tpu.ops.device_run import (DeviceRun, device_label,
+                                            dtype_kind, padded_blocks,
+                                            plane_nbytes)
 from yugabyte_db_tpu.storage.residency import device_nbytes, hbm_cache
 from yugabyte_db_tpu.storage.breaker import CircuitBreaker
 from yugabyte_db_tpu.storage.columnar import ColumnarRun
@@ -78,6 +80,32 @@ PAD_BLOCKS = 64            # run block-axis padding (multiple of every window)
 HOST_GC_MASK_MAX = 2_000_000
 
 
+# Round-robin cursor for --tpu_run_placement=round_robin (module-level:
+# placement balances across ALL engines in the process, which is the
+# point — one tserver, one local mesh).
+_PLACE_LOCK = threading.Lock()
+_PLACE_NEXT = 0
+
+
+def _place_run():
+    """The device a new run's planes will live on, per
+    --tpu_run_placement."""
+    from yugabyte_db_tpu.utils.flags import FLAGS
+
+    global _PLACE_NEXT
+    devs = jax.local_devices()
+    try:
+        policy = FLAGS.get("tpu_run_placement")
+    except KeyError:
+        policy = "default"
+    if policy != "round_robin" or len(devs) == 1:
+        return devs[0]
+    with _PLACE_LOCK:
+        d = devs[_PLACE_NEXT % len(devs)]
+        _PLACE_NEXT += 1
+    return d
+
+
 class TpuRun:
     """A columnar run plus its managed device residency.
 
@@ -88,14 +116,21 @@ class TpuRun:
     access. Hold a :meth:`pin` across multi-dispatch windows so the
     accounting can't drop planes a dispatch still references."""
 
-    def __init__(self, crun: ColumnarRun, device_tracker=None):
+    def __init__(self, crun: ColumnarRun, device_tracker=None,
+                 device=None):
         self.crun = crun
         self.host_index = None  # storage.host_page.HostPageIndex, lazy
         self._dev_nbytes_hint: int | None = None
-        self._res_key = hbm_cache().register(self, device_tracker, "run")
+        # The owning device: every demand (re-)upload for this run
+        # targets it, so eviction/readmission cycles never migrate a
+        # run's bytes into another chip's budget bucket.
+        self.jax_device = device if device is not None else _place_run()
+        self._res_key = hbm_cache().register(
+            self, device_tracker, "run",
+            device=device_label(self.jax_device))
 
     def _build_dev(self):
-        d = DeviceRun(self.crun, PAD_BLOCKS)
+        d = DeviceRun(self.crun, PAD_BLOCKS, device=self.jax_device)
         return d, d.nbytes
 
     def _nbytes_hint(self) -> int:
@@ -121,6 +156,13 @@ class TpuRun:
 
     def unpin(self) -> None:
         hbm_cache().unpin(self._res_key)
+
+    def peek_device(self) -> DeviceRun | None:
+        """The resident DeviceRun if its planes are on device right now
+        (e.g. just seeded by the device flush), else None — no demand
+        upload, no LRU touch.  Lets the mesh stack update feed from
+        already-resident planes without paying for a miss."""
+        return hbm_cache().peek(self._res_key)
 
     def invalidate_device(self) -> None:
         """Drop any resident planes for a run that stays live (host

@@ -1,20 +1,38 @@
-"""MeshScanService: multi-tablet aggregates over the device mesh.
+"""MeshScanService: multi-tablet scans over the device mesh.
 
-The cluster read path for aggregates: instead of one ts.scan per tablet
-with the CLIENT merging partial aggregates on host (the reference's shape
-— per-tablet EvalAggregate partials recombined by the CQL executor /
-PG FDW, src/yb/docdb/pgsql_operation.cc:473), a tserver that leads
-several tablets of a table serves them with ONE device program: tablets
-sharded over the mesh "t" axis, each tablet's blocks over "b", partials
-combined with psum / two-plane lexicographic pmax over ICI
-(parallel.sharded.sharded_aggregate). The client-side host merge remains
-only as the cross-tserver / ineligible-spec fallback.
+The cluster read path for a tserver leading several tablets of a table:
+instead of one ts.scan per tablet with the CLIENT merging on host (the
+reference's shape — per-tablet EvalAggregate partials recombined by the
+CQL executor / PG FDW, src/yb/docdb/pgsql_operation.cc:473, and the
+batcher's thread-per-tablet row fan-out, src/yb/client/batcher.h:80),
+the tserver serves them with ONE device program: tablets sharded over
+the mesh "t" axis, each tablet's blocks over "b".
+
+- Aggregates: partials combined with psum / two-plane lexicographic
+  pmax over ICI (parallel.sharded.sharded_aggregate).
+- Row scans: the packed MVCC row gather runs on every (tablet,
+  block-range) shard, per-device match counts psum over ICI, and the
+  host decodes only the LIMIT page's rows
+  (parallel.sharded.sharded_row_page). Cross-tablet paging rides the
+  (tablet index, last key) resume token, opaque to the client.
+
+The client-side merge remains only as the cross-tserver / ineligible-
+spec fallback.
 
 Mesh policy: built once from the visible devices — "t" gets the larger
 factor (tablet parallelism is the dominant axis), "b" gets 2 when the
 device count is even. A single-chip node degenerates to a 1x1 mesh and
 still executes the same program (collectives become identities), so the
 code path is identical from laptop to pod slice.
+
+Stack lifecycle: stacked device residency is cached per run set. A
+flush/compaction replaces ONE tablet's ColumnarRun; when the stack is
+un-encoded the cache updates that tablet's slot in place with a jitted
+dynamic_update_slice — fed straight from the run's resident device
+planes (the PR-15 device-flush output) when they are on device, no host
+round trip. Otherwise the superseded stack's residency is released
+immediately (close() — in-flight scans holding the old arrays finish
+unharmed; the bytes leave the budget when the last reference dies).
 """
 
 from __future__ import annotations
@@ -22,13 +40,14 @@ from __future__ import annotations
 import threading
 
 from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
+from yugabyte_db_tpu.utils.fault_injection import maybe_fault
 
 
 class MeshScanService:
-    """Per-tserver service executing multi-tablet aggregate scans on the
-    device mesh. Stateless between calls except for a small cache of
-    stacked device residency (rebuilt whenever any tablet's run set
-    changes — flush/compaction replace ColumnarRun objects)."""
+    """Per-tserver service executing multi-tablet scans on the device
+    mesh. Stateless between calls except for a small cache of stacked
+    device residency, invalidated incrementally as flush/compaction
+    replace ColumnarRun objects."""
 
     def __init__(self, max_cached_stacks: int = 2):
         self._lock = threading.Lock()
@@ -36,7 +55,10 @@ class MeshScanService:
         self._stacks: dict[tuple, object] = {}
         self._max_cached = max_cached_stacks
         self.served = 0       # aggregates answered on the mesh
+        self.served_rows = 0  # row pages answered on the mesh
+        self.updated = 0      # stacks refreshed in place (update_tablet)
         self.fallbacks = 0    # ineligible requests bounced to per-tablet
+        self.chip_losses = 0  # mesh dispatches lost to a dropped chip
 
     def _get_mesh(self):
         if self._mesh is None:
@@ -67,35 +89,100 @@ class MeshScanService:
             return False
         return True
 
-    def aggregate(self, peers: list, spec: ScanSpec) -> ScanResult | None:
-        """Run spec's aggregates over all peers' tablets on the mesh.
-        Returns None when ineligible (caller falls back to per-tablet
-        scans + host combine)."""
-        from yugabyte_db_tpu.parallel import ShardedTablets, sharded_aggregate
-
-        if not spec.is_aggregate or spec.group_by:
-            self.fallbacks += 1
-            return None
-        if not all(self.eligible_peer(p, spec) for p in peers):
-            self.fallbacks += 1
-            return None
-        runs = [p.tablet.engine.runs[0].crun for p in peers]
+    def _get_stack(self, peers: list, runs: list):
+        """The cached ShardedTablets for this exact run set, refreshed
+        incrementally when exactly one tablet's run changed since a
+        cached stack (the flush/compaction case): the changed slot is
+        rewritten in place on device, seeded from the run's resident
+        flush planes when they exist. Full rebuilds release the
+        superseded stack's residency immediately. None = unbuildable
+        (caller falls back)."""
         key = tuple(id(r) for r in runs)
         mesh = self._get_mesh()
         with self._lock:
             st = self._stacks.get(key)
-            if st is None:
-                schema = peers[0].tablet.meta.schema
-                try:
-                    st = ShardedTablets(schema, runs, mesh)
-                except ValueError:
-                    st = None  # counted outside the lock
-                else:
-                    if len(self._stacks) >= self._max_cached:
-                        self._stacks.pop(next(iter(self._stacks)))
-                    self._stacks[key] = st
+            if st is not None:
+                return st
+            for okey in list(self._stacks):
+                if len(okey) != len(key):
+                    continue
+                diff = [i for i, (a, b) in enumerate(zip(okey, key))
+                        if a != b]
+                if len(diff) != 1:
+                    continue
+                t = diff[0]
+                ost = self._stacks[okey]
+                trun = peers[t].tablet.engine.runs[0]
+                dev = getattr(trun, "peek_device", lambda: None)()
+                if ost.update_tablet(t, runs[t],
+                                     device_arrays=(dev.arrays
+                                                    if dev is not None
+                                                    else None)):
+                    del self._stacks[okey]
+                    self._stacks[key] = ost
+                    self.updated += 1
+                    return ost
+                break
+            from yugabyte_db_tpu.parallel import ShardedTablets
+
+            schema = peers[0].tablet.meta.schema
+            try:
+                st = ShardedTablets(schema, runs, mesh)
+            except ValueError:
+                return None
+            while len(self._stacks) >= self._max_cached:
+                old = self._stacks.pop(next(iter(self._stacks)))
+                old.close()  # release residency; in-flight scans finish
+            self._stacks[key] = st
+            return st
+
+    def drop_stacks(self) -> int:
+        """Release every cached stack's residency (chip loss / device
+        hot-unplug: placements on the lost chip are unusable, so the
+        whole per-device footprint unwinds — in-flight scans holding
+        the old arrays finish unharmed). Subsequent eligible scans
+        rebuild on the surviving mesh. Returns the number dropped."""
+        with self._lock:
+            stacks = list(self._stacks.values())
+            self._stacks.clear()
+        for st in stacks:
+            st.close()
+        return len(stacks)
+
+    def _lost_chip(self) -> bool:
+        """The ``fault.mesh_dispatch`` point, evaluated right before a
+        device dispatch: a fired fault models a mesh chip dropping out
+        mid-scan. The service releases all stacked residency and bounces
+        the request to the per-tablet host path (byte-identical serve);
+        it does NOT retry on the device — the caller's fallback is the
+        availability story, exactly like the engine breaker's."""
+        if not maybe_fault("fault.mesh_dispatch"):
+            return False
+        self.chip_losses += 1
+        self.fallbacks += 1
+        self.drop_stacks()
+        return True
+
+    def _eligible_runs(self, peers: list, spec: ScanSpec):
+        if not all(self.eligible_peer(p, spec) for p in peers):
+            return None
+        return [p.tablet.engine.runs[0].crun for p in peers]
+
+    def aggregate(self, peers: list, spec: ScanSpec) -> ScanResult | None:
+        """Run spec's aggregates over all peers' tablets on the mesh.
+        Returns None when ineligible (caller falls back to per-tablet
+        scans + host combine)."""
+        from yugabyte_db_tpu.parallel import sharded_aggregate
+
+        if not spec.is_aggregate or spec.group_by:
+            self.fallbacks += 1
+            return None
+        runs = self._eligible_runs(peers, spec)
+        st = self._get_stack(peers, runs) if runs else None
         if st is None:
             self.fallbacks += 1
+            return None
+        if self._lost_chip():
             return None
         try:
             res = sharded_aggregate(st, spec)
@@ -103,4 +190,31 @@ class MeshScanService:
             self.fallbacks += 1
             return None  # spec not device-exact: fallback
         self.served += 1
+        return res
+
+    def rows(self, peers: list, spec: ScanSpec,
+             resume: bytes | None = None) -> ScanResult | None:
+        """Serve one LIMIT row page over all peers' tablets on the mesh
+        (parallel.sharded.sharded_row_page). ``resume`` is the previous
+        page's resume token (opaque (tablet index, last key)); tablet
+        indices resolve against THIS peer list, so callers must pass the
+        same tablet order every page. Returns None when ineligible."""
+        from yugabyte_db_tpu.parallel import sharded_row_page
+
+        if spec.is_aggregate or spec.group_by:
+            self.fallbacks += 1
+            return None
+        runs = self._eligible_runs(peers, spec)
+        st = self._get_stack(peers, runs) if runs else None
+        if st is None:
+            self.fallbacks += 1
+            return None
+        if self._lost_chip():
+            return None
+        try:
+            res = sharded_row_page(st, spec, resume=resume)
+        except ValueError:
+            self.fallbacks += 1
+            return None  # spec not device-exact: fallback
+        self.served_rows += 1
         return res

@@ -130,6 +130,27 @@ class TabletServer:
         self.webserver.add_json_handler("/rpcz", self.rpcz.dump)
         self.webserver.add_dashboard("/dashboards/tablets", "Tablets",
                                      _tablet_rows)
+
+        def _hbm_device_rows():
+            # Per-device residency: /memz's hbm_cache.by_device as a
+            # table, one row per mesh device (the labeled-gauge twin).
+            from yugabyte_db_tpu.storage.residency import hbm_cache
+
+            stats = hbm_cache().stats()
+            return [
+                {"device": dev,
+                 "resident_bytes": d["resident_bytes"],
+                 "budget_bytes": d["budget_bytes"],
+                 "pinned_bytes": d["pinned_bytes"],
+                 "entries": d["entries"],
+                 "utilization": (round(d["resident_bytes"]
+                                       / d["budget_bytes"], 3)
+                                 if d["budget_bytes"] else None)}
+                for dev, d in sorted(stats["by_device"].items())]
+
+        self.webserver.add_json_handler("/hbm-devices", _hbm_device_rows)
+        self.webserver.add_dashboard("/dashboards/hbm-devices",
+                                     "HBM devices", _hbm_device_rows)
         return self.webserver.start(host, port)
 
     def _rpc_entity(self, method: str):
@@ -1197,20 +1218,21 @@ class TabletServer:
         self.txn_notifier.trigger()
         return {"code": "ok"}
 
-    def _h_ts_multi_agg_scan(self, p: dict):
-        """Aggregate over MANY tablets this server leads, as ONE device
-        program over the mesh (tablets on the "t" axis, blocks on "b",
-        psum/pmax combine over ICI — tserver.mesh_scan). The client falls
-        back to per-tablet ts.scan + host combine on any non-ok reply."""
+    def _multi_scan_peers(self, p: dict):
+        """Shared front half of the multi-tablet mesh scan handlers:
+        gather the named peers (all must be leaders holding leases on
+        THIS server), pin one repeatable read point across all of them,
+        and resolve blocking intents. Returns (peers, spec, None) or
+        (None, None, error-reply)."""
         peers = []
         for tid in p["tablet_ids"]:
             try:
                 peer = self.tablet_manager.get(tid)
             except TabletNotFound:
-                return {"code": "not_found", "tablet_id": tid}
+                return None, None, {"code": "not_found", "tablet_id": tid}
             if not (peer.raft.is_leader() and peer.raft.has_lease()):
-                return {"code": "not_leader", "tablet_id": tid,
-                        "leader_hint": peer.raft.leader_uuid()}
+                return None, None, {"code": "not_leader", "tablet_id": tid,
+                                    "leader_hint": peer.raft.leader_uuid()}
             peers.append(peer)
         spec = wire.decode_spec(p["spec"])
         if spec.read_ht == wire.MAX_HT:
@@ -1223,16 +1245,45 @@ class TabletServer:
             deadline = self._rpc_deadline(p)
             for peer in peers:
                 if deadline.expired():
-                    return {"code": "timed_out"}
+                    return None, None, {"code": "timed_out"}
                 err = self._pin_read_point(peer, spec.read_ht,
                                            deadline.timeout())
                 if err is not None:
-                    return err
+                    return None, None, err
         for peer in peers:
             err = self._resolve_read_intents(peer, spec)
             if err is not None:
-                return err
+                return None, None, err
+        return peers, spec, None
+
+    def _h_ts_multi_agg_scan(self, p: dict):
+        """Aggregate over MANY tablets this server leads, as ONE device
+        program over the mesh (tablets on the "t" axis, blocks on "b",
+        psum/pmax combine over ICI — tserver.mesh_scan). The client falls
+        back to per-tablet ts.scan + host combine on any non-ok reply."""
+        peers, spec, err = self._multi_scan_peers(p)
+        if err is not None:
+            return err
         res = self.mesh_scan.aggregate(peers, spec)
+        if res is None:
+            return {"code": "ineligible"}
+        out = wire.encode_result(res)
+        out["code"] = "ok"
+        out["read_ht"] = spec.read_ht
+        return out
+
+    def _h_ts_multi_row_scan(self, p: dict):
+        """One LIMIT row page over MANY tablets this server leads, as ONE
+        device program over the mesh (the packed MVCC row gather sharded
+        on ("t", "b"), match counts psum over ICI — tserver.mesh_scan).
+        ``resume`` carries the previous page's cross-tablet resume token,
+        opaque to the client; tablet_ids must repeat in the same order
+        every page. The client falls back to per-tablet ts.scan paging on
+        any non-ok reply."""
+        peers, spec, err = self._multi_scan_peers(p)
+        if err is not None:
+            return err
+        res = self.mesh_scan.rows(peers, spec, resume=p.get("resume"))
         if res is None:
             return {"code": "ineligible"}
         out = wire.encode_result(res)

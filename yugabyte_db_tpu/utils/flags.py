@@ -87,11 +87,18 @@ FLAGS.define("tpu_engine_use_pallas", False,
              "hand-written Pallas fold kernel (ops.pallas_agg) instead "
              "of the XLA scan program", ("evolving", "runtime"))
 FLAGS.define("tpu_hbm_budget_bytes", 0,
-             "capacity budget for device-resident (HBM) columnar run "
-             "planes; 0 = unbounded. When set, run planes are "
-             "demand-uploaded through the storage.residency cache and "
-             "evicted LRU with a scan-resistant two-pool policy "
-             "(reference: rocksdb/util/cache.cc high-pri/low-pri split)",
+             "PER-DEVICE capacity budget for device-resident (HBM) "
+             "columnar run planes; 0 = unbounded. When set, run planes "
+             "are demand-uploaded through the storage.residency cache "
+             "and evicted LRU per device with a scan-resistant two-pool "
+             "policy (reference: rocksdb/util/cache.cc high-pri/low-pri "
+             "split). Each mesh chip gets its own bucket of this size",
+             ("evolving", "runtime"))
+FLAGS.define("tpu_run_placement", "default",
+             "which device a tablet's run planes live on: 'default' = "
+             "jax's default device (single-chip behavior), "
+             "'round_robin' = spread runs across the local mesh so "
+             "per-device HBM budgets are actually load-balanced",
              ("evolving", "runtime"))
 FLAGS.define("global_memstore_limit_bytes", 1 << 40,
              "process-wide memtable budget; crossing it flushes the "

@@ -347,6 +347,7 @@ def observe_serve_batch(proto: str, ops: int) -> None:
 
 # -- HBM residency-cache observability ----------------------------------------
 _HBM_ENTITY: MetricEntity | None = None
+_HBM_DEVICE_ENTITIES: dict[str, MetricEntity] = {}
 
 
 def hbm_cache_entity() -> MetricEntity:
@@ -360,6 +361,21 @@ def hbm_cache_entity() -> MetricEntity:
         if _HBM_ENTITY is None:
             _HBM_ENTITY = _PROCESS_REGISTRY.entity()
         return _HBM_ENTITY
+
+
+def hbm_device_entity(device: str) -> MetricEntity:
+    """Per-device HBM residency series: one ``{device=...}``-labeled
+    entity per mesh device, carrying
+    ``yb_hbm_resident_bytes{device=...}`` and
+    ``yb_hbm_demand_upload_bytes{device=...}``.  The unlabeled totals on
+    :func:`hbm_cache_entity` stay — both render under the same metric
+    name, the labeled series break the totals down by chip."""
+    with _SERVE_LOCK:
+        ent = _HBM_DEVICE_ENTITIES.get(device)
+        if ent is None:
+            ent = _PROCESS_REGISTRY.entity(device=device)
+            _HBM_DEVICE_ENTITIES[device] = ent
+        return ent
 
 
 _HOST_VERIFY_ENTITY: MetricEntity | None = None
