@@ -1386,6 +1386,32 @@ def bench_ycsb_a_cluster(n_keys=20_000, n_ops=24_000, workers=4,
     }
 
 
+def bench_traffic(seed=1234):
+    """Sustained-traffic replay: the seeded mixed-protocol sweep
+    (YCSB-A/B/E + TPC-H Q1/Q6 + Redis, zipfian hot keys) against a live
+    RF=3 cluster WHILE both seed tablets split, a follower rolls, and
+    the leader balancer moves leaders — the elasticity scenario, not a
+    steady-state ceiling. Emits the sweep's TRAFFIC_METRICS line
+    (per-protocol p50/p99 + ops/s, splits fired, leader moves) and
+    returns it as the section sub-metric."""
+    import tempfile
+
+    from yugabyte_db_tpu.integration.traffic_sweep import run_sweep
+
+    with tempfile.TemporaryDirectory() as root:
+        out = run_sweep(root, seed)
+    print("TRAFFIC_METRICS " + json.dumps(out, sort_keys=True))
+    return {
+        "metric": "traffic",
+        "value": out["ops_per_sec"],
+        "unit": ("ops/s (mixed YCSB/TPC-H/Redis under splits + "
+                 "rolling restart + leader rebalance, RF=3)"),
+        "splits_fired": out["splits_fired"],
+        "leader_moves": out["leader_moves"],
+        "protocols": out["protocols"],
+    }
+
+
 def bench_device_flush(schema, rows, make_engine, n=65_536):
     """Flush cost after the device-side overhaul: one memtable of n rows
     built into a sorted columnar run. The device path stages the op log,
@@ -1558,7 +1584,8 @@ def main():
     # run each one is isolated in a child interpreter; with --only we ARE
     # the child (or the user asked for exactly this section): in-process.
     for cname, cfn in (("cluster_write", bench_cluster_write),
-                       ("ycsb_a_cluster", bench_ycsb_a_cluster)):
+                       ("ycsb_a_cluster", bench_ycsb_a_cluster),
+                       ("traffic", bench_traffic)):
         if not want(cname):
             continue
         if ONLY is None:

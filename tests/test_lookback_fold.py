@@ -12,6 +12,7 @@ from yugabyte_db_tpu.storage.row_version import MAX_HT
 from tests.test_seg_fold import AGGS, assert_same_agg, enc, setup
 
 
+@pytest.mark.slow
 def test_lookback_route_taken(monkeypatch):
     """The ENGINE's aggregate planner must actually dispatch through
     lookback_fold for a bounded-version run (not fall to seg_fold)."""
@@ -39,6 +40,7 @@ def test_lookback_matches_oracle_many_read_points():
         assert_same_agg(cpu, tpu, read_ht=rp, aggregates=list(AGGS))
 
 
+@pytest.mark.slow
 def test_lookback_predicates_and_bounds():
     schema, cpu, tpu, ht = setup(seed=43)
     lo = enc(schema, "k0020", 0)
@@ -99,6 +101,7 @@ def test_lookback_matches_seg_fold_exactly():
                 assert vs == vl, (rp, ag)
 
 
+@pytest.mark.slow
 def test_lookback_randomized_blocks_sizes():
     for seed, rpb in ((61, 32), (62, 128), (63, 257)):
         schema, cpu, tpu, ht = setup(n=400, seed=seed,

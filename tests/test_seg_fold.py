@@ -132,7 +132,7 @@ def _pred_cases():
                 read_ht=ht, aggregates=list(AGGS),
                 predicates=[Predicate("a", "<", 0),
                             Predicate("d", "!=", 3)]),
-            id="two-predicates"),
+            id="two-predicates", marks=pytest.mark.slow),
         pytest.param(
             lambda schema, ht: dict(
                 read_ht=ht // 2, aggregates=list(AGGS),

@@ -135,3 +135,52 @@ def test_load_tester_workloads(cluster):
                    limit=50)
     assert out["scan"]["ops"] == 30 and out["scan"]["errors"] == 0
     assert out["scan"]["p99_us"] > 0
+
+
+def test_yb_admin_split_tablet_and_rebalance(cluster, capsys):
+    from yugabyte_db_tpu.client.client import YBClient
+    from yugabyte_db_tpu.client.session import YBSession
+    from yugabyte_db_tpu.models.datatypes import DataType
+    from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
+    from yugabyte_db_tpu.storage.scan_spec import ScanSpec
+    from yugabyte_db_tpu.tools import yb_admin
+    from yugabyte_db_tpu.tools.admin_client import AdminClient
+
+    client = YBClient.connect(cluster.master_addresses())
+    table = client.create_table("adm", [
+        ColumnSchema("k", DataType.STRING, ColumnKind.HASH),
+        ColumnSchema("v", DataType.INT64)], num_tablets=2)
+    s = YBSession(client)
+    for i in range(120):
+        s.insert(table, {"k": f"adm{i:04d}", "v": i})
+    s.flush()
+
+    admin = AdminClient.connect(cluster.master_addresses())
+    parent = admin.table_locations("adm")[0]["tablet_id"]
+    resp = admin.split_tablet("adm", parent)
+    children = resp["children"]
+    assert len(children) == 2
+    after = [t["tablet_id"] for t in admin.table_locations("adm")]
+    assert parent not in after and set(children) <= set(after)
+    # Data survives the split over the TCP path.
+    res = YBSession(client).scan(table, ScanSpec(projection=["k", "v"]))
+    assert dict(res.rows) == {f"adm{i:04d}": i for i in range(120)}
+
+    # CLI wiring: rebalance prints either a move or "balanced".
+    rc = yb_admin.main(["--master", cluster.master_addresses(),
+                        "rebalance"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "moved leader" in out or "balanced" in out
+    assert "leaders" in out  # the per-tserver count table
+
+    # Master dashboard: split lineage rendered parent -> children.
+    state = cluster.load()
+    master = next(d for d in state["daemons"] if d["role"] == "master")
+    page = _get(f"http://127.0.0.1:{master['web_port']}"
+                "/dashboards/tablet-splits").decode()
+    assert parent in page and children[0] in page
+    splits = json.loads(_get(
+        f"http://127.0.0.1:{master['web_port']}/tablet-splits"))
+    rec = next(r for r in splits if r["parent"] == parent)
+    assert rec["state"] == "COMMITTED"

@@ -16,6 +16,7 @@ from yugabyte_db_tpu.models.partition import compute_hash_code
 from yugabyte_db_tpu.models.schema import ColumnSchema, Schema
 from yugabyte_db_tpu.utils.metrics import count_swallowed
 from yugabyte_db_tpu.utils.retry import RetryPolicy
+from yugabyte_db_tpu.utils.status import TabletSplit
 
 
 class MasterUnavailable(Exception):
@@ -263,6 +264,16 @@ class YBClient:
                 if code == "not_found":
                     last = resp
                     continue  # replica being moved/created: try others
+                if code == "tablet_split":
+                    # The addressed tablet was split: invalidate exactly
+                    # that cache entry (siblings keep their locations +
+                    # leader hints) and hand re-planning to the caller —
+                    # the key now maps to a child tablet the server
+                    # can't name for us.
+                    self.meta_cache.invalidate_tablet(
+                        table_name, resp.get("tablet_id") or loc.tablet_id)
+                    raise TabletSplit(resp.get("tablet_id")
+                                      or loc.tablet_id)
                 if code == "ok":
                     if mark_leader:
                         self.meta_cache.mark_leader(table_name,
@@ -291,14 +302,30 @@ class YBClient:
                     self.refresh_tserver_addresses()
                 except Exception as e:  # noqa: BLE001 — best effort
                     count_swallowed("client.refresh_tserver_addresses", e)
+                locs = None
                 try:
                     locs = self.meta_cache.locations(table_name, refresh=True)
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                if locs is not None:
+                    found = False
                     for t in locs.tablets:
                         if t.tablet_id == loc.tablet_id:
                             loc = t
+                            found = True
                             break
-                except Exception as e:  # noqa: BLE001
-                    last = e
+                    if not found and any(
+                            t.contains(loc.partition_start)
+                            for t in locs.tablets):
+                        # The tablet vanished from the table's location
+                        # list AND other tablets now own its range: a
+                        # split committed while our cache named the
+                        # (now-deleted) parent. Hand re-planning to the
+                        # caller, same as the tablet_split wire code. A
+                        # listing that does NOT cover the range is a
+                        # transient partial view (master catching up) —
+                        # keep retrying, don't misreport a split.
+                        raise TabletSplit(loc.tablet_id)
             attempt.note(last)
         raise TabletOpFailed(
             f"{method} on {loc.tablet_id} failed before deadline: {last}")

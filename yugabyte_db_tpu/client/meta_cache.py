@@ -78,8 +78,14 @@ class MetaCache:
         return locs
 
     def lookup_by_hash(self, table_name: str, hash_code: int) -> TabletLocation:
-        """Route a key's hash code to its tablet (the EP-routing analog)."""
+        """Route a key's hash code to its tablet (the EP-routing analog).
+        A miss inside the table's range (invalidate_tablet punched the
+        owning tablet out after a split) does ONE refreshing lookup."""
         locs = self.locations(table_name)
+        for t in locs.tablets:
+            if t.contains(hash_code):
+                return t
+        locs = self.locations(table_name, refresh=True)
         for t in locs.tablets:
             if t.contains(hash_code):
                 return t
@@ -101,3 +107,33 @@ class MetaCache:
                 self._tables.clear()
             else:
                 self._tables.pop(table_name, None)
+
+    def invalidate_tablet(self, table_name: str, tablet_id: str) -> None:
+        """Per-TABLET invalidation (the tablet_split wire code's
+        contract): punch just the split tablet out of the cached
+        location list so the next lookup touching its range re-fetches,
+        while every sibling's cached location — and its learned leader
+        hint — survives (reference: meta_cache.cc marking one
+        RemoteTablet stale on TABLET_SPLIT instead of dropping the
+        table)."""
+        with self._lock:
+            locs = self._tables.get(table_name)
+            if locs is None:
+                return
+            kept = [t for t in locs.tablets if t.tablet_id != tablet_id]
+            if len(kept) == len(locs.tablets):
+                return  # unknown tablet: nothing cached to punch out
+            if kept:
+                locs.tablets = kept
+            else:
+                self._tables.pop(table_name, None)
+
+    def covers(self, table_name: str, hash_code: int) -> bool:
+        """True when the cached location list has a tablet owning
+        ``hash_code`` (False after invalidate_tablet punched its range
+        out — the caller should do a refreshing lookup)."""
+        with self._lock:
+            locs = self._tables.get(table_name)
+            if locs is None:
+                return False
+            return any(t.contains(hash_code) for t in locs.tablets)

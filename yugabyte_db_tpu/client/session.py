@@ -19,10 +19,21 @@ from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
 from yugabyte_db_tpu.storage.scan_spec import (AggSpec, Predicate, ScanResult,
                                                ScanSpec)
 from yugabyte_db_tpu.utils.metrics import count_swallowed
+from yugabyte_db_tpu.utils.status import TabletSplit
 
 # Key-column dtype codes for the native batch encoder (writeplane.cc).
 _KEY_DTYPE_CODE = {DataType.BOOL: 0, DataType.FLOAT: 2, DataType.DOUBLE: 2,
                    DataType.STRING: 3, DataType.BINARY: 4}
+
+
+def _row_hash_code(key: bytes) -> int:
+    """Partition hash of an encoded doc key (TAG_HASH + 2-byte code) —
+    re-routing a materialized row after a tablet split."""
+    from yugabyte_db_tpu.models.encoding import TAG_HASH
+
+    if len(key) >= 3 and key[0] == TAG_HASH:
+        return int.from_bytes(key[1:3], "big")
+    return 0
 
 
 def _table_block_desc(table: YBTable):
@@ -195,7 +206,7 @@ class YBSession:
                 g = row_groups.get(loc.tablet_id)
                 if g is None:
                     g = row_groups[loc.tablet_id] = (table, loc, [])
-                g[2].append(row)
+                g[2].append((hash_code, row))
 
         errors = []
         for name, table_ops in per_table.items():
@@ -229,16 +240,49 @@ class YBSession:
             except Exception as e:  # noqa: BLE001 — surfaced after sends
                 errors.append(e)
 
-        def send_rows(table, loc, rows):
-            self.client.tablet_rpc(
-                table.name, loc, "ts.write",
-                {"rows": wire.encode_rows(rows),
-                 # Exactly-once across retries: tablet_rpc resends the
-                 # SAME payload, so the id survives every retry attempt.
-                 "client_id": self.client.client_id,
-                 "request_id": self.client.next_request_id()},
-                timeout_s=timeout_s)
-            return len(rows)
+        def send_rows(table, loc, hrows):
+            """Write one tablet group of (hash_code, row) pairs. A
+            tablet_split reply means the target was sealed by a split
+            mid-flush: re-route every row by its hash through a fresh
+            location lookup and keep going until the writes land (the
+            split-commit window bounds how long the re-plan loop spins;
+            the flush deadline bounds it absolutely)."""
+            import time as _time
+
+            deadline = _time.monotonic() + timeout_s
+            pending = [(loc, hrows)]
+            written = 0
+            while pending:
+                l, hr = pending.pop()
+                try:
+                    self.client.tablet_rpc(
+                        table.name, l, "ts.write",
+                        {"rows": wire.encode_rows([r for _h, r in hr]),
+                         # Exactly-once across retries: tablet_rpc resends
+                         # the SAME payload, so the id survives every
+                         # retry attempt.
+                         "client_id": self.client.client_id,
+                         "request_id": self.client.next_request_id()},
+                        timeout_s=timeout_s)
+                    written += len(hr)
+                except TabletSplit:
+                    if _time.monotonic() >= deadline:
+                        raise
+                    _time.sleep(0.05)
+                    regrouped: dict = {}
+                    for h, r in hr:
+                        nl = self.client.meta_cache.lookup_by_hash(
+                            table.name, h)
+                        regrouped.setdefault(
+                            nl.tablet_id, (nl, []))[1].append((h, r))
+                    pending.extend(regrouped.values())
+            return written
+
+        def block_hrows(block):
+            # split re-plan fallback for a native block: materialize the
+            # rows and re-route them down the row path
+            return [(_row_hash_code(r.key), r)
+                    for r in rowblock.rows_from_block(block)]
 
         written = 0
         # Row groups replicate in parallel on the batcher pool while the
@@ -259,6 +303,12 @@ class YBSession:
                     table.name, loc, "ts.write_admit",
                     {"rows": block, "client_id": cid, "request_id": rid},
                     timeout_s=timeout_s)
+            except TabletSplit:
+                try:
+                    written += send_rows(table, loc, block_hrows(block))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                continue
             except Exception as e:  # noqa: BLE001 — surfaced after joins
                 errors.append(e)
                 continue
@@ -281,6 +331,14 @@ class YBSession:
                         {"rows": block, "client_id": cid,
                          "request_id": rid}, timeout_s=timeout_s)
                 written += n
+            except TabletSplit:
+                # Sealed mid-pipeline: the admitted entry either landed
+                # below the seal (value-identical re-apply on the child)
+                # or was never admitted — re-route down the row path.
+                try:
+                    written += send_rows(table, loc, block_hrows(block))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
         for f in futs:
@@ -316,7 +374,15 @@ class YBSession:
         """Batched point reads: keys group by tablet and each tablet
         serves its whole group in ONE scan-batch RPC (reference: the
         batcher packing many ops per tserver call,
-        src/yb/client/batcher.h:80). Results align with kv_list."""
+        src/yb/client/batcher.h:80). Results align with kv_list.
+        Re-plans from refreshed locations when a tablet splits
+        mid-batch (reads are idempotent: a full replay is safe)."""
+        return self._split_replan(
+            table, timeout_s,
+            lambda: self._get_many_once(table, kv_list, timeout_s))
+
+    def _get_many_once(self, table: YBTable, kv_list: list[dict],
+                       timeout_s: float) -> list[tuple | None]:
         from yugabyte_db_tpu.models.encoding import prefix_successor
 
         groups: dict = {}
@@ -353,8 +419,41 @@ class YBSession:
                 return r
         return None
 
+    def _split_replan(self, table: YBTable, timeout_s: float, fn):
+        """Run an idempotent read ``fn``, restarting it from refreshed
+        locations whenever a tablet splits underneath it. During the
+        seal->commit window the refreshed list still names the sealed
+        parent, so the loop keeps re-trying (bounded by timeout_s)
+        until the children start serving."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                return fn()
+            except TabletSplit as e:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.05)
+                try:
+                    self.client.meta_cache.locations(table.name,
+                                                     refresh=True)
+                except Exception as err:  # noqa: BLE001 — retry decides
+                    count_swallowed("session.split_replan", err)
+                del e
+
     def scan(self, table: YBTable, spec: ScanSpec,
              timeout_s: float = 30.0, stale_ok: bool = False) -> ScanResult:
+        """Split-aware scan entry point: the fan-out restarts from
+        refreshed locations when a tablet splits mid-scan (scans are
+        idempotent; a full replay cannot duplicate side effects)."""
+        return self._split_replan(
+            table, timeout_s,
+            lambda: self._scan_once(table, spec, timeout_s, stale_ok))
+
+    def _scan_once(self, table: YBTable, spec: ScanSpec,
+                   timeout_s: float = 30.0,
+                   stale_ok: bool = False) -> ScanResult:
         """Fan a scan out over the table's tablets and merge.
 
         Row scans: tablets are visited in partition order, honoring

@@ -37,6 +37,7 @@ from yugabyte_db_tpu.models.datatypes import DataType
 from yugabyte_db_tpu.models.schema import ColumnKind, ColumnSchema
 from yugabyte_db_tpu.storage import wire
 from yugabyte_db_tpu.storage.row_version import MAX_HT, RowVersion
+from yugabyte_db_tpu.utils.status import TabletSplit
 from yugabyte_db_tpu.txn.coordinator import TXN_STATUS_TABLE
 from yugabyte_db_tpu.txn.errors import (TransactionAborted,
                                         TransactionConflict)
@@ -116,8 +117,20 @@ class TransactionManager:
         locs = self.client.meta_cache.locations(TXN_STATUS_TABLE)
         loc = random.choice(locs.tablets)
         txn_id = uuid_mod.uuid4().hex
-        resp = self.client.tablet_rpc(
-            TXN_STATUS_TABLE, loc, "ts.txn_create", {"txn_id": txn_id})
+        try:
+            resp = self.client.tablet_rpc(
+                TXN_STATUS_TABLE, loc, "ts.txn_create",
+                {"txn_id": txn_id})
+        except TabletSplit:
+            # The cached status tablet was superseded (a split committed,
+            # or a concurrent first-begin recreated the table): re-resolve
+            # once against a fresh listing and retry.
+            locs = self.client.meta_cache.locations(
+                TXN_STATUS_TABLE, refresh=True)
+            loc = random.choice(locs.tablets)
+            resp = self.client.tablet_rpc(
+                TXN_STATUS_TABLE, loc, "ts.txn_create",
+                {"txn_id": txn_id})
         txn = YBTransaction(self, txn_id, loc, resp["read_ht"])
         self._register(txn)
         return txn

@@ -66,6 +66,13 @@ class CatalogState:
         # (reference: SysSnapshotEntryPB states driven by
         # src/yb/tserver/backup.proto TabletSnapshotOp).
         self.snapshots: dict[str, dict] = {}
+        # Tablet-split lineage: parent tablet_id -> {"table_id",
+        # "split_hash", "children": [low_id, high_id], "state"
+        # ("SPLITTING" until split_commit, then "COMMITTED")}. Kept
+        # after commit for the /dashboards/tablets lineage view
+        # (reference: the split_parent_tablet_id back-links of
+        # SysTabletsEntryPB).
+        self.splits: dict[str, dict] = {}
 
     def apply(self, op: dict) -> None:
         kind = op["op"]
@@ -148,6 +155,43 @@ class CatalogState:
                 if t is not None:
                     t.indexes = [i for i in t.indexes
                                  if i["name"] != op["name"]]
+            elif kind == "split_tablet":
+                # Phase 2 of a tablet split: register BOTH children (with
+                # their intended replica sets) and the lineage BEFORE any
+                # child replica exists, so the heartbeat orphan-GC never
+                # mistakes a freshly created child for a deleted tablet.
+                # Children are NOT yet in table.tablet_ids: lookups keep
+                # resolving to the parent until split_commit swaps them.
+                t = self.tables.get(op["table_id"])
+                if t is None or op["tablet_id"] not in self.tablets:
+                    return  # replay after delete_table / double apply
+                for cd in op["children"]:
+                    if cd["tablet_id"] not in self.tablets:
+                        self.tablets[cd["tablet_id"]] = TabletInfo(
+                            cd["tablet_id"], t.table_id,
+                            cd["partition_start"], cd["partition_end"],
+                            list(cd["replicas"]))
+                self.splits[op["tablet_id"]] = {
+                    "table_id": t.table_id,
+                    "split_hash": op["split_hash"],
+                    "children": [cd["tablet_id"]
+                                 for cd in op["children"]],
+                    "state": "SPLITTING"}
+            elif kind == "split_commit":
+                # Phase 6: atomically swap parent -> children in the
+                # table's serving list and drop the parent TabletInfo —
+                # the next heartbeat's orphan-GC tombstones its replicas.
+                t = self.tables.get(op["table_id"])
+                parent_id = op["tablet_id"]
+                if t is not None and parent_id in t.tablet_ids:
+                    idx = t.tablet_ids.index(parent_id)
+                    t.tablet_ids[idx:idx + 1] = [
+                        c for c in op["children"]
+                        if c not in t.tablet_ids]
+                self.tablets.pop(parent_id, None)
+                s = self.splits.get(parent_id)
+                if s is not None:
+                    s["state"] = "COMMITTED"
             elif kind == "alter_table":
                 t = self.tables.get(op["table_id"])
                 # versions only move forward (idempotent across replays)
@@ -178,3 +222,12 @@ class CatalogState:
     def known_tablet_ids(self) -> set[str]:
         with self._lock:
             return set(self.tablets)
+
+    def split_lineage(self) -> list[dict]:
+        """Parent -> children rows for the tablets dashboard."""
+        with self._lock:
+            return [{"parent": pid, "table_id": s["table_id"],
+                     "split_hash": s["split_hash"],
+                     "children": list(s["children"]),
+                     "state": s["state"]}
+                    for pid, s in self.splits.items()]

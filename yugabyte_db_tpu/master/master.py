@@ -20,6 +20,8 @@ import uuid as uuid_mod
 from yugabyte_db_tpu.consensus.metadata import ConsensusMetadata, RaftConfig
 from yugabyte_db_tpu.consensus.raft import NotLeader, RaftConsensus, RaftOptions
 from yugabyte_db_tpu.master.catalog import CatalogState
+from yugabyte_db_tpu.master.load_balancer import LeaderBalancer
+from yugabyte_db_tpu.master.split_manager import SplitError, SplitManager
 from yugabyte_db_tpu.master.ts_manager import TSManager
 from yugabyte_db_tpu.models.partition import PartitionSchema
 from yugabyte_db_tpu.models.schema import Schema
@@ -57,6 +59,8 @@ class Master:
         self.instance = _fs.format_or_open(fs_root, uuid)
         self.catalog = CatalogState()
         self.ts_manager = TSManager(ts_unresponsive_timeout_s)
+        self.split_manager = SplitManager(self)
+        self.load_balancer = LeaderBalancer(self)
         self.balance_interval_s = balance_interval_s
         self.clock = HybridClock()
         sys_dir = os.path.join(fs_root, "sys-catalog")
@@ -145,14 +149,28 @@ class Master:
                     for t in self.catalog.list_tables()]
 
         def _tablets_rows():
+            # split lineage annotations: a serving child links back to
+            # its parent; lineage records themselves are separate rows.
+            child_of = {c: pid
+                        for pid, s in self.catalog.splits.items()
+                        for c in s["children"]}
             return [{"tablet_id": i.tablet_id, "table_id": i.table_id,
                      "leader": self.ts_manager.leader_of(i.tablet_id),
-                     "replicas": i.replicas}
+                     "replicas": i.replicas,
+                     "split_parent": child_of.get(i.tablet_id)}
                     for t in self.catalog.list_tables()
                     for i in self.catalog.tablets_of(t.table_id)]
 
+        def _splits_rows():
+            return [{"parent": r["parent"],
+                     "children": " ".join(r["children"]),
+                     "split_hash": r["split_hash"],
+                     "state": r["state"]}
+                    for r in self.catalog.split_lineage()]
+
         self.webserver.add_json_handler("/tables", _tables_rows)
         self.webserver.add_json_handler("/tablets", _tablets_rows)
+        self.webserver.add_json_handler("/tablet-splits", _splits_rows)
         self.webserver.add_json_handler("/rpcz", self.rpcz.dump)
 
         def _tservers_rows():
@@ -161,6 +179,9 @@ class Master:
             live = {d.uuid for d in self.ts_manager.live_tservers()}
             return [{"uuid": d.uuid, "live": d.uuid in live,
                      "tablets": d.num_live_tablets,
+                     # balancer skew input: leaders this tserver hosts
+                     "leaders": sum(1 for r in d.tablet_roles.values()
+                                    if r == "leader"),
                      "last_heartbeat_age_s": round(
                          _t.monotonic() - d.last_heartbeat, 1)}
                     for d in self.ts_manager.all_tservers()]
@@ -169,6 +190,8 @@ class Master:
                                      _tables_rows)
         self.webserver.add_dashboard("/dashboards/tablets", "Tablets",
                                      _tablets_rows)
+        self.webserver.add_dashboard("/dashboards/tablet-splits",
+                                     "Tablet splits", _splits_rows)
         self.webserver.add_dashboard("/dashboards/tablet-servers",
                                      "Tablet servers", _tservers_rows)
         return self.webserver.start(host, port)
@@ -495,6 +518,41 @@ class Master:
                 except Exception as e:  # noqa: BLE001 — heartbeat GC retries
                     count_swallowed("master.delete_tablet", e)
         return {"code": "ok"}
+
+    # -- tablet splitting / leader balancing (admin RPCs) --------------------
+    def _h_master_split_tablet(self, p: dict):
+        """Manually split one tablet (yb_admin split_tablet). Works
+        regardless of the automatic-splitting flags — the thresholds
+        gate the background pass, not the protocol."""
+        if not self.raft.is_leader():
+            return self._not_leader()
+        tid = p["tablet_id"]
+        info = self.catalog.tablets.get(tid)
+        if info is None:
+            return {"code": "not_found"}
+        if p.get("table"):
+            t = self.catalog.table_by_name(p["table"])
+            if t is None or info.table_id != t.table_id:
+                return {"code": "not_found",
+                        "message": f"tablet {tid} is not in table "
+                                   f"{p['table']}"}
+        try:
+            res = self.split_manager.split(
+                tid, timeout=float(p.get("timeout", 30.0)))
+        except NotLeader:
+            return self._not_leader()
+        except SplitError as e:
+            return {"code": "error", "message": str(e)}
+        return {"code": "ok", **res}
+
+    def _h_master_rebalance(self, p: dict):
+        """Run one forced leader-balancing pass (yb_admin rebalance);
+        returns the move made, or move=None when already balanced."""
+        if not self.raft.is_leader():
+            return self._not_leader()
+        move = self.load_balancer.run_pass(force=True)
+        return {"code": "ok", "move": move,
+                "leader_counts": self.ts_manager.leader_counts()}
 
     # -- lookups ------------------------------------------------------------
     def _h_master_get_table(self, p: dict):
@@ -870,6 +928,14 @@ class Master:
                 self._retry_pending_alters()
             except Exception as e:  # noqa: BLE001 — next tick retries
                 count_swallowed("master.retry_alters_tick", e)
+            try:
+                self.split_manager.run_pass()
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                count_swallowed("master.split_tick", e)
+            try:
+                self.load_balancer.run_pass()
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                count_swallowed("master.balance_tick", e)
 
     def _deliver_schema(self, info, schema_dict: dict) -> bool:
         """Push a schema version to one tablet's leader (whichever

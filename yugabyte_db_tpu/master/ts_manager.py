@@ -40,6 +40,13 @@ class TSManager:
         # freshest leader replica — the authoritative membership view the
         # repair paths compare against the catalog.
         self._tablet_configs: dict[str, tuple[tuple, int]] = {}
+        # Split-manager inputs, from the LEADER replica's heartbeat
+        # stats: on-disk size, and the raw data-op counter differentiated
+        # across successive samples into an ops/s rate (soft state, like
+        # everything else here).
+        self._tablet_sizes: dict[str, int] = {}
+        self._tablet_ops: dict[str, tuple[int, float]] = {}
+        self._tablet_rates: dict[str, float] = {}
         self.unresponsive_timeout_s = unresponsive_timeout_s
 
     def heartbeat(self, req: dict) -> None:
@@ -53,19 +60,38 @@ class TSManager:
             d.cloud_info = req.get("cloud_info") or {}
             d.last_heartbeat = now
             d.num_live_tablets = req.get("num_live_tablets", 0)
-            d.tablet_roles = {t["tablet_id"]: t["role"]
+            # Normalize roles at the ingestion boundary: raft reports
+            # "LEADER"/"FOLLOWER" (Role enum values) while every
+            # consumer here compares lowercase.
+            d.tablet_roles = {t["tablet_id"]: str(t.get("role", "")).lower()
                               for t in req.get("tablets", [])}
             for t in req.get("tablets", []):
+                role = str(t.get("role", "")).lower()
                 leader, term = t.get("leader"), t.get("term", 0)
                 if leader:
                     cur = self._tablet_leaders.get(t["tablet_id"])
                     if cur is None or term >= cur[1]:
                         self._tablet_leaders[t["tablet_id"]] = (leader, term)
-                if t.get("role") == "leader" and t.get("peers"):
+                if role == "leader" and t.get("peers"):
                     cur = self._tablet_configs.get(t["tablet_id"])
                     if cur is None or term >= cur[1]:
                         self._tablet_configs[t["tablet_id"]] = (
                             tuple(t["peers"]), term)
+                st = t.get("stats")
+                if st and role == "leader":
+                    tid = t["tablet_id"]
+                    self._tablet_sizes[tid] = st.get("size_bytes", 0)
+                    ops = st.get("ops_seen", 0)
+                    prev = self._tablet_ops.get(tid)
+                    self._tablet_ops[tid] = (ops, now)
+                    if prev is not None and now > prev[1]:
+                        delta = ops - prev[0]
+                        if delta < 0:
+                            # counter restarted (tserver bounce or
+                            # leadership moved to a fresh replica)
+                            delta = ops
+                        self._tablet_rates[tid] = \
+                            delta / (now - prev[1])
 
     def live_tservers(self) -> list[TSDescriptor]:
         cutoff = time.monotonic() - self.unresponsive_timeout_s
@@ -103,3 +129,31 @@ class TSManager:
         with self._lock:
             d = self._descs.get(uuid)
             return dict(d.cloud_info) if d else {}
+
+    def tablet_load(self, tablet_id: str) -> tuple[int, float]:
+        """(size_bytes, ops_per_sec) from the leader's latest heartbeat
+        stats — the split manager's trigger inputs."""
+        with self._lock:
+            return (self._tablet_sizes.get(tablet_id, 0),
+                    self._tablet_rates.get(tablet_id, 0.0))
+
+    def forget_tablet(self, tablet_id: str) -> None:
+        """Drop soft per-tablet state after a split removes the tablet
+        (stale rate samples must not re-trigger on a reused id)."""
+        with self._lock:
+            self._tablet_sizes.pop(tablet_id, None)
+            self._tablet_ops.pop(tablet_id, None)
+            self._tablet_rates.pop(tablet_id, None)
+            self._tablet_leaders.pop(tablet_id, None)
+            self._tablet_configs.pop(tablet_id, None)
+
+    def leader_counts(self) -> dict[str, int]:
+        """LIVE tserver uuid -> number of tablet leaders it hosts (the
+        leader balancer's skew input). Every live tserver appears, even
+        with zero leaders — an idle node is the balancer's best target."""
+        cutoff = time.monotonic() - self.unresponsive_timeout_s
+        with self._lock:
+            return {d.uuid: sum(1 for r in d.tablet_roles.values()
+                                if r == "leader")
+                    for d in self._descs.values()
+                    if d.last_heartbeat >= cutoff}

@@ -5,7 +5,9 @@ key columns onto a uint16 space (kMaxPartitionKey = 65535, partition.h:156;
 EncodeMultiColumnHashValue partition.h:204; HashColumnCompoundValue
 partition.h:274), split evenly into N tablets at table-creation time
 (CatalogManager::CreateTabletsFromTable, src/yb/master/catalog_manager.cc:2274).
-There is no auto-splitting (matching reference v1.2.4).
+The initial split is even; master-driven tablet splitting
+(master/split_manager.py) can later divide a hot tablet at the median
+resident key hash, so ranges need not stay uniform over time.
 
 The hash function differs from the reference's Jenkins hash by design (we are
 not wire-compatible with YB's on-disk layout); it only needs to be stable and

@@ -45,6 +45,13 @@ class TabletMetadata:
     # [{"name", "column", "index_table"}] (reference: the IndexMap the
     # tablet consults in UpdateQLIndexes, tablet.cc:1015).
     indexes: list = None
+    # Sealed for a tablet split: every data RPC answers "tablet_split"
+    # and the frozen state has been (or is being) forked into the
+    # children. Persisted so a crash between the seal and the parent's
+    # deletion cannot resurrect a writable parent — the seal entry
+    # itself may sit below the flushed replay frontier by then
+    # (reference: the kSplit tablet-data state of tablet_metadata.h).
+    split_sealed: bool = False
 
     def __post_init__(self):
         if self.indexes is None:
@@ -62,6 +69,7 @@ class TabletMetadata:
                 "engine": self.engine,
                 "flushed_op_index": self.flushed_op_index,
                 "indexes": self.indexes,
+                "split_sealed": self.split_sealed,
             }, f)
             f.flush()
             os.fsync(f.fileno())
@@ -75,6 +83,7 @@ class TabletMetadata:
             d["tablet_id"], d["table_name"], Schema.from_dict(d["schema"]),
             d["partition_start"], d["partition_end"], d["engine"],
             d["flushed_op_index"], d.get("indexes") or [],
+            d.get("split_sealed", False),
         )
 
 
@@ -394,11 +403,27 @@ class Tablet:
             self._apply_write_body(entry)
         elif entry.op_type == "alter_schema":
             self._apply_alter_schema(entry.body)
+        elif entry.op_type == "split_seal":
+            self._apply_split_seal()
         elif entry.op_type in ("create_snapshot", "restore_snapshot",
                                "delete_snapshot"):
             self._apply_snapshot_op(entry.op_type, entry.body)
         else:
             self._apply_txn_op(entry)
+
+    def _apply_split_seal(self) -> None:
+        """Apply the split-seal entry: freeze this tablet for its split.
+        Runs at one log position on every replica, so each rejects data
+        RPCs from the same point in the write sequence; everything at or
+        below the seal is captured by the parent's fork snapshot, and
+        everything after it is bounced to the clients with the
+        ``tablet_split`` code to retry against the children. Idempotent
+        across WAL replays; persisted immediately so a post-flush crash
+        cannot replay the tablet back into service unsealed."""
+        if self.meta.split_sealed:
+            return
+        self.meta.split_sealed = True
+        self.meta.save(self.meta_path)
 
     def _apply_alter_schema(self, body: dict) -> None:
         """Adopt a replicated schema change (idempotent across replays:

@@ -25,6 +25,20 @@ from yugabyte_db_tpu.storage.scan_spec import ScanResult, ScanSpec
 from yugabyte_db_tpu.tablet.tablet import (Tablet, TabletMetadata,
                                            _encode_rows)
 from yugabyte_db_tpu.utils.hybrid_time import HybridClock, HybridTime
+from yugabyte_db_tpu.utils.status import TabletSplit
+
+
+def _key_hash(key: bytes) -> int:
+    """Partition hash of an encoded DocKey: the big-endian uint16 after
+    the hash tag byte (models/encoding.py encode_doc_key_prefix).
+    Range-partitioned keys (no hash tag) all map to 0 — they live in a
+    single full-range tablet and are never split."""
+    import struct
+
+    from yugabyte_db_tpu.models.encoding import TAG_HASH
+    if len(key) >= 3 and key[0] == TAG_HASH:
+        return struct.unpack(">H", key[1:3])[0]
+    return 0
 
 
 class TabletPeer:
@@ -66,6 +80,17 @@ class TabletPeer:
         # required; clients may disappear after admission).
         self._mvcc_unresolved: dict = {}
         self.raft.on_entries_truncated = self._on_entries_truncated
+        # Monotone count of data ops (writes + scans) this replica
+        # served — reported raw in the master heartbeat, which turns
+        # successive samples into the per-tablet op RATE the split
+        # manager and leader balancer feed on. Bumped without a lock
+        # (a lost increment only shaves the rate estimate).
+        self.ops_seen = 0
+        # Set (under _intent_lock) the moment a split seal is being
+        # appended: admissions behind the flag bounce with TabletSplit
+        # BEFORE entering the log, so every admitted write sits below
+        # the seal entry and is captured by the fork snapshot.
+        self._split_sealing = False
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -112,6 +137,8 @@ class TabletPeer:
         call). Returns an opaque token for write_finish."""
         if not (self.raft.is_leader() and self.raft.leader_ready()):
             raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        if self._split_sealing or self.tablet.meta.split_sealed:
+            raise TabletSplit(self.tablet_id)
         self._purge_inflight_rids()
         if any(r.increments for r in rows):
             # increments resolve under the tserver's intent-admission
@@ -170,6 +197,8 @@ class TabletPeer:
 
         if not (self.raft.is_leader() and self.raft.leader_ready()):
             raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        if self._split_sealing or self.tablet.meta.split_sealed:
+            raise TabletSplit(self.tablet_id)
         self._purge_inflight_rids()
         rid = None
         rid_key = None
@@ -428,6 +457,84 @@ class TabletPeer:
             flushed = self.tablet.meta.flushed_op_index
         return {"entries": entries, "tail": tail,
                 "flushed_op_index": flushed}
+
+    # -- tablet splitting ----------------------------------------------------
+    def split_key_hash(self) -> int | None:
+        """The partition hash of this tablet's median RESIDENT key —
+        the split point a size/load-triggered split divides the range
+        at (reference: the mid-key the reference asks the largest SST
+        for in TabletServiceAdminImpl::GetSplitKey). Flushes first so
+        the memtable is counted. None when the resident keys span
+        fewer than two distinct hash codes (nothing to divide)."""
+        with self._maintenance_lock:
+            self.raft.wait_apply_drained()
+            self.tablet.flush()
+            entries = self.tablet.engine.dump_entries()
+        hashes = sorted({_key_hash(key) for key, _vers in entries})
+        if len(hashes) < 2:
+            return None
+        # Split ABOVE the median hash: keys at the median stay in the
+        # low child, so both children are non-empty by construction.
+        return hashes[len(hashes) // 2]
+
+    def split_seal(self, timeout=10.0) -> None:
+        """Seal this tablet for a split: replicate a ``split_seal``
+        entry through its own Raft log. The sealing flag flips under
+        the intent-admission lock BEFORE the append, so every admitted
+        write sits at a lower log index than the seal — once the seal
+        entry applies (in order, behind them all), the tablet's state
+        is the complete frozen prefix the children are forked from.
+        Idempotent; leader-only."""
+        if not (self.raft.is_leader() and self.raft.leader_ready()):
+            raise NotLeader(self.node_uuid, self.raft.leader_uuid())
+        with self._intent_lock:
+            if self.tablet.meta.split_sealed:
+                return
+            self._split_sealing = True
+        try:
+            self.replicate_txn_op("split_seal", {}, timeout)
+        except BaseException:
+            # Replication failed (leader change / timeout): don't leave
+            # this replica wedged rejecting writes for a seal that may
+            # never commit — the flag re-arms if the master retries here.
+            with self._intent_lock:
+                if not self.tablet.meta.split_sealed:
+                    self._split_sealing = False
+            raise
+
+    def split_fork_rows(self, lower: int, upper: int) -> list:
+        """Range-clamped frozen rows of a SEALED parent: every
+        (key, versions) entry whose partition hash falls in
+        [lower, upper), tombstones and all — the seed payload for one
+        child. The seal already froze the log, so after the apply
+        drain + flush the dump is the tablet's final state."""
+        if not self.tablet.meta.split_sealed:
+            raise RuntimeError(
+                f"tablet {self.tablet_id} is not sealed for split")
+        with self._maintenance_lock:
+            self.raft.wait_apply_drained()
+            self.tablet.flush()
+            entries = self.tablet.engine.dump_entries()
+        return [(key, vers) for key, vers in entries
+                if lower <= _key_hash(key) < upper]
+
+    def split_seed(self, rows: list[RowVersion], timeout=10.0,
+                   chunk: int = 1024) -> int:
+        """Seed a CHILD tablet from its parent's forked rows: the child
+        LEADER replicates ordinary ``write`` entries through the
+        child's OWN Raft log (chunked), so every child replica builds
+        the identical seeded state from the log — seeding each replica
+        from its local parent copy would diverge, the replicas sit at
+        different apply points. Rows keep their original hybrid times
+        (the bodies are encoded pre-stamped), so MVCC visibility,
+        TTL expiry and tombstone ordering match the parent exactly."""
+        n = 0
+        for i in range(0, len(rows), chunk):
+            batch = rows[i:i + chunk]
+            self.replicate_txn_op("write", _encode_rows(batch), timeout,
+                                  track_mvcc=True)
+            n += len(batch)
+        return n
 
     def compact(self, history_cutoff_ht: int = 0) -> None:
         with self._maintenance_lock:

@@ -120,6 +120,32 @@ class AdminClient:
         if resp.get("code") != "ok":
             raise AdminError(f"delete {table}: {resp.get('code')}")
 
+    def split_tablet(self, table: str, tablet_id: str,
+                     timeout_s: float = 30.0) -> dict:
+        """Manually split one tablet at its median resident key
+        (yb-admin split_tablet): the master drives the whole seal →
+        fork → seed → commit protocol and answers with the child
+        tablet ids."""
+        resp = self.master_rpc("master.split_tablet",
+                               {"table": table, "tablet_id": tablet_id,
+                                "timeout": timeout_s},
+                               timeout_s=timeout_s + 5.0)
+        if resp.get("code") != "ok":
+            raise AdminError(
+                f"split_tablet {tablet_id}: "
+                f"{resp.get('message', resp.get('code'))}")
+        return resp
+
+    def rebalance(self) -> dict:
+        """Run one forced leader-balancing pass on the master
+        (yb-admin's rebalance trigger); returns the move made (if any)
+        plus the per-tserver leader counts."""
+        resp = self.master_rpc("master.rebalance", {})
+        if resp.get("code") != "ok":
+            raise AdminError(
+                f"rebalance: {resp.get('message', resp.get('code'))}")
+        return resp
+
     def locate_tablet(self, tablet_id: str) -> dict:
         resp = self.master_rpc("master.locate_tablet",
                                {"tablet_id": tablet_id})

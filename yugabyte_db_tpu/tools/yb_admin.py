@@ -82,6 +82,29 @@ def cmd_delete_table(admin: AdminClient, args) -> int:
     return 0
 
 
+def cmd_split_tablet(admin: AdminClient, args) -> int:
+    resp = admin.split_tablet(args.table, args.tablet_id,
+                              timeout_s=args.timeout)
+    kids = resp.get("children") or []
+    print(f"split {args.tablet_id} -> {', '.join(kids)}")
+    return 0
+
+
+def cmd_rebalance(admin: AdminClient, args) -> int:
+    resp = admin.rebalance()
+    move = resp.get("move")
+    if move:
+        print(f"moved leader of {move['tablet_id']}: "
+              f"{move['from']} -> {move['to']}")
+    else:
+        print("balanced (no move needed)")
+    counts = resp.get("leader_counts") or {}
+    rows = [[u, n] for u, n in sorted(counts.items())]
+    if rows:
+        print(_fmt_table(rows, ["tserver", "leaders"]))
+    return 0
+
+
 def cmd_create_snapshot(admin: AdminClient, args) -> int:
     n = admin.snapshot_table(args.table, args.snapshot_id,
                              "create_snapshot")
@@ -146,6 +169,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("delete_table")
     p.add_argument("table")
     p.set_defaults(fn=cmd_delete_table)
+
+    p = sub.add_parser("split_tablet")
+    p.add_argument("table")
+    p.add_argument("tablet_id")
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=cmd_split_tablet)
+
+    sub.add_parser("rebalance").set_defaults(fn=cmd_rebalance)
 
     for name, fn in (("create_snapshot", cmd_create_snapshot),
                      ("restore_snapshot", cmd_restore_snapshot),

@@ -228,5 +228,23 @@ class TSTabletManager:
                 # mismatch (a lost push must not disable maintenance).
                 "index_names": sorted(i["name"]
                                       for i in p.tablet.meta.indexes),
+                # Split-manager inputs: on-disk size (WAL segments — a
+                # cheap stat that tracks data written) and the raw data-op
+                # counter; the master differentiates successive heartbeat
+                # samples into the per-tablet op rate.
+                "stats": {
+                    "size_bytes": self._tablet_size_bytes(p),
+                    "ops_seen": p.ops_seen,
+                },
             })
         return out
+
+    @staticmethod
+    def _tablet_size_bytes(p: TabletPeer) -> int:
+        total = 0
+        for path in p.tablet.log.segment_paths():
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass  # segment GC'd between listing and stat
+        return total

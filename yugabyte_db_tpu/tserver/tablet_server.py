@@ -23,6 +23,7 @@ from yugabyte_db_tpu.tserver.tablet_manager import (TabletNotFound,
                                                     TSTabletManager)
 from yugabyte_db_tpu.utils.metrics import count_swallowed
 from yugabyte_db_tpu.utils.retry import Deadline, DeadlineExpired
+from yugabyte_db_tpu.utils.status import TabletSplit
 from yugabyte_db_tpu.utils.trace import TRACE, RpczStore, trace_request
 
 
@@ -210,6 +211,13 @@ class TabletServer:
         with trace_request(method) as t:
             try:
                 return self._dispatch(method, payload)
+            except TabletSplit as e:
+                # The addressed tablet is sealed for (or replaced by) a
+                # split: tell the client to invalidate exactly this
+                # location entry and re-plan (tserver_error.h
+                # TABLET_SPLIT). Raised by the admission seal gate, so
+                # every write path funnels here.
+                return {"code": "tablet_split", "tablet_id": e.tablet_id}
             finally:
                 ent = self._rpc_entity(method)
                 ent.counter("rpc_requests_total").increment()
@@ -247,6 +255,84 @@ class TabletServer:
     def _h_ts_delete_tablet(self, p: dict):
         self.tablet_manager.delete_tablet(p["tablet_id"])
         return {"code": "ok"}
+
+    # -- tablet splitting -----------------------------------------------------
+    def _h_ts_get_split_key(self, p: dict):
+        """Split phase 1: the master asks the parent leader for its
+        split point — the median resident key hash (reference:
+        TabletServiceAdminImpl::GetSplitKey). Refused when the tablet
+        has no interior point (fewer than two distinct hashes, or the
+        median collides with a partition bound)."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if not (peer.raft.is_leader() and peer.raft.leader_ready()):
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        h = peer.split_key_hash()
+        lo = peer.tablet.meta.partition_start
+        hi = peer.tablet.meta.partition_end
+        if h is None or not (lo < h < hi):
+            return {"code": "error",
+                    "message": "tablet has no interior split point"}
+        return {"code": "ok", "split_hash": h}
+
+    def _h_ts_split_seal(self, p: dict):
+        """Split phase 4: stop admitting writes on the parent by
+        replicating a split_seal entry through its own Raft log — every
+        admitted write sits below the seal, so seal-applied implies all
+        prior writes applied on this replica."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        try:
+            peer.split_seal(timeout=float(p.get("timeout", 10.0)))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            return {"code": "timed_out"}
+        return {"code": "ok"}
+
+    def _h_ts_split_fork(self, p: dict):
+        """Split phase 5a: ship the sealed parent's frozen rows clamped
+        to one child's hash range [lower, upper)."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        if not (peer.raft.is_leader() and peer.raft.leader_ready()):
+            return {"code": "not_leader",
+                    "leader_hint": peer.raft.leader_uuid()}
+        try:
+            entries = peer.split_fork_rows(p["lower"], p["upper"])
+        except RuntimeError as e:
+            return {"code": "error", "message": str(e)}
+        return {"code": "ok",
+                "rows": [[key, wire.encode_rows(vers)]
+                         for key, vers in entries]}
+
+    def _h_ts_split_seed(self, p: dict):
+        """Split phase 5b: replicate the forked rows through the CHILD
+        leader's Raft log as ordinary write entries carrying the
+        original row hybrid times — every child replica converges on
+        byte-identical state (per-replica local forking would
+        diverge)."""
+        try:
+            peer = self.tablet_manager.get(p["tablet_id"])
+        except TabletNotFound:
+            return {"code": "not_found"}
+        rows = [v for _key, vers in p["rows"]
+                for v in wire.decode_rows(vers)]
+        try:
+            n = peer.split_seed(rows,
+                                timeout=float(p.get("timeout", 30.0)))
+        except NotLeader as e:
+            return {"code": "not_leader", "leader_hint": e.leader_hint}
+        except TimeoutError:
+            return {"code": "timed_out"}
+        return {"code": "ok", "seeded": n}
 
     # -- remote bootstrap -----------------------------------------------------
     def _request_remote_bootstrap(self, tablet_id: str,
@@ -508,6 +594,7 @@ class TabletServer:
         # ONE deadline for the whole write RPC: admission backpressure,
         # the commit wait, and any retry rounds debit the same budget.
         deadline = Deadline.after(float(p.get("timeout", 10.0)))
+        peer.ops_seen += 1  # split-manager load signal
         if p.get("propagated_ht"):
             from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
 
@@ -645,6 +732,7 @@ class TabletServer:
                 rid is None or p.get("if_not_exists") or \
                 peer.tablet.meta.indexes:
             return self._h_ts_write(p)  # full synchronous write
+        peer.ops_seen += 1  # split-manager load signal
         if p.get("propagated_ht"):
             from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
 
@@ -761,12 +849,19 @@ class TabletServer:
             peer = self.tablet_manager.get(p["tablet_id"])
         except TabletNotFound:
             return None, None, {"code": "not_found"}
+        if peer._split_sealing or peer.tablet.meta.split_sealed:
+            # A sealed parent must not serve reads: once the split
+            # commits, its children take new writes the frozen parent
+            # would silently miss.
+            return None, None, {"code": "tablet_split",
+                                "tablet_id": peer.tablet_id}
         if p.get("propagated_ht"):
             from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
 
             peer.tablet.clock.update(_HT(p["propagated_ht"]))
         if specs is None:
             specs = [wire.decode_spec(p["spec"])]
+        peer.ops_seen += len(specs)  # split-manager load signal
         explicit = [s.read_ht for s in specs if s.read_ht != wire.MAX_HT]
         if explicit:
             timeout = (deadline.timeout() if deadline is not None
@@ -904,6 +999,9 @@ class TabletServer:
             peer = self.tablet_manager.get(p["tablet_id"])
         except TabletNotFound:
             return {"code": "not_found"}
+        if peer._split_sealing or peer.tablet.meta.split_sealed:
+            return {"code": "tablet_split", "tablet_id": peer.tablet_id}
+        peer.ops_seen += len(p["keys"])  # split-manager load signal
         if p.get("propagated_ht"):
             from yugabyte_db_tpu.utils.hybrid_time import HybridTime as _HT
 
@@ -957,6 +1055,9 @@ class TabletServer:
             peer = self.tablet_manager.get(p["tablet_id"])
         except TabletNotFound:
             return {"code": "not_found"}
+        if peer._split_sealing or peer.tablet.meta.split_sealed:
+            # Intent writes bypass write_admit's seal gate — check here.
+            return {"code": "tablet_split", "tablet_id": peer.tablet_id}
         rows = wire.decode_rows(p["rows"])
         for _attempt in range(3):
             try:

@@ -195,3 +195,26 @@ FLAGS.define("fault.raft_apply_stall", 0.0,
              "stay unapplied) — used by the commit_ack_crash fault-sweep "
              "round to widen the commit-ack/apply window deterministically",
              ("unsafe", "runtime", "hidden"))
+FLAGS.define("tablet_split_size_bytes", 0,
+             "size threshold for master-driven tablet splitting: a "
+             "tablet whose reported on-disk size (WAL + flushed runs) "
+             "crosses this many bytes is split at its median resident "
+             "key; 0 disables size-based splitting (reference: "
+             "FLAGS_tablet_split_size_threshold_bytes of "
+             "catalog_manager's tablet-split heuristics)",
+             ("evolving", "runtime"))
+FLAGS.define("tablet_split_ops_per_sec", 0.0,
+             "op-rate threshold for master-driven tablet splitting: a "
+             "tablet whose heartbeat-reported op rate sustains above "
+             "this many ops/s is split at its median resident key; 0 "
+             "disables load-based splitting (reference: the automatic "
+             "tablet-splitting thresholds of the reference's "
+             "TabletSplitManager)",
+             ("evolving", "runtime"))
+FLAGS.define("enable_leader_balancing", False,
+             "run the master's leader load-balancer pass: when the "
+             "spread between the most- and least-leader-loaded live "
+             "tservers reaches 2, step one leader down toward the "
+             "least-loaded tserver (one move per pass; reference: "
+             "the leader-balancing half of cluster_balance.cc)",
+             ("evolving", "runtime"))

@@ -577,3 +577,83 @@ def count_host_verify_rows(n: int) -> None:
         _HOST_VERIFY_ENTITY.counter("yb_scan_host_verify_rows").increment(n)
     except Exception:  # noqa: BLE001 — accounting must not throw
         _SWALLOW_LOG.debug("count_host_verify_rows failed")
+
+
+# -- cluster-elasticity observability -----------------------------------------
+# Splits and leader moves are rare, cluster-shaping events: both get
+# process-wide counters the master bumps as each operation COMMITS (a
+# dispatched-but-failed split does not count), and the traffic-sweep
+# harness asserts its own ledger against them exactly like the fault
+# sweep does against yb_faults_fired.
+_ELASTICITY_ENTITY: MetricEntity | None = None
+_REQ_LATENCY_ENTITIES: dict[str, MetricEntity] = {}
+
+# Request latencies are client-observed seconds: sub-ms point ops up
+# through multi-second split-stall retries must all land in-range.
+REQUEST_LATENCY_S_BUCKETS = tuple(1e-5 * (2 ** i) for i in range(22))
+
+
+def _elasticity_entity() -> MetricEntity:
+    global _ELASTICITY_ENTITY
+    with _SERVE_LOCK:
+        if _ELASTICITY_ENTITY is None:
+            _ELASTICITY_ENTITY = _PROCESS_REGISTRY.entity()
+        return _ELASTICITY_ENTITY
+
+
+def count_tablet_split() -> None:
+    """Bump ``yb_tablet_splits_total``: one committed tablet split
+    (parent swapped for both children in the catalog). Never raises."""
+    try:
+        _elasticity_entity().counter("yb_tablet_splits_total").increment()
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("count_tablet_split failed")
+
+
+def tablet_splits_total() -> int:
+    """Current ``yb_tablet_splits_total`` value (0 if none committed)."""
+    return _elasticity_entity().counter("yb_tablet_splits_total").get()
+
+
+def count_leader_move() -> None:
+    """Bump ``yb_leader_moves_total``: one leader-balancer stepdown
+    actually issued to a tserver. Never raises."""
+    try:
+        _elasticity_entity().counter("yb_leader_moves_total").increment()
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("count_leader_move failed")
+
+
+def leader_moves_total() -> int:
+    """Current ``yb_leader_moves_total`` value (0 if none issued)."""
+    return _elasticity_entity().counter("yb_leader_moves_total").get()
+
+
+def observe_request_latency(proto: str, seconds: float) -> None:
+    """Record one client-observed request latency into the
+    per-protocol histogram ``yb_request_latency_seconds{proto=...}``
+    on the process registry. The traffic sweep feeds this from every
+    op it issues (ycsb_a/ycsb_b/ycsb_e/tpch/redis) and asserts its
+    per-protocol p99 SLOs against the same series a dashboard scrape
+    sees. Never raises."""
+    try:
+        with _SERVE_LOCK:
+            ent = _REQ_LATENCY_ENTITIES.get(proto)
+            if ent is None:
+                ent = _PROCESS_REGISTRY.entity(proto=proto)
+                _REQ_LATENCY_ENTITIES[proto] = ent
+        ent.histogram("yb_request_latency_seconds",
+                      buckets=REQUEST_LATENCY_S_BUCKETS).observe(seconds)
+    except Exception:  # noqa: BLE001 — accounting must not throw
+        _SWALLOW_LOG.debug("observe_request_latency failed for %s", proto)
+
+
+def request_latency_percentile(proto: str, p: float):
+    """Approximate percentile (seconds) of one protocol's
+    ``yb_request_latency_seconds`` series; 0 when nothing observed."""
+    with _SERVE_LOCK:
+        ent = _REQ_LATENCY_ENTITIES.get(proto)
+    if ent is None:
+        return 0
+    return ent.histogram("yb_request_latency_seconds",
+                         buckets=REQUEST_LATENCY_S_BUCKETS).percentile(p)

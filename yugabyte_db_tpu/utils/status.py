@@ -94,6 +94,21 @@ class IllegalState(StatusError):
         super().__init__(Status(Code.ILLEGAL_STATE, message))
 
 
+class TabletSplit(StatusError):
+    """The addressed tablet has been sealed for (or replaced by) a
+    split: the caller's location entry is stale at TABLET granularity.
+    Carries the split tablet's id so the client can invalidate exactly
+    that entry and re-plan from fresh locations (reference: the
+    TABLET_SPLIT error of tserver_error.h driving per-tablet meta-cache
+    invalidation in client-side LookupRpc retries)."""
+
+    def __init__(self, tablet_id: str):
+        super().__init__(Status(Code.ILLEGAL_STATE,
+                                f"tablet {tablet_id} has been split",
+                                {"tablet_id": tablet_id}))
+        self.tablet_id = tablet_id
+
+
 OK = Status()
 
 
